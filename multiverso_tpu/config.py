@@ -1,0 +1,154 @@
+"""Configuration / flag system.
+
+TPU-native re-design of the reference's GFlags-like macro system
+(reference: include/multiverso/util/configure.h, src/util/configure.cpp —
+``MV_DEFINE_bool/int/string/double`` + ``ParseCMDFlags``; see SURVEY.md §2.20).
+
+Flags keep the reference's names (``sync``, ``updater_type``, ``machine_file``,
+``port``, ``backup_worker_ratio``) so launch scripts port unchanged, and the
+same ``-name=value`` argv syntax is accepted (plus ``--name=value``).
+
+Instead of C macros registering globals, flags live in a single registry that
+both the Python runtime and the native C layer read.  ``machine_file`` and
+``backup_worker_ratio`` are accepted for CLI compatibility but are
+no-ops under single-controller SPMD (documented in SURVEY.md §2.9-bis).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "define_bool",
+    "define_int",
+    "define_double",
+    "define_string",
+    "get",
+    "set_flag",
+    "parse_cmd_flags",
+    "reset",
+    "all_flags",
+]
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    help: str
+    value: Any = None
+
+    def __post_init__(self) -> None:
+        self.value = self.default
+
+
+_LOCK = threading.RLock()
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _define(name: str, default: Any, parser: Callable[[str], Any], help: str) -> None:
+    with _LOCK:
+        if name in _REGISTRY:
+            # Re-definition keeps the first registration (matches the
+            # reference's CHECK on duplicate flags but tolerates re-import).
+            return
+        _REGISTRY[name] = _Flag(name, default, parser, help)
+
+
+def define_bool(name: str, default: bool, help: str = "") -> None:
+    _define(name, default, _parse_bool, help)
+
+
+def define_int(name: str, default: int, help: str = "") -> None:
+    _define(name, default, int, help)
+
+
+def define_double(name: str, default: float, help: str = "") -> None:
+    _define(name, default, float, help)
+
+
+def define_string(name: str, default: str, help: str = "") -> None:
+    _define(name, default, str, help)
+
+
+def get(name: str) -> Any:
+    with _LOCK:
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown flag: {name}")
+        return _REGISTRY[name].value
+
+
+def set_flag(name: str, value: Any) -> None:
+    with _LOCK:
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown flag: {name}")
+        flag = _REGISTRY[name]
+        if isinstance(value, str):
+            flag.value = flag.parser(value)
+        else:
+            flag.value = value
+
+
+def parse_cmd_flags(argv: Optional[List[str]] = None) -> List[str]:
+    """Parse ``-name=value`` / ``--name=value`` args; return the leftovers.
+
+    Unknown flags are left in the returned remainder rather than raising,
+    mirroring the reference parser which skips unknown argv entries.
+    """
+    if argv is None:
+        argv = []
+    rest: List[str] = []
+    for arg in argv:
+        body = None
+        if arg.startswith("--"):
+            body = arg[2:]
+        elif arg.startswith("-"):
+            body = arg[1:]
+        if body and "=" in body:
+            name, _, val = body.partition("=")
+            with _LOCK:
+                if name in _REGISTRY:
+                    flag = _REGISTRY[name]
+                    flag.value = flag.parser(val)
+                    continue
+        rest.append(arg)
+    return rest
+
+
+def reset() -> None:
+    """Reset every flag to its default (test isolation helper)."""
+    with _LOCK:
+        for flag in _REGISTRY.values():
+            flag.value = flag.default
+
+
+def all_flags() -> Dict[str, Any]:
+    with _LOCK:
+        return {name: f.value for name, f in _REGISTRY.items()}
+
+
+# ---------------------------------------------------------------------------
+# Core flags — names match the reference CLI (SURVEY.md §2.20).
+# ---------------------------------------------------------------------------
+
+define_bool("sync", False, "BSP (True) vs ASP (False) training semantics")
+define_string("updater_type", "default",
+              "server-side updater: default|sgd|adagrad|momentum|smooth_gradient")
+define_string("machine_file", "", "accepted for CLI parity; unused on TPU mesh")
+define_int("port", 55555, "accepted for CLI parity; unused on TPU mesh")
+define_double("backup_worker_ratio", 0.0,
+              "straggler slack; N/A under SPMD lockstep, kept for parity")
+define_string("log_level", os.environ.get("MVTPU_LOG_LEVEL", "info"),
+              "debug|info|error|fatal")
+define_string("log_file", "", "optional log file sink")
+define_string("checkpoint_dir", "", "directory for table checkpoints")
+define_int("checkpoint_interval", 0,
+           "clocks between automatic checkpoints (0 = disabled)")

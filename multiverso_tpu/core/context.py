@@ -1,0 +1,257 @@
+"""Core runtime context — the TPU-native successor of the reference ``Zoo``.
+
+Reference semantics (SURVEY.md §2.2, §3.1): ``Zoo::Start`` parses flags,
+initializes the transport (MPI/ZMQ), spawns the Communicator / Worker /
+Server / Controller actor threads, registers every node with rank 0, and
+barriers.  ``Zoo::Stop`` barriers, joins actors, dumps the Dashboard, and
+finalizes the transport.
+
+TPU-native redesign: there are no server processes and no point-to-point
+transport.  Model state lives in sharded ``jax.Array``s over a
+``jax.sharding.Mesh``; the push-pull message path compiles to XLA
+collectives over ICI.  What remains on the host is the control plane:
+
+- ``init()``      → flag parsing, optional ``jax.distributed.initialize``
+                    (DCN, multi-host), mesh construction, table registry.
+- ``barrier()``   → ``multihost_utils.sync_global_devices`` across hosts
+                    (the Controller's Control_Barrier round-trip) + the BSP
+                    clock tick that sync-mode tables key on.
+- ``shutdown()``  → final barrier, Dashboard dump, registry teardown.
+
+Identity mapping (kept name-compatible with the reference C API):
+
+- a reference *worker process*  ↔ a controller **host process**
+  (``worker_id() == jax.process_index()``): the unit that loads a data shard.
+- a reference *server process*  ↔ the same host (every device holds table
+  shards), so ``server_id() == worker_id()`` under Role.ALL, matching the
+  reference's default role assignment.
+- device-level data parallelism (the mesh's worker axis) is *inside* the
+  compiled step; its width is exposed as ``num_replicas()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .. import config, dashboard
+from ..log import Log
+
+__all__ = [
+    "Role", "Context", "init", "shutdown", "initialized", "barrier",
+    "get_context", "worker_id", "workers_num", "server_id", "servers_num",
+    "is_master_worker", "num_replicas", "clock",
+]
+
+
+class Role:
+    """Role bitmask — parity with reference ``node.h`` (SURVEY.md §2.5)."""
+
+    NONE = 0
+    WORKER = 1
+    SERVER = 2
+    ALL = 3
+
+
+@dataclass
+class Node:
+    """Per-process node info (reference ``Node``; SURVEY.md §2.5)."""
+
+    rank: int
+    size: int
+    role: int = Role.ALL
+
+    @property
+    def is_worker(self) -> bool:
+        return bool(self.role & Role.WORKER)
+
+    @property
+    def is_server(self) -> bool:
+        return bool(self.role & Role.SERVER)
+
+
+class Context:
+    """Singleton runtime registry (reference ``Zoo``; SURVEY.md §2.2)."""
+
+    def __init__(self, mesh: jax.sharding.Mesh, node: Node, sync: bool,
+                 updater_type: str):
+        self.mesh = mesh
+        self.node = node
+        self.sync = sync
+        self.updater_type = updater_type
+        self.clock = 0
+        self._tables: Dict[int, Any] = {}
+        self._next_table_id = 0
+        self._lock = threading.Lock()
+
+    # -- table registry (Zoo::RegisterTable) --------------------------------
+    def register_table(self, table: Any) -> int:
+        with self._lock:
+            tid = self._next_table_id
+            self._next_table_id += 1
+            self._tables[tid] = table
+            return tid
+
+    def table(self, table_id: int) -> Any:
+        return self._tables[table_id]
+
+    def tables(self) -> List[Any]:
+        return list(self._tables.values())
+
+    # -- barrier / clock ----------------------------------------------------
+    def barrier(self, name: Optional[str] = None) -> None:
+        with dashboard.monitor("Zoo::Barrier"):
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(
+                    name or f"mvtpu_barrier_{self.clock}")
+            self.clock += 1
+            for t in self.tables():
+                flush = getattr(t, "flush", None)
+                if flush is not None:
+                    flush()
+
+
+_LOCK = threading.Lock()
+_CONTEXT: Optional[Context] = None
+
+
+def _default_mesh(axis_name: str = "worker") -> jax.sharding.Mesh:
+    devices = np.asarray(jax.devices())
+    return jax.sharding.Mesh(devices, (axis_name,))
+
+
+def init(args: Optional[List[str]] = None,
+         sync: Optional[bool] = None,
+         updater_type: Optional[str] = None,
+         mesh: Optional[jax.sharding.Mesh] = None,
+         role: int = Role.ALL,
+         distributed: bool = False,
+         **distributed_kwargs) -> Context:
+    """Start the runtime (reference ``MV_Init`` → ``Zoo::Start``; §3.1).
+
+    ``args`` takes reference-style ``-flag=value`` argv.  Keyword arguments
+    override parsed flags.  ``distributed=True`` calls
+    ``jax.distributed.initialize`` for multi-host (DCN) jobs before building
+    the mesh — the analog of the transport Init + rank-0 registration.
+    """
+    global _CONTEXT
+    with _LOCK:
+        if _CONTEXT is not None:
+            Log.info("multiverso_tpu.init: already initialized; reusing context")
+            return _CONTEXT
+
+        # CLI args mutate the process-global flag registry (reference
+        # semantics); keyword overrides are per-lifecycle only, so a
+        # sync=True passed to one init() cannot leak into the next.
+        config.parse_cmd_flags(args)
+        sync_val = bool(config.get("sync")) if sync is None else bool(sync)
+        updater_val = (str(config.get("updater_type"))
+                       if updater_type is None else str(updater_type))
+
+        from ..log import configure as log_configure
+
+        log_configure(config.get("log_level"), config.get("log_file"))
+
+        if distributed:
+            # Multi-host bring-up (DCN): the reference's NetInterface::Init +
+            # Control_Register handshake collapses into this one call. Must
+            # run before anything touches the backend (so no process_count()
+            # guard here); tolerate an environment that already initialized.
+            try:
+                jax.distributed.initialize(**distributed_kwargs)
+            except RuntimeError as e:
+                Log.info("jax.distributed.initialize skipped: %s", e)
+
+        if mesh is None:
+            mesh = _default_mesh()
+
+        node = Node(rank=jax.process_index(), size=jax.process_count(),
+                    role=role)
+        _CONTEXT = Context(mesh=mesh, node=node,
+                           sync=sync_val,
+                           updater_type=updater_val)
+        Log.info(
+            "multiverso_tpu initialized: %d process(es), %d device(s), "
+            "mesh axes %s, sync=%s, updater=%s",
+            node.size, len(jax.devices()), dict(mesh.shape),
+            _CONTEXT.sync, _CONTEXT.updater_type,
+        )
+        _CONTEXT.barrier("mvtpu_init")
+        return _CONTEXT
+
+
+def shutdown(finalize: bool = True) -> None:
+    """Stop the runtime (reference ``MV_ShutDown`` → ``Zoo::Stop``; §3.5)."""
+    global _CONTEXT
+    with _LOCK:
+        if _CONTEXT is None:
+            return
+        _CONTEXT.barrier("mvtpu_shutdown")
+        dashboard.report(log=True)
+        if finalize:
+            dashboard.reset()
+        _CONTEXT = None
+
+
+def initialized() -> bool:
+    return _CONTEXT is not None
+
+
+def get_context() -> Context:
+    if _CONTEXT is None:
+        raise RuntimeError(
+            "multiverso_tpu is not initialized; call multiverso_tpu.init()")
+    return _CONTEXT
+
+
+def barrier() -> None:
+    get_context().barrier()
+
+
+def clock() -> int:
+    return get_context().clock
+
+
+def worker_id() -> int:
+    """Rank of this host's worker role (reference ``MV_WorkerId``)."""
+    return get_context().node.rank
+
+
+def workers_num() -> int:
+    """Number of worker hosts (reference ``MV_NumWorkers``)."""
+    return get_context().node.size
+
+
+def server_id() -> int:
+    """Under Role.ALL every host co-hosts server shards (``MV_ServerId``)."""
+    node = get_context().node
+    return node.rank if node.is_server else -1
+
+
+def servers_num() -> int:
+    return get_context().node.size
+
+
+def is_master_worker() -> bool:
+    return worker_id() == 0
+
+
+def num_replicas() -> int:
+    """Device-level data-parallel width inside the compiled step.
+
+    The size of the mesh's data-parallel axis (named ``worker``, ``dp`` or
+    ``data``); for a mesh with no such axis, the full device count (a pure
+    model-parallel mesh has one replica per full model, but tables still
+    shard over every device).
+    """
+    ctx = get_context()
+    for axis in ("worker", "dp", "data"):
+        if axis in ctx.mesh.shape:
+            return int(ctx.mesh.shape[axis])
+    return int(np.prod(list(ctx.mesh.shape.values())))

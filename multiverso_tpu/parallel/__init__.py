@@ -1,0 +1,15 @@
+from .sharding import (
+    make_mesh,
+    table_mesh,
+    replicated,
+    shard_along,
+    host_to_global,
+)
+
+__all__ = [
+    "make_mesh",
+    "table_mesh",
+    "replicated",
+    "shard_along",
+    "host_to_global",
+]

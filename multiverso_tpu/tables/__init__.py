@@ -1,0 +1,15 @@
+from .base import Table
+from .array_table import ArrayTable
+from .matrix_table import MatrixTable
+from .sparse_matrix_table import SparseMatrixTable
+from .kv_table import KVTable
+from .factory import create_table
+
+__all__ = [
+    "Table",
+    "ArrayTable",
+    "MatrixTable",
+    "SparseMatrixTable",
+    "KVTable",
+    "create_table",
+]

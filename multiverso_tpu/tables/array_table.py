@@ -1,0 +1,147 @@
+"""ArrayTable — dense 1-D parameter vector.
+
+Reference (SURVEY.md §2.11, ``table/array_table.h``): contiguous float/int
+vector evenly sharded over server processes; workers ``Get`` the whole array
+and ``Add`` whole-array deltas; the server applies the Updater per shard.
+
+TPU-native: the vector is ONE ``jax.Array`` sharded over the table mesh
+(each device holds the contiguous chunk a reference server would).  ``Get``
+is a device→host gather; ``Add`` is a jitted donate-in-place updater call —
+on a multi-device mesh XLA lays the delta scatter + update on each shard's
+home device, which is exactly the reference's server-side `ProcessAdd` with
+the network replaced by ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.sharding import shard_along, table_mesh
+from ..updaters import AddOption
+from .base import Table
+
+__all__ = ["ArrayTable"]
+
+
+class ArrayTable(Table):
+    kind = "array"
+
+    def __init__(self, size: int, dtype: Any = jnp.float32,
+                 init: Optional[np.ndarray] = None, **kw):
+        super().__init__(**kw)
+        self.size = int(size)
+        self.dtype = jnp.dtype(dtype)
+        self._mesh = table_mesh(self._ctx.mesh)
+        n = self._mesh.devices.size
+        self._padded = ((self.size + n - 1) // n) * n
+        self._sharding = shard_along(self._mesh, ndim=1, dim=0)
+
+        host = np.zeros(self._padded, dtype=self.dtype)
+        if init is not None:
+            host[: self.size] = np.asarray(init, dtype=self.dtype)
+        self._data = jax.device_put(host, self._sharding)
+        self._state = tuple(
+            jax.device_put(np.zeros(self._padded, dtype=self.dtype),
+                           self._sharding)
+            for _ in range(self.updater.num_slots))
+        # BSP clock buffers, bucketed per AddOption so a flush applies each
+        # option's aggregate with the right hyper-parameters.
+        self._pending: Dict[Optional[AddOption], np.ndarray] = {}
+        self._apply_cache: Dict[AddOption, Any] = {}
+
+    # ------------------------------------------------------------------ Get
+    def get(self, option=None) -> np.ndarray:
+        """Pull the whole array (reference ``ArrayWorker<T>::Get``; §3.2)."""
+        with self._monitor("Get"):
+            return np.asarray(jax.device_get(self._data))[: self.size]
+
+    # ------------------------------------------------------------------ Add
+    def add(self, delta, option: Optional[AddOption] = None,
+            sync: bool = False) -> None:
+        """Push a delta/gradient (reference ``ArrayWorker<T>::Add``; §3.3).
+
+        ``delta`` is [size] or [k, size] (stacked per-worker contributions,
+        summed before the updater — the server receiving k Adds).  ``sync``
+        blocks until the device commit completes (the reference's blocking
+        Add vs AddAsync).
+        """
+        with self._monitor("Add"):
+            delta = np.asarray(delta, dtype=self.dtype)
+            if delta.ndim == 2:
+                delta = delta.sum(axis=0)
+            if delta.shape != (self.size,):
+                raise ValueError(
+                    f"delta shape {delta.shape} != ({self.size},)")
+            if self.sync:
+                # BSP: buffer until the clock boundary (barrier → flush).
+                with self._lock:
+                    if option in self._pending:
+                        self._pending[option] += delta
+                    else:
+                        self._pending[option] = delta.astype(
+                            self.dtype, copy=True)
+                return
+            self._apply_now(delta, option)
+            if sync:
+                jax.block_until_ready(self._data)
+
+    def flush(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        for option, delta in pending.items():
+            self._apply_now(delta, option)
+
+    def _apply_now(self, delta: np.ndarray, option: Optional[AddOption]) -> None:
+        opt = option or self.default_option
+        fn = self._apply_cache.get(opt)
+        if fn is None:
+            updater = self.updater
+
+            def _apply(data, state, d):
+                return updater.apply_dense(data, state, d, opt)
+
+            fn = jax.jit(_apply, donate_argnums=(0, 1))
+            self._apply_cache[opt] = fn
+        padded = np.zeros(self._padded, dtype=self.dtype)
+        padded[: self.size] = delta
+        d = jax.device_put(padded, self._sharding)
+        # Lock: the jit donates self._data/_state, so concurrent eager adds
+        # must serialize or thread B reads a deleted buffer.
+        with self._lock:
+            self._data, self._state = fn(self._data, self._state, d)
+
+    # ------------------------------------------------- fused (in-jit) path
+    def raw_value(self) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+        """Hand the sharded arrays to a jitted step (TPU-native hot loop)."""
+        return self._data, self._state
+
+    def raw_assign(self, data: jax.Array,
+                   state: Optional[Tuple[jax.Array, ...]] = None) -> None:
+        self._data = data
+        if state is not None:
+            self._state = state
+
+    @property
+    def sharding(self):
+        return self._sharding
+
+    # ------------------------------------------------------------ checkpoint
+    def store_state(self) -> Any:
+        return {
+            "kind": self.kind,
+            "size": self.size,
+            "data": np.asarray(jax.device_get(self._data)),
+            "state": [np.asarray(jax.device_get(s)) for s in self._state],
+        }
+
+    def load_state(self, snap: Any) -> None:
+        assert snap["kind"] == self.kind and snap["size"] == self.size
+        self._data = jax.device_put(
+            snap["data"].astype(self.dtype), self._sharding)
+        self._state = tuple(
+            jax.device_put(s.astype(self.dtype), self._sharding)
+            for s in snap["state"])

@@ -1,0 +1,80 @@
+"""Table base class.
+
+Reference (SURVEY.md §2.10, ``table_interface.h``): a table is a
+worker-side stub (``WorkerTable::{Get,Add,Partition,Wait,Notify}``) plus
+server-side shards (``ServerTable::{ProcessGet,ProcessAdd,Store,Load}``)
+connected by request/reply messages.
+
+TPU-native redesign: **the worker/server split disappears into sharded
+device memory.** A table owns
+
+- ``_data``  — a ``jax.Array`` sharded over the 1-D table mesh (the "server
+  shards"),
+- ``_state`` — the updater's state arrays, sharded identically (per-row
+  optimizer state lives with its rows),
+
+and two execution paths:
+
+- the *eager parity path* — ``get()``/``add()`` with host arrays, matching
+  the reference C-API semantics (used by the bindings and the ported apps);
+- the *fused path* — ``raw_value()``/``raw_assign()`` handing the sharded
+  arrays to a jitted training step so Get/Add/update fuse into one XLA
+  program (the TPU-native hot loop).
+
+Sync (BSP) vs async (ASP) semantic mapping (SURVEY.md §7 hard-parts):
+``sync=False`` (ASP default) applies every ``add`` immediately — workers see
+each other's updates as soon as XLA commits them.  ``sync=True`` (BSP)
+buffers adds for the current clock; ``flush()`` — triggered by
+``barrier()``, i.e. the clock boundary — aggregates and applies them in one
+updater call, exactly the reference sync-server behavior of holding replies
+until all adds for clock *t* arrive.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from .. import config, dashboard
+from ..core import context as core_context
+from ..updaters import AddOption, get_updater
+
+__all__ = ["Table"]
+
+
+class Table:
+    """Common lifecycle: registration, updater selection, BSP buffering."""
+
+    kind = "table"
+
+    def __init__(self, name: Optional[str] = None,
+                 updater_type: Optional[str] = None,
+                 sync: Optional[bool] = None,
+                 default_option: Optional[AddOption] = None):
+        ctx = core_context.get_context()
+        self._ctx = ctx
+        if updater_type is None:
+            updater_type = ctx.updater_type
+        self.updater = get_updater(updater_type)
+        self.updater_type = updater_type
+        self.sync = ctx.sync if sync is None else bool(sync)
+        self.default_option = default_option or AddOption()
+        self.table_id = ctx.register_table(self)
+        self.name = name or f"{self.kind}_{self.table_id}"
+        self._lock = threading.Lock()
+
+    # -- BSP clock boundary --------------------------------------------------
+    def flush(self) -> None:
+        """Apply buffered (sync-mode) adds; called by ``barrier()``."""
+        raise NotImplementedError
+
+    # -- checkpoint hooks (ServerTable::Store/Load parity) -------------------
+    def store_state(self) -> Any:
+        """Pytree of everything needed to restore the table."""
+        raise NotImplementedError
+
+    def load_state(self, state: Any) -> None:
+        raise NotImplementedError
+
+    def _monitor(self, op: str):
+        return dashboard.monitor(f"{type(self).__name__}::{op}")

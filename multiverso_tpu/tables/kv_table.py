@@ -1,0 +1,137 @@
+"""KVTable — key→value table with a worker-local cache.
+
+Reference (SURVEY.md §2.14, ``table/kv_table.h``): hash-map table; the
+worker keeps a local dict (``KVWorkerTable::raw``), ``Get(keys)`` refreshes
+it from the server, ``Add`` pushes deltas.
+
+TPU-native: KV data is control-plane metadata (vocabulary counts, clocks,
+small stats) — it stays on the host.  Values are numpy arrays; updater math
+runs vectorized per key in numpy (the server-side hot loop is trivial at
+this scale).  Multi-host consistency rides the barrier like every table.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..updaters import AddOption
+from .base import Table
+
+__all__ = ["KVTable"]
+
+
+def _np_apply(name: str, w: np.ndarray, state: List[np.ndarray],
+              d: np.ndarray, opt: AddOption) -> np.ndarray:
+    """Numpy mirror of the jnp updaters (same math, host execution)."""
+    if name in ("default", "add"):
+        w += d
+    elif name == "sgd":
+        w -= opt.learning_rate * d
+    elif name == "adagrad":
+        state[0] += d * d
+        w -= opt.learning_rate * d / (np.sqrt(state[0]) + opt.eps)
+    elif name == "momentum":
+        state[0][...] = opt.momentum * state[0] + opt.learning_rate * d
+        w -= state[0]
+    elif name == "smooth_gradient":
+        state[0][...] = opt.rho * state[0] + (1.0 - opt.rho) * d
+        w -= opt.learning_rate * state[0]
+    else:
+        raise ValueError(f"unknown updater {name}")
+    return w
+
+
+class KVTable(Table):
+    kind = "kv"
+
+    def __init__(self, value_shape: Tuple[int, ...] = (), dtype=np.float32,
+                 **kw):
+        super().__init__(**kw)
+        self.value_shape = tuple(value_shape)
+        self.dtype = np.dtype(dtype)
+        self._store: Dict[Any, np.ndarray] = {}
+        self._state: Dict[Any, List[np.ndarray]] = {}
+        self._cache: Dict[Any, np.ndarray] = {}
+        self._pending: List[Tuple[Dict[Any, np.ndarray],
+                                  Optional[AddOption]]] = []
+
+    @property
+    def raw(self) -> Dict[Any, np.ndarray]:
+        """Worker-local cache (reference ``KVWorkerTable::raw``)."""
+        return self._cache
+
+    def _zero(self) -> np.ndarray:
+        return np.zeros(self.value_shape, dtype=self.dtype)
+
+    def get(self, keys) -> Dict[Any, np.ndarray]:
+        """Refresh the local cache for ``keys`` from the store."""
+        with self._monitor("Get"):
+            with self._lock:
+                for k in keys:
+                    self._cache[k] = self._store.get(k, self._zero()).copy()
+            return {k: self._cache[k] for k in keys}
+
+    def add(self, updates: Dict[Any, Any],
+            option: Optional[AddOption] = None, sync: bool = False) -> None:
+        with self._monitor("Add"):
+            ups = {k: np.asarray(v, dtype=self.dtype)
+                   for k, v in updates.items()}
+            if self.sync:
+                with self._lock:
+                    self._pending.append((ups, option))
+                return
+            self._apply_now(ups, option)
+
+    def flush(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        # Aggregate per AddOption so each bucket flushes with its own
+        # hyper-parameters.
+        merged: Dict[Optional[AddOption], Dict[Any, np.ndarray]] = {}
+        for ups, option in pending:
+            bucket = merged.setdefault(option, {})
+            for k, v in ups.items():
+                if k in bucket:
+                    bucket[k] = bucket[k] + v
+                else:
+                    bucket[k] = v.copy()
+        for option, ups in merged.items():
+            self._apply_now(ups, option)
+
+    def _apply_now(self, ups: Dict[Any, np.ndarray],
+                   option: Optional[AddOption]) -> None:
+        opt = option or self.default_option
+        with self._lock:
+            for k, d in ups.items():
+                w = self._store.get(k)
+                if w is None:
+                    w = self._zero()
+                st = self._state.get(k)
+                if st is None:
+                    st = [np.zeros_like(w)
+                          for _ in range(self.updater.num_slots)]
+                    self._state[k] = st
+                self._store[k] = _np_apply(
+                    self.updater_type, w.copy(), st, d, opt)
+
+    # ------------------------------------------------------------ checkpoint
+    def store_state(self) -> Any:
+        with self._lock:
+            return {
+                "kind": self.kind,
+                "store": {k: v.copy() for k, v in self._store.items()},
+                "state": {k: [s.copy() for s in v]
+                          for k, v in self._state.items()},
+            }
+
+    def load_state(self, snap: Any) -> None:
+        assert snap["kind"] == self.kind
+        with self._lock:
+            self._store = {k: np.asarray(v) for k, v in snap["store"].items()}
+            self._state = {k: [np.asarray(s) for s in v]
+                           for k, v in snap["state"].items()}
+            self._cache.clear()

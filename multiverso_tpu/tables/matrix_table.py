@@ -1,0 +1,229 @@
+"""MatrixTable — 2-D row-sharded parameter matrix.
+
+Reference (SURVEY.md §2.12, ``table/matrix_table.h``): row-partitioned over
+server processes; workers Get/Add the whole matrix or a set of row ids — the
+sparse-access workhorse behind word2vec and LightLDA.
+
+TPU-native: one ``jax.Array`` [rows, cols] sharded on dim 0 over the table
+mesh.  ``get_rows`` compiles to a gather (XLA inserts the all-to-all /
+collective-permute needed to fetch off-shard rows over ICI); ``add_rows``
+compiles to scatter-apply with the updater fused in.  Row batches are
+padded to power-of-two buckets so shapes stay static for the compiler
+(SURVEY.md §7 hard-parts: "sparse tables on TPU ... padding/bucketing").
+Duplicate rows in a batch are pre-aggregated host-side (segment-sum) so
+stateful updaters see one delta per row.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.sharding import shard_along, table_mesh
+from ..updaters import AddOption
+from .base import Table
+
+__all__ = ["MatrixTable"]
+
+
+def _bucket(k: int, floor: int = 8) -> int:
+    b = floor
+    while b < k:
+        b *= 2
+    return b
+
+
+class MatrixTable(Table):
+    kind = "matrix"
+
+    def __init__(self, num_rows: int, num_cols: int, dtype: Any = jnp.float32,
+                 init: Optional[np.ndarray] = None, **kw):
+        super().__init__(**kw)
+        self.num_rows = int(num_rows)
+        self.num_cols = int(num_cols)
+        self.dtype = jnp.dtype(dtype)
+        self._mesh = table_mesh(self._ctx.mesh)
+        n = self._mesh.devices.size
+        self._padded_rows = ((self.num_rows + n - 1) // n) * n
+        self._sharding = shard_along(self._mesh, ndim=2, dim=0)
+
+        host = np.zeros((self._padded_rows, self.num_cols), dtype=self.dtype)
+        if init is not None:
+            host[: self.num_rows] = np.asarray(init, dtype=self.dtype)
+        self._data = jax.device_put(host, self._sharding)
+        self._state = tuple(
+            jax.device_put(
+                np.zeros((self._padded_rows, self.num_cols), dtype=self.dtype),
+                self._sharding)
+            for _ in range(self.updater.num_slots))
+        # BSP buffers, bucketed per AddOption so a flush applies each
+        # option's aggregate with the right hyper-parameters.
+        self._pending_dense: Dict[Optional[AddOption], np.ndarray] = {}
+        self._pending_sparse: List[
+            Tuple[np.ndarray, np.ndarray, Optional[AddOption]]] = []
+        self._dense_cache: Dict[AddOption, Any] = {}
+        self._rows_cache: Dict[AddOption, Any] = {}
+        # jax.jit caches per input shape internally; one gather fn suffices.
+        self._gather_fn = jax.jit(lambda data, r: data[r])
+
+    # ------------------------------------------------------------------ Get
+    def get(self, option=None) -> np.ndarray:
+        """Whole-matrix pull (reference ``MatrixWorkerTable::Get`` all-rows)."""
+        with self._monitor("Get"):
+            return np.asarray(jax.device_get(self._data))[: self.num_rows]
+
+    def get_rows(self, row_ids, option=None) -> np.ndarray:
+        """Row-subset pull — the sparse hot read path.
+
+        Reference: ``MatrixWorkerTable::Get(row_ids)`` partitions ids across
+        servers; here it is one compiled gather over the sharded array.
+        """
+        with self._monitor("GetRows"):
+            rows = np.asarray(row_ids, dtype=np.int32)
+            k = rows.shape[0]
+            if k == 0:
+                return np.zeros((0, self.num_cols), dtype=self.dtype)
+            b = _bucket(k)
+            padded = np.zeros(b, dtype=np.int32)
+            padded[:k] = rows
+            out = self._gather_fn(self._data, jnp.asarray(padded))
+            return np.asarray(jax.device_get(out))[:k]
+
+    # ------------------------------------------------------------------ Add
+    def add(self, delta, option: Optional[AddOption] = None,
+            sync: bool = False) -> None:
+        """Whole-matrix add (reference ``Add`` all-rows path)."""
+        with self._monitor("Add"):
+            delta = np.asarray(delta, dtype=self.dtype)
+            if delta.shape != (self.num_rows, self.num_cols):
+                raise ValueError(
+                    f"delta shape {delta.shape} != "
+                    f"({self.num_rows}, {self.num_cols})")
+            if self.sync:
+                with self._lock:
+                    if option in self._pending_dense:
+                        self._pending_dense[option] += delta
+                    else:
+                        self._pending_dense[option] = delta.astype(
+                            self.dtype, copy=True)
+                return
+            self._apply_dense_now(delta, option)
+            if sync:
+                jax.block_until_ready(self._data)
+
+    def add_rows(self, row_ids, delta, option: Optional[AddOption] = None,
+                 sync: bool = False) -> None:
+        """Row-subset push — the sparse hot write path (§3.3 with rows)."""
+        with self._monitor("AddRows"):
+            rows = np.asarray(row_ids, dtype=np.int64)
+            delta = np.asarray(delta, dtype=self.dtype)
+            if delta.shape != (rows.shape[0], self.num_cols):
+                raise ValueError("rows/delta shape mismatch")
+            if self.sync:
+                with self._lock:
+                    self._pending_sparse.append((rows, delta, option))
+                return
+            self._apply_rows_now(rows, delta, option)
+            if sync:
+                jax.block_until_ready(self._data)
+
+    def flush(self) -> None:
+        with self._lock:
+            dense, self._pending_dense = self._pending_dense, {}
+            sparse, self._pending_sparse = self._pending_sparse, []
+        by_opt: Dict[Optional[AddOption],
+                     List[Tuple[np.ndarray, np.ndarray]]] = {}
+        for rows, deltas, option in sparse:
+            by_opt.setdefault(option, []).append((rows, deltas))
+        for option, batches in by_opt.items():
+            rows = np.concatenate([r for r, _ in batches])
+            deltas = np.concatenate([d for _, d in batches])
+            self._apply_rows_now(rows, deltas, option)
+        for option, delta in dense.items():
+            self._apply_dense_now(delta, option)
+
+    # ----------------------------------------------------------- internals
+    def _apply_dense_now(self, delta: np.ndarray,
+                         option: Optional[AddOption]) -> None:
+        opt = option or self.default_option
+        fn = self._dense_cache.get(opt)
+        if fn is None:
+            updater = self.updater
+
+            def _apply(data, state, d):
+                return updater.apply_dense(data, state, d, opt)
+
+            fn = jax.jit(_apply, donate_argnums=(0, 1))
+            self._dense_cache[opt] = fn
+        padded = np.zeros((self._padded_rows, self.num_cols), dtype=self.dtype)
+        padded[: self.num_rows] = delta
+        d = jax.device_put(padded, self._sharding)
+        # Lock: the jit donates self._data/_state (see ArrayTable._apply_now).
+        with self._lock:
+            self._data, self._state = fn(self._data, self._state, d)
+
+    def _apply_rows_now(self, rows: np.ndarray, delta: np.ndarray,
+                        option: Optional[AddOption]) -> None:
+        opt = option or self.default_option
+        # Pre-aggregate duplicates (segment-sum) so stateful updaters see a
+        # single delta per row; reference servers get the same effect from
+        # sequential Add application.
+        uniq, inv = np.unique(rows, return_inverse=True)
+        agg = np.zeros((uniq.shape[0], self.num_cols), dtype=self.dtype)
+        np.add.at(agg, inv, delta)
+
+        k = uniq.shape[0]
+        b = _bucket(k)
+        fn = self._rows_cache.get(opt)
+        if fn is None:
+            updater = self.updater
+
+            def _apply(data, state, r, d):
+                return updater.apply_rows(data, state, r, d, opt)
+
+            fn = jax.jit(_apply, donate_argnums=(0, 1))
+            self._rows_cache[opt] = fn
+        # Padding entries point past the padded row count → scatter drops.
+        prows = np.full(b, self._padded_rows, dtype=np.int32)
+        prows[:k] = uniq
+        pdelta = np.zeros((b, self.num_cols), dtype=self.dtype)
+        pdelta[:k] = agg
+        with self._lock:
+            self._data, self._state = fn(
+                self._data, self._state, jnp.asarray(prows),
+                jnp.asarray(pdelta))
+
+    # ------------------------------------------------- fused (in-jit) path
+    def raw_value(self) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+        return self._data, self._state
+
+    def raw_assign(self, data: jax.Array,
+                   state: Optional[Tuple[jax.Array, ...]] = None) -> None:
+        self._data = data
+        if state is not None:
+            self._state = state
+
+    @property
+    def sharding(self):
+        return self._sharding
+
+    # ------------------------------------------------------------ checkpoint
+    def store_state(self) -> Any:
+        return {
+            "kind": self.kind,
+            "shape": (self.num_rows, self.num_cols),
+            "data": np.asarray(jax.device_get(self._data)),
+            "state": [np.asarray(jax.device_get(s)) for s in self._state],
+        }
+
+    def load_state(self, snap: Any) -> None:
+        assert snap["kind"] == self.kind
+        assert tuple(snap["shape"]) == (self.num_rows, self.num_cols)
+        self._data = jax.device_put(
+            snap["data"].astype(self.dtype), self._sharding)
+        self._state = tuple(
+            jax.device_put(s.astype(self.dtype), self._sharding)
+            for s in snap["state"])

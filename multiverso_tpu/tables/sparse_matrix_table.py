@@ -1,0 +1,70 @@
+"""SparseMatrixTable — sparse-access variant of MatrixTable.
+
+Reference (SURVEY.md §2.13, ``table/sparse_matrix_table.h``): only touched
+rows travel the wire; the server tracks which rows each worker holds.
+
+TPU-native: off-shard row traffic already moves as gathers/scatters over
+ICI, so the "only touched rows" property is inherent.  What this subclass
+adds is the reference's *worker-side freshness* feature: a host row cache so
+repeated ``get_rows`` of hot rows (LightLDA's access pattern) skip the
+device round-trip until the row is invalidated by an add or a clock tick.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .matrix_table import MatrixTable
+
+__all__ = ["SparseMatrixTable"]
+
+
+class SparseMatrixTable(MatrixTable):
+    kind = "sparse_matrix"
+
+    def __init__(self, *args, cache: bool = True, **kw):
+        super().__init__(*args, **kw)
+        self._cache_enabled = cache
+        self._row_cache: Dict[int, np.ndarray] = {}
+
+    def get_rows(self, row_ids, option=None) -> np.ndarray:
+        rows = np.asarray(row_ids, dtype=np.int64)
+        if not self._cache_enabled:
+            return super().get_rows(rows, option)
+        if rows.shape[0] == 0:
+            return np.zeros((0, self.num_cols), dtype=self.dtype)
+        missing = [int(r) for r in rows if int(r) not in self._row_cache]
+        if missing:
+            fetched = super().get_rows(np.asarray(missing), option)
+            for r, v in zip(missing, fetched):
+                self._row_cache[r] = v
+        return np.stack([self._row_cache[int(r)] for r in rows])
+
+    def _invalidate(self, rows: Optional[np.ndarray] = None) -> None:
+        if rows is None:
+            self._row_cache.clear()
+        else:
+            for r in rows:
+                self._row_cache.pop(int(r), None)
+
+    def add_rows(self, row_ids, delta, option=None, sync: bool = False) -> None:
+        super().add_rows(row_ids, delta, option=option, sync=sync)
+        self._invalidate(np.asarray(row_ids, dtype=np.int64))
+
+    def add(self, delta, option=None, sync: bool = False) -> None:
+        super().add(delta, option=option, sync=sync)
+        self._invalidate()
+
+    def flush(self) -> None:
+        super().flush()
+        self._invalidate()
+
+    def load_state(self, snap) -> None:
+        super().load_state(snap)
+        self._invalidate()
+
+    def raw_assign(self, data, state=None) -> None:
+        super().raw_assign(data, state)
+        self._invalidate()

@@ -1,0 +1,37 @@
+"""Server-side updaters, TPU-native.
+
+Reference (SURVEY.md §2.16): ``Updater<T>::GetUpdater`` returns one of
+default/add, SGD, AdaGrad, Momentum, SmoothGradient based on the
+``-updater_type`` flag; the server applies it element-wise to its shard on
+every ``Add``, with per-call hyper-parameters carried by ``AddOption``.
+
+Here the "server" is wherever the table shard lives, so updaters are pure
+jittable functions ``(weights, state, delta, option) -> (weights', state')``
+that XLA fuses straight into the collective step — the hot arithmetic loop of
+reference ``src/updater/*.cpp`` becomes a fused vector op on the MXU/VPU.
+
+Delta convention (documented, reference-compatible in spirit):
+- ``default``: delta IS the increment — ``w += delta``.
+- ``sgd|adagrad|momentum|smooth_gradient``: delta is a *gradient*; the
+  updater performs the descent step with ``AddOption`` hyper-params.
+
+Sparse (row) application keeps per-row state sharded with its rows
+(SURVEY.md §7 hard-parts: "per-row server-side updaters").
+"""
+
+from __future__ import annotations
+
+from .base import AddOption, GetOption, Updater, register_updater, get_updater, updater_names
+from . import sgd as _sgd            # noqa: F401  (registration side effect)
+from . import adagrad as _adagrad    # noqa: F401
+from . import momentum as _momentum  # noqa: F401
+from . import smooth_gradient as _sg # noqa: F401
+
+__all__ = [
+    "AddOption",
+    "GetOption",
+    "Updater",
+    "get_updater",
+    "register_updater",
+    "updater_names",
+]

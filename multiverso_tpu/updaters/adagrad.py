@@ -1,0 +1,43 @@
+"""AdaGrad updater — reference ``updater/adagrad_updater.h`` (SURVEY.md §2.16).
+
+Per-row accumulator state is sharded identically to its rows, so the sparse
+path updates state with the same scatter as the weights (SURVEY.md §7
+hard-parts: per-row server-side updaters).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import AddOption, Updater, effective_rows, masked, register_updater
+
+
+@register_updater
+class AdaGradUpdater(Updater):
+    """h += g^2 ; w -= lr * g / (sqrt(h) + eps)."""
+
+    name = "adagrad"
+    num_slots = 1
+
+    def apply_dense(self, w, state, delta, opt: AddOption):
+        (h,) = state
+        h = h + delta * delta
+        w = w - opt.learning_rate * delta / (jnp.sqrt(h) + opt.eps)
+        return w, (h,)
+
+    def apply_rows(self, w, state, rows, delta, opt: AddOption,
+                   mask: Optional[jax.Array] = None):
+        (h,) = state
+        rows = effective_rows(rows, mask, w.shape[0])
+        d = masked(delta, mask)
+        # Gather-updated-scatter keeps duplicate-row semantics sane for the
+        # weight step; state accumulates by scatter-add (exact for uniques,
+        # accumulate-then-read for duplicates).
+        h = h.at[rows].add(d * d, mode="drop")
+        h_rows = h[rows]
+        step = opt.learning_rate * d / (jnp.sqrt(h_rows) + opt.eps)
+        w = w.at[rows].add(-step, mode="drop")
+        return w, (h,)

@@ -1,0 +1,121 @@
+"""Updater base + registry + the default (plain add) updater.
+
+Reference: ``include/multiverso/updater/updater.h`` — base ``Update``/
+``Access`` virtuals and the ``GetUpdater`` factory switch (SURVEY.md §2.16).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["AddOption", "GetOption", "Updater", "register_updater",
+           "get_updater", "updater_names"]
+
+
+@dataclass(frozen=True)
+class AddOption:
+    """Per-Add hyper-parameters (reference ``AddOption``; SURVEY.md §2.10).
+
+    The reference packs these into the message header; here they are static
+    jit arguments (python floats hash into the compilation cache).
+    """
+
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    rho: float = 0.9          # smoothing coefficient (smooth_gradient)
+    eps: float = 1e-8         # adagrad denominator floor
+    worker_id: int = -1       # carried for parity; unused by math
+
+
+@dataclass(frozen=True)
+class GetOption:
+    """Per-Get options (reference ``GetOption``); reserved for parity."""
+
+    worker_id: int = -1
+
+
+State = Tuple[jax.Array, ...]
+
+
+class Updater:
+    """Pure-functional updater. Subclasses override the three hooks.
+
+    All hooks are shape-polymorphic and jittable; tables call them inside
+    their compiled push path (dense) or scatter path (rows).
+    """
+
+    name = "default"
+    num_slots = 0  # state arrays, each shaped like the table
+
+    # -- state --------------------------------------------------------------
+    def init_state(self, shape, dtype) -> State:
+        return tuple(jnp.zeros(shape, dtype) for _ in range(self.num_slots))
+
+    # -- dense path ---------------------------------------------------------
+    def apply_dense(self, w: jax.Array, state: State, delta: jax.Array,
+                    opt: AddOption) -> Tuple[jax.Array, State]:
+        return w + delta, state
+
+    # -- sparse (row) path --------------------------------------------------
+    def apply_rows(self, w: jax.Array, state: State, rows: jax.Array,
+                   delta: jax.Array, opt: AddOption,
+                   mask: Optional[jax.Array] = None
+                   ) -> Tuple[jax.Array, State]:
+        """Scatter-apply to ``w[rows]``.
+
+        ``rows``: int32 [k]; ``delta``: [k, cols]; ``mask``: bool [k] marks
+        valid entries (padding rows carry mask=False and must not touch
+        state). Default: plain scatter-add, duplicate rows accumulate.
+        """
+        rows = effective_rows(rows, mask, w.shape[0])
+        return w.at[rows].add(masked(delta, mask), mode="drop"), state
+
+
+_REGISTRY: Dict[str, Type[Updater]] = {}
+
+
+def register_updater(cls: Type[Updater]) -> Type[Updater]:
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+register_updater(Updater)  # "default"
+_REGISTRY["add"] = Updater  # alias
+
+
+def get_updater(name: str) -> Updater:
+    """Factory — reference ``Updater<T>::GetUpdater`` switch."""
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown updater_type '{name}'; known: {sorted(_REGISTRY)}")
+
+
+def updater_names():
+    return sorted(_REGISTRY)
+
+
+def masked(delta: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+    """Zero out padding rows so they cannot perturb weights or state."""
+    if mask is None:
+        return delta
+    return jnp.where(mask[:, None], delta, 0)
+
+
+def effective_rows(rows: jax.Array, mask: Optional[jax.Array],
+                   num_rows: int) -> jax.Array:
+    """Redirect padding entries to an out-of-bounds index.
+
+    With ``mode="drop"`` scatters, an out-of-bounds row is silently skipped,
+    so padding can never clobber real rows — regardless of whether the caller
+    padded with in-bounds indices. Callers must pre-aggregate duplicate rows
+    (tables do, via segment-sum) before stateful ``.set`` updaters.
+    """
+    if mask is None:
+        return rows
+    return jnp.where(mask, rows, num_rows)

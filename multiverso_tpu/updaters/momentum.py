@@ -1,0 +1,32 @@
+"""Momentum updater — reference ``updater/momentum_updater.h`` (SURVEY.md §2.16)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .base import AddOption, Updater, effective_rows, masked, register_updater
+
+
+@register_updater
+class MomentumUpdater(Updater):
+    """v = mu*v + lr*g ; w -= v."""
+
+    name = "momentum"
+    num_slots = 1
+
+    def apply_dense(self, w, state, delta, opt: AddOption):
+        (v,) = state
+        v = opt.momentum * v + opt.learning_rate * delta
+        return w - v, (v,)
+
+    def apply_rows(self, w, state, rows, delta, opt: AddOption,
+                   mask: Optional[jax.Array] = None):
+        (v,) = state
+        rows = effective_rows(rows, mask, w.shape[0])
+        d = masked(delta, mask)
+        v_rows = opt.momentum * v[rows] + opt.learning_rate * d
+        v = v.at[rows].set(v_rows, mode="drop")
+        w = w.at[rows].add(-v_rows, mode="drop")
+        return w, (v,)

@@ -1,0 +1,27 @@
+"""SGD updater — reference ``updater/sgd_updater.h`` (SURVEY.md §2.16)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import AddOption, State, Updater, effective_rows, masked, register_updater
+
+
+@register_updater
+class SGDUpdater(Updater):
+    """w -= lr * g (delta is a gradient)."""
+
+    name = "sgd"
+    num_slots = 0
+
+    def apply_dense(self, w, state, delta, opt: AddOption):
+        return w - opt.learning_rate * delta, state
+
+    def apply_rows(self, w, state, rows, delta, opt: AddOption,
+                   mask: Optional[jax.Array] = None):
+        rows = effective_rows(rows, mask, w.shape[0])
+        d = masked(delta, mask)
+        return w.at[rows].add(-opt.learning_rate * d, mode="drop"), state

@@ -1,0 +1,35 @@
+"""Smooth-gradient updater — reference ``updater/smooth_gradient_updater.h``
+(SURVEY.md §2.16): exponential smoothing of incoming gradients before the
+descent step."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import AddOption, Updater, effective_rows, masked, register_updater
+
+
+@register_updater
+class SmoothGradientUpdater(Updater):
+    """s = rho*s + (1-rho)*g ; w -= lr*s."""
+
+    name = "smooth_gradient"
+    num_slots = 1
+
+    def apply_dense(self, w, state, delta, opt: AddOption):
+        (s,) = state
+        s = opt.rho * s + (1.0 - opt.rho) * delta
+        return w - opt.learning_rate * s, (s,)
+
+    def apply_rows(self, w, state, rows, delta, opt: AddOption,
+                   mask: Optional[jax.Array] = None):
+        (s,) = state
+        rows = effective_rows(rows, mask, w.shape[0])
+        d = masked(delta, mask)
+        s_rows = opt.rho * s[rows] + (1.0 - opt.rho) * d
+        s = s.at[rows].set(s_rows, mode="drop")
+        w = w.at[rows].add(-opt.learning_rate * s_rows, mode="drop")
+        return w, (s,)

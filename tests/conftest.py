@@ -1,0 +1,36 @@
+"""Test harness: every distributed test runs on a virtual 8-device CPU mesh.
+
+This is the proper version of the reference's single-process degenerate
+mode (SURVEY.md §4): instead of one process holding both roles, we get a
+real 8-way mesh on one host via XLA's forced host platform device count.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize may import jax before this file runs, in
+# which case the env vars above were read too late — force via jax.config.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def mv():
+    """Fresh multiverso_tpu runtime per test."""
+    import multiverso_tpu as mv
+
+    mv.config.reset()
+    if mv.initialized():
+        mv.shutdown()
+    yield mv
+    if mv.initialized():
+        mv.shutdown()
+    mv.config.reset()

@@ -1,0 +1,96 @@
+"""Core runtime tests: init/shutdown/barrier/ids, flags, log, dashboard.
+
+Models the reference's Configure/Log/lifecycle unit tests (SURVEY.md §4).
+"""
+
+import jax
+import pytest
+
+
+def test_devices_virtualized():
+    assert len(jax.devices()) == 8
+
+
+def test_init_shutdown_lifecycle(mv):
+    ctx = mv.init()
+    assert mv.initialized()
+    assert mv.workers_num() == 1          # one controller process
+    assert mv.worker_id() == 0
+    assert mv.server_id() == 0            # Role.ALL co-hosts server shards
+    assert mv.is_master_worker()
+    assert mv.num_replicas() == 8         # device-level dp width
+    c0 = mv.clock()
+    mv.barrier()
+    assert mv.clock() == c0 + 1
+    mv.shutdown()
+    assert not mv.initialized()
+
+
+def test_init_idempotent(mv):
+    ctx1 = mv.init()
+    ctx2 = mv.init()
+    assert ctx1 is ctx2
+
+
+def test_flag_parsing(mv):
+    rest = mv.config.parse_cmd_flags(
+        ["-sync=true", "--updater_type=adagrad", "-port=1234", "positional"])
+    assert rest == ["positional"]
+    assert mv.config.get("sync") is True
+    assert mv.config.get("updater_type") == "adagrad"
+    assert mv.config.get("port") == 1234
+
+
+def test_init_applies_flags(mv):
+    ctx = mv.init(args=["-sync=true", "-updater_type=momentum"])
+    assert ctx.sync is True
+    assert ctx.updater_type == "momentum"
+
+
+def test_init_kwargs_override_flags(mv):
+    ctx = mv.init(args=["-sync=true"], sync=False, updater_type="sgd")
+    assert ctx.sync is False
+    assert ctx.updater_type == "sgd"
+
+
+def test_unknown_flag_left_in_remainder(mv):
+    rest = mv.config.parse_cmd_flags(["-no_such_flag=1"])
+    assert rest == ["-no_such_flag=1"]
+
+
+def test_log_fatal_raises(mv):
+    from multiverso_tpu.log import FatalError
+
+    with pytest.raises(FatalError):
+        mv.Log.fatal("boom %d", 42)
+
+
+def test_dashboard_monitor(mv):
+    mv.dashboard.reset()
+    with mv.dashboard.monitor("UnitTest::Op"):
+        pass
+    with mv.dashboard.monitor("UnitTest::Op"):
+        pass
+    mons = mv.dashboard.report(log=False)
+    assert mons["UnitTest::Op"].count == 2
+    assert mons["UnitTest::Op"].total_s >= 0
+
+
+def test_table_registry(mv):
+    mv.init()
+    t1 = mv.ArrayTable(16)
+    t2 = mv.ArrayTable(32)
+    ctx = mv.get_context()
+    assert t1.table_id != t2.table_id
+    assert ctx.table(t1.table_id) is t1
+    assert len(ctx.tables()) == 2
+
+
+def test_init_kwargs_do_not_leak_across_lifecycles(mv):
+    """sync/updater kwargs are per-lifecycle; only CLI args persist."""
+    ctx1 = mv.init(sync=True, updater_type="momentum")
+    assert ctx1.sync is True
+    mv.shutdown()
+    ctx2 = mv.init()
+    assert ctx2.sync is False
+    assert ctx2.updater_type == "default"
