@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line.
+
+Measures the BASELINE.json headline configs on whatever devices JAX sees
+(one real TPU chip under the driver; the 8-device CPU mesh in tests):
+
+- **LR** (ArrayTable, dense): fused-step training throughput, samples/sec.
+- **word2vec** (MatrixTable, sparse rows): fused-step pairs/sec.
+- **Add/Get bandwidth**: eager parity-path push-pull GB/s on a large
+  ArrayTable (the reference's wire metric, here host<->device + update).
+
+``vs_baseline`` compares the fused TPU path against the reference-shaped
+push-pull loop measured in the same run on the same hardware (the
+per-batch Get -> local grad -> Add round-trip the reference's workers do).
+The reference's own 8-node MPI numbers are unmeasurable here (empty mount,
+no egress — see BASELINE.md), so this self-measured ratio is the honest
+stand-in: it is exactly the speedup a Multiverso user gets from moving
+their loop onto the fused path on this chip.
+
+Primary metric: LR samples/sec (headline config #1). Extras ride along in
+the same JSON object.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _time_loop(fn, *, warmup: int = 3, iters: int = 10) -> float:
+    """Median wall seconds per call after warmup."""
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def bench_lr(batch: int = 8192, features: int = 784, classes: int = 10):
+    import jax
+
+    from multiverso_tpu.apps import LogisticRegression, synthetic_classification
+
+    x, y = synthetic_classification(batch, features, classes, seed=0)
+
+    # Fused path.
+    lr = LogisticRegression(features, classes, learning_rate=0.1,
+                            name="bench_lr")
+    step, place = lr.make_fused_step()
+    data, state = lr.table.raw_value()
+    xb, yb = place(x), place(y)
+
+    def fused_once():
+        nonlocal data, state
+        data, state, loss = step(data, state, xb, yb)
+        jax.block_until_ready(data)
+
+    fused_s = _time_loop(fused_once)
+    lr.table.raw_assign(data, state)
+
+    # Reference-shaped push-pull loop (per-batch Get -> grad -> Add).
+    pp = LogisticRegression(features, classes, learning_rate=0.1,
+                            name="bench_lr_pp")
+
+    def pushpull_once():
+        pp.train_batch(x, y)
+
+    pushpull_s = _time_loop(pushpull_once, warmup=2, iters=5)
+
+    return {
+        "lr_fused_samples_per_sec": batch / fused_s,
+        "lr_pushpull_samples_per_sec": batch / pushpull_s,
+        "lr_fused_vs_pushpull": pushpull_s / fused_s,
+    }
+
+
+def bench_w2v(batch: int = 8192, vocab: int = 100_000, dim: int = 128,
+              negatives: int = 5):
+    import jax
+
+    from multiverso_tpu.apps import SkipGram
+
+    rng = np.random.RandomState(0)
+    c = rng.randint(vocab, size=batch).astype(np.int32)
+    o = rng.randint(vocab, size=batch).astype(np.int32)
+    neg = rng.randint(vocab, size=(batch, negatives)).astype(np.int32)
+
+    sg = SkipGram(vocab, dim, negatives=negatives, learning_rate=0.025)
+    step, place = sg.make_fused_step()
+    din, sin = sg.table_in.raw_value()
+    dout, sout = sg.table_out.raw_value()
+    cb, ob, negb = place(c), place(o), place(neg)
+
+    def fused_once():
+        nonlocal din, sin, dout, sout
+        din, sin, dout, sout, loss = step(din, sin, dout, sout, cb, ob, negb)
+        jax.block_until_ready(din)
+
+    fused_s = _time_loop(fused_once)
+    sg.table_in.raw_assign(din, sin)
+    sg.table_out.raw_assign(dout, sout)
+
+    def pushpull_once():
+        sg.train_batch(c, o, neg)
+
+    pushpull_s = _time_loop(pushpull_once, warmup=2, iters=5)
+
+    return {
+        "w2v_fused_pairs_per_sec": batch / fused_s,
+        "w2v_pushpull_pairs_per_sec": batch / pushpull_s,
+        "w2v_fused_vs_pushpull": pushpull_s / fused_s,
+    }
+
+
+def bench_add_get(size: int = 16 * 1024 * 1024):
+    """Eager parity-path Add/Get GB/s on a 64 MiB float32 ArrayTable."""
+    from multiverso_tpu.tables import ArrayTable
+
+    t = ArrayTable(size, name="bench_bw")
+    delta = np.ones(size, np.float32)
+    nbytes = size * 4
+
+    add_s = _time_loop(lambda: t.add(delta, sync=True), warmup=2, iters=5)
+    get_s = _time_loop(lambda: t.get(), warmup=2, iters=5)
+    return {
+        "add_gbps": nbytes / add_s / 1e9,
+        "get_gbps": nbytes / get_s / 1e9,
+    }
+
+
+def main() -> None:
+    import multiverso_tpu as mv
+
+    mv.init(args=["-log_level=error"], updater_type="sgd")
+    results = {}
+    results.update(bench_lr())
+    results.update(bench_w2v())
+    results.update(bench_add_get())
+    mv.shutdown()
+
+    line = {
+        "metric": "lr_fused_samples_per_sec",
+        "value": round(results["lr_fused_samples_per_sec"], 1),
+        "unit": "samples/sec",
+        # Fused TPU path vs reference-shaped push-pull loop, same hardware
+        # (see module docstring; reference 8-node MPI numbers unmeasurable).
+        "vs_baseline": round(results["lr_fused_vs_pushpull"], 2),
+        "extras": {k: round(v, 2) for k, v in results.items()},
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,21 @@
+"""Bundled applications.
+
+The reference ships its flagship apps as binding examples / sibling repos
+(SURVEY.md §2.32, §2.36): Theano logistic regression, distributed word
+embedding (word2vec), LightLDA.  Here they are first-class packages built on
+the TPU-native tables, each with
+
+- a *parity* training path using push-pull ``Get``/``Add`` (the literal
+  reference training-loop shape, SURVEY.md §3.4), and
+- a *fused* path where the whole data-parallel step — pull, compute, push,
+  update — compiles into one XLA program over the device mesh (the
+  TPU-native hot loop that the benchmarks run).
+"""
+
+from .logistic_regression import LogisticRegression, synthetic_classification
+from .word2vec import SkipGram, synthetic_corpus
+
+__all__ = [
+    "LogisticRegression", "synthetic_classification",
+    "SkipGram", "synthetic_corpus",
+]

@@ -1,0 +1,224 @@
+"""Distributed word embedding (skip-gram negative sampling).
+
+Reference (SURVEY.md §2.36, ``Microsoft/distributed_word_embedding`` linking
+libmultiverso): embeddings live in (Sparse)MatrixTables row-sharded over
+servers; workers pull the rows a batch touches (`Get(rows)`), compute SGNS
+gradients locally, and push row deltas (`Add(rows)`), with an AsyncBuffer
+overlapping the next pull with compute.
+
+TPU-native: both embedding matrices are row-sharded ``jax.Array`` tables.
+The fused step compiles the whole pull→grad→push round-trip into one XLA
+program: gathers fetch rows over ICI, autodiff produces the row gradients,
+and the updater scatter-applies them on the rows' home shards.  Row batches
+are static-shaped; negatives are pre-sampled on host (the reference samples
+on the worker too).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import context as core_context
+from ..tables import MatrixTable
+from ..updaters import AddOption
+from ..util import AsyncBuffer
+
+__all__ = ["SkipGram", "synthetic_corpus"]
+
+
+def synthetic_corpus(num_tokens: int, vocab_size: int, seed: int = 0,
+                     zipf_a: float = 1.1) -> np.ndarray:
+    """Zipf-distributed token stream (text8 stand-in; no dataset egress)."""
+    rng = np.random.RandomState(seed)
+    ranks = rng.zipf(zipf_a, size=num_tokens)
+    return ((ranks - 1) % vocab_size).astype(np.int32)
+
+
+def _sgns_loss(vc: jax.Array, uo: jax.Array, un: jax.Array) -> jax.Array:
+    """Skip-gram negative-sampling loss.
+
+    ``vc`` [B,D] center (input) embeddings, ``uo`` [B,D] positive context
+    (output) embeddings, ``un`` [B,K,D] negative samples.
+    """
+    pos = jnp.einsum("bd,bd->b", vc, uo)
+    neg = jnp.einsum("bd,bkd->bk", vc, un)
+    return -(jnp.sum(jax.nn.log_sigmoid(pos))
+             + jnp.sum(jax.nn.log_sigmoid(-neg))) / vc.shape[0]
+
+
+class SkipGram:
+    """Word2vec SGNS over two row-sharded MatrixTables."""
+
+    def __init__(self, vocab_size: int, dim: int,
+                 learning_rate: float = 0.025,
+                 negatives: int = 5,
+                 window: int = 5,
+                 updater_type: str = "sgd",
+                 seed: int = 0):
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.negatives = int(negatives)
+        self.window = int(window)
+        self.option = AddOption(learning_rate=learning_rate)
+        rng = np.random.RandomState(seed)
+        init_in = ((rng.rand(vocab_size, dim) - 0.5) / dim).astype(np.float32)
+        self.table_in = MatrixTable(vocab_size, dim, init=init_in,
+                                    updater_type=updater_type,
+                                    name="w2v_in",
+                                    default_option=self.option)
+        self.table_out = MatrixTable(vocab_size, dim,
+                                     updater_type=updater_type,
+                                     name="w2v_out",
+                                     default_option=self.option)
+        self._rng = np.random.RandomState(seed + 1)
+        self._grad_fn = jax.jit(jax.grad(
+            lambda vc, uo, un: _sgns_loss(vc, uo, un), argnums=(0, 1, 2)))
+        self._fused_cache = {}
+
+    # ------------------------------------------------------------- batching
+    def batches(self, corpus: np.ndarray, batch_size: int,
+                seed: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray,
+                                                 np.ndarray]]:
+        """Static-shaped (centers [B], contexts [B], negatives [B,K])."""
+        rng = np.random.RandomState(seed)
+        n = corpus.shape[0]
+        centers, contexts = [], []
+        for i in range(n):
+            w = 1 + rng.randint(self.window)
+            for j in range(max(0, i - w), min(n, i + w + 1)):
+                if j != i:
+                    centers.append(corpus[i])
+                    contexts.append(corpus[j])
+            while len(centers) >= batch_size:
+                c = np.asarray(centers[:batch_size], np.int32)
+                o = np.asarray(contexts[:batch_size], np.int32)
+                del centers[:batch_size], contexts[:batch_size]
+                neg = rng.randint(self.vocab_size,
+                                  size=(batch_size, self.negatives)
+                                  ).astype(np.int32)
+                yield c, o, neg
+
+    # ------------------------------------------------ parity push-pull path
+    def train_batch(self, centers: np.ndarray, contexts: np.ndarray,
+                    negatives: np.ndarray) -> None:
+        """Reference loop body: Get(rows) → local grads → Add(rows)."""
+        B, K = negatives.shape
+        vc = jnp.asarray(self.table_in.get_rows(centers))
+        out_rows = np.concatenate([contexts, negatives.reshape(-1)])
+        out_emb = self.table_out.get_rows(out_rows)
+        uo = jnp.asarray(out_emb[:B])
+        un = jnp.asarray(out_emb[B:]).reshape(B, K, self.dim)
+        dvc, duo, dun = self._grad_fn(vc, uo, un)
+        self.table_in.add_rows(centers, np.asarray(dvc), option=self.option)
+        self.table_out.add_rows(
+            out_rows,
+            np.concatenate([np.asarray(duo),
+                            np.asarray(dun).reshape(B * K, self.dim)]),
+            option=self.option)
+
+    def train_epoch(self, corpus: np.ndarray, batch_size: int,
+                    seed: int = 0, prefetch: bool = True) -> int:
+        """Parity epoch with AsyncBuffer overlapping batch prep (§2.24)."""
+        it = self.batches(corpus, batch_size, seed=seed)
+        steps = 0
+        if not prefetch:
+            for c, o, neg in it:
+                self.train_batch(c, o, neg)
+                steps += 1
+        else:
+            with AsyncBuffer(lambda: next(it, None)) as buf:
+                while True:
+                    batch = buf.get()
+                    if batch is None:
+                        break
+                    self.train_batch(*batch)
+                    steps += 1
+        if steps == 0:
+            raise ValueError(
+                f"corpus of {corpus.shape[0]} tokens produced no full batch "
+                f"of {batch_size} pairs (partial batches are dropped for "
+                "static shapes)")
+        return steps
+
+    # ------------------------------------------------------ fused SPMD path
+    def make_fused_step(self, batch_axis: str = "worker"):
+        """One XLA program: gather rows, SGNS grads, scatter-apply updater.
+
+        Index batches are sharded over the mesh's worker axis; the gathers
+        and the scatter-adds cross shards over ICI exactly where the
+        reference crossed the network.  Returns
+        ``step(din, sin, dout, sout, c, o, neg) -> (din, sin, dout, sout, loss)``
+        and a placer for the index arrays.
+        """
+        cached = self._fused_cache.get(batch_axis)
+        if cached is not None:  # reuse: a fresh jit wrapper would recompile
+            return cached
+        ctx = core_context.get_context()
+        from ..parallel.sharding import batch_placer
+        _, place = batch_placer(ctx.mesh, batch_axis, dtype=jnp.int32)
+        upd_in = self.table_in.updater
+        upd_out = self.table_out.updater
+        opt = self.option
+        D = self.dim
+
+        from ..updaters.base import aggregate_rows
+
+        def scatter(upd, data, state, rows, delta):
+            # Non-linear updaters need duplicate rows segment-summed first
+            # (matches the eager path's host-side np.unique aggregation).
+            if upd.linear:
+                return upd.apply_rows(data, state, rows, delta, opt)
+            uniq, agg, mask = aggregate_rows(rows, delta)
+            return upd.apply_rows(data, state, uniq, agg, opt, mask=mask)
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def step(din, sin, dout, sout, c, o, neg):
+            B, K = neg.shape
+            vc = din[c]
+            uo = dout[o]
+            un = dout[neg.reshape(-1)].reshape(B, K, D)
+            loss, grads = jax.value_and_grad(
+                _sgns_loss, argnums=(0, 1, 2))(vc, uo, un)
+            dvc, duo, dun = grads
+            din, sin = scatter(upd_in, din, sin, c, dvc)
+            out_rows = jnp.concatenate([o, neg.reshape(-1)])
+            out_delta = jnp.concatenate([duo, dun.reshape(B * K, D)])
+            dout, sout = scatter(upd_out, dout, sout, out_rows, out_delta)
+            return din, sin, dout, sout, loss
+
+        self._fused_cache[batch_axis] = (step, place)
+        return step, place
+
+    def train_epoch_fused(self, corpus: np.ndarray, batch_size: int,
+                          seed: int = 0) -> Tuple[int, float]:
+        step, place = self.make_fused_step()
+        din, sin = self.table_in.raw_value()
+        dout, sout = self.table_out.raw_value()
+        loss = jnp.zeros(())
+        steps = 0
+        for c, o, neg in self.batches(corpus, batch_size, seed=seed):
+            din, sin, dout, sout, loss = step(
+                din, sin, dout, sout, place(c), place(o), place(neg))
+            steps += 1
+        if steps == 0:
+            raise ValueError(
+                f"corpus of {corpus.shape[0]} tokens produced no full batch "
+                f"of {batch_size} pairs (partial batches are dropped for "
+                "static shapes)")
+        self.table_in.raw_assign(din, sin)
+        self.table_out.raw_assign(dout, sout)
+        return steps, float(loss)
+
+    # ------------------------------------------------------------- analysis
+    def most_similar(self, token: int, topk: int = 5) -> np.ndarray:
+        emb = self.table_in.get()
+        v = emb[token] / (np.linalg.norm(emb[token]) + 1e-8)
+        norms = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-8)
+        sims = norms @ v
+        sims[token] = -np.inf
+        return np.argsort(-sims)[:topk]

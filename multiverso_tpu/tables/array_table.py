@@ -51,7 +51,6 @@ class ArrayTable(Table):
         # BSP clock buffers, bucketed per AddOption so a flush applies each
         # option's aggregate with the right hyper-parameters.
         self._pending: Dict[Optional[AddOption], np.ndarray] = {}
-        self._apply_cache: Dict[AddOption, Any] = {}
 
     # ------------------------------------------------------------------ Get
     def get(self, option=None) -> np.ndarray:
@@ -96,23 +95,7 @@ class ArrayTable(Table):
             self._apply_now(delta, option)
 
     def _apply_now(self, delta: np.ndarray, option: Optional[AddOption]) -> None:
-        opt = option or self.default_option
-        fn = self._apply_cache.get(opt)
-        if fn is None:
-            updater = self.updater
-
-            def _apply(data, state, d):
-                return updater.apply_dense(data, state, d, opt)
-
-            fn = jax.jit(_apply, donate_argnums=(0, 1))
-            self._apply_cache[opt] = fn
-        padded = np.zeros(self._padded, dtype=self.dtype)
-        padded[: self.size] = delta
-        d = jax.device_put(padded, self._sharding)
-        # Lock: the jit donates self._data/_state, so concurrent eager adds
-        # must serialize or thread B reads a deleted buffer.
-        with self._lock:
-            self._data, self._state = fn(self._data, self._state, d)
+        self._apply_dense_padded(delta, option)
 
     # ------------------------------------------------- fused (in-jit) path
     def raw_value(self) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:

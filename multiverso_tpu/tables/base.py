@@ -62,6 +62,34 @@ class Table:
         self.table_id = ctx.register_table(self)
         self.name = name or f"{self.kind}_{self.table_id}"
         self._lock = threading.Lock()
+        self._dense_cache: dict = {}
+
+    def _apply_dense_padded(self, delta, option) -> None:
+        """Shared eager dense-apply: pad to the sharded shape, ship, update.
+
+        Used by the Array/Matrix ``add`` paths.  The jitted apply donates
+        ``_data``/``_state``, so the swap holds ``_lock`` — a concurrent
+        eager add reading a donated (deleted) buffer would crash otherwise.
+        """
+        import jax
+        import numpy as np
+
+        opt = option or self.default_option
+        fn = self._dense_cache.get(opt)
+        if fn is None:
+            updater = self.updater
+
+            def _apply(data, state, d):
+                return updater.apply_dense(data, state, d, opt)
+
+            fn = jax.jit(_apply, donate_argnums=(0, 1))
+            self._dense_cache[opt] = fn
+        padded_shape = self._data.shape
+        padded = np.zeros(padded_shape, dtype=self.dtype)
+        padded[tuple(slice(0, s) for s in delta.shape)] = delta
+        d = jax.device_put(padded, self._sharding)
+        with self._lock:
+            self._data, self._state = fn(self._data, self._state, d)
 
     # -- BSP clock boundary --------------------------------------------------
     def flush(self) -> None:

@@ -70,7 +70,9 @@ class KVTable(Table):
         with self._monitor("Get"):
             with self._lock:
                 for k in keys:
-                    self._cache[k] = self._store.get(k, self._zero()).copy()
+                    w = self._store.get(k)
+                    self._cache[k] = (w.copy() if w is not None
+                                      else self._zero())
             return {k: self._cache[k] for k in keys}
 
     def add(self, updates: Dict[Any, Any],

@@ -64,7 +64,6 @@ class MatrixTable(Table):
         self._pending_dense: Dict[Optional[AddOption], np.ndarray] = {}
         self._pending_sparse: List[
             Tuple[np.ndarray, np.ndarray, Optional[AddOption]]] = []
-        self._dense_cache: Dict[AddOption, Any] = {}
         self._rows_cache: Dict[AddOption, Any] = {}
         # jax.jit caches per input shape internally; one gather fn suffices.
         self._gather_fn = jax.jit(lambda data, r: data[r])
@@ -148,22 +147,7 @@ class MatrixTable(Table):
     # ----------------------------------------------------------- internals
     def _apply_dense_now(self, delta: np.ndarray,
                          option: Optional[AddOption]) -> None:
-        opt = option or self.default_option
-        fn = self._dense_cache.get(opt)
-        if fn is None:
-            updater = self.updater
-
-            def _apply(data, state, d):
-                return updater.apply_dense(data, state, d, opt)
-
-            fn = jax.jit(_apply, donate_argnums=(0, 1))
-            self._dense_cache[opt] = fn
-        padded = np.zeros((self._padded_rows, self.num_cols), dtype=self.dtype)
-        padded[: self.num_rows] = delta
-        d = jax.device_put(padded, self._sharding)
-        # Lock: the jit donates self._data/_state (see ArrayTable._apply_now).
-        with self._lock:
-            self._data, self._state = fn(self._data, self._state, d)
+        self._apply_dense_padded(delta, option)
 
     def _apply_rows_now(self, rows: np.ndarray, delta: np.ndarray,
                         option: Optional[AddOption]) -> None:

@@ -12,6 +12,7 @@ device round-trip until the row is invalidated by an add or a clock tick.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
 import numpy as np
@@ -28,6 +29,7 @@ class SparseMatrixTable(MatrixTable):
         super().__init__(*args, **kw)
         self._cache_enabled = cache
         self._row_cache: Dict[int, np.ndarray] = {}
+        self._cache_lock = threading.Lock()
 
     def get_rows(self, row_ids, option=None) -> np.ndarray:
         rows = np.asarray(row_ids, dtype=np.int64)
@@ -35,19 +37,25 @@ class SparseMatrixTable(MatrixTable):
             return super().get_rows(rows, option)
         if rows.shape[0] == 0:
             return np.zeros((0, self.num_cols), dtype=self.dtype)
-        missing = [int(r) for r in rows if int(r) not in self._row_cache]
-        if missing:
-            fetched = super().get_rows(np.asarray(missing), option)
-            for r, v in zip(missing, fetched):
-                self._row_cache[r] = v
-        return np.stack([self._row_cache[int(r)] for r in rows])
+        # _cache_lock held across the fetch: a concurrent add_rows must not
+        # invalidate entries between the miss check and the stack below.
+        # (Distinct from self._lock, which the inherited add path takes —
+        # holding that one here would serialize against device applies.)
+        with self._cache_lock:
+            missing = [int(r) for r in rows if int(r) not in self._row_cache]
+            if missing:
+                fetched = super().get_rows(np.asarray(missing), option)
+                for r, v in zip(missing, fetched):
+                    self._row_cache[r] = v
+            return np.stack([self._row_cache[int(r)] for r in rows])
 
     def _invalidate(self, rows: Optional[np.ndarray] = None) -> None:
-        if rows is None:
-            self._row_cache.clear()
-        else:
-            for r in rows:
-                self._row_cache.pop(int(r), None)
+        with self._cache_lock:
+            if rows is None:
+                self._row_cache.clear()
+            else:
+                for r in rows:
+                    self._row_cache.pop(int(r), None)
 
     def add_rows(self, row_ids, delta, option=None, sync: bool = False) -> None:
         super().add_rows(row_ids, delta, option=option, sync=sync)

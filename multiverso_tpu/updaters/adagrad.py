@@ -21,6 +21,7 @@ class AdaGradUpdater(Updater):
 
     name = "adagrad"
     num_slots = 1
+    linear = False  # duplicate rows must be segment-summed before apply
 
     def apply_dense(self, w, state, delta, opt: AddOption):
         (h,) = state

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["AddOption", "GetOption", "Updater", "register_updater",
-           "get_updater", "updater_names"]
+           "get_updater", "updater_names", "aggregate_rows"]
 
 
 @dataclass(frozen=True)
@@ -50,6 +50,11 @@ class Updater:
 
     name = "default"
     num_slots = 0  # state arrays, each shaped like the table
+    # True iff apply is linear in the delta, i.e. scatter-adding duplicate
+    # rows equals applying their pre-aggregated sum.  Non-linear updaters
+    # (stateful or normalized) require duplicate rows to be segment-summed
+    # first — eager tables do it host-side; fused steps via aggregate_rows.
+    linear = True
 
     # -- state --------------------------------------------------------------
     def init_state(self, shape, dtype) -> State:
@@ -98,6 +103,29 @@ def get_updater(name: str) -> Updater:
 
 def updater_names():
     return sorted(_REGISTRY)
+
+
+def aggregate_rows(rows: jax.Array, delta: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Jittable static-shape segment-sum of duplicate row ids.
+
+    Sorts the batch, sums each duplicate group into its first slot, and
+    returns ``(uniq_rows [k], agg_delta [k, ...], mask [k])`` where surplus
+    slots carry ``mask=False`` (feed all three to ``Updater.apply_rows`` —
+    ``effective_rows`` turns masked slots into dropped scatters).  This is
+    the in-jit equivalent of the host-side ``np.unique`` + segment-sum the
+    eager tables do, required before any non-``linear`` updater.
+    """
+    order = jnp.argsort(rows)
+    r = rows[order]
+    d = delta[order]
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), bool), r[1:] != r[:-1]])
+    seg = jnp.cumsum(is_new) - 1
+    agg = jnp.zeros_like(d).at[seg].add(d)
+    uniq = jnp.zeros_like(r).at[seg].set(r)
+    mask = jnp.zeros(r.shape, bool).at[seg].set(True)
+    return uniq, agg, mask
 
 
 def masked(delta: jax.Array, mask: Optional[jax.Array]) -> jax.Array:

@@ -15,6 +15,7 @@ class MomentumUpdater(Updater):
 
     name = "momentum"
     num_slots = 1
+    linear = False  # duplicate rows must be segment-summed before apply
 
     def apply_dense(self, w, state, delta, opt: AddOption):
         (v,) = state

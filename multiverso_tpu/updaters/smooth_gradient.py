@@ -18,6 +18,7 @@ class SmoothGradientUpdater(Updater):
 
     name = "smooth_gradient"
     num_slots = 1
+    linear = False  # duplicate rows must be segment-summed before apply
 
     def apply_dense(self, w, state, delta, opt: AddOption):
         (s,) = state

@@ -1,0 +1,6 @@
+"""Cross-cutting utilities (reference ``include/multiverso/util/``)."""
+
+from .async_buffer import AsyncBuffer
+from .timer import Timer
+
+__all__ = ["AsyncBuffer", "Timer"]

@@ -1,0 +1,190 @@
+"""App-level tests: the reference's example-driven validation (SURVEY.md §4,
+§7 minimum slice) — LR converging with loss decrease + parity between the
+push-pull path and the fused SPMD path; word2vec training on both paths.
+"""
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------- LR
+
+def test_lr_parity_path_converges(mv):
+    mv.init(updater_type="sgd")
+    from multiverso_tpu.apps import LogisticRegression, synthetic_classification
+
+    x, y = synthetic_classification(512, 16, 4, seed=0)
+    lr = LogisticRegression(16, 4, learning_rate=0.5)
+    first = lr.evaluate(x, y)[0]
+    for _ in range(5):
+        for i in range(0, 512, 64):
+            lr.train_batch(x[i:i + 64], y[i:i + 64])
+    last, acc = lr.evaluate(x, y)
+    assert last < first * 0.5
+    assert acc > 0.8
+
+
+def test_lr_fused_path_converges(mv):
+    mv.init(updater_type="sgd")
+    from multiverso_tpu.apps import LogisticRegression, synthetic_classification
+
+    x, y = synthetic_classification(1024, 16, 4, seed=1)
+    lr = LogisticRegression(16, 4, learning_rate=0.5)
+    first = lr.evaluate(x, y)[0]
+    for _ in range(5):
+        lr.train_epoch_fused(x, y, batch_size=128)
+    last, acc = lr.evaluate(x, y)
+    assert last < first * 0.5
+    assert acc > 0.8
+
+
+def test_lr_fused_matches_parity_single_step(mv):
+    """The fused SPMD step computes the same math as the push-pull loop."""
+    mv.init(updater_type="sgd")
+    from multiverso_tpu.apps import LogisticRegression, synthetic_classification
+
+    x, y = synthetic_classification(128, 8, 3, seed=2)
+    a = LogisticRegression(8, 3, learning_rate=0.1, name="lr_a", seed=7)
+    b = LogisticRegression(8, 3, learning_rate=0.1, name="lr_b", seed=7)
+    np.testing.assert_allclose(a.table.get(), b.table.get())
+
+    a.train_batch(x, y)
+
+    step, place = b.make_fused_step()
+    data, state = b.table.raw_value()
+    data, state, _ = step(data, state, place(x), place(y))
+    b.table.raw_assign(data, state)
+
+    np.testing.assert_allclose(a.table.get(), b.table.get(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lr_workers_consistent_bsp(mv):
+    """Sync mode: k workers' adds all apply at the barrier; every worker then
+    pulls identical parameters (the §7 cross-worker consistency check)."""
+    mv.init(sync=True, updater_type="sgd")
+    from multiverso_tpu.apps import LogisticRegression, synthetic_classification
+
+    x, y = synthetic_classification(256, 8, 3, seed=3)
+    lr = LogisticRegression(8, 3, learning_rate=0.1)
+    w0 = lr.table.get()
+    for wid in range(4):  # 4 simulated workers, one batch each
+        lr.train_batch(x[wid * 64:(wid + 1) * 64], y[wid * 64:(wid + 1) * 64])
+    np.testing.assert_allclose(lr.table.get(), w0)  # clock still open
+    mv.barrier()
+    assert not np.allclose(lr.table.get(), w0)
+
+
+# ----------------------------------------------------------------- word2vec
+
+def test_w2v_parity_path_trains(mv):
+    mv.init(updater_type="sgd")
+    from multiverso_tpu.apps import SkipGram, synthetic_corpus
+
+    sg = SkipGram(vocab_size=50, dim=8, window=2, negatives=3)
+    corpus = synthetic_corpus(500, 50, seed=0)
+    before = sg.table_in.get().copy()
+    steps = sg.train_epoch(corpus, batch_size=64, prefetch=True)
+    assert steps > 0
+    assert not np.allclose(sg.table_in.get(), before)
+
+
+def test_w2v_fused_loss_decreases(mv):
+    mv.init(updater_type="sgd")
+    from multiverso_tpu.apps import SkipGram, synthetic_corpus
+
+    sg = SkipGram(vocab_size=64, dim=16, window=3, negatives=4,
+                  learning_rate=0.1)
+    corpus = synthetic_corpus(2000, 64, seed=1)
+    _, first = sg.train_epoch_fused(corpus, batch_size=256, seed=1)
+    for e in range(3):
+        _, last = sg.train_epoch_fused(corpus, batch_size=256, seed=1)
+    assert last < first
+
+
+def test_w2v_fused_matches_parity_single_batch(mv):
+    mv.init(updater_type="sgd")
+    from multiverso_tpu.apps import SkipGram
+
+    a = SkipGram(vocab_size=32, dim=4, negatives=2, seed=5)
+
+    c = np.array([1, 2, 3, 1], np.int32)
+    o = np.array([4, 5, 6, 7], np.int32)
+    neg = np.array([[8, 9], [10, 11], [12, 13], [14, 15]], np.int32)
+    a.train_batch(c, o, neg)
+    got_in_a = a.table_in.get()
+    got_out_a = a.table_out.get()
+
+    import multiverso_tpu as mv2
+    b = SkipGram(vocab_size=32, dim=4, negatives=2, seed=5)
+    step, place = b.make_fused_step()
+    din, sin = b.table_in.raw_value()
+    dout, sout = b.table_out.raw_value()
+    din, sin, dout, sout, _ = step(din, sin, dout, sout,
+                                   place(c), place(o), place(neg))
+    b.table_in.raw_assign(din, sin)
+    b.table_out.raw_assign(dout, sout)
+
+    np.testing.assert_allclose(got_in_a, b.table_in.get(),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(got_out_a, b.table_out.get(),
+                               rtol=1e-4, atol=1e-6)
+
+
+# ------------------------------------------------------------- AsyncBuffer
+
+def test_async_buffer_order_and_overlap(mv):
+    from multiverso_tpu.util import AsyncBuffer
+
+    calls = []
+
+    def fill():
+        calls.append(len(calls))
+        return len(calls) - 1
+
+    with AsyncBuffer(fill) as buf:
+        assert buf.get() == 0
+        assert buf.get() == 1
+        assert buf.get() == 2
+
+
+def test_timer():
+    from multiverso_tpu.util import Timer
+
+    t = Timer()
+    assert t.elapsed >= 0.0
+    t.stop()
+    e = t.elapsed
+    assert t.elapsed == e
+
+
+def test_w2v_fused_matches_parity_stateful_duplicates(mv):
+    """Momentum (stateful) updater: duplicate rows in a fused batch must be
+    segment-summed before apply, matching the eager path exactly."""
+    mv.init(updater_type="momentum")
+    from multiverso_tpu.apps import SkipGram
+
+    c = np.array([1, 1, 1, 2], np.int32)            # heavy duplication
+    o = np.array([4, 4, 5, 4], np.int32)
+    neg = np.array([[4, 5], [5, 4], [4, 4], [5, 5]], np.int32)
+
+    a = SkipGram(32, 4, negatives=2, seed=9, updater_type="momentum")
+    a.train_batch(c, o, neg)
+
+    b = SkipGram(32, 4, negatives=2, seed=9, updater_type="momentum")
+    step, place = b.make_fused_step()
+    din, sin = b.table_in.raw_value()
+    dout, sout = b.table_out.raw_value()
+    din, sin, dout, sout, _ = step(din, sin, dout, sout,
+                                   place(c), place(o), place(neg))
+    b.table_in.raw_assign(din, sin)
+    b.table_out.raw_assign(dout, sout)
+
+    np.testing.assert_allclose(a.table_in.get(), b.table_in.get(),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(a.table_out.get(), b.table_out.get(),
+                               rtol=1e-4, atol=1e-6)
+    # momentum state must match too
+    np.testing.assert_allclose(
+        np.asarray(a.table_out.raw_value()[1][0]),
+        np.asarray(b.table_out.raw_value()[1][0]), rtol=1e-4, atol=1e-6)
