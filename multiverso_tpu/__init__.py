@@ -18,7 +18,7 @@ Top-level API mirrors the reference Python binding
 
 from __future__ import annotations
 
-from . import config, dashboard
+from . import checkpoint, config, dashboard, io
 from .core import (
     barrier,
     clock,
@@ -82,5 +82,5 @@ __all__ = [
     "Table", "ArrayTable", "MatrixTable", "SparseMatrixTable", "KVTable",
     "create_table", "TableHandler", "ArrayTableHandler", "MatrixTableHandler",
     "AddOption", "GetOption", "get_updater",
-    "config", "dashboard", "Log",
+    "config", "dashboard", "Log", "checkpoint", "io",
 ]

@@ -12,10 +12,12 @@ the TPU-native tables, each with
   TPU-native hot loop that the benchmarks run).
 """
 
+from .lightlda import LightLDA, synthetic_documents
 from .logistic_regression import LogisticRegression, synthetic_classification
 from .word2vec import SkipGram, synthetic_corpus
 
 __all__ = [
     "LogisticRegression", "synthetic_classification",
     "SkipGram", "synthetic_corpus",
+    "LightLDA", "synthetic_documents",
 ]

@@ -59,6 +59,7 @@ class SkipGram:
                  negatives: int = 5,
                  window: int = 5,
                  updater_type: str = "sgd",
+                 name: str = "w2v",
                  seed: int = 0):
         self.vocab_size = int(vocab_size)
         self.dim = int(dim)
@@ -69,11 +70,11 @@ class SkipGram:
         init_in = ((rng.rand(vocab_size, dim) - 0.5) / dim).astype(np.float32)
         self.table_in = MatrixTable(vocab_size, dim, init=init_in,
                                     updater_type=updater_type,
-                                    name="w2v_in",
+                                    name=f"{name}_in",
                                     default_option=self.option)
         self.table_out = MatrixTable(vocab_size, dim,
                                      updater_type=updater_type,
-                                     name="w2v_out",
+                                     name=f"{name}_out",
                                      default_option=self.option)
         self._rng = np.random.RandomState(seed + 1)
         self._grad_fn = jax.jit(jax.grad(

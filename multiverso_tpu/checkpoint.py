@@ -1,0 +1,86 @@
+"""Checkpoint / resume — reference ``ServerTable::Store/Load`` over Streams
+(SURVEY.md §5 "Checkpoint / resume", §2.27).
+
+The reference periodically dumps each server table shard through a Stream
+and reloads it on restart.  Here a checkpoint is one atomic snapshot of
+every registered table (weights + updater state, pulled from device), the
+runtime clock, and optional app extras — written through the ``io`` Stream
+seam so local/remote backends interchange.
+
+Resume follows the reference's shape: the app re-creates its tables (same
+kinds/shapes, same order), then ``restore()`` loads state back into them by
+table name.  Multi-host: only process 0 writes; everyone barriers after.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, Optional
+
+from .core import context as core_context
+from .io import StreamFactory
+from .log import Log
+
+__all__ = ["save", "restore"]
+
+_MAGIC = b"MVTPUCKPT1"
+
+
+def save(uri: str, extra: Optional[Dict[str, Any]] = None) -> None:
+    """Snapshot all registered tables + clock to ``uri`` (one file).
+
+    Only rank 0 materializes and writes the snapshot.  Multi-host note:
+    ``store_state`` device-gets each table; tables sharded across hosts
+    need a cross-host gather first (wire ``multihost_utils.
+    process_allgather`` into ``store_state`` when deploying multi-host —
+    single-controller runs, the only mode testable here, are complete).
+    """
+    ctx = core_context.get_context()
+    if ctx.node.rank == 0:
+        snap = {
+            "clock": ctx.clock,
+            "extra": extra or {},
+            "tables": {t.name: t.store_state() for t in ctx.tables()},
+        }
+        with StreamFactory.open(uri, "wb") as s:
+            s.write(_MAGIC)
+            s.write(pickle.dumps(snap, protocol=4))
+        Log.info("checkpoint saved: %s (%d tables, clock=%d)",
+                 uri, len(snap["tables"]), ctx.clock)
+    ctx.host_sync("mvtpu_checkpoint_save")
+
+
+def restore(uri: str, strict: bool = True) -> Dict[str, Any]:
+    """Load a snapshot into the currently registered tables (matched by
+    name).  Returns the ``extra`` dict stored at save time.
+
+    ``strict=True`` raises if any registered table has no snapshot entry or
+    vice versa (the reference's Load aborts on shard mismatch).
+    """
+    ctx = core_context.get_context()
+    with StreamFactory.open(uri, "rb") as s:
+        magic = s.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError(f"{uri}: not a multiverso_tpu checkpoint")
+        snap = pickle.loads(s.read())
+
+    tables = {t.name: t for t in ctx.tables()}
+    missing = set(tables) - set(snap["tables"])
+    orphaned = set(snap["tables"]) - set(tables)
+    if strict and (missing or orphaned):
+        raise ValueError(
+            f"checkpoint/table mismatch: tables without snapshot entries "
+            f"{sorted(missing)}; snapshot entries without tables "
+            f"{sorted(orphaned)} (re-create tables before restore, or pass "
+            f"strict=False)")
+    for name in set(tables) & set(snap["tables"]):
+        t = tables[name]
+        # Stale pre-restore BSP buffers must not apply on top of restored
+        # weights at the next barrier.
+        t.discard_pending()
+        t.load_state(snap["tables"][name])
+    ctx.clock = int(snap["clock"])
+    ctx.host_sync("mvtpu_checkpoint_restore")
+    Log.info("checkpoint restored: %s (%d tables, clock=%d)",
+             uri, len(snap["tables"]), ctx.clock)
+    return snap["extra"]

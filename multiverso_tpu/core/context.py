@@ -96,6 +96,10 @@ class Context:
             self._tables[tid] = table
             return tid
 
+    def unregister_table(self, table_id: int) -> None:
+        with self._lock:
+            self._tables.pop(table_id, None)
+
     def table(self, table_id: int) -> Any:
         return self._tables[table_id]
 
@@ -103,13 +107,20 @@ class Context:
         return list(self._tables.values())
 
     # -- barrier / clock ----------------------------------------------------
+    def host_sync(self, name: str) -> None:
+        """Cross-host rendezvous WITHOUT the BSP clock tick / flush.
+
+        For control-plane sync points (checkpointing) that must not apply
+        pending sync-mode adds or advance the training clock.
+        """
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+
     def barrier(self, name: Optional[str] = None) -> None:
         with dashboard.monitor("Zoo::Barrier"):
-            if jax.process_count() > 1:
-                from jax.experimental import multihost_utils
-
-                multihost_utils.sync_global_devices(
-                    name or f"mvtpu_barrier_{self.clock}")
+            self.host_sync(name or f"mvtpu_barrier_{self.clock}")
             self.clock += 1
             for t in self.tables():
                 flush = getattr(t, "flush", None)

@@ -1,0 +1,17 @@
+"""Framework integration extensions.
+
+Parity targets (SURVEY.md §2.30–2.33): the reference's Theano
+``sharedvar``/Lasagne ``MVNetParamManager`` Python extensions and the
+Lua/Torch binding — thin layers that put an existing model's parameters
+behind one table and sync them per step.  Here:
+
+- ``jax_ext`` — shared variables / pytree param manager for JAX models
+  (flax/haiku/pure pytrees) — the ``multiverso.jax`` binding from
+  BASELINE.json's north star.
+- ``torch_ext`` — the same manager for ``torch.nn.Module`` (CPU torch is in
+  the image), replacing the reference's Lua/Torch FFI binding.
+"""
+
+from .jax_ext import MVSharedVariable, SharedParamManager, mv_shared
+
+__all__ = ["mv_shared", "MVSharedVariable", "SharedParamManager"]

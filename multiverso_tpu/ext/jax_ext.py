@@ -1,0 +1,136 @@
+"""JAX shared variables + pytree param manager.
+
+Reference (SURVEY.md §2.30–2.31): ``theano_ext/sharedvar.py`` wraps a
+Theano shared variable over an ArrayTable — the worker trains locally, then
+``mv_sync()`` pushes ``value - last_synced`` and pulls the merged value;
+``lasagne_ext/param_manager.py`` (``MVNetParamManager``) does the same for
+every parameter of a network through ONE table.
+
+TPU-native: the same delta-sync protocol over any JAX pytree.  This is the
+``multiverso.jax`` binding named in BASELINE.json's north star; it makes an
+existing single-device training script data-parallel across hosts with two
+calls (wrap params, sync per step).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import context as core_context
+from ..tables import ArrayTable
+from ..updaters import AddOption
+
+__all__ = ["mv_shared", "MVSharedVariable", "SharedParamManager",
+           "sync_all_mv_shared_vars"]
+
+_ALL_SHARED: List["MVSharedVariable"] = []
+_ALL_LOCK = threading.Lock()
+
+
+class MVSharedVariable:
+    """One array behind an ArrayTable with delta-sync (ref ``mv_shared``).
+
+    Protocol (reference ``MVSharedVariable.mv_sync``): push
+    ``(value - last_synced) / workers`` as the worker's contribution, pull
+    the merged global value, overwrite the local copy.  Division by the
+    worker count makes N identical workers converge to the same average
+    the reference's example scripts get.
+    """
+
+    def __init__(self, value, name: Optional[str] = None,
+                 average: bool = True):
+        arr = np.asarray(value, dtype=np.float32)
+        self.shape = arr.shape
+        self._average = average
+        self.table = ArrayTable(arr.size, init=arr.ravel(),
+                                updater_type="default", name=name)
+        self._value = arr.copy()
+        self._synced = arr.copy()
+        with _ALL_LOCK:
+            _ALL_SHARED.append(self)
+
+    def get_value(self) -> np.ndarray:
+        return self._value.copy()
+
+    def set_value(self, value) -> None:
+        self._value = np.asarray(value, dtype=np.float32).reshape(self.shape)
+
+    def mv_sync(self) -> np.ndarray:
+        """Push local delta, pull merged value (reference protocol)."""
+        scale = (1.0 / core_context.workers_num()) if self._average else 1.0
+        delta = (self._value - self._synced).ravel() * scale
+        self.table.add(delta)
+        merged = self.table.get().reshape(self.shape)
+        self._value = merged.copy()
+        self._synced = merged.copy()
+        return merged
+
+
+def mv_shared(value, name: Optional[str] = None,
+              average: bool = True) -> MVSharedVariable:
+    """Reference ``sharedvar.mv_shared`` constructor."""
+    return MVSharedVariable(value, name=name, average=average)
+
+
+def sync_all_mv_shared_vars() -> None:
+    """Sync every shared variable (reference helper of the same name).
+
+    Variables created under an earlier (shut-down) runtime are pruned —
+    their tables died with that context.
+    """
+    live = core_context._CONTEXT
+    with _ALL_LOCK:
+        _ALL_SHARED[:] = [s for s in _ALL_SHARED if s.table._ctx is live]
+        shared = list(_ALL_SHARED)
+    for s in shared:
+        s.mv_sync()
+
+
+class SharedParamManager:
+    """Whole-pytree manager (reference ``MVNetParamManager``; §2.31).
+
+    Flattens any JAX pytree (flax/haiku params, optax state, plain dicts)
+    into ONE ArrayTable and delta-syncs it per step:
+
+        mgr = SharedParamManager(params)
+        ...
+        params = mgr.sync(params)   # push local progress, pull merged
+    """
+
+    def __init__(self, params: Any, name: Optional[str] = None,
+                 average: bool = True):
+        leaves, self._treedef = jax.tree_util.tree_flatten(params)
+        self._shapes = [np.asarray(l).shape for l in leaves]
+        self._sizes = [int(np.prod(s)) if s else 1 for s in self._shapes]
+        self._average = average
+        flat = np.concatenate(
+            [np.asarray(l, np.float32).ravel() for l in leaves])
+        self.table = ArrayTable(flat.size, init=flat,
+                                updater_type="default", name=name)
+        self._synced = flat.copy()
+
+    def _flatten(self, params: Any) -> np.ndarray:
+        leaves = jax.tree_util.tree_leaves(params)
+        return np.concatenate(
+            [np.asarray(l, np.float32).ravel() for l in leaves])
+
+    def _unflatten(self, flat: np.ndarray) -> Any:
+        out, ofs = [], 0
+        for shape, size in zip(self._shapes, self._sizes):
+            out.append(jnp.asarray(flat[ofs:ofs + size].reshape(shape)))
+            ofs += size
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def sync(self, params: Any) -> Any:
+        """Push ``(params - last_synced)/workers``, pull the merged pytree."""
+        flat = self._flatten(params)
+        scale = (1.0 / core_context.workers_num()) if self._average else 1.0
+        self.table.add((flat - self._synced) * scale)
+        merged = self.table.get()
+        self._synced = merged.copy()
+        return self._unflatten(merged)

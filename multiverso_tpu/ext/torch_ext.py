@@ -1,0 +1,74 @@
+"""Torch integration — parity with the reference's Lua/Torch binding.
+
+Reference (SURVEY.md §2.33, ``binding/lua/``): an FFI mirror of the Python
+binding whose documented flagship is data-parallel ResNet-20/CIFAR-10 via
+``fb.resnet.torch`` — every worker trains locally, parameters sync through
+an ArrayTable each iteration.
+
+TPU-native: torch (CPU build in this image) drives local compute; the
+parameter store and cross-worker merge run through the same TPU tables as
+everything else.  ``TorchParamManager`` flattens a ``torch.nn.Module``'s
+parameters into ONE ArrayTable and delta-syncs per step — the exact
+protocol of the Lua ``MVNetParamManager`` usage shown in the reference
+docs.  Import is lazy/gated so environments without torch still load the
+package.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import context as core_context
+from ..tables import ArrayTable
+
+__all__ = ["TorchParamManager"]
+
+
+class TorchParamManager:
+    """Sync a ``torch.nn.Module``'s parameters through one ArrayTable."""
+
+    def __init__(self, module, name: Optional[str] = None,
+                 average: bool = True):
+        import torch  # lazy: keep the package importable without torch
+
+        self._torch = torch
+        self.module = module
+        self._average = average
+        with torch.no_grad():
+            flat = np.concatenate(
+                [p.detach().cpu().numpy().astype(np.float32).ravel()
+                 for p in module.parameters()])
+        self.table = ArrayTable(flat.size, init=flat,
+                                updater_type="default", name=name)
+        self._synced = flat.copy()
+
+    def _flatten(self) -> np.ndarray:
+        with self._torch.no_grad():
+            return np.concatenate(
+                [p.detach().cpu().numpy().astype(np.float32).ravel()
+                 for p in self.module.parameters()])
+
+    def _write_back(self, flat: np.ndarray) -> None:
+        ofs = 0
+        with self._torch.no_grad():
+            for p in self.module.parameters():
+                n = p.numel()
+                chunk = flat[ofs:ofs + n].reshape(tuple(p.shape))
+                p.copy_(self._torch.from_numpy(chunk.copy()))
+                ofs += n
+
+    def sync_all_param(self) -> None:
+        """Push local progress, pull merged params into the module.
+
+        Reference protocol (Lua binding docs): each worker contributes
+        ``(local - last_synced) / workers``; the merged value overwrites the
+        module's parameters in place.
+        """
+        flat = self._flatten()
+        scale = (1.0 / core_context.workers_num()) if self._average else 1.0
+        self.table.add((flat - self._synced) * scale)
+        merged = self.table.get()
+        self._synced = merged.copy()
+        self._write_back(merged)

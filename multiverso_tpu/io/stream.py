@@ -1,0 +1,120 @@
+"""Stream abstraction — reference ``io/io.h`` (`Stream`, `StreamFactory`,
+`LocalStream`, `HDFSStream`; SURVEY.md §2.27)."""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import BinaryIO, Optional
+
+__all__ = ["Stream", "LocalStream", "HDFSStream", "StreamFactory"]
+
+
+class Stream:
+    """Sequential byte stream with the reference's Read/Write surface."""
+
+    def write(self, data: bytes) -> int:
+        raise NotImplementedError
+
+    def read(self, size: int = -1) -> bytes:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # Python file-object compat so numpy/np.savez can write through us.
+    def readable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return False
+
+    def __enter__(self) -> "Stream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class LocalStream(Stream):
+    """Local-filesystem stream (reference ``LocalStream``)."""
+
+    def __init__(self, path: str, mode: str = "rb"):
+        if "b" not in mode:
+            mode += "b"
+        parent = os.path.dirname(os.path.abspath(path))
+        if "w" in mode or "a" in mode:
+            os.makedirs(parent, exist_ok=True)
+        self.path = path
+        self._f: BinaryIO = open(path, mode)
+
+    def write(self, data: bytes) -> int:
+        return self._f.write(data)
+
+    def read(self, size: int = -1) -> bytes:
+        return self._f.read(size)
+
+    def seek(self, pos: int, whence: int = 0) -> int:
+        return self._f.seek(pos, whence)
+
+    def tell(self) -> int:
+        return self._f.tell()
+
+    def seekable(self) -> bool:
+        return self._f.seekable()
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+class HDFSStream(Stream):
+    """HDFS stream stub.
+
+    The reference builds this over libhdfs; no Hadoop client exists in this
+    image, so constructing one raises with the integration contract instead
+    of failing obscurely.  Wire a pyarrow/fsspec filesystem here when the
+    deployment has one.
+    """
+
+    def __init__(self, path: str, mode: str = "rb"):
+        raise NotImplementedError(
+            "HDFS streams need a hadoop client (libhdfs / pyarrow.fs / "
+            "fsspec) which this environment does not provide; pass a "
+            "local path or register a custom scheme with StreamFactory")
+
+
+class StreamFactory:
+    """Scheme-dispatched opener (reference ``StreamFactory::GetStream``)."""
+
+    _schemes = {}
+
+    @classmethod
+    def register(cls, scheme: str, ctor) -> None:
+        cls._schemes[scheme] = ctor
+
+    @classmethod
+    def open(cls, uri: str, mode: str = "rb") -> Stream:
+        if "://" in uri:
+            scheme, path = uri.split("://", 1)
+        else:
+            scheme, path = "file", uri
+        ctor = cls._schemes.get(scheme)
+        if ctor is None:
+            raise ValueError(
+                f"unknown stream scheme '{scheme}' "
+                f"(known: {sorted(cls._schemes)})")
+        return ctor(path, mode)
+
+
+StreamFactory.register("file", LocalStream)
+StreamFactory.register("hdfs", HDFSStream)

@@ -94,6 +94,10 @@ class ArrayTable(Table):
         for option, delta in pending.items():
             self._apply_now(delta, option)
 
+    def discard_pending(self) -> None:
+        with self._lock:
+            self._pending = {}
+
     def _apply_now(self, delta: np.ndarray, option: Optional[AddOption]) -> None:
         self._apply_dense_padded(delta, option)
 

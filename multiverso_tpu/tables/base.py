@@ -61,6 +61,15 @@ class Table:
         self.default_option = default_option or AddOption()
         self.table_id = ctx.register_table(self)
         self.name = name or f"{self.kind}_{self.table_id}"
+        # Names key checkpoints; a silent duplicate would drop state on save.
+        for other in ctx.tables():
+            if other is not self and other.name == self.name:
+                # Leave no half-constructed table behind: barrier()/shutdown
+                # iterate the registry and would touch it.
+                ctx.unregister_table(self.table_id)
+                raise ValueError(
+                    f"duplicate table name '{self.name}' (held by another "
+                    f"{other.kind} table); pass a unique name=")
         self._lock = threading.Lock()
         self._dense_cache: dict = {}
 
@@ -94,6 +103,14 @@ class Table:
     # -- BSP clock boundary --------------------------------------------------
     def flush(self) -> None:
         """Apply buffered (sync-mode) adds; called by ``barrier()``."""
+        raise NotImplementedError
+
+    def discard_pending(self) -> None:
+        """Drop buffered (sync-mode) adds without applying them.
+
+        Used by checkpoint restore: deltas buffered before the restore
+        belong to the abandoned timeline.
+        """
         raise NotImplementedError
 
     # -- checkpoint hooks (ServerTable::Store/Load parity) -------------------

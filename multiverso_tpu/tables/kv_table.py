@@ -86,6 +86,10 @@ class KVTable(Table):
                 return
             self._apply_now(ups, option)
 
+    def discard_pending(self) -> None:
+        with self._lock:
+            self._pending = []
+
     def flush(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, []

@@ -144,6 +144,11 @@ class MatrixTable(Table):
         for option, delta in dense.items():
             self._apply_dense_now(delta, option)
 
+    def discard_pending(self) -> None:
+        with self._lock:
+            self._pending_dense = {}
+            self._pending_sparse = []
+
     # ----------------------------------------------------------- internals
     def _apply_dense_now(self, delta: np.ndarray,
                          option: Optional[AddOption]) -> None:

@@ -106,7 +106,7 @@ def test_w2v_fused_matches_parity_single_batch(mv):
     mv.init(updater_type="sgd")
     from multiverso_tpu.apps import SkipGram
 
-    a = SkipGram(vocab_size=32, dim=4, negatives=2, seed=5)
+    a = SkipGram(vocab_size=32, dim=4, negatives=2, seed=5, name="w2v_a")
 
     c = np.array([1, 2, 3, 1], np.int32)
     o = np.array([4, 5, 6, 7], np.int32)
@@ -116,7 +116,7 @@ def test_w2v_fused_matches_parity_single_batch(mv):
     got_out_a = a.table_out.get()
 
     import multiverso_tpu as mv2
-    b = SkipGram(vocab_size=32, dim=4, negatives=2, seed=5)
+    b = SkipGram(vocab_size=32, dim=4, negatives=2, seed=5, name="w2v_b")
     step, place = b.make_fused_step()
     din, sin = b.table_in.raw_value()
     dout, sout = b.table_out.raw_value()
@@ -168,10 +168,10 @@ def test_w2v_fused_matches_parity_stateful_duplicates(mv):
     o = np.array([4, 4, 5, 4], np.int32)
     neg = np.array([[4, 5], [5, 4], [4, 4], [5, 5]], np.int32)
 
-    a = SkipGram(32, 4, negatives=2, seed=9, updater_type="momentum")
+    a = SkipGram(32, 4, negatives=2, seed=9, updater_type="momentum", name="w2v_a")
     a.train_batch(c, o, neg)
 
-    b = SkipGram(32, 4, negatives=2, seed=9, updater_type="momentum")
+    b = SkipGram(32, 4, negatives=2, seed=9, updater_type="momentum", name="w2v_b")
     step, place = b.make_fused_step()
     din, sin = b.table_in.raw_value()
     dout, sout = b.table_out.raw_value()

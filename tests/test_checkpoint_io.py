@@ -1,0 +1,129 @@
+"""Checkpoint/resume + Stream IO tests (reference Test/main.cpp checkpoint
+scenario + io/ streams; SURVEY.md §4, §5)."""
+
+import numpy as np
+import pytest
+
+
+def test_local_stream_roundtrip(tmp_path, mv):
+    from multiverso_tpu.io import LocalStream, StreamFactory
+
+    p = str(tmp_path / "sub" / "x.bin")  # parent dir auto-created
+    with StreamFactory.open(p, "wb") as s:
+        s.write(b"hello multiverso")
+    with StreamFactory.open("file://" + p, "rb") as s:
+        assert s.read() == b"hello multiverso"
+
+
+def test_stream_unknown_scheme(mv):
+    from multiverso_tpu.io import StreamFactory
+
+    with pytest.raises(ValueError, match="unknown stream scheme"):
+        StreamFactory.open("s3://bucket/key")
+
+
+def test_hdfs_stub_raises(mv):
+    from multiverso_tpu.io import StreamFactory
+
+    with pytest.raises(NotImplementedError, match="hadoop"):
+        StreamFactory.open("hdfs://nn/path", "rb")
+
+
+def test_checkpoint_roundtrip_all_table_kinds(tmp_path, mv):
+    mv.init(updater_type="adagrad")
+    a = mv.ArrayTable(32, name="a")
+    m = mv.MatrixTable(16, 4, name="m")
+    s = mv.SparseMatrixTable(16, 4, name="s")
+    k = mv.KVTable(value_shape=(2,), name="k")
+
+    a.add(np.ones(32, np.float32))
+    m.add_rows([1, 5], np.ones((2, 4), np.float32))
+    s.add_rows([2, 3], np.full((2, 4), 2.0, np.float32))
+    k.add({"x": [1.0, 2.0]})
+    want_a, want_m, want_s = a.get(), m.get(), s.get()
+    want_k = k.get(["x"])["x"]
+
+    path = str(tmp_path / "ck.bin")
+    mv.checkpoint.save(path, extra={"step": 7})
+
+    # trash the state, then restore
+    a.add(np.ones(32, np.float32))
+    m.add(np.ones((16, 4), np.float32))
+    extra = mv.checkpoint.restore(path)
+    assert extra == {"step": 7}
+    np.testing.assert_allclose(a.get(), want_a)
+    np.testing.assert_allclose(m.get(), want_m)
+    np.testing.assert_allclose(s.get(), want_s)
+    np.testing.assert_allclose(k.get(["x"])["x"], want_k)
+
+
+def test_checkpoint_restores_updater_state(tmp_path, mv):
+    """AdaGrad accumulator must survive the round trip — resumed training
+    continues the same trajectory (reference Store/Load dumps state too)."""
+    mv.init(updater_type="adagrad")
+    t = mv.ArrayTable(8, name="t")
+    opt = mv.AddOption(learning_rate=0.1)
+    t.add(np.ones(8, np.float32), option=opt)
+    path = str(tmp_path / "ck.bin")
+    mv.checkpoint.save(path)
+
+    t.add(np.ones(8, np.float32), option=opt)
+    after_two = t.get().copy()
+
+    mv.checkpoint.restore(path)
+    t.add(np.ones(8, np.float32), option=opt)
+    np.testing.assert_allclose(t.get(), after_two, rtol=1e-6)
+
+
+def test_checkpoint_strict_mismatch(tmp_path, mv):
+    mv.init()
+    mv.ArrayTable(8, name="t")
+    path = str(tmp_path / "ck.bin")
+    mv.checkpoint.save(path)
+    mv.ArrayTable(8, name="extra")
+    with pytest.raises(ValueError, match="mismatch"):
+        mv.checkpoint.restore(path)
+    # non-strict loads the intersection
+    mv.checkpoint.restore(path, strict=False)
+
+
+def test_checkpoint_bad_magic(tmp_path, mv):
+    mv.init()
+    path = str(tmp_path / "junk.bin")
+    with open(path, "wb") as f:
+        f.write(b"not a checkpoint")
+    with pytest.raises(ValueError, match="not a multiverso_tpu checkpoint"):
+        mv.checkpoint.restore(path)
+
+
+def test_checkpoint_does_not_flush_pending_bsp(tmp_path, mv):
+    """Saving mid-clock must not apply sync-mode buffered adds."""
+    mv.init(sync=True)
+    t = mv.ArrayTable(4, name="t", updater_type="default")
+    t.add(np.ones(4, np.float32))
+    path = str(tmp_path / "ck.bin")
+    mv.checkpoint.save(path)
+    np.testing.assert_allclose(t.get(), 0.0)   # still buffered
+    mv.barrier()
+    np.testing.assert_allclose(t.get(), 1.0)
+
+
+def test_duplicate_table_name_rejected(mv):
+    mv.init()
+    mv.ArrayTable(4, name="dup")
+    with pytest.raises(ValueError, match="duplicate table name"):
+        mv.MatrixTable(2, 2, name="dup")
+    # failed constructor must not leave a half-built table behind
+    mv.barrier()
+
+
+def test_restore_discards_pending_bsp_adds(tmp_path, mv):
+    """Deltas buffered before a restore belong to the abandoned timeline."""
+    mv.init(sync=True)
+    t = mv.ArrayTable(4, name="t", updater_type="default")
+    path = str(tmp_path / "ck.bin")
+    mv.checkpoint.save(path)
+    t.add(np.ones(4, np.float32))       # buffered, then abandoned
+    mv.checkpoint.restore(path)
+    mv.barrier()
+    np.testing.assert_allclose(t.get(), 0.0)
