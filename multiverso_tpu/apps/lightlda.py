@@ -21,8 +21,7 @@ TPU-native: the same AD-LDA math, two execution paths:
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +29,6 @@ import numpy as np
 
 from ..core import context as core_context
 from ..tables import ArrayTable, SparseMatrixTable
-from ..updaters import AddOption
 
 __all__ = ["LightLDA", "synthetic_documents"]
 
@@ -71,12 +69,15 @@ class LightLDA:
         self.K = int(num_topics)
         self.alpha = float(alpha)
         self.beta = float(beta)
-        # Counts use the plain-add updater regardless of the runtime default
-        # — LDA pushes count deltas, not gradients.
+        # Plain-add updater and ASP pinned regardless of runtime defaults:
+        # LDA pushes count deltas (not gradients) and the AD-LDA scheme
+        # requires async Adds visible to the next sweep.
         self.word_topic = SparseMatrixTable(self.V, self.K,
                                             updater_type="default",
+                                            sync=False,
                                             name=f"{name}_word_topic")
         self.topic_sum = ArrayTable(self.K, updater_type="default",
+                                    sync=False,
                                     name=f"{name}_topic_sum")
         self._key = jax.random.PRNGKey(seed)
         self._fused_cache = {}
@@ -154,7 +155,9 @@ class LightLDA:
         (AD-LDA staleness, same approximation the reference's async Add
         makes across workers).  Returns
         ``pass_fn(wt, ts, docs, z, doc_topic, key) ->
-        (z', doc_topic', wt_delta_rows...)`` wired through ``run_fused_pass``.
+        (z', doc_topic', topic_sum_delta)`` wired through
+        ``run_fused_pass`` (which rebuilds the sparse word-topic deltas
+        host-side from ``z``/``z'``).
         """
         cached = self._fused_cache.get((max_len, batch_axis))
         if cached is not None:
@@ -178,13 +181,14 @@ class LightLDA:
                       - jnp.log(jnp.maximum(ts_tok + V * beta, 1e-30)))
             new_z = jax.random.categorical(key, logits, axis=-1)
             new_z = jnp.where(valid, new_z, -1)
-            # deltas: -old +new per token
+            # deltas: -old +new per token; only the [D,K]/[K] reductions
+            # leave the device — the [D,L,K] intermediate fuses away.
             old_oh = own
             new_oh = jax.nn.one_hot(new_z, K, dtype=wt.dtype) * valid[..., None]
-            delta = new_oh - old_oh                          # [D, L, K]
+            delta = new_oh - old_oh
             doc_topic = doc_topic + delta.sum(axis=1)
             ts_delta = delta.sum(axis=(0, 1))
-            return new_z, doc_topic, delta, ts_delta
+            return new_z, doc_topic, ts_delta
 
         self._fused_cache[(max_len, batch_axis)] = (pass_fn, place_f)
         return pass_fn, place_f
@@ -200,15 +204,22 @@ class LightLDA:
         # Doc-dimension arrays shard over the worker axis (data parallelism);
         # the word-topic table stays on its own shards; XLA lays the gathers
         # and the one-hot reductions across ICI.
-        new_z, new_dt, delta, ts_delta = pass_fn(
+        old_z = self._z
+        new_z, new_dt, ts_delta = pass_fn(
             wt_full, ts, place(jnp.asarray(docs)),
-            place(jnp.asarray(self._z)), place(jnp.asarray(doc_topic)), sub)
+            place(jnp.asarray(old_z)), place(jnp.asarray(doc_topic)), sub)
         self._z = np.asarray(new_z)
-        # Scatter word-topic deltas via the table's sparse Add (async path).
+        # Word-topic deltas rebuilt sparsely on host from (old_z, new_z):
+        # [touched_words, K] instead of shipping a dense [D, L, K].
         valid = docs != PAD
-        flat_w = docs[valid]
-        flat_delta = np.asarray(delta)[valid]
-        self.word_topic.add_rows(flat_w, flat_delta)
+        w_flat = docs[valid]
+        old_flat = old_z[valid]
+        new_flat = self._z[valid]
+        touched, inv = np.unique(w_flat, return_inverse=True)
+        agg = np.zeros((touched.size, self.K), np.float32)
+        np.add.at(agg, (inv, old_flat), -1.0)
+        np.add.at(agg, (inv, new_flat), 1.0)
+        self.word_topic.add_rows(touched, agg)
         self.topic_sum.add(np.asarray(ts_delta))
         return np.asarray(new_dt)
 

@@ -23,7 +23,6 @@ import numpy as np
 
 from ..core import context as core_context
 from ..tables import ArrayTable
-from ..updaters import AddOption
 
 __all__ = ["mv_shared", "MVSharedVariable", "SharedParamManager",
            "sync_all_mv_shared_vars"]
@@ -47,8 +46,11 @@ class MVSharedVariable:
         arr = np.asarray(value, dtype=np.float32)
         self.shape = arr.shape
         self._average = average
+        # sync=False pinned: the push-then-pull delta protocol needs adds
+        # visible immediately (ASP), regardless of the runtime's BSP flag.
         self.table = ArrayTable(arr.size, init=arr.ravel(),
-                                updater_type="default", name=name)
+                                updater_type="default", sync=False,
+                                name=name)
         self._value = arr.copy()
         self._synced = arr.copy()
         with _ALL_LOCK:
@@ -110,8 +112,10 @@ class SharedParamManager:
         self._average = average
         flat = np.concatenate(
             [np.asarray(l, np.float32).ravel() for l in leaves])
+        # sync=False: see MVSharedVariable — the protocol is ASP.
         self.table = ArrayTable(flat.size, init=flat,
-                                updater_type="default", name=name)
+                                updater_type="default", sync=False,
+                                name=name)
         self._synced = flat.copy()
 
     def _flatten(self, params: Any) -> np.ndarray:

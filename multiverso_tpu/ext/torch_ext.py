@@ -40,8 +40,10 @@ class TorchParamManager:
             flat = np.concatenate(
                 [p.detach().cpu().numpy().astype(np.float32).ravel()
                  for p in module.parameters()])
+        # sync=False: the delta protocol is ASP (see ext.jax_ext).
         self.table = ArrayTable(flat.size, init=flat,
-                                updater_type="default", name=name)
+                                updater_type="default", sync=False,
+                                name=name)
         self._synced = flat.copy()
 
     def _flatten(self) -> np.ndarray:

@@ -1,0 +1,230 @@
+"""Python binding to the native (C++) host runtime.
+
+The reference's Python binding loads ``libmultiverso.so`` via ctypes
+(SURVEY.md §2.28); this package does the same over the TPU framework's
+native control plane (``native/src``) — a real actor/message runtime
+serving the flat ``MV_*`` C API (SURVEY.md §2.19).
+
+Role in the TPU framework: the JAX tables are the accelerator data path;
+the native runtime is the host control plane + FFI surface, letting non-
+Python frontends (C, C++, Lua-style FFI) keep the Multiverso API.  The
+math (updaters) matches the JAX updaters in float32 so either plane can
+serve a table.
+
+Build on demand with ``ensure_built()`` (g++ + make, few seconds) or
+``make -C multiverso_tpu/native``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ensure_built", "load", "NativeRuntime", "lib_path"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB = os.path.join(_DIR, "build", "libmvtpu.so")
+_lib: Optional[ctypes.CDLL] = None
+
+
+def lib_path() -> str:
+    return _LIB
+
+
+def ensure_built(quiet: bool = True) -> str:
+    """Build libmvtpu.so if missing; returns its path."""
+    if not os.path.exists(_LIB):
+        subprocess.run(
+            ["make", "-C", _DIR, "-j", str(os.cpu_count() or 2),
+             f"{os.path.join('build', 'libmvtpu.so')}"],
+            check=True,
+            stdout=subprocess.DEVNULL if quiet else None,
+            stderr=subprocess.STDOUT if quiet else None)
+    return _LIB
+
+
+def load(build: bool = True) -> ctypes.CDLL:
+    """Load (and memoize) the shared library with typed signatures."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if build:
+        ensure_built()
+    lib = ctypes.CDLL(_LIB)
+
+    c_float_p = ctypes.POINTER(ctypes.c_float)
+    c_int32_p = ctypes.POINTER(ctypes.c_int32)
+
+    lib.MV_Init.argtypes = [ctypes.c_int,
+                            ctypes.POINTER(ctypes.c_char_p)]
+    lib.MV_Init.restype = ctypes.c_int
+    for name in ("MV_ShutDown", "MV_Barrier", "MV_NumWorkers", "MV_WorkerId",
+                 "MV_ServerId"):
+        getattr(lib, name).argtypes = []
+        getattr(lib, name).restype = ctypes.c_int
+    lib.MV_SetFlag.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.MV_SetFlag.restype = ctypes.c_int
+    lib.MV_NewArrayTable.argtypes = [ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_int32)]
+    lib.MV_NewArrayTable.restype = ctypes.c_int
+    for name in ("MV_GetArrayTable", "MV_AddArrayTable",
+                 "MV_AddAsyncArrayTable"):
+        getattr(lib, name).argtypes = [ctypes.c_int32, c_float_p,
+                                       ctypes.c_int64]
+        getattr(lib, name).restype = ctypes.c_int
+    lib.MV_NewMatrixTable.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_int32)]
+    lib.MV_NewMatrixTable.restype = ctypes.c_int
+    for name in ("MV_GetMatrixTableAll", "MV_AddMatrixTableAll",
+                 "MV_AddAsyncMatrixTableAll"):
+        getattr(lib, name).argtypes = [ctypes.c_int32, c_float_p,
+                                       ctypes.c_int64]
+        getattr(lib, name).restype = ctypes.c_int
+    lib.MV_GetMatrixTableByRows.argtypes = [
+        ctypes.c_int32, c_float_p, c_int32_p, ctypes.c_int64, ctypes.c_int64]
+    lib.MV_GetMatrixTableByRows.restype = ctypes.c_int
+    for name in ("MV_AddMatrixTableByRows", "MV_AddAsyncMatrixTableByRows"):
+        getattr(lib, name).argtypes = [
+            ctypes.c_int32, c_float_p, c_int32_p, ctypes.c_int64,
+            ctypes.c_int64]
+        getattr(lib, name).restype = ctypes.c_int
+    lib.MV_SetAddOption.argtypes = [ctypes.c_float] * 4
+    lib.MV_SetAddOption.restype = ctypes.c_int
+    lib.MV_StoreTable.argtypes = [ctypes.c_int32, ctypes.c_char_p]
+    lib.MV_StoreTable.restype = ctypes.c_int
+    lib.MV_LoadTable.argtypes = [ctypes.c_int32, ctypes.c_char_p]
+    lib.MV_LoadTable.restype = ctypes.c_int
+    lib.MV_DashboardReport.argtypes = []
+    lib.MV_DashboardReport.restype = ctypes.c_void_p
+    lib.MV_FreeString.argtypes = [ctypes.c_void_p]
+    lib.MV_FreeString.restype = None
+    _lib = lib
+    return lib
+
+
+def _f32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.float32)
+
+
+def _fp(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _ip(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeRuntime:
+    """Numpy-facing wrapper over the MV_* C API."""
+
+    def __init__(self, args: Optional[Sequence[str]] = None,
+                 build: bool = True):
+        self.lib = load(build=build)
+        argv = [a.encode() for a in (args or [])]
+        arr = (ctypes.c_char_p * len(argv))(*argv)
+        if self.lib.MV_Init(len(argv), arr) != 0:
+            raise RuntimeError("MV_Init failed (bad flags?)")
+
+    def shutdown(self) -> None:
+        self.lib.MV_ShutDown()
+
+    def barrier(self) -> None:
+        self._check(self.lib.MV_Barrier(), "MV_Barrier")
+
+    def workers_num(self) -> int:
+        return self.lib.MV_NumWorkers()
+
+    def worker_id(self) -> int:
+        return self.lib.MV_WorkerId()
+
+    def server_id(self) -> int:
+        return self.lib.MV_ServerId()
+
+    def set_add_option(self, learning_rate=0.1, momentum=0.9, rho=0.9,
+                       eps=1e-8) -> None:
+        self.lib.MV_SetAddOption(learning_rate, momentum, rho, eps)
+
+    # ------------------------------------------------------------- arrays
+    def new_array_table(self, size: int) -> int:
+        h = ctypes.c_int32(-1)
+        self._check(self.lib.MV_NewArrayTable(size, ctypes.byref(h)),
+                    "MV_NewArrayTable")
+        return h.value
+
+    def array_get(self, handle: int, size: int) -> np.ndarray:
+        out = np.zeros(size, np.float32)
+        self._check(self.lib.MV_GetArrayTable(handle, _fp(out), size),
+                    "MV_GetArrayTable")
+        return out
+
+    def array_add(self, handle: int, delta, sync: bool = True) -> None:
+        d = _f32(delta)
+        fn = (self.lib.MV_AddArrayTable if sync
+              else self.lib.MV_AddAsyncArrayTable)
+        self._check(fn(handle, _fp(d), d.size), "MV_AddArrayTable")
+
+    # ------------------------------------------------------------ matrices
+    def new_matrix_table(self, rows: int, cols: int) -> int:
+        h = ctypes.c_int32(-1)
+        self._check(self.lib.MV_NewMatrixTable(rows, cols, ctypes.byref(h)),
+                    "MV_NewMatrixTable")
+        return h.value
+
+    def matrix_get_all(self, handle: int, rows: int, cols: int) -> np.ndarray:
+        out = np.zeros(rows * cols, np.float32)
+        self._check(
+            self.lib.MV_GetMatrixTableAll(handle, _fp(out), out.size),
+            "MV_GetMatrixTableAll")
+        return out.reshape(rows, cols)
+
+    def matrix_add_all(self, handle: int, delta, sync: bool = True) -> None:
+        d = _f32(delta).ravel()
+        fn = (self.lib.MV_AddMatrixTableAll if sync
+              else self.lib.MV_AddAsyncMatrixTableAll)
+        self._check(fn(handle, _fp(d), d.size), "MV_AddMatrixTableAll")
+
+    def matrix_get_rows(self, handle: int, row_ids, cols: int) -> np.ndarray:
+        ids = np.ascontiguousarray(row_ids, dtype=np.int32)
+        out = np.zeros(ids.size * cols, np.float32)
+        self._check(
+            self.lib.MV_GetMatrixTableByRows(handle, _fp(out), _ip(ids),
+                                             ids.size, cols),
+            "MV_GetMatrixTableByRows")
+        return out.reshape(ids.size, cols)
+
+    def matrix_add_rows(self, handle: int, row_ids, delta,
+                        sync: bool = True) -> None:
+        ids = np.ascontiguousarray(row_ids, dtype=np.int32)
+        d = _f32(delta)
+        if d.shape[0] != ids.size:
+            raise ValueError("rows/delta shape mismatch")
+        fn = (self.lib.MV_AddMatrixTableByRows if sync
+              else self.lib.MV_AddAsyncMatrixTableByRows)
+        self._check(fn(handle, _fp(d.ravel()), _ip(ids), ids.size,
+                       d.shape[1]),
+                    "MV_AddMatrixTableByRows")
+
+    # ----------------------------------------------------------- checkpoint
+    def store_table(self, handle: int, path: str) -> None:
+        self._check(self.lib.MV_StoreTable(handle, path.encode()),
+                    "MV_StoreTable")
+
+    def load_table(self, handle: int, path: str) -> None:
+        self._check(self.lib.MV_LoadTable(handle, path.encode()),
+                    "MV_LoadTable")
+
+    def dashboard_report(self) -> str:
+        ptr = self.lib.MV_DashboardReport()
+        try:
+            return ctypes.cast(ptr, ctypes.c_char_p).value.decode()
+        finally:
+            self.lib.MV_FreeString(ptr)
+
+    @staticmethod
+    def _check(rc: int, what: str) -> None:
+        if rc != 0:
+            raise RuntimeError(f"{what} failed with rc={rc}")

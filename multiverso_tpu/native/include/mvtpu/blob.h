@@ -1,0 +1,45 @@
+// Blob — ref-counted byte buffer with typed views.
+// Capability parity with the reference's include/multiverso/blob.h
+// (SURVEY.md §2.4): the unit of message payload. Implemented fresh on
+// shared_ptr instead of a hand-rolled refcount.
+#pragma once
+
+#include <cstddef>
+#include <cstring>
+#include <memory>
+#include <vector>
+
+namespace mvtpu {
+
+class Blob {
+ public:
+  Blob() = default;
+  explicit Blob(size_t size) : data_(std::make_shared<std::vector<char>>(size)) {}
+  Blob(const void* src, size_t size)
+      : data_(std::make_shared<std::vector<char>>(size)) {
+    std::memcpy(data_->data(), src, size);
+  }
+
+  size_t size() const { return data_ ? data_->size() : 0; }
+  char* data() { return data_ ? data_->data() : nullptr; }
+  const char* data() const { return data_ ? data_->data() : nullptr; }
+
+  template <typename T>
+  T* As() { return reinterpret_cast<T*>(data()); }
+  template <typename T>
+  const T* As() const { return reinterpret_cast<const T*>(data()); }
+  template <typename T>
+  size_t count() const { return size() / sizeof(T); }
+
+  // Shallow copy shares the buffer (the reference Blob's refcount
+  // semantics); CopyFrom deep-copies.
+  void CopyFrom(const Blob& other) {
+    data_ = std::make_shared<std::vector<char>>(
+        other.data(), other.data() + other.size());
+  }
+
+ private:
+  std::shared_ptr<std::vector<char>> data_;
+};
+
+}  // namespace mvtpu

@@ -1,0 +1,57 @@
+// Flat extern-C surface for FFI bindings.
+// Capability parity with include/multiverso/c_api.h (SURVEY.md §2.19):
+// init/shutdown/barrier, ids, array + matrix tables with sync and async
+// Add variants. float32 payloads (the reference's binding-facing type).
+// All functions return 0 on success, negative on error, unless noted.
+#pragma once
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+int MV_Init(int argc, const char* const* argv);
+int MV_ShutDown();
+int MV_Barrier();
+int MV_NumWorkers();
+int MV_WorkerId();
+int MV_ServerId();
+
+// Flags (reference configure surface).
+int MV_SetFlag(const char* name, const char* value);
+
+// Tables. handle := table id (>=0).
+int MV_NewArrayTable(int64_t size, int32_t* handle);
+int MV_GetArrayTable(int32_t handle, float* data, int64_t size);
+int MV_AddArrayTable(int32_t handle, const float* delta, int64_t size);
+int MV_AddAsyncArrayTable(int32_t handle, const float* delta, int64_t size);
+
+int MV_NewMatrixTable(int64_t rows, int64_t cols, int32_t* handle);
+int MV_GetMatrixTableAll(int32_t handle, float* data, int64_t size);
+int MV_AddMatrixTableAll(int32_t handle, const float* delta, int64_t size);
+int MV_AddAsyncMatrixTableAll(int32_t handle, const float* delta, int64_t size);
+int MV_GetMatrixTableByRows(int32_t handle, float* data, const int32_t* row_ids,
+                            int64_t num_rows, int64_t cols);
+int MV_AddMatrixTableByRows(int32_t handle, const float* delta,
+                            const int32_t* row_ids, int64_t num_rows,
+                            int64_t cols);
+int MV_AddAsyncMatrixTableByRows(int32_t handle, const float* delta,
+                                 const int32_t* row_ids, int64_t num_rows,
+                                 int64_t cols);
+
+// Per-call hyper-parameters for subsequent Add* on this thread
+// (reference AddOption-in-message).
+int MV_SetAddOption(float learning_rate, float momentum, float rho, float eps);
+
+// Checkpoint one table to / from a local file.
+int MV_StoreTable(int32_t handle, const char* path);
+int MV_LoadTable(int32_t handle, const char* path);
+
+// Dashboard report as a malloc'd C string; caller frees with MV_FreeString.
+char* MV_DashboardReport();
+void MV_FreeString(char* s);
+
+#ifdef __cplusplus
+}
+#endif

@@ -1,0 +1,36 @@
+// Dashboard — named accumulating monitors (per-op latency counters),
+// dumped at shutdown. Capability parity with include/multiverso/dashboard.h
+// (SURVEY.md §2.26).
+#pragma once
+
+#include <chrono>
+#include <string>
+
+namespace mvtpu {
+
+class Dashboard {
+ public:
+  static void Record(const std::string& name, double seconds);
+  static std::string Report();
+  static void Reset();
+  // count/total for one monitor (testing/introspection).
+  static bool Query(const std::string& name, long long* count, double* total);
+};
+
+// RAII timer: MONITOR-macro equivalent.
+class Monitor {
+ public:
+  explicit Monitor(std::string name)
+      : name_(std::move(name)), start_(std::chrono::steady_clock::now()) {}
+  ~Monitor() {
+    auto dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - start_).count();
+    Dashboard::Record(name_, dt);
+  }
+
+ private:
+  std::string name_;
+  std::chrono::steady_clock::time_point start_;
+};
+
+}  // namespace mvtpu

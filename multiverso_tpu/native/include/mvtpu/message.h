@@ -1,0 +1,42 @@
+// Message — the wire/mailbox unit: routing header + blob payload.
+// Capability parity with include/multiverso/message.h (SURVEY.md §2.4).
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <vector>
+
+#include "mvtpu/blob.h"
+
+namespace mvtpu {
+
+enum class MsgType : int32_t {
+  RequestGet = 1,
+  RequestAdd = 2,
+  ReplyGet = 3,
+  ReplyAdd = 4,
+  ControlRegister = 16,
+  ControlReply = 17,
+  ControlBarrier = 18,
+  ControlBarrierReply = 19,
+  Exit = 64,
+};
+
+struct Message {
+  int32_t src = -1;
+  int32_t dst = -1;
+  MsgType type = MsgType::RequestGet;
+  int32_t table_id = -1;
+  int64_t msg_id = -1;
+  std::vector<Blob> data;
+
+  // Serialize to one contiguous buffer (header + per-blob length prefix) —
+  // the shape a cross-process transport would ship. Exercised by tests and
+  // available to future DCN transports; in-process routing skips it.
+  Blob Serialize() const;
+  static Message Deserialize(const Blob& buf);
+};
+
+using MessagePtr = std::unique_ptr<Message>;
+
+}  // namespace mvtpu

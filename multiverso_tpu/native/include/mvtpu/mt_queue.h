@@ -1,0 +1,62 @@
+// MtQueue — blocking MPMC queue; every actor's mailbox.
+// Capability parity with include/multiverso/util/mt_queue.h (SURVEY.md §2.22).
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <utility>
+
+namespace mvtpu {
+
+template <typename T>
+class MtQueue {
+ public:
+  void Push(T item) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      q_.push_back(std::move(item));
+    }
+    cv_.notify_one();
+  }
+
+  // Blocks until an item arrives or Exit() is called.
+  // Returns false iff exited and drained.
+  bool Pop(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return !q_.empty() || exit_; });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    return true;
+  }
+
+  bool TryPop(T* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    return true;
+  }
+
+  void Exit() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      exit_ = true;
+    }
+    cv_.notify_all();
+  }
+
+  size_t Size() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<T> q_;
+  bool exit_ = false;
+};
+
+}  // namespace mvtpu

@@ -1,0 +1,118 @@
+// Table layer: worker-side stubs + server-side shards.
+// Capability parity with include/multiverso/table_interface.h and
+// include/multiverso/table/ (SURVEY.md §2.10–2.12): ArrayTable (dense 1-D)
+// and MatrixTable (2-D, row-addressable) in float32. The worker stub turns
+// Get/Add into request messages answered by the Server actor; a Waiter
+// blocks the caller until the reply lands — the reference's §3.2/§3.3 hot
+// path, in-process.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include "mvtpu/message.h"
+#include "mvtpu/stream.h"
+#include "mvtpu/updater.h"
+#include "mvtpu/waiter.h"
+
+namespace mvtpu {
+
+// ---------------------------------------------------------------- server
+class ServerTable {
+ public:
+  virtual ~ServerTable() = default;
+  // Fill reply blobs for a get request.
+  virtual void ProcessGet(const Message& req, Message* reply) = 0;
+  virtual void ProcessAdd(const Message& req) = 0;
+  virtual bool Store(Stream* out) const = 0;
+  virtual bool Load(Stream* in) = 0;
+};
+
+class ArrayServerTable : public ServerTable {
+ public:
+  ArrayServerTable(int64_t size, UpdaterType updater);
+  void ProcessGet(const Message& req, Message* reply) override;
+  void ProcessAdd(const Message& req) override;
+  bool Store(Stream* out) const override;
+  bool Load(Stream* in) override;
+  int64_t size() const { return static_cast<int64_t>(data_.size()); }
+
+ private:
+  std::vector<float> data_;
+  std::vector<float> slot0_;
+  UpdaterType updater_;
+  std::mutex mu_;
+};
+
+class MatrixServerTable : public ServerTable {
+ public:
+  MatrixServerTable(int64_t rows, int64_t cols, UpdaterType updater);
+  void ProcessGet(const Message& req, Message* reply) override;
+  void ProcessAdd(const Message& req) override;
+  bool Store(Stream* out) const override;
+  bool Load(Stream* in) override;
+  int64_t rows() const { return rows_; }
+  int64_t cols() const { return cols_; }
+
+ private:
+  int64_t rows_, cols_;
+  std::vector<float> data_;   // rows*cols, row-major
+  std::vector<float> slot0_;
+  UpdaterType updater_;
+  std::mutex mu_;
+};
+
+// ---------------------------------------------------------------- worker
+// Blocking stub; one instance per table per process.
+class WorkerTable {
+ public:
+  explicit WorkerTable(int32_t table_id) : table_id_(table_id) {}
+  virtual ~WorkerTable() = default;
+  int32_t table_id() const { return table_id_; }
+
+  // Called by the Worker actor when a reply for msg_id arrives.
+  void Notify(int64_t msg_id, const Message& reply);
+
+ protected:
+  // Send req via the Zoo, block until the reply is consumed by `consume`.
+  void RoundTrip(MessagePtr req,
+                 void (*consume)(void*, const Message&), void* arg);
+
+  int32_t table_id_;
+
+ private:
+  std::mutex mu_;
+  struct Pending {
+    Waiter* waiter;
+    void (*consume)(void*, const Message&);
+    void* arg;
+  };
+  std::unordered_map<int64_t, Pending> pending_;
+};
+
+class ArrayWorkerTable : public WorkerTable {
+ public:
+  using WorkerTable::WorkerTable;
+  void Get(float* data, int64_t size);
+  void Add(const float* delta, int64_t size, const AddOption& opt,
+           bool blocking);
+};
+
+class MatrixWorkerTable : public WorkerTable {
+ public:
+  MatrixWorkerTable(int32_t table_id, int64_t rows, int64_t cols)
+      : WorkerTable(table_id), rows_(rows), cols_(cols) {}
+  void GetAll(float* data);                       // [rows*cols]
+  void GetRows(const int32_t* row_ids, int64_t k, float* data);  // [k*cols]
+  void AddAll(const float* delta, const AddOption& opt, bool blocking);
+  void AddRows(const int32_t* row_ids, int64_t k, const float* delta,
+               const AddOption& opt, bool blocking);
+
+ private:
+  int64_t rows_, cols_;
+};
+
+}  // namespace mvtpu

@@ -1,0 +1,38 @@
+// Waiter — counted latch used to block Get/Add until replies arrive.
+// Capability parity with include/multiverso/util/waiter.h (SURVEY.md §2.23).
+#pragma once
+
+#include <condition_variable>
+#include <mutex>
+
+namespace mvtpu {
+
+class Waiter {
+ public:
+  explicit Waiter(int count = 1) : count_(count) {}
+
+  void Wait() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return count_ <= 0; });
+  }
+
+  void Notify() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      --count_;
+    }
+    cv_.notify_all();
+  }
+
+  void Reset(int count) {
+    std::lock_guard<std::mutex> lk(mu_);
+    count_ = count;
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int count_;
+};
+
+}  // namespace mvtpu

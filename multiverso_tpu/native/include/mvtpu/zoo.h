@@ -1,0 +1,76 @@
+// Zoo — the runtime registry/singleton: owns the actors, routes messages,
+// registers tables, answers barrier.
+// Capability parity with include/multiverso/zoo.h (SURVEY.md §2.2, §3.1).
+//
+// Placement note (TPU-native design): the reference's Zoo also owns the
+// MPI/ZMQ transport between processes. In this framework cross-host data
+// movement is XLA collectives over ICI/DCN (the Python/JAX layer); the
+// native Zoo is the HOST control plane — a real actor runtime running the
+// worker/server/controller message path in-process (the reference's
+// Role::ALL degenerate mode, which is also its test mode), serving the C
+// API for FFI parity.
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "mvtpu/actor.h"
+#include "mvtpu/table.h"
+
+namespace mvtpu {
+
+class Zoo {
+ public:
+  static Zoo* Get();
+
+  // argc/argv parsed through configure; spawns actors; idempotent.
+  bool Start(int argc, const char* const* argv);
+  void Stop();
+  bool started() const { return started_; }
+
+  int rank() const { return 0; }   // single-process control plane
+  int size() const { return 1; }
+  int num_workers() const { return 1; }
+  int worker_id() const { return 0; }
+  int server_id() const { return 0; }
+
+  void Barrier();
+
+  // Deliver to a local actor's mailbox (the communicator's routing).
+  void SendTo(const std::string& actor_name, MessagePtr msg);
+
+  int64_t NextMsgId() { return next_msg_id_.fetch_add(1); }
+
+  // ---- table registry -------------------------------------------------
+  int32_t RegisterArrayTable(int64_t size);
+  int32_t RegisterMatrixTable(int64_t rows, int64_t cols);
+  ServerTable* server_table(int32_t id);
+  WorkerTable* worker_table(int32_t id);
+  ArrayWorkerTable* array_worker(int32_t id);
+  MatrixWorkerTable* matrix_worker(int32_t id);
+
+  UpdaterType updater_type() const { return updater_type_; }
+
+ private:
+  Zoo() = default;
+
+  bool started_ = false;
+  std::mutex mu_;         // lifecycle (Start/Stop)
+  std::mutex tables_mu_;  // table registry — actors query it mid-Stop, so
+                          // it must never be held across a thread join
+  std::atomic<int64_t> next_msg_id_{0};
+  UpdaterType updater_type_ = UpdaterType::kDefault;
+
+  std::unique_ptr<Actor> worker_actor_;
+  std::unique_ptr<Actor> server_actor_;
+  std::unique_ptr<Actor> controller_actor_;
+
+  std::vector<std::unique_ptr<ServerTable>> server_tables_;
+  std::vector<std::unique_ptr<WorkerTable>> worker_tables_;
+};
+
+}  // namespace mvtpu

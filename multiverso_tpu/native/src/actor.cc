@@ -1,0 +1,37 @@
+#include "mvtpu/actor.h"
+
+#include "mvtpu/log.h"
+
+namespace mvtpu {
+
+Actor::~Actor() { Stop(); }
+
+void Actor::Start() {
+  if (running_) return;
+  running_ = true;
+  thread_ = std::thread(&Actor::Main, this);
+}
+
+void Actor::Stop() {
+  if (!running_) return;
+  running_ = false;
+  mailbox_.Exit();
+  if (thread_.joinable()) thread_.join();
+}
+
+void Actor::Main() {
+  MessagePtr msg;
+  while (mailbox_.Pop(&msg)) {
+    if (!msg) continue;
+    if (msg->type == MsgType::Exit) break;
+    auto it = handlers_.find(msg->type);
+    if (it == handlers_.end()) {
+      Log::Error("actor %s: no handler for msg type %d", name_.c_str(),
+                 static_cast<int>(msg->type));
+      continue;
+    }
+    it->second(msg);
+  }
+}
+
+}  // namespace mvtpu

@@ -1,0 +1,178 @@
+#include "mvtpu/c_api.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <stdexcept>
+
+#include "mvtpu/configure.h"
+#include "mvtpu/dashboard.h"
+#include "mvtpu/stream.h"
+#include "mvtpu/zoo.h"
+
+using mvtpu::AddOption;
+using mvtpu::Zoo;
+
+namespace {
+thread_local AddOption g_add_option;
+
+int RequireStarted() { return Zoo::Get()->started() ? 0 : -1; }
+}  // namespace
+
+extern "C" {
+
+int MV_Init(int argc, const char* const* argv) {
+  return Zoo::Get()->Start(argc, argv) ? 0 : -1;
+}
+
+int MV_ShutDown() {
+  Zoo::Get()->Stop();
+  return 0;
+}
+
+int MV_Barrier() {
+  if (RequireStarted()) return -1;
+  Zoo::Get()->Barrier();
+  return 0;
+}
+
+int MV_NumWorkers() { return Zoo::Get()->num_workers(); }
+int MV_WorkerId() { return Zoo::Get()->worker_id(); }
+int MV_ServerId() { return Zoo::Get()->server_id(); }
+
+int MV_SetFlag(const char* name, const char* value) {
+  mvtpu::configure::RegisterDefaults();
+  try {
+    mvtpu::configure::Set(name, value);
+  } catch (const std::invalid_argument&) {
+    return -1;
+  }
+  return 0;
+}
+
+int MV_NewArrayTable(int64_t size, int32_t* handle) {
+  if (RequireStarted() || size <= 0 || !handle) return -1;
+  *handle = Zoo::Get()->RegisterArrayTable(size);
+  return 0;
+}
+
+int MV_GetArrayTable(int32_t handle, float* data, int64_t size) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->array_worker(handle);
+  if (!t) return -2;
+  t->Get(data, size);
+  return 0;
+}
+
+static int AddArray(int32_t handle, const float* delta, int64_t size,
+                    bool blocking) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->array_worker(handle);
+  if (!t) return -2;
+  t->Add(delta, size, g_add_option, blocking);
+  return 0;
+}
+
+int MV_AddArrayTable(int32_t h, const float* d, int64_t n) {
+  return AddArray(h, d, n, true);
+}
+int MV_AddAsyncArrayTable(int32_t h, const float* d, int64_t n) {
+  return AddArray(h, d, n, false);
+}
+
+int MV_NewMatrixTable(int64_t rows, int64_t cols, int32_t* handle) {
+  if (RequireStarted() || rows <= 0 || cols <= 0 || !handle) return -1;
+  *handle = Zoo::Get()->RegisterMatrixTable(rows, cols);
+  return 0;
+}
+
+int MV_GetMatrixTableAll(int32_t handle, float* data, int64_t size) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->matrix_worker(handle);
+  if (!t) return -2;
+  t->GetAll(data);
+  return 0;
+}
+
+static int AddMatrixAll(int32_t handle, const float* delta, bool blocking) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->matrix_worker(handle);
+  if (!t) return -2;
+  t->AddAll(delta, g_add_option, blocking);
+  return 0;
+}
+
+int MV_AddMatrixTableAll(int32_t h, const float* d, int64_t) {
+  return AddMatrixAll(h, d, true);
+}
+int MV_AddAsyncMatrixTableAll(int32_t h, const float* d, int64_t) {
+  return AddMatrixAll(h, d, false);
+}
+
+int MV_GetMatrixTableByRows(int32_t handle, float* data,
+                            const int32_t* row_ids, int64_t num_rows,
+                            int64_t /*cols*/) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->matrix_worker(handle);
+  if (!t) return -2;
+  t->GetRows(row_ids, num_rows, data);
+  return 0;
+}
+
+static int AddMatrixRows(int32_t handle, const float* delta,
+                         const int32_t* row_ids, int64_t num_rows,
+                         bool blocking) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->matrix_worker(handle);
+  if (!t) return -2;
+  t->AddRows(row_ids, num_rows, delta, g_add_option, blocking);
+  return 0;
+}
+
+int MV_AddMatrixTableByRows(int32_t h, const float* d, const int32_t* ids,
+                            int64_t k, int64_t) {
+  return AddMatrixRows(h, d, ids, k, true);
+}
+int MV_AddAsyncMatrixTableByRows(int32_t h, const float* d, const int32_t* ids,
+                                 int64_t k, int64_t) {
+  return AddMatrixRows(h, d, ids, k, false);
+}
+
+int MV_SetAddOption(float learning_rate, float momentum, float rho,
+                    float eps) {
+  g_add_option.learning_rate = learning_rate;
+  g_add_option.momentum = momentum;
+  g_add_option.rho = rho;
+  g_add_option.eps = eps;
+  return 0;
+}
+
+int MV_StoreTable(int32_t handle, const char* path) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->server_table(handle);
+  if (!t) return -2;
+  Zoo::Get()->Barrier();  // flush pending adds first
+  auto s = mvtpu::StreamFactory::Open(path, "wb");
+  if (!s) return -3;
+  return t->Store(s.get()) ? 0 : -4;
+}
+
+int MV_LoadTable(int32_t handle, const char* path) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->server_table(handle);
+  if (!t) return -2;
+  Zoo::Get()->Barrier();
+  auto s = mvtpu::StreamFactory::Open(path, "rb");
+  if (!s) return -3;
+  return t->Load(s.get()) ? 0 : -4;
+}
+
+char* MV_DashboardReport() {
+  std::string r = mvtpu::Dashboard::Report();
+  char* out = static_cast<char*>(malloc(r.size() + 1));
+  std::memcpy(out, r.c_str(), r.size() + 1);
+  return out;
+}
+
+void MV_FreeString(char* s) { free(s); }
+
+}  // extern "C"

@@ -1,0 +1,146 @@
+#include "mvtpu/configure.h"
+
+#include <map>
+#include <mutex>
+#include <stdexcept>
+
+namespace mvtpu {
+namespace configure {
+
+namespace {
+
+enum class Kind { kBool, kInt, kDouble, kString };
+
+struct Flag {
+  Kind kind;
+  std::string value;
+  std::string dflt;
+  std::string help;
+};
+
+std::map<std::string, Flag>& Registry() {
+  static std::map<std::string, Flag> r;
+  return r;
+}
+std::mutex g_mu;
+
+void Define(const std::string& name, Kind kind, const std::string& dflt,
+            const std::string& help) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Registry()[name] = Flag{kind, dflt, dflt, help};
+}
+
+Flag& Find(const std::string& name) {
+  auto it = Registry().find(name);
+  if (it == Registry().end())
+    throw std::invalid_argument("unknown flag: " + name);
+  return it->second;
+}
+
+void Validate(Kind kind, const std::string& value) {
+  size_t pos = 0;
+  switch (kind) {
+    case Kind::kBool:
+      if (value != "true" && value != "false" && value != "1" && value != "0")
+        throw std::invalid_argument("bad bool: " + value);
+      break;
+    case Kind::kInt:
+      (void)std::stoll(value, &pos);
+      if (pos != value.size()) throw std::invalid_argument("bad int: " + value);
+      break;
+    case Kind::kDouble:
+      (void)std::stod(value, &pos);
+      if (pos != value.size())
+        throw std::invalid_argument("bad double: " + value);
+      break;
+    case Kind::kString:
+      break;
+  }
+}
+
+}  // namespace
+
+void DefineBool(const std::string& n, bool d, const std::string& h) {
+  Define(n, Kind::kBool, d ? "true" : "false", h);
+}
+void DefineInt(const std::string& n, long long d, const std::string& h) {
+  Define(n, Kind::kInt, std::to_string(d), h);
+}
+void DefineDouble(const std::string& n, double d, const std::string& h) {
+  Define(n, Kind::kDouble, std::to_string(d), h);
+}
+void DefineString(const std::string& n, const std::string& d,
+                  const std::string& h) {
+  Define(n, Kind::kString, d, h);
+}
+
+bool GetBool(const std::string& n) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  const std::string& v = Find(n).value;
+  return v == "true" || v == "1";
+}
+long long GetInt(const std::string& n) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return std::stoll(Find(n).value);
+}
+double GetDouble(const std::string& n) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return std::stod(Find(n).value);
+}
+std::string GetString(const std::string& n) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return Find(n).value;
+}
+
+bool Has(const std::string& n) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return Registry().count(n) > 0;
+}
+
+void Set(const std::string& n, const std::string& value) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Flag& f = Find(n);
+  Validate(f.kind, value);
+  f.value = value;
+}
+
+int ParseCmdFlags(int argc, const char* const* argv) {
+  int parsed = 0;
+  for (int i = 0; i < argc; ++i) {
+    std::string a = argv[i] ? argv[i] : "";
+    if (a.rfind("--", 0) == 0) a = a.substr(2);
+    else if (a.rfind("-", 0) == 0) a = a.substr(1);
+    else continue;  // non-flag argv entries are ignored (reference behavior)
+    auto eq = a.find('=');
+    if (eq == std::string::npos) continue;
+    try {
+      Set(a.substr(0, eq), a.substr(eq + 1));
+      ++parsed;
+    } catch (const std::invalid_argument&) {
+      return -1;
+    }
+  }
+  return parsed;
+}
+
+void Reset() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto& kv : Registry()) kv.second.value = kv.second.dflt;
+}
+
+void RegisterDefaults() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    DefineBool("sync", false, "BSP (true) vs ASP (false) training");
+    DefineString("updater_type", "default",
+                 "default|sgd|adagrad|momentum|smooth_gradient");
+    DefineString("machine_file", "", "host list (transport parity flag)");
+    DefineInt("port", 55555, "base port (transport parity flag)");
+    DefineDouble("backup_worker_ratio", 0.0, "straggler slack (parity flag)");
+    DefineString("log_level", "info", "debug|info|error|fatal");
+    DefineString("log_file", "", "optional log sink path");
+  });
+}
+
+}  // namespace configure
+}  // namespace mvtpu

@@ -1,0 +1,56 @@
+#include "mvtpu/message.h"
+
+#include <cstring>
+
+namespace mvtpu {
+
+namespace {
+struct Header {
+  int32_t src, dst, type, table_id;
+  int64_t msg_id;
+  int32_t num_blobs;
+};
+}  // namespace
+
+Blob Message::Serialize() const {
+  size_t total = sizeof(Header);
+  for (const auto& b : data) total += sizeof(int64_t) + b.size();
+  Blob out(total);
+  char* p = out.data();
+  Header h{src, dst, static_cast<int32_t>(type), table_id, msg_id,
+           static_cast<int32_t>(data.size())};
+  std::memcpy(p, &h, sizeof(h));
+  p += sizeof(h);
+  for (const auto& b : data) {
+    int64_t len = static_cast<int64_t>(b.size());
+    std::memcpy(p, &len, sizeof(len));
+    p += sizeof(len);
+    std::memcpy(p, b.data(), b.size());
+    p += b.size();
+  }
+  return out;
+}
+
+Message Message::Deserialize(const Blob& buf) {
+  Message m;
+  const char* p = buf.data();
+  Header h;
+  std::memcpy(&h, p, sizeof(h));
+  p += sizeof(h);
+  m.src = h.src;
+  m.dst = h.dst;
+  m.type = static_cast<MsgType>(h.type);
+  m.table_id = h.table_id;
+  m.msg_id = h.msg_id;
+  m.data.reserve(h.num_blobs);
+  for (int32_t i = 0; i < h.num_blobs; ++i) {
+    int64_t len;
+    std::memcpy(&len, p, sizeof(len));
+    p += sizeof(len);
+    m.data.emplace_back(p, static_cast<size_t>(len));
+    p += len;
+  }
+  return m;
+}
+
+}  // namespace mvtpu

@@ -1,0 +1,279 @@
+// Wire conventions between worker stubs and server tables (in-process):
+//   Array Get      req: no blobs                 reply: [float data]
+//   Array Add      req: [AddOption][float delta]
+//   Matrix GetAll  req: no blobs                 reply: [float data]
+//   Matrix GetRows req: [int32 ids]              reply: [float rows-packed]
+//   Matrix AddAll  req: [AddOption][float delta]
+//   Matrix AddRows req: [AddOption][int32 ids][float rows-packed]
+// msg_id >= 0 means the caller blocks on a reply; msg_id < 0 is async.
+#include "mvtpu/table.h"
+
+#include <cstring>
+
+#include "mvtpu/dashboard.h"
+#include "mvtpu/log.h"
+#include "mvtpu/zoo.h"
+
+namespace mvtpu {
+
+// ---------------------------------------------------------------- server
+
+ArrayServerTable::ArrayServerTable(int64_t size, UpdaterType updater)
+    : data_(static_cast<size_t>(size), 0.0f), updater_(updater) {
+  if (NumSlots(updater_) > 0) slot0_.assign(data_.size(), 0.0f);
+}
+
+void ArrayServerTable::ProcessGet(const Message& req, Message* reply) {
+  (void)req;
+  Monitor mon("ArrayServer::ProcessGet");
+  std::lock_guard<std::mutex> lk(mu_);
+  reply->data.emplace_back(data_.data(), data_.size() * sizeof(float));
+}
+
+void ArrayServerTable::ProcessAdd(const Message& req) {
+  Monitor mon("ArrayServer::ProcessAdd");
+  const AddOption* opt = req.data[0].As<AddOption>();
+  const float* delta = req.data[1].As<float>();
+  size_t n = req.data[1].count<float>();
+  std::lock_guard<std::mutex> lk(mu_);
+  if (n != data_.size()) {
+    Log::Error("ArrayServerTable: delta size %zu != %zu", n, data_.size());
+    return;
+  }
+  ApplyUpdate(updater_, *opt, data_.data(),
+              slot0_.empty() ? nullptr : slot0_.data(), delta, n);
+}
+
+bool ArrayServerTable::Store(Stream* out) const {
+  int64_t n = size();
+  return out->Write(&n, sizeof(n)) == sizeof(n) &&
+         out->Write(data_.data(), n * sizeof(float)) == n * sizeof(float) &&
+         (slot0_.empty() ||
+          out->Write(slot0_.data(), n * sizeof(float)) == n * sizeof(float));
+}
+
+bool ArrayServerTable::Load(Stream* in) {
+  int64_t n = 0;
+  if (in->Read(&n, sizeof(n)) != sizeof(n) || n != size()) return false;
+  if (in->Read(data_.data(), n * sizeof(float)) !=
+      static_cast<size_t>(n) * sizeof(float))
+    return false;
+  if (!slot0_.empty() &&
+      in->Read(slot0_.data(), n * sizeof(float)) !=
+          static_cast<size_t>(n) * sizeof(float))
+    return false;
+  return true;
+}
+
+MatrixServerTable::MatrixServerTable(int64_t rows, int64_t cols,
+                                     UpdaterType updater)
+    : rows_(rows), cols_(cols),
+      data_(static_cast<size_t>(rows * cols), 0.0f), updater_(updater) {
+  if (NumSlots(updater_) > 0) slot0_.assign(data_.size(), 0.0f);
+}
+
+void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
+  Monitor mon("MatrixServer::ProcessGet");
+  std::lock_guard<std::mutex> lk(mu_);
+  if (req.data.empty()) {  // GetAll
+    reply->data.emplace_back(data_.data(), data_.size() * sizeof(float));
+    return;
+  }
+  const int32_t* ids = req.data[0].As<int32_t>();
+  size_t k = req.data[0].count<int32_t>();
+  Blob out(k * cols_ * sizeof(float));
+  float* dst = out.As<float>();
+  for (size_t i = 0; i < k; ++i) {
+    int64_t r = ids[i];
+    if (r < 0 || r >= rows_) {  // out-of-range rows read as zeros
+      std::memset(dst + i * cols_, 0, cols_ * sizeof(float));
+      continue;
+    }
+    std::memcpy(dst + i * cols_, data_.data() + r * cols_,
+                cols_ * sizeof(float));
+  }
+  reply->data.push_back(std::move(out));
+}
+
+void MatrixServerTable::ProcessAdd(const Message& req) {
+  Monitor mon("MatrixServer::ProcessAdd");
+  const AddOption* opt = req.data[0].As<AddOption>();
+  std::lock_guard<std::mutex> lk(mu_);
+  float* slots = slot0_.empty() ? nullptr : slot0_.data();
+  if (req.data.size() == 2) {  // AddAll
+    const float* delta = req.data[1].As<float>();
+    if (req.data[1].count<float>() != data_.size()) {
+      Log::Error("MatrixServerTable: AddAll size mismatch");
+      return;
+    }
+    ApplyUpdate(updater_, *opt, data_.data(), slots, delta, data_.size());
+    return;
+  }
+  // AddRows: rows applied sequentially — duplicate ids compose like
+  // consecutive reference Adds.
+  const int32_t* ids = req.data[1].As<int32_t>();
+  size_t k = req.data[1].count<int32_t>();
+  const float* delta = req.data[2].As<float>();
+  if (req.data[2].count<float>() != k * static_cast<size_t>(cols_)) {
+    Log::Error("MatrixServerTable: AddRows size mismatch");
+    return;
+  }
+  for (size_t i = 0; i < k; ++i) {
+    int64_t r = ids[i];
+    if (r < 0 || r >= rows_) continue;  // out-of-range rows dropped
+    ApplyUpdate(updater_, *opt, data_.data() + r * cols_,
+                slots ? slots + r * cols_ : nullptr, delta + i * cols_,
+                static_cast<size_t>(cols_));
+  }
+}
+
+bool MatrixServerTable::Store(Stream* out) const {
+  int64_t hdr[2] = {rows_, cols_};
+  size_t bytes = data_.size() * sizeof(float);
+  return out->Write(hdr, sizeof(hdr)) == sizeof(hdr) &&
+         out->Write(data_.data(), bytes) == bytes &&
+         (slot0_.empty() || out->Write(slot0_.data(), bytes) == bytes);
+}
+
+bool MatrixServerTable::Load(Stream* in) {
+  int64_t hdr[2];
+  if (in->Read(hdr, sizeof(hdr)) != sizeof(hdr) || hdr[0] != rows_ ||
+      hdr[1] != cols_)
+    return false;
+  size_t bytes = data_.size() * sizeof(float);
+  if (in->Read(data_.data(), bytes) != bytes) return false;
+  if (!slot0_.empty() && in->Read(slot0_.data(), bytes) != bytes) return false;
+  return true;
+}
+
+// ---------------------------------------------------------------- worker
+
+void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
+  Pending p;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = pending_.find(msg_id);
+    if (it == pending_.end()) {
+      Log::Error("WorkerTable %d: reply for unknown msg %lld", table_id_,
+                 static_cast<long long>(msg_id));
+      return;
+    }
+    p = it->second;
+    pending_.erase(it);
+  }
+  if (p.consume) p.consume(p.arg, reply);
+  p.waiter->Notify();
+}
+
+void WorkerTable::RoundTrip(MessagePtr req,
+                            void (*consume)(void*, const Message&),
+                            void* arg) {
+  Waiter waiter(1);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_[req->msg_id] = Pending{&waiter, consume, arg};
+  }
+  Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+  waiter.Wait();
+}
+
+namespace {
+struct CopyDest {
+  float* dst;
+  size_t count;
+};
+void CopyReply(void* arg, const Message& reply) {
+  auto* d = static_cast<CopyDest*>(arg);
+  size_t n = reply.data.empty() ? 0 : reply.data[0].count<float>();
+  if (n > d->count) n = d->count;
+  if (n) std::memcpy(d->dst, reply.data[0].As<float>(), n * sizeof(float));
+}
+void DiscardReply(void*, const Message&) {}
+}  // namespace
+
+void ArrayWorkerTable::Get(float* data, int64_t size) {
+  Monitor mon("ArrayWorker::Get");
+  auto req = std::make_unique<Message>();
+  req->type = MsgType::RequestGet;
+  req->table_id = table_id_;
+  req->msg_id = Zoo::Get()->NextMsgId();
+  CopyDest d{data, static_cast<size_t>(size)};
+  RoundTrip(std::move(req), CopyReply, &d);
+}
+
+void ArrayWorkerTable::Add(const float* delta, int64_t size,
+                           const AddOption& opt, bool blocking) {
+  Monitor mon("ArrayWorker::Add");
+  auto req = std::make_unique<Message>();
+  req->type = MsgType::RequestAdd;
+  req->table_id = table_id_;
+  req->data.emplace_back(&opt, sizeof(opt));
+  req->data.emplace_back(delta, size * sizeof(float));
+  if (blocking) {
+    req->msg_id = Zoo::Get()->NextMsgId();
+    RoundTrip(std::move(req), DiscardReply, nullptr);
+  } else {
+    req->msg_id = -1;
+    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+  }
+}
+
+void MatrixWorkerTable::GetAll(float* data) {
+  Monitor mon("MatrixWorker::GetAll");
+  auto req = std::make_unique<Message>();
+  req->type = MsgType::RequestGet;
+  req->table_id = table_id_;
+  req->msg_id = Zoo::Get()->NextMsgId();
+  CopyDest d{data, static_cast<size_t>(rows_ * cols_)};
+  RoundTrip(std::move(req), CopyReply, &d);
+}
+
+void MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
+                                float* data) {
+  Monitor mon("MatrixWorker::GetRows");
+  auto req = std::make_unique<Message>();
+  req->type = MsgType::RequestGet;
+  req->table_id = table_id_;
+  req->msg_id = Zoo::Get()->NextMsgId();
+  req->data.emplace_back(row_ids, k * sizeof(int32_t));
+  CopyDest d{data, static_cast<size_t>(k * cols_)};
+  RoundTrip(std::move(req), CopyReply, &d);
+}
+
+void MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,
+                               bool blocking) {
+  Monitor mon("MatrixWorker::AddAll");
+  auto req = std::make_unique<Message>();
+  req->type = MsgType::RequestAdd;
+  req->table_id = table_id_;
+  req->data.emplace_back(&opt, sizeof(opt));
+  req->data.emplace_back(delta, rows_ * cols_ * sizeof(float));
+  if (blocking) {
+    req->msg_id = Zoo::Get()->NextMsgId();
+    RoundTrip(std::move(req), DiscardReply, nullptr);
+  } else {
+    req->msg_id = -1;
+    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+  }
+}
+
+void MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
+                                const float* delta, const AddOption& opt,
+                                bool blocking) {
+  Monitor mon("MatrixWorker::AddRows");
+  auto req = std::make_unique<Message>();
+  req->type = MsgType::RequestAdd;
+  req->table_id = table_id_;
+  req->data.emplace_back(&opt, sizeof(opt));
+  req->data.emplace_back(row_ids, k * sizeof(int32_t));
+  req->data.emplace_back(delta, k * cols_ * sizeof(float));
+  if (blocking) {
+    req->msg_id = Zoo::Get()->NextMsgId();
+    RoundTrip(std::move(req), DiscardReply, nullptr);
+  } else {
+    req->msg_id = -1;
+    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+  }
+}
+
+}  // namespace mvtpu

@@ -1,0 +1,202 @@
+#include "mvtpu/zoo.h"
+
+#include "mvtpu/configure.h"
+#include "mvtpu/dashboard.h"
+#include "mvtpu/log.h"
+#include "mvtpu/waiter.h"
+
+namespace mvtpu {
+
+namespace {
+
+// Barrier messages carry the requester's Waiter through the actor chain
+// worker → server → controller so every request enqueued before the
+// barrier is processed before it completes (the flush guarantee).
+struct BarrierPayload {
+  Waiter* waiter;
+};
+
+class WorkerActor : public Actor {
+ public:
+  WorkerActor() : Actor(actor::kWorker) {
+    RegisterHandler(MsgType::RequestGet, [](MessagePtr& m) {
+      Zoo::Get()->SendTo(actor::kServer, std::move(m));
+    });
+    RegisterHandler(MsgType::RequestAdd, [](MessagePtr& m) {
+      Zoo::Get()->SendTo(actor::kServer, std::move(m));
+    });
+    RegisterHandler(MsgType::ControlBarrier, [](MessagePtr& m) {
+      Zoo::Get()->SendTo(actor::kServer, std::move(m));
+    });
+    RegisterHandler(MsgType::ReplyGet, [](MessagePtr& m) {
+      Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
+    });
+    RegisterHandler(MsgType::ReplyAdd, [](MessagePtr& m) {
+      Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
+    });
+  }
+};
+
+class ServerActor : public Actor {
+ public:
+  ServerActor() : Actor(actor::kServer) {
+    RegisterHandler(MsgType::RequestGet, [](MessagePtr& m) {
+      auto* table = Zoo::Get()->server_table(m->table_id);
+      auto reply = std::make_unique<Message>();
+      reply->type = MsgType::ReplyGet;
+      reply->table_id = m->table_id;
+      reply->msg_id = m->msg_id;
+      table->ProcessGet(*m, reply.get());
+      Zoo::Get()->SendTo(actor::kWorker, std::move(reply));
+    });
+    RegisterHandler(MsgType::RequestAdd, [](MessagePtr& m) {
+      Zoo::Get()->server_table(m->table_id)->ProcessAdd(*m);
+      if (m->msg_id >= 0) {  // blocking add wants an ack
+        auto reply = std::make_unique<Message>();
+        reply->type = MsgType::ReplyAdd;
+        reply->table_id = m->table_id;
+        reply->msg_id = m->msg_id;
+        Zoo::Get()->SendTo(actor::kWorker, std::move(reply));
+      }
+    });
+    RegisterHandler(MsgType::ControlBarrier, [](MessagePtr& m) {
+      Zoo::Get()->SendTo(actor::kController, std::move(m));
+    });
+  }
+};
+
+class ControllerActor : public Actor {
+ public:
+  ControllerActor() : Actor(actor::kController) {
+    RegisterHandler(MsgType::ControlBarrier, [](MessagePtr& m) {
+      // Single-process control plane: all (one) participants arrived.
+      m->data[0].As<BarrierPayload>()->waiter->Notify();
+    });
+  }
+};
+
+}  // namespace
+
+Zoo* Zoo::Get() {
+  static Zoo zoo;
+  return &zoo;
+}
+
+bool Zoo::Start(int argc, const char* const* argv) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (started_) return true;
+  configure::RegisterDefaults();
+  if (configure::ParseCmdFlags(argc, argv) < 0) return false;
+  std::string upd = configure::GetString("updater_type");
+  if (!IsUpdaterName(upd)) {
+    Log::Error("unknown updater_type '%s'", upd.c_str());
+    return false;
+  }
+  updater_type_ = UpdaterFromName(upd);
+  std::string lvl = configure::GetString("log_level");
+  Log::SetLevel(lvl == "debug" ? LogLevel::kDebug
+                : lvl == "error" ? LogLevel::kError
+                : lvl == "fatal" ? LogLevel::kFatal
+                                 : LogLevel::kInfo);
+  Log::ResetLogFile(configure::GetString("log_file"));
+
+  worker_actor_ = std::make_unique<WorkerActor>();
+  server_actor_ = std::make_unique<ServerActor>();
+  controller_actor_ = std::make_unique<ControllerActor>();
+  worker_actor_->Start();
+  server_actor_->Start();
+  controller_actor_->Start();
+  started_ = true;
+  Log::Info("mvtpu native runtime started (updater=%s)", upd.c_str());
+  return true;
+}
+
+void Zoo::Stop() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!started_) return;
+    started_ = false;
+  }
+  // Join OUTSIDE mu_: a draining handler may query the table registry.
+  // Pipeline order so queued async adds apply before teardown.
+  worker_actor_->Stop();
+  server_actor_->Stop();
+  controller_actor_->Stop();
+  std::lock_guard<std::mutex> lk(mu_);
+  worker_actor_.reset();
+  server_actor_.reset();
+  controller_actor_.reset();
+  {
+    std::lock_guard<std::mutex> tlk(tables_mu_);
+    server_tables_.clear();
+    worker_tables_.clear();
+  }
+  Log::Info("%s", Dashboard::Report().c_str());
+}
+
+void Zoo::Barrier() {
+  Monitor mon("Zoo::Barrier");
+  Waiter waiter(1);
+  BarrierPayload payload{&waiter};
+  auto msg = std::make_unique<Message>();
+  msg->type = MsgType::ControlBarrier;
+  msg->msg_id = NextMsgId();
+  msg->data.emplace_back(&payload, sizeof(payload));
+  SendTo(actor::kWorker, std::move(msg));
+  waiter.Wait();
+}
+
+void Zoo::SendTo(const std::string& actor_name, MessagePtr msg) {
+  Actor* a = nullptr;
+  if (actor_name == actor::kWorker) a = worker_actor_.get();
+  else if (actor_name == actor::kServer) a = server_actor_.get();
+  else if (actor_name == actor::kController) a = controller_actor_.get();
+  if (!a) {
+    Log::Error("SendTo: unknown or stopped actor '%s'", actor_name.c_str());
+    return;
+  }
+  a->Receive(std::move(msg));
+}
+
+int32_t Zoo::RegisterArrayTable(int64_t size) {
+  std::lock_guard<std::mutex> lk(tables_mu_);
+  int32_t id = static_cast<int32_t>(server_tables_.size());
+  server_tables_.push_back(
+      std::make_unique<ArrayServerTable>(size, updater_type_));
+  worker_tables_.push_back(std::make_unique<ArrayWorkerTable>(id));
+  return id;
+}
+
+int32_t Zoo::RegisterMatrixTable(int64_t rows, int64_t cols) {
+  std::lock_guard<std::mutex> lk(tables_mu_);
+  int32_t id = static_cast<int32_t>(server_tables_.size());
+  server_tables_.push_back(
+      std::make_unique<MatrixServerTable>(rows, cols, updater_type_));
+  worker_tables_.push_back(
+      std::make_unique<MatrixWorkerTable>(id, rows, cols));
+  return id;
+}
+
+ServerTable* Zoo::server_table(int32_t id) {
+  std::lock_guard<std::mutex> lk(tables_mu_);
+  return (id >= 0 && id < static_cast<int32_t>(server_tables_.size()))
+             ? server_tables_[id].get()
+             : nullptr;
+}
+
+WorkerTable* Zoo::worker_table(int32_t id) {
+  std::lock_guard<std::mutex> lk(tables_mu_);
+  return (id >= 0 && id < static_cast<int32_t>(worker_tables_.size()))
+             ? worker_tables_[id].get()
+             : nullptr;
+}
+
+ArrayWorkerTable* Zoo::array_worker(int32_t id) {
+  return dynamic_cast<ArrayWorkerTable*>(worker_table(id));
+}
+
+MatrixWorkerTable* Zoo::matrix_worker(int32_t id) {
+  return dynamic_cast<MatrixWorkerTable*>(worker_table(id));
+}
+
+}  // namespace mvtpu

@@ -1,0 +1,218 @@
+// Native test driver — reference Test/ parity (SURVEY.md §2.35, §4):
+// named scenarios + unit checks in one binary. Run all: ./mvtpu_test
+// Run one: ./mvtpu_test blob|queue|configure|message|array|matrix|
+//                        updater|checkpoint|threads
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "mvtpu/blob.h"
+#include "mvtpu/c_api.h"
+#include "mvtpu/configure.h"
+#include "mvtpu/message.h"
+#include "mvtpu/mt_queue.h"
+#include "mvtpu/updater.h"
+#include "mvtpu/waiter.h"
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__, __LINE__,   \
+              #cond);                                                      \
+      return 1;                                                            \
+    }                                                                      \
+  } while (0)
+
+static int TestBlob() {
+  mvtpu::Blob b(16);
+  CHECK(b.size() == 16);
+  for (int i = 0; i < 4; ++i) b.As<float>()[i] = i * 1.5f;
+  mvtpu::Blob shared = b;  // shallow
+  shared.As<float>()[0] = 42.0f;
+  CHECK(b.As<float>()[0] == 42.0f);
+  mvtpu::Blob deep;
+  deep.CopyFrom(b);
+  deep.As<float>()[0] = 0.0f;
+  CHECK(b.As<float>()[0] == 42.0f);
+  CHECK(b.count<float>() == 4);
+  return 0;
+}
+
+static int TestQueue() {
+  mvtpu::MtQueue<int> q;
+  const int kN = 1000;
+  std::thread producer([&] {
+    for (int i = 0; i < kN; ++i) q.Push(i);
+  });
+  long long sum = 0;
+  int got = 0, v;
+  while (got < kN && q.Pop(&v)) {
+    sum += v;
+    ++got;
+  }
+  producer.join();
+  CHECK(got == kN);
+  CHECK(sum == (long long)kN * (kN - 1) / 2);
+  q.Exit();
+  CHECK(!q.Pop(&v));
+  return 0;
+}
+
+static int TestConfigure() {
+  namespace cfg = mvtpu::configure;
+  cfg::RegisterDefaults();
+  cfg::Reset();
+  CHECK(cfg::GetBool("sync") == false);
+  const char* argv[] = {"-sync=true", "-updater_type=sgd", "notaflag",
+                        "-port=1234"};
+  CHECK(cfg::ParseCmdFlags(4, argv) == 3);
+  CHECK(cfg::GetBool("sync") == true);
+  CHECK(cfg::GetString("updater_type") == "sgd");
+  CHECK(cfg::GetInt("port") == 1234);
+  const char* bad[] = {"-port=notanint"};
+  CHECK(cfg::ParseCmdFlags(1, bad) == -1);
+  const char* unknown[] = {"-no_such_flag=1"};
+  CHECK(cfg::ParseCmdFlags(1, unknown) == -1);
+  cfg::Reset();
+  CHECK(cfg::GetBool("sync") == false);
+  return 0;
+}
+
+static int TestMessage() {
+  mvtpu::Message m;
+  m.src = 1;
+  m.dst = 2;
+  m.type = mvtpu::MsgType::RequestAdd;
+  m.table_id = 7;
+  m.msg_id = 99;
+  float payload[3] = {1.0f, 2.0f, 3.0f};
+  int32_t rows[2] = {4, 5};
+  m.data.emplace_back(payload, sizeof(payload));
+  m.data.emplace_back(rows, sizeof(rows));
+  mvtpu::Blob wire = m.Serialize();
+  mvtpu::Message back = mvtpu::Message::Deserialize(wire);
+  CHECK(back.src == 1 && back.dst == 2 && back.table_id == 7 &&
+        back.msg_id == 99);
+  CHECK(back.type == mvtpu::MsgType::RequestAdd);
+  CHECK(back.data.size() == 2);
+  CHECK(back.data[0].count<float>() == 3);
+  CHECK(back.data[0].As<float>()[2] == 3.0f);
+  CHECK(back.data[1].As<int32_t>()[1] == 5);
+  return 0;
+}
+
+static int TestUpdater() {
+  using mvtpu::AddOption;
+  using mvtpu::UpdaterType;
+  AddOption opt;
+  opt.learning_rate = 0.5f;
+  float w[2] = {1.0f, 1.0f}, d[2] = {2.0f, 2.0f};
+  mvtpu::ApplyUpdate(UpdaterType::kSGD, opt, w, nullptr, d, 2);
+  CHECK(w[0] == 0.0f);
+  // adagrad twice matches the JAX test: -0.1 - 0.1/sqrt(2)
+  opt.learning_rate = 0.1f;
+  opt.eps = 1e-8f;
+  float w2[1] = {0.0f}, h[1] = {0.0f}, g[1] = {1.0f};
+  mvtpu::ApplyUpdate(UpdaterType::kAdaGrad, opt, w2, h, g, 1);
+  mvtpu::ApplyUpdate(UpdaterType::kAdaGrad, opt, w2, h, g, 1);
+  float expect = -0.1f - 0.1f / sqrtf(2.0f);
+  CHECK(fabsf(w2[0] - expect) < 1e-5f);
+  return 0;
+}
+
+static int TestArray() {
+  const char* argv[] = {"-updater_type=default", "-log_level=error"};
+  CHECK(MV_Init(2, argv) == 0);
+  int32_t h;
+  CHECK(MV_NewArrayTable(64, &h) == 0);
+  std::vector<float> delta(64, 1.0f), out(64, -1.0f);
+  CHECK(MV_AddArrayTable(h, delta.data(), 64) == 0);
+  CHECK(MV_AddAsyncArrayTable(h, delta.data(), 64) == 0);
+  CHECK(MV_Barrier() == 0);  // flushes the async add
+  CHECK(MV_GetArrayTable(h, out.data(), 64) == 0);
+  for (float v : out) CHECK(v == 2.0f);
+  CHECK(MV_NumWorkers() == 1 && MV_WorkerId() == 0 && MV_ServerId() == 0);
+  return 0;
+}
+
+static int TestMatrix() {
+  int32_t h;
+  CHECK(MV_NewMatrixTable(8, 4, &h) == 0);
+  std::vector<float> all(32, 0.5f), out(32, 0.0f);
+  CHECK(MV_AddMatrixTableAll(h, all.data(), 32) == 0);
+  int32_t rows[3] = {1, 3, 1};  // duplicate row composes sequentially
+  std::vector<float> rd(12, 1.0f), rout(8, 0.0f);
+  CHECK(MV_AddMatrixTableByRows(h, rd.data(), rows, 3, 4) == 0);
+  int32_t qrows[2] = {1, 3};
+  CHECK(MV_GetMatrixTableByRows(h, rout.data(), qrows, 2, 4) == 0);
+  for (int c = 0; c < 4; ++c) {
+    CHECK(rout[c] == 2.5f);       // row 1: 0.5 + 1 + 1
+    CHECK(rout[4 + c] == 1.5f);   // row 3: 0.5 + 1
+  }
+  CHECK(MV_GetMatrixTableAll(h, out.data(), 32) == 0);
+  CHECK(out[0] == 0.5f);
+  return 0;
+}
+
+static int TestCheckpoint() {
+  int32_t h;
+  CHECK(MV_NewArrayTable(16, &h) == 0);
+  std::vector<float> delta(16, 3.0f), out(16, 0.0f);
+  CHECK(MV_AddArrayTable(h, delta.data(), 16) == 0);
+  const char* path = "/tmp/mvtpu_native_ck.bin";
+  CHECK(MV_StoreTable(h, path) == 0);
+  CHECK(MV_AddArrayTable(h, delta.data(), 16) == 0);
+  CHECK(MV_LoadTable(h, path) == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 16) == 0);
+  for (float v : out) CHECK(v == 3.0f);
+  return 0;
+}
+
+static int TestThreads() {
+  // Concurrent blocking adds from many app threads — the actor pipeline
+  // must serialize them without loss (reference MtQueue/actor guarantee).
+  int32_t h;
+  CHECK(MV_NewArrayTable(32, &h) == 0);
+  const int kThreads = 8, kAdds = 50;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t)
+    ts.emplace_back([h] {
+      std::vector<float> d(32, 1.0f);
+      for (int i = 0; i < kAdds; ++i) MV_AddArrayTable(h, d.data(), 32);
+    });
+  for (auto& t : ts) t.join();
+  std::vector<float> out(32, 0.0f);
+  CHECK(MV_GetArrayTable(h, out.data(), 32) == 0);
+  for (float v : out) CHECK(v == (float)(kThreads * kAdds));
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  struct Case {
+    const char* name;
+    int (*fn)();
+  };
+  // array must run before the other C-API scenarios (it calls MV_Init).
+  Case cases[] = {
+      {"blob", TestBlob},         {"queue", TestQueue},
+      {"configure", TestConfigure}, {"message", TestMessage},
+      {"updater", TestUpdater},   {"array", TestArray},
+      {"matrix", TestMatrix},     {"checkpoint", TestCheckpoint},
+      {"threads", TestThreads},
+  };
+  int failures = 0;
+  std::string only = argc > 1 ? argv[1] : "";
+  for (const Case& c : cases) {
+    if (!only.empty() && only != c.name) continue;
+    int rc = c.fn();
+    printf("%-12s %s\n", c.name, rc == 0 ? "OK" : "FAILED");
+    failures += rc != 0;
+  }
+  MV_ShutDown();
+  printf(failures ? "FAILURES: %d\n" : "ALL NATIVE TESTS PASSED\n", failures);
+  return failures ? 1 : 0;
+}

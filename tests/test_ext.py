@@ -131,3 +131,14 @@ def test_torch_data_parallel_training_converges(mv):
     for pa, pb in zip(nets[0].parameters(), nets[1].parameters()):
         np.testing.assert_allclose(pa.detach().numpy(), pb.detach().numpy(),
                                    rtol=1e-5)
+
+
+def test_delta_sync_pins_asp_under_bsp_runtime(mv):
+    """mv_shared/param managers must work under a sync=True runtime — their
+    protocol is ASP and their tables pin sync=False."""
+    mv.init(sync=True)
+    from multiverso_tpu.ext import mv_shared
+
+    v = mv_shared(np.zeros(4, np.float32), average=False)
+    v.set_value(np.full(4, 2.0, np.float32))
+    np.testing.assert_allclose(v.mv_sync(), 2.0)  # visible pre-barrier

@@ -63,3 +63,16 @@ def test_lda_fused_recovers_planted_topics(mv):
         dt = lda.run_fused_pass(docs, dt)
     purity = lda.topic_purity(docs, true_topics, dt)
     assert purity > 0.6, purity   # random ≈ 1/K = 0.25
+
+
+def test_lda_works_under_bsp_runtime(mv):
+    """LDA pins async adds; a sync=True runtime must not starve its counts."""
+    mv.init(sync=True)
+    from multiverso_tpu.apps import LightLDA, synthetic_documents
+
+    docs, _ = synthetic_documents(10, 30, 3, doc_len=20, seed=4)
+    lda = LightLDA(30, 3)
+    dt = lda.initialize_counts(docs, seed=4)
+    _counts_consistent(lda, docs, dt)
+    dt = lda.run_fused_pass(docs, dt)
+    _counts_consistent(lda, docs, dt)

@@ -1,0 +1,121 @@
+"""Native (C++) runtime tests through the ctypes binding — the Python side
+of the reference's C-API surface (SURVEY.md §2.19, §2.28) plus a math-parity
+check against the JAX updaters.
+
+The C++ unit tests themselves live in native/test/test_main.cc; the first
+test here runs that binary.
+"""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "multiverso_tpu", "native")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def native():
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    rt = nat.NativeRuntime(args=["-updater_type=default",
+                                 "-log_level=error"])
+    yield rt
+    rt.shutdown()
+
+
+def test_cpp_unit_suite_passes(native):
+    binary = os.path.join(NATIVE_DIR, "build", "mvtpu_test")
+    subprocess.run(["make", "-C", NATIVE_DIR, "-j4", "build/mvtpu_test"],
+                   check=True, capture_output=True)
+    out = subprocess.run([binary], capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ALL NATIVE TESTS PASSED" in out.stdout
+
+
+def test_native_ids(native):
+    assert native.workers_num() == 1
+    assert native.worker_id() == 0
+    assert native.server_id() == 0
+
+
+def test_native_array_roundtrip(native):
+    h = native.new_array_table(32)
+    np.testing.assert_allclose(native.array_get(h, 32), 0.0)
+    native.array_add(h, np.ones(32, np.float32))
+    native.array_add(h, np.full(32, 2.0, np.float32), sync=False)
+    native.barrier()  # flush the async add
+    np.testing.assert_allclose(native.array_get(h, 32), 3.0)
+
+
+def test_native_matrix_rows(native):
+    h = native.new_matrix_table(8, 4)
+    native.matrix_add_rows(h, [1, 3], np.ones((2, 4), np.float32))
+    got = native.matrix_get_rows(h, [1, 2, 3], 4)
+    np.testing.assert_allclose(got[0], 1.0)
+    np.testing.assert_allclose(got[1], 0.0)
+    np.testing.assert_allclose(got[2], 1.0)
+    full = native.matrix_get_all(h, 8, 4)
+    np.testing.assert_allclose(full.sum(), 8.0)
+
+
+def test_native_checkpoint(native, tmp_path):
+    h = native.new_array_table(8)
+    native.array_add(h, np.full(8, 7.0, np.float32))
+    p = str(tmp_path / "t.bin")
+    native.store_table(h, p)
+    native.array_add(h, np.ones(8, np.float32))
+    native.load_table(h, p)
+    np.testing.assert_allclose(native.array_get(h, 8), 7.0)
+
+
+def test_native_bad_handle(native):
+    with pytest.raises(RuntimeError, match="rc=-2"):
+        native.array_get(999, 4)
+
+
+def test_native_dashboard(native):
+    report = native.dashboard_report()
+    assert "Dashboard" in report
+    assert "ArrayWorker::Get" in report
+
+
+def test_native_updater_math_matches_jax(mv):
+    """SGD through the native server == SGD through the JAX table (float32).
+
+    A separate process is needed because the module-scoped runtime above
+    is pinned to the default updater; use a subprocess with -updater_type=sgd.
+    """
+    code = """
+import numpy as np
+from multiverso_tpu import native as nat
+rt = nat.NativeRuntime(args=["-updater_type=sgd", "-log_level=error"])
+rt.set_add_option(learning_rate=0.5)
+h = rt.new_array_table(8)
+rt.array_add(h, np.full(8, 2.0, np.float32))
+out = rt.array_get(h, 8)
+assert np.allclose(out, -1.0), out   # 0 - 0.5*2
+rt.shutdown()
+print("NATIVE_SGD_OK")
+"""
+    out = subprocess.run(
+        ["python", "-c", code], capture_output=True, text=True,
+        cwd=os.path.dirname(NATIVE_DIR.rstrip("/")).rsplit("/", 1)[0] or "/",
+        env={**os.environ, "PYTHONPATH": os.path.dirname(
+            os.path.dirname(NATIVE_DIR))})
+    assert "NATIVE_SGD_OK" in out.stdout, out.stdout + out.stderr
+
+    # identical math through the JAX table
+    mv.init(updater_type="sgd")
+    import multiverso_tpu as m
+    t = m.ArrayTable(8)
+    t.add(np.full(8, 2.0, np.float32),
+          option=m.AddOption(learning_rate=0.5))
+    np.testing.assert_allclose(t.get(), -1.0)
