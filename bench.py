@@ -134,6 +134,28 @@ def bench_add_get(size: int = 16 * 1024 * 1024):
     }
 
 
+def bench_transformer(batch: int = 8, seq: int = 512):
+    """Flagship LM train-step throughput, tokens/sec (bf16 compute)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from multiverso_tpu.models import TransformerConfig, TransformerTrainer
+
+    cfg = TransformerConfig(vocab_size=8192, dim=512, n_layers=4, n_heads=8,
+                            hidden=1408, max_seq=seq)
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    tr = TransformerTrainer(cfg, mesh, updater_type="sgd")
+    toks = np.random.RandomState(0).randint(
+        8192, size=(batch, seq)).astype(np.int32)
+
+    def once():
+        tr.train_step(toks)
+
+    sec = _time_loop(once, warmup=1, iters=3)
+    return {"transformer_tokens_per_sec": batch * seq / sec}
+
+
 def main() -> None:
     import multiverso_tpu as mv
 
@@ -142,6 +164,7 @@ def main() -> None:
     results.update(bench_lr())
     results.update(bench_w2v())
     results.update(bench_add_get())
+    results.update(bench_transformer())
     mv.shutdown()
 
     line = {

@@ -1,0 +1,230 @@
+"""Llama-style decoder-only transformer, TPU-first.
+
+Design (not in the reference — see models/__init__):
+
+- pure functions over a params pytree; everything jits;
+- **bfloat16 compute, float32 params/state** — the MXU-friendly recipe;
+- mesh-aware: batch shards over ``dp``, attention heads + MLP hidden +
+  vocab shard over ``tp`` (GSPMD inserts the collectives), sequence shards
+  over ``sp`` with ring attention (``parallel/ring_attention.py``);
+- updater integration: the train step applies the framework's server-side
+  updaters (SURVEY.md §2.16) per parameter leaf, so a Multiverso user's
+  ``-updater_type`` flag means the same thing here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..updaters import AddOption, get_updater
+from .. import dashboard
+
+__all__ = ["TransformerConfig", "init_params", "transformer_forward",
+           "TransformerTrainer"]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    hidden: int = 1408          # SwiGLU inner dim
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
+    """Float32 master weights, truncated-normal-ish init."""
+    rng = np.random.RandomState(seed)
+
+    def w(*shape, scale=None):
+        scale = scale or (shape[0] ** -0.5)
+        return (scale * rng.randn(*shape)).astype(np.float32)
+
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append({
+            "wq": w(cfg.dim, cfg.dim),
+            "wk": w(cfg.dim, cfg.dim),
+            "wv": w(cfg.dim, cfg.dim),
+            "wo": w(cfg.dim, cfg.dim),
+            "w1": w(cfg.dim, cfg.hidden),   # gate
+            "w3": w(cfg.dim, cfg.hidden),   # up
+            "w2": w(cfg.hidden, cfg.dim),   # down
+            "attn_norm": np.ones(cfg.dim, np.float32),
+            "mlp_norm": np.ones(cfg.dim, np.float32),
+        })
+    return {
+        "embed": w(cfg.vocab_size, cfg.dim, scale=0.02),
+        "out_norm": np.ones(cfg.dim, np.float32),
+        "head": w(cfg.dim, cfg.vocab_size),
+        "layers": layers,
+    }
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
+    """TP layout: attention io dims, MLP hidden, and vocab shard over ``tp``;
+    everything else replicated (dp/sp shard activations, not weights)."""
+    tp = "tp" if "tp" in mesh.shape else None
+
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layer = {
+        "wq": s(None, tp), "wk": s(None, tp), "wv": s(None, tp),
+        "wo": s(tp, None),
+        "w1": s(None, tp), "w3": s(None, tp), "w2": s(tp, None),
+        "attn_norm": s(None), "mlp_norm": s(None),
+    }
+    return {
+        "embed": s(None, None),
+        "out_norm": s(None),
+        "head": s(None, tp),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def _rms_norm(x, gain, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * gain
+
+
+def _rope(x, theta: float):
+    """Rotary embedding over global positions; x [B, H, T, D]."""
+    B, H, T, D = x.shape
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = jnp.arange(T, dtype=jnp.float32)[:, None] * freqs[None, :]  # [T,half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return rot.astype(x.dtype)
+
+
+def transformer_forward(params, tokens, cfg: TransformerConfig,
+                        mesh: Optional[Mesh] = None):
+    """tokens [B, T] int32 → logits [B, T, vocab] (compute dtype)."""
+    from ..parallel.ring_attention import blockwise_attention_local, ring_attention
+
+    dt = cfg.compute_dtype
+    x = params["embed"][tokens].astype(dt)                # [B,T,dim]
+    B, T, _ = x.shape
+    scale = cfg.head_dim ** -0.5
+    use_ring = mesh is not None and int(mesh.shape.get("sp", 1)) > 1
+
+    for lyr in params["layers"]:
+        h = _rms_norm(x, lyr["attn_norm"].astype(dt), cfg.norm_eps)
+        q = (h @ lyr["wq"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = (h @ lyr["wk"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        v = (h @ lyr["wv"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        q = _rope(q.transpose(0, 2, 1, 3), cfg.rope_theta)
+        k = _rope(k.transpose(0, 2, 1, 3), cfg.rope_theta)
+        v = v.transpose(0, 2, 1, 3)
+        if use_ring:
+            o = ring_attention(q, k, v, mesh, axis_name="sp", causal=True,
+                               scale=scale)
+        else:
+            o = blockwise_attention_local(q, k, v, scale, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.dim)
+        x = x + o @ lyr["wo"].astype(dt)
+
+        h = _rms_norm(x, lyr["mlp_norm"].astype(dt), cfg.norm_eps)
+        gated = jax.nn.silu(h @ lyr["w1"].astype(dt)) * (h @ lyr["w3"].astype(dt))
+        x = x + gated @ lyr["w2"].astype(dt)
+
+    x = _rms_norm(x, params["out_norm"].astype(dt), cfg.norm_eps)
+    return x @ params["head"].astype(dt)
+
+
+def lm_loss(params, tokens, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None):
+    """Next-token cross-entropy, mean over all positions (float32)."""
+    logits = transformer_forward(params, tokens, cfg, mesh)[:, :-1]
+    targets = tokens[:, 1:]
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - ll)
+
+
+class TransformerTrainer:
+    """Mesh-parallel LM training through the framework's updaters.
+
+    The parameter pytree is the "table": sharded master weights in float32,
+    updated in place by the same Updater the tables use — the reference's
+    server-side optimizer semantics at transformer scale.
+    """
+
+    def __init__(self, cfg: TransformerConfig, mesh: Mesh,
+                 updater_type: str = "sgd",
+                 option: Optional[AddOption] = None,
+                 seed: int = 0):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.updater = get_updater(updater_type)
+        self.option = option or AddOption(learning_rate=0.1)
+        shardings = param_shardings(cfg, mesh)
+        host = init_params(cfg, seed)
+        self.params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, s), host, shardings,
+            is_leaf=lambda x: isinstance(x, np.ndarray))
+        self.state = jax.tree_util.tree_map(
+            lambda p: tuple(jnp.zeros_like(p)
+                            for _ in range(self.updater.num_slots)),
+            self.params)
+        self._step = None
+        self._eval = None
+
+    def _build_step(self):
+        cfg, mesh, updater, opt = self.cfg, self.mesh, self.updater, self.option
+        from ..parallel.sharding import batch_placer
+        _, place_tokens = batch_placer(mesh, "dp", dtype=jnp.int32)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, state, tokens):
+            loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg,
+                                                      mesh)
+            def apply(p, s, g):
+                new_p, new_s = updater.apply_dense(p, s, g, opt)
+                return new_p, new_s
+
+            flat_p, tree = jax.tree_util.tree_flatten(params)
+            flat_s = tree.flatten_up_to(state)
+            flat_g = tree.flatten_up_to(grads)
+            out = [apply(p, s, g) for p, s, g in zip(flat_p, flat_s, flat_g)]
+            params = jax.tree_util.tree_unflatten(tree, [p for p, _ in out])
+            state = jax.tree_util.tree_unflatten(tree, [s for _, s in out])
+            return params, state, loss
+
+        return step, place_tokens
+
+    def train_step(self, tokens) -> float:
+        if self._step is None:
+            self._step = self._build_step()
+        step, place = self._step
+        with dashboard.monitor("Transformer::train_step"):
+            self.params, self.state, loss = step(self.params, self.state,
+                                                 place(tokens))
+        return float(loss)
+
+    def loss(self, tokens) -> float:
+        if self._eval is None:
+            cfg, mesh = self.cfg, self.mesh
+            self._eval = jax.jit(
+                lambda p, t: lm_loss(p, t, cfg, mesh))
+        return float(self._eval(self.params,
+                                jnp.asarray(tokens, jnp.int32)))

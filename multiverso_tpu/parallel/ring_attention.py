@@ -1,0 +1,124 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference framework predates long-context models and has nothing here
+(SURVEY.md §5 "long-context: does not exist"), but this framework treats
+long-context as first-class: sequences shard over a mesh axis (``sp``) and
+attention runs blockwise, rotating K/V shards around the ring with
+``ppermute`` over ICI while each device accumulates its queries' output
+with an online (streaming) softmax.  Peak memory per device is O(T_local²)
+instead of O(T_global²), and the K/V transfer overlaps compute around the
+ring — the standard TPU recipe for million-token contexts.
+
+Implementation: pure ``shard_map`` + ``lax.fori_loop`` + ``ppermute`` —
+compiler-friendly (static shapes, no data-dependent control flow), no
+Pallas required; XLA overlaps the collective-permute with the block matmuls
+on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_attention", "blockwise_attention_local"]
+
+_NEG = -1e30  # finite mask sentinel: exp(_NEG - m) underflows to exactly 0
+
+
+def _online_block(q, k_blk, v_blk, o, m, l, q_pos, k_pos, scale, causal):
+    """One streaming-softmax accumulation step over a K/V block.
+
+    q [B,H,T,D]; k_blk/v_blk [B,H,Tb,D]; o [B,H,T,D] f32; m,l [B,H,T,1]
+    f32; q_pos [T], k_pos [Tb] are GLOBAL positions for causal masking.
+    The block matmul runs in the compute dtype (MXU); the softmax
+    statistics and the output accumulate in float32 — bf16 accumulation
+    across ring steps would compound rounding error.
+    """
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k_blk).astype(jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]                # [T,Tb]
+        s = jnp.where(mask[None, None], s, _NEG)
+    blk_max = jnp.max(s, axis=-1, keepdims=True)               # [B,H,T,1]
+    new_m = jnp.maximum(m, blk_max)
+    # exp(_NEG - new_m) == 0 for every masked entry once any real score
+    # has been seen; before that the correction factor zeroes the garbage.
+    p = jnp.exp(s - new_m)
+    corr = jnp.exp(m - new_m)
+    l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    o = o * corr + jnp.einsum("bhts,bhsd->bhtd",
+                              p.astype(v_blk.dtype), v_blk
+                              ).astype(jnp.float32)
+    return o, new_m, l
+
+
+def blockwise_attention_local(q, k, v, scale: float, causal: bool = True,
+                              q_offset: int = 0, k_offset: int = 0):
+    """Single-device blockwise attention (the ring's degenerate case)."""
+    B, H, T, D = q.shape
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full((B, H, T, 1), _NEG, jnp.float32)
+    l = jnp.zeros((B, H, T, 1), jnp.float32)
+    q_pos = q_offset + jnp.arange(T)
+    k_pos = k_offset + jnp.arange(k.shape[2])
+    o, m, l = _online_block(q, k, v, o, m, l, q_pos, k_pos, scale, causal)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = True,
+                   batch_axis: Optional[str] = "dp",
+                   head_axis: Optional[str] = "tp",
+                   scale: Optional[float] = None):
+    """Causal self-attention with sequences sharded over ``axis_name``.
+
+    ``q``/``k``/``v``: [B, H, T_global, D] jax.Arrays (sharded or not —
+    shard_map re-lays them: batch over ``batch_axis``, heads over
+    ``head_axis``, sequence over ``axis_name``).  Returns [B, H, T, D]
+    with the same layout.  The streaming softmax accumulates statistics and
+    output in float32 regardless of the compute dtype, so bf16 inputs see
+    only the block-matmul rounding, not compounded per-ring-step error.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    axes = dict(mesh.shape)
+    sp = int(axes.get(axis_name, 1))
+    b_ax = batch_axis if (batch_axis and batch_axis in axes) else None
+    h_ax = head_axis if (head_axis and head_axis in axes) else None
+    spec = P(b_ax, h_ax, axis_name if sp > 1 else None, None)
+
+    if sp == 1 and b_ax is None and h_ax is None:
+        return blockwise_attention_local(q, k, v, scale, causal)
+
+    def local(q_l, k_l, v_l):
+        B, H, T, D = q_l.shape
+        if sp == 1:
+            return blockwise_attention_local(q_l, k_l, v_l, scale, causal)
+        idx = jax.lax.axis_index(axis_name)
+        o = jnp.zeros(q_l.shape, jnp.float32)
+        m = jnp.full((B, H, T, 1), _NEG, jnp.float32)
+        l = jnp.zeros((B, H, T, 1), jnp.float32)
+        q_pos = idx * T + jnp.arange(T)
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+        def body(i, carry):
+            o, m, l, k_blk, v_blk = carry
+            src = (idx - i) % sp          # owner of the current K/V block
+            k_pos = src * T + jnp.arange(T)
+            o, m, l = _online_block(q_l, k_blk, v_blk, o, m, l,
+                                    q_pos, k_pos, scale, causal)
+            # rotate AFTER consuming; the last rotation is harmless and
+            # keeps the loop body uniform (XLA overlaps it with compute)
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            return o, m, l, k_blk, v_blk
+
+        o, m, l, _, _ = jax.lax.fori_loop(0, sp, body, (o, m, l, k_l, v_l))
+        return (o / jnp.maximum(l, 1e-30)).astype(q_l.dtype)
+
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)
