@@ -20,4 +20,5 @@ __all__ = [
     "LogisticRegression", "synthetic_classification",
     "SkipGram", "synthetic_corpus",
     "LightLDA", "synthetic_documents",
+    # torch-dependent (import from .resnet directly): ResNet20DataParallel
 ]

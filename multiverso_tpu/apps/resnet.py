@@ -1,0 +1,138 @@
+"""Data-parallel ResNet-20 / CIFAR-10 — the torch-binding flagship.
+
+Reference (SURVEY.md §2.33, ``binding/lua/`` docs): the Lua/Torch binding's
+documented example is ``fb.resnet.torch`` ResNet-20 on CIFAR-10 made
+data-parallel by syncing parameters through an ArrayTable each iteration.
+
+Here the same app runs on CPU torch (the image's build) through
+``ext.torch_ext.TorchParamManager``: N workers train on disjoint shards
+and delta-sync through one table per step.  CIFAR-10 itself cannot be
+downloaded in this sandbox, so ``synthetic_cifar`` generates CIFAR-shaped
+data with planted class structure; swap in real loaders outside.
+
+Torch is imported lazily — importing this module without torch installed
+raises only when the app is actually constructed.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ..ext.torch_ext import TorchParamManager
+
+__all__ = ["ResNet20DataParallel", "build_resnet20", "synthetic_cifar"]
+
+
+def synthetic_cifar(num_samples: int, num_classes: int = 10, seed: int = 0
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-shaped [N,3,32,32] data with class-dependent channel structure."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(num_classes, size=num_samples).astype(np.int64)
+    x = rng.randn(num_samples, 3, 32, 32).astype(np.float32)
+    # plant a per-class mean pattern so a small net can separate classes
+    patterns = rng.randn(num_classes, 3, 8, 8).astype(np.float32)
+    up = np.kron(patterns, np.ones((1, 1, 4, 4), np.float32))
+    x += 2.0 * up[y]
+    return x, y
+
+
+def build_resnet20(num_classes: int = 10):
+    """ResNet-20 (CIFAR variant: 3 stages x 3 basic blocks, 16/32/64)."""
+    import torch
+    import torch.nn as nn
+
+    class BasicBlock(nn.Module):
+        def __init__(self, cin, cout, stride=1):
+            super().__init__()
+            self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(cout)
+            self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(cout)
+            self.short = (nn.Sequential() if stride == 1 and cin == cout else
+                          nn.Sequential(
+                              nn.Conv2d(cin, cout, 1, stride, bias=False),
+                              nn.BatchNorm2d(cout)))
+            self.relu = nn.ReLU(inplace=True)
+
+        def forward(self, x):
+            out = self.relu(self.bn1(self.conv1(x)))
+            out = self.bn2(self.conv2(out))
+            return self.relu(out + self.short(x))
+
+    def stage(cin, cout, n, stride):
+        blocks: List[nn.Module] = [BasicBlock(cin, cout, stride)]
+        blocks += [BasicBlock(cout, cout) for _ in range(n - 1)]
+        return nn.Sequential(*blocks)
+
+    return nn.Sequential(
+        nn.Conv2d(3, 16, 3, 1, 1, bias=False), nn.BatchNorm2d(16),
+        nn.ReLU(inplace=True),
+        stage(16, 16, 3, 1), stage(16, 32, 3, 2), stage(32, 64, 3, 2),
+        nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(64, num_classes))
+
+
+class ResNet20DataParallel:
+    """N simulated torch workers sharing one parameter table.
+
+    The reference's multi-process layout collapses to in-process workers
+    for the degenerate test mode (SURVEY.md §4); on a real deployment each
+    worker is a host process and the table rides the TPU mesh.
+    """
+
+    def __init__(self, num_workers: int = 2, lr: float = 0.1,
+                 num_classes: int = 10, seed: int = 0):
+        import torch
+
+        torch.manual_seed(seed)
+        self.num_workers = num_workers
+        self.nets = []
+        self.opts = []
+        for _ in range(num_workers):
+            torch.manual_seed(seed)  # identical init across workers
+            net = build_resnet20(num_classes)
+            self.nets.append(net)
+            self.opts.append(torch.optim.SGD(net.parameters(), lr=lr,
+                                             momentum=0.9))
+        self.mgrs = [TorchParamManager(self.nets[0], name="resnet20",
+                                       peers=num_workers)]
+        for net in self.nets[1:]:
+            self.mgrs.append(
+                TorchParamManager(net, table=self.mgrs[0].table,
+                                  peers=num_workers))
+        self.loss_fn = torch.nn.CrossEntropyLoss()
+
+    def train_epoch(self, x: np.ndarray, y: np.ndarray,
+                    batch_size: int = 64) -> float:
+        import torch
+
+        last = 0.0
+        n = x.shape[0]
+        for i in range(0, n - batch_size + 1, batch_size):
+            for wid in range(self.num_workers):
+                # shard the batch across workers
+                xb = torch.from_numpy(
+                    x[i:i + batch_size][wid::self.num_workers])
+                yb = torch.from_numpy(
+                    y[i:i + batch_size][wid::self.num_workers])
+                self.opts[wid].zero_grad()
+                loss = self.loss_fn(self.nets[wid](xb), yb)
+                loss.backward()
+                self.opts[wid].step()
+                last = float(loss)
+            for m in self.mgrs:
+                m.sync_all_param()
+        return last
+
+    def accuracy(self, x: np.ndarray, y: np.ndarray) -> float:
+        import torch
+
+        net = self.nets[0]
+        net.eval()  # BatchNorm must use running stats, not the eval batch
+        try:
+            with torch.no_grad():
+                logits = net(torch.from_numpy(x))
+                return float((logits.argmax(1).numpy() == y).mean())
+        finally:
+            net.train()

@@ -30,21 +30,39 @@ class TorchParamManager:
     """Sync a ``torch.nn.Module``'s parameters through one ArrayTable."""
 
     def __init__(self, module, name: Optional[str] = None,
-                 average: bool = True):
+                 average: bool = True, table: Optional[ArrayTable] = None,
+                 peers: Optional[int] = None):
+        """``table``: share another worker's table (multi-worker-in-process
+        mode, the reference's degenerate test layout) instead of creating
+        one; the module must have the same parameter shapes.  ``peers``:
+        total number of workers contributing to the table — defaults to
+        ``workers_num()`` (host count), which undercounts when several
+        in-process managers share one table, so shared-table users must
+        pass it for true averaging."""
         import torch  # lazy: keep the package importable without torch
 
         self._torch = torch
         self.module = module
         self._average = average
+        self._peers = peers
         with torch.no_grad():
             flat = np.concatenate(
                 [p.detach().cpu().numpy().astype(np.float32).ravel()
                  for p in module.parameters()])
-        # sync=False: the delta protocol is ASP (see ext.jax_ext).
-        self.table = ArrayTable(flat.size, init=flat,
-                                updater_type="default", sync=False,
-                                name=name)
-        self._synced = flat.copy()
+        if table is not None:
+            if table.size != flat.size:
+                raise ValueError(
+                    f"shared table holds {table.size} params, module has "
+                    f"{flat.size}")
+            self.table = table
+            self._synced = table.get().copy()
+            self._write_back(self._synced)  # adopt the shared weights
+        else:
+            # sync=False: the delta protocol is ASP (see ext.jax_ext).
+            self.table = ArrayTable(flat.size, init=flat,
+                                    updater_type="default", sync=False,
+                                    name=name)
+            self._synced = flat.copy()
 
     def _flatten(self) -> np.ndarray:
         with self._torch.no_grad():
@@ -69,7 +87,8 @@ class TorchParamManager:
         module's parameters in place.
         """
         flat = self._flatten()
-        scale = (1.0 / core_context.workers_num()) if self._average else 1.0
+        peers = self._peers or core_context.workers_num()
+        scale = (1.0 / peers) if self._average else 1.0
         self.table.add((flat - self._synced) * scale)
         merged = self.table.get()
         self._synced = merged.copy()

@@ -120,6 +120,10 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
     """tokens [B, T] int32 → logits [B, T, vocab] (compute dtype)."""
     from ..parallel.ring_attention import blockwise_attention_local, ring_attention
 
+    if tokens.shape[1] > cfg.max_seq:
+        raise ValueError(
+            f"sequence length {tokens.shape[1]} exceeds max_seq "
+            f"{cfg.max_seq}")
     dt = cfg.compute_dtype
     x = params["embed"][tokens].astype(dt)                # [B,T,dim]
     B, T, _ = x.shape

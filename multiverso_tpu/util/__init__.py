@@ -1,6 +1,8 @@
 """Cross-cutting utilities (reference ``include/multiverso/util/``)."""
 
 from .async_buffer import AsyncBuffer
+from .net_util import get_host_name, get_local_ips, match_machine_file
 from .timer import Timer
 
-__all__ = ["AsyncBuffer", "Timer"]
+__all__ = ["AsyncBuffer", "Timer", "get_local_ips", "get_host_name",
+           "match_machine_file"]

@@ -142,3 +142,29 @@ def test_delta_sync_pins_asp_under_bsp_runtime(mv):
     v = mv_shared(np.zeros(4, np.float32), average=False)
     v.set_value(np.full(4, 2.0, np.float32))
     np.testing.assert_allclose(v.mv_sync(), 2.0)  # visible pre-barrier
+
+
+def test_resnet20_data_parallel_trains(mv):
+    """ResNet-20/CIFAR-shaped data-parallel run (BASELINE config #4) at toy
+    scale: 2 workers, shared table, accuracy above chance after 2 epochs."""
+    torch = pytest.importorskip("torch")
+    mv.init()
+    from multiverso_tpu.apps.resnet import (ResNet20DataParallel,
+                                            synthetic_cifar)
+
+    x, y = synthetic_cifar(256, num_classes=4, seed=0)
+    app = ResNet20DataParallel(num_workers=2, lr=0.05, num_classes=4)
+    for _ in range(2):
+        app.train_epoch(x, y, batch_size=64)
+    acc = app.accuracy(x[:128], y[:128])
+    assert acc > 0.4, acc   # chance = 0.25
+
+
+def test_torch_param_manager_shared_table_shape_check(mv):
+    torch = pytest.importorskip("torch")
+    mv.init()
+    from multiverso_tpu.ext.torch_ext import TorchParamManager
+
+    a = TorchParamManager(torch.nn.Linear(4, 2), name="shape_a")
+    with pytest.raises(ValueError, match="shared table"):
+        TorchParamManager(torch.nn.Linear(8, 2), table=a.table)
