@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the hot ops.
+
+XLA's fusion covers most of this framework (the tables' gather/scatter
+paths, the updaters), but attention at long sequence length is the op
+worth hand-scheduling: the XLA path materializes the [B,H,T,T] score
+tensor in HBM, while the Pallas kernel streams K/V blocks through VMEM
+with float32 accumulators and never leaves on-chip memory — the flash
+attention recipe, tiled for the MXU.
+"""
+
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

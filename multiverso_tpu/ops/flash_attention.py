@@ -1,0 +1,121 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Causal/full attention with O(T) memory: the grid walks (batch·head,
+q-block, k-block) with the k dimension innermost; per q-block the kernel
+keeps the output accumulator and the streaming-softmax statistics (m, l)
+in VMEM scratch across k-steps, writing the normalized output once on the
+last step.  Score/accumulator math is float32 regardless of input dtype;
+the two matmuls run on the MXU in the input dtype.  Fully-masked causal
+blocks are skipped with ``pl.when`` — the causal schedule does half the
+FLOPs, which the XLA dense path cannot do.
+
+Used by ``parallel.ring_attention.blockwise_attention_local`` on TPU
+backends (each ring step's local block compute); everywhere else the jnp
+fallback runs.  ``interpret=True`` runs the same kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *, scale,
+            causal, block_q, block_k, num_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    def _compute():
+        q = q_ref[0]                                   # [Bq, D]
+        k = k_ref[0]                                   # [Bk, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        m_prev = m_scr[:, 0:1]                          # [Bq, 1]
+        l_prev = l_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, 0:1] = m_new
+        l_scr[:, 0:1] = l_new
+
+    if causal:
+        # A k-block strictly after the q-block contributes nothing — skip
+        # it outright (half the FLOPs on the causal schedule).
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, 0:1], 1e-30)
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, scale: Optional[float] = None,
+                    causal: bool = True,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """q/k/v: [B, H, T, D] (same T for q and k/v) → [B, H, T, D]."""
+    B, H, T, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
+                         f"T={T}")
+    num_q = T // block_q
+    num_k = T // block_k
+    bh = B * H
+    qr = q.reshape(bh, T, D)
+    kr = k.reshape(bh, T, D)
+    vr = v.reshape(bh, T, D)
+
+    kernel = functools.partial(_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               num_k=num_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, T, D)

@@ -17,11 +17,11 @@ on TPU.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -56,10 +56,34 @@ def _online_block(q, k_blk, v_blk, o, m, l, q_pos, k_pos, scale, causal):
     return o, new_m, l
 
 
+def _flash_block(t: int) -> int:
+    """Largest power-of-two block ≤512 dividing t (0 if none ≥64)."""
+    for b in (512, 256, 128, 64):
+        if t % b == 0:
+            return b
+    return 0
+
+
 def blockwise_attention_local(q, k, v, scale: float, causal: bool = True,
                               q_offset: int = 0, k_offset: int = 0):
-    """Single-device blockwise attention (the ring's degenerate case)."""
+    """Single-device attention (the ring's degenerate case).
+
+    On TPU backends with aligned shapes this dispatches to the Pallas
+    flash kernel (``ops/flash_attention.py``) — O(T) memory, causal-block
+    skipping; elsewhere (CPU tests, odd shapes, offset blocks) the jnp
+    streaming-softmax path runs and XLA fuses it.
+    """
+    import os
+
     B, H, T, D = q.shape
+    block = _flash_block(T)
+    if (q_offset == 0 and k_offset == 0 and T == k.shape[2] and block
+            and jax.default_backend() == "tpu"
+            and not os.environ.get("MVTPU_NO_FLASH")):
+        from ..ops import flash_attention
+
+        return flash_attention(q, k, v, scale=scale, causal=causal,
+                               block_q=block, block_k=block)
     o = jnp.zeros(q.shape, jnp.float32)
     m = jnp.full((B, H, T, 1), _NEG, jnp.float32)
     l = jnp.zeros((B, H, T, 1), jnp.float32)
@@ -73,7 +97,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                    causal: bool = True,
                    batch_axis: Optional[str] = "dp",
                    head_axis: Optional[str] = "tp",
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   layout: str = "auto"):
     """Causal self-attention with sequences sharded over ``axis_name``.
 
     ``q``/``k``/``v``: [B, H, T_global, D] jax.Arrays (sharded or not —
@@ -82,6 +107,15 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     with the same layout.  The streaming softmax accumulates statistics and
     output in float32 regardless of the compute dtype, so bf16 inputs see
     only the block-matmul rounding, not compounded per-ring-step error.
+
+    ``layout``: ``"contiguous"`` gives each device one contiguous sequence
+    block — simple, but under causal masking low-rank devices burn most
+    ring steps on fully-masked blocks.  ``"zigzag"`` gives each device the
+    chunk pair (d, 2*sp-1-d), which balances causal work exactly: every
+    non-self ring step computes two fully-unmasked c x c sub-blocks — half
+    the FLOPs of the contiguous schedule — at the cost of one global
+    sequence permutation on the way in and out.  ``"auto"`` picks zigzag
+    for causal attention whenever 2*sp divides T.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -94,7 +128,29 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     if sp == 1 and b_ax is None and h_ax is None:
         return blockwise_attention_local(q, k, v, scale, causal)
 
-    def local(q_l, k_l, v_l):
+    if layout not in ("auto", "zigzag", "contiguous"):
+        raise ValueError(
+            f"unknown layout '{layout}'; expected auto|zigzag|contiguous")
+    T_global = q.shape[2]
+    use_zigzag = (sp > 1 and causal and T_global % (2 * sp) == 0
+                  and layout in ("auto", "zigzag"))
+    if layout == "zigzag" and not use_zigzag:
+        raise ValueError(
+            f"zigzag layout needs sp > 1 (got {sp}), causal=True (got "
+            f"{causal}), and T ({T_global}) divisible by 2*sp ({2 * sp})")
+
+    if use_zigzag:
+        c = T_global // (2 * sp)
+        perm = np.concatenate(
+            [np.r_[d * c:(d + 1) * c,
+                   (2 * sp - 1 - d) * c:(2 * sp - d) * c]
+             for d in range(sp)])
+        inv_perm = np.argsort(perm)
+        q = jnp.take(q, perm, axis=2)
+        k = jnp.take(k, perm, axis=2)
+        v = jnp.take(v, perm, axis=2)
+
+    def local_contiguous(q_l, k_l, v_l):
         B, H, T, D = q_l.shape
         if sp == 1:
             return blockwise_attention_local(q_l, k_l, v_l, scale, causal)
@@ -103,7 +159,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         m = jnp.full((B, H, T, 1), _NEG, jnp.float32)
         l = jnp.zeros((B, H, T, 1), jnp.float32)
         q_pos = idx * T + jnp.arange(T)
-        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        ring = [(j, (j + 1) % sp) for j in range(sp)]
 
         def body(i, carry):
             o, m, l, k_blk, v_blk = carry
@@ -113,12 +169,75 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                                     q_pos, k_pos, scale, causal)
             # rotate AFTER consuming; the last rotation is harmless and
             # keeps the loop body uniform (XLA overlaps it with compute)
-            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
             return o, m, l, k_blk, v_blk
 
         o, m, l, _, _ = jax.lax.fori_loop(0, sp, body, (o, m, l, k_l, v_l))
         return (o / jnp.maximum(l, 1e-30)).astype(q_l.dtype)
 
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+    def local_zigzag(q_l, k_l, v_l):
+        B, H, T, D = q_l.shape                      # T == 2c
+        idx = jax.lax.axis_index(axis_name)
+        o = jnp.zeros(q_l.shape, jnp.float32)
+        m = jnp.full((B, H, T, 1), _NEG, jnp.float32)
+        l = jnp.zeros((B, H, T, 1), jnp.float32)
+        ring = [(j, (j + 1) % sp) for j in range(sp)]
+
+        def pos_of(d):
+            """Global positions of device d's zigzag chunk pair."""
+            ar = jnp.arange(c)
+            return jnp.concatenate([d * c + ar, (2 * sp - 1 - d) * c + ar])
+
+        q_pos = pos_of(idx)
+
+        def self_step(o, m, l, k_blk, v_blk, src):
+            # Own block: general masked update (runs once; the position
+            # vectors make the diagonal-chunk masks correct automatically).
+            return _online_block(q_l, k_blk, v_blk, o, m, l,
+                                 q_pos, pos_of(src), scale, True)
+
+        def low_step(o, m, l, k_blk, v_blk, src):
+            # src < idx: BOTH local chunks attend to src's LOW chunk only;
+            # every score is valid — no mask, half the block FLOPs.
+            kl = k_blk[:, :, :c]
+            vl = v_blk[:, :, :c]
+            return _online_block(q_l, kl, vl, o, m, l,
+                                 q_pos, pos_of(src)[:c], scale, False)
+
+        def high_step(o, m, l, k_blk, v_blk, src):
+            # src > idx: only the local HIGH chunk attends, to BOTH of
+            # src's chunks; every score is valid — no mask.
+            qh = q_l[:, :, c:]
+            oh, mh, lh = o[:, :, c:], m[:, :, c:], l[:, :, c:]
+            oh, mh, lh = _online_block(qh, k_blk, v_blk, oh, mh, lh,
+                                       q_pos[c:], pos_of(src), scale, False)
+            return (jnp.concatenate([o[:, :, :c], oh], axis=2),
+                    jnp.concatenate([m[:, :, :c], mh], axis=2),
+                    jnp.concatenate([l[:, :, :c], lh], axis=2))
+
+        def body(i, carry):
+            o, m, l, k_blk, v_blk = carry
+            src = (idx - i) % sp
+            o, m, l = jax.lax.cond(
+                i == 0,
+                lambda a: self_step(*a),
+                lambda a: jax.lax.cond(
+                    a[5] < idx,
+                    lambda b: low_step(*b),
+                    lambda b: high_step(*b),
+                    a),
+                (o, m, l, k_blk, v_blk, src))
+            k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
+            return o, m, l, k_blk, v_blk
+
+        o, m, l, _, _ = jax.lax.fori_loop(0, sp, body, (o, m, l, k_l, v_l))
+        return (o / jnp.maximum(l, 1e-30)).astype(q_l.dtype)
+
+    local = local_zigzag if use_zigzag else local_contiguous
+    out = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_rep=False)(q, k, v)
+    if use_zigzag:
+        out = jnp.take(out, inv_perm, axis=2)
+    return out

@@ -34,3 +34,17 @@ def mv():
     if mv.initialized():
         mv.shutdown()
     mv.config.reset()
+
+
+def dense_attention_ref(q, k, v, causal=True):
+    """Shared dense attention reference for kernel/ring tests."""
+    import jax
+    import jax.numpy as jnp
+
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k) * scale
+    T = q.shape[2]
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    return jnp.einsum("bhts,bhsd->bhtd", jax.nn.softmax(s, -1), v)

@@ -1,0 +1,47 @@
+"""Pallas flash-attention kernel tests (interpret mode on the CPU mesh;
+the same kernel compiles for real on TPU — see ops/flash_attention.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import dense_attention_ref
+
+from multiverso_tpu.ops import flash_attention
+
+
+@pytest.mark.parametrize("B,H,T,D,bq,bk", [
+    (2, 2, 256, 64, 128, 128),
+    (1, 4, 128, 32, 64, 32),
+    (2, 1, 64, 64, 64, 64),
+    (1, 2, 256, 128, 256, 64),
+])
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_dense(B, H, T, D, bq, bk, causal):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    want = dense_attention_ref(q, k, v, causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
+def test_flash_rejects_misaligned():
+    q = jnp.zeros((1, 1, 100, 32))
+    with pytest.raises(ValueError, match="must divide"):
+        flash_attention(q, q, q, block_q=64, block_k=64, interpret=True)
+
+
+def test_local_attention_cpu_fallback_is_jnp():
+    """On the CPU backend the dispatcher must not take the Pallas path."""
+    from multiverso_tpu.parallel.ring_attention import blockwise_attention_local
+
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 2, 128, 32).astype(np.float32))
+    got = blockwise_attention_local(q, q, q, 32 ** -0.5)
+    want = dense_attention_ref(q, q, q)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)

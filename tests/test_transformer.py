@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from conftest import dense_attention_ref
 from jax.sharding import Mesh
 
 from multiverso_tpu.models import (TransformerConfig, TransformerTrainer,
@@ -13,16 +15,6 @@ from multiverso_tpu.models import (TransformerConfig, TransformerTrainer,
 from multiverso_tpu.models.transformer import lm_loss, transformer_forward
 from multiverso_tpu.parallel.ring_attention import (
     blockwise_attention_local, ring_attention)
-
-
-def _dense_ref(q, k, v, causal=True):
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bhtd,bhsd->bhts", q, k) * scale
-    T = q.shape[2]
-    if causal:
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    return jnp.einsum("bhts,bhsd->bhtd", jax.nn.softmax(s, -1), v)
 
 
 @pytest.fixture(scope="module")
@@ -34,7 +26,7 @@ def qkv():
 
 def test_blockwise_local_matches_dense(qkv):
     q, k, v = qkv
-    want = _dense_ref(q, k, v)
+    want = dense_attention_ref(q, k, v)
     got = blockwise_attention_local(q, k, v, 16 ** -0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-5)
@@ -48,7 +40,7 @@ def test_blockwise_local_matches_dense(qkv):
 def test_ring_attention_exact(qkv, shape, names):
     q, k, v = qkv
     mesh = Mesh(np.asarray(jax.devices()).reshape(shape), names)
-    want = _dense_ref(q, k, v)
+    want = dense_attention_ref(q, k, v)
     got = ring_attention(q, k, v, mesh)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
 
@@ -56,7 +48,7 @@ def test_ring_attention_exact(qkv, shape, names):
 def test_ring_attention_non_causal(qkv):
     q, k, v = qkv
     mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "sp"))
-    want = _dense_ref(q, k, v, causal=False)
+    want = dense_attention_ref(q, k, v, causal=False)
     got = ring_attention(q, k, v, mesh, causal=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
 
@@ -111,3 +103,19 @@ def test_bf16_compute_path():
     assert out.dtype == jnp.bfloat16
     loss = lm_loss(params, toks, cfg)
     assert np.isfinite(float(loss))
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_ring_attention_layouts_exact(qkv, layout):
+    q, k, v = qkv
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "sp"))
+    want = dense_attention_ref(q, k, v)
+    got = ring_attention(q, k, v, mesh, layout=layout)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+def test_ring_zigzag_rejects_non_causal():
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "sp"))
+    q = jnp.zeros((1, 1, 64, 16))
+    with pytest.raises(ValueError, match="zigzag"):
+        ring_attention(q, q, q, mesh, causal=False, layout="zigzag")
