@@ -1,17 +1,34 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as a differentiable Pallas TPU kernel.
 
-Causal/full attention with O(T) memory: the grid walks (batch·head,
+Causal/full attention with O(T) memory: the forward grid walks (batch·head,
 q-block, k-block) with the k dimension innermost; per q-block the kernel
 keeps the output accumulator and the streaming-softmax statistics (m, l)
-in VMEM scratch across k-steps, writing the normalized output once on the
-last step.  Score/accumulator math is float32 regardless of input dtype;
-the two matmuls run on the MXU in the input dtype.  Fully-masked causal
-blocks are skipped with ``pl.when`` — the causal schedule does half the
-FLOPs, which the XLA dense path cannot do.
+in VMEM scratch across k-steps, writing the normalized output and the
+row logsumexp once on the last step.  Score/accumulator math is float32
+regardless of input dtype; the matmuls run on the MXU in the input dtype.
+Fully-masked causal blocks are skipped with ``pl.when`` — the causal
+schedule does half the FLOPs, which the XLA dense path cannot do.
+
+Differentiation is a ``jax.custom_vjp``: the forward saves (q, k, v, o,
+lse) and the backward recomputes the probability blocks from lse in two
+Pallas kernels — one accumulating dq over k-blocks, one accumulating
+dk/dv over q-blocks — instead of materializing the T×T score matrix.
+Per-row stats (lse, delta) ride in lane-broadcast [*, T, 128] buffers, the
+TPU-safe layout for per-row scalars (the vector unit has 128 lanes; a
+[T]-shaped block cannot be tiled).
+
+The kernel also returns ``lse`` on request so sequence-parallel callers
+can combine normalized partial results across ring steps: ``lse =
+logaddexp(lse1, lse2); o = o1·e^{lse1-lse} + o2·e^{lse2-lse}`` (see
+``parallel.ring_attention`` for the ring schedules; wiring the kernel
+into the sp>1 ring steps uses exactly this identity).  The vjp accounts
+for the lse cotangent by folding it
+into the delta term (``ds = p·(dp − Δ)`` with ``Δ = rowsum(do·o) −
+dlse``), so gradients flow correctly through that combination.
 
 Used by ``parallel.ring_attention.blockwise_attention_local`` on TPU
-backends (each ring step's local block compute); everywhere else the jnp
-fallback runs.  ``interpret=True`` runs the same kernel on CPU for tests.
+backends; everywhere else the jnp fallback runs.  ``interpret=True`` runs
+the same kernels on CPU for tests.
 """
 
 from __future__ import annotations
@@ -27,10 +44,19 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["flash_attention"]
 
 _NEG = -1e30
+_LANES = 128
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *, scale,
-            causal, block_q, block_k, num_k):
+def _causal_mask(s, qi, ki, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
+                scale, causal, block_q, block_k, num_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -48,11 +74,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *, scale,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG)
+            s = _causal_mask(s, qi, ki, block_q, block_k)
         m_prev = m_scr[:, 0:1]                          # [Bq, 1]
         l_prev = l_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -76,32 +98,106 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *, scale,
     def _finalize():
         l = jnp.maximum(l_scr[:, 0:1], 1e-30)
         o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        # Lane-broadcast logsumexp; only lane 0 is meaningful downstream.
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
-def flash_attention(q, k, v, scale: Optional[float] = None,
-                    causal: bool = True,
-                    block_q: int = 128, block_k: int = 128,
-                    interpret: bool = False):
-    """q/k/v: [B, H, T, D] (same T for q and k/v) → [B, H, T, D]."""
-    B, H, T, D = q.shape
-    if scale is None:
-        scale = D ** -0.5
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
-        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
-                         f"T={T}")
-    num_q = T // block_q
-    num_k = T // block_k
-    bh = B * H
-    qr = q.reshape(bh, T, D)
-    kr = k.reshape(bh, T, D)
-    vr = v.reshape(bh, T, D)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, block_q, block_k, num_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
 
-    kernel = functools.partial(_kernel, scale=scale, causal=causal,
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0:1]                        # [Bq, 1]
+        delta = delta_ref[0][:, 0:1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                            # [Bq, Bk] f32
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                block_q, block_k, num_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0:1]
+        delta = delta_ref[0][:, 0:1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [Bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                    # [Bq, Bk]
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(qi * block_q + block_q - 1 >= ki * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q [bh, Tq, D], k/v [bh, Tk, D] → (o [bh, Tq, D], lse [bh, Tq] f32)."""
+    bh, Tq, D = q.shape
+    Tk = k.shape[1]
+    num_q = Tq // block_q
+    num_k = Tk // block_k
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
                                num_k=num_k)
-    out = pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         grid=(bh, num_q, num_k),
         in_specs=[
@@ -109,13 +205,131 @@ def flash_attention(q, k, v, scale: Optional[float] = None,
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((bh, Tq, _LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(B, H, T, D)
+    )(q, k, v)
+    return o, lse[:, :, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    bh, Tq, D = q.shape
+    Tk = k.shape[1]
+    num_q = Tq // block_q
+    num_k = Tk // block_k
+
+    # Δ_i = Σ_d do·o − dlse: the lse cotangent enters exactly where the
+    # softmax normalizer does (∂lse/∂s_ij = p_ij), so it folds into delta.
+    delta = (jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
+             - dlse.astype(jnp.float32))                 # [bh, Tq]
+    lse_b = jnp.broadcast_to(lse[:, :, None], (bh, Tq, _LANES))
+    delta_b = jnp.broadcast_to(delta[:, :, None], (bh, Tq, _LANES))
+
+    row_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k=num_k),
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            row_spec,
+            row_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b)
+
+    row_spec_j = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q=num_q),
+        grid=(bh, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, j, 0)),
+            row_spec_j,
+            row_spec_j,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, scale: Optional[float] = None,
+                    causal: bool = True,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False,
+                    return_lse: bool = False):
+    """q [B,H,Tq,D], k/v [B,H,Tk,D] → [B,H,Tq,D] (and lse [B,H,Tq] f32).
+
+    ``causal=True`` requires Tq == Tk (the standard aligned causal mask);
+    cross-length blocks (ring attention's low/high steps) use
+    ``causal=False``.  Fully differentiable via ``jax.custom_vjp`` —
+    including through the lse output, so ring-step combinations
+    backpropagate correctly.
+    """
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    if causal and Tq != Tk:
+        raise ValueError(f"causal flash attention needs Tq == Tk, got "
+                         f"{Tq} != {Tk}")
+    if scale is None:
+        scale = D ** -0.5
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k:
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
+                         f"Tq={Tq}, Tk={Tk}")
+    bh = B * H
+    o, lse = _flash(q.reshape(bh, Tq, D), k.reshape(bh, Tk, D),
+                    v.reshape(bh, Tk, D), float(scale), bool(causal),
+                    int(block_q), int(block_k), bool(interpret))
+    o = o.reshape(B, H, Tq, D)
+    if return_lse:
+        return o, lse.reshape(B, H, Tq)
+    return o

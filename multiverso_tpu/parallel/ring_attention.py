@@ -70,20 +70,27 @@ def blockwise_attention_local(q, k, v, scale: float, causal: bool = True,
 
     On TPU backends with aligned shapes this dispatches to the Pallas
     flash kernel (``ops/flash_attention.py``) — O(T) memory, causal-block
-    skipping; elsewhere (CPU tests, odd shapes, offset blocks) the jnp
-    streaming-softmax path runs and XLA fuses it.
+    skipping, differentiable via its custom_vjp; elsewhere (CPU tests,
+    odd shapes, offset blocks) the jnp streaming-softmax path runs and
+    XLA fuses it.  Setting ``MVTPU_FORCE_FLASH`` (any non-empty value)
+    forces the kernel on any backend — in interpret mode off-TPU, so CI
+    covers this exact dispatch; ``MVTPU_NO_FLASH`` disables it.
     """
     import os
 
     B, H, T, D = q.shape
     block = _flash_block(T)
-    if (q_offset == 0 and k_offset == 0 and T == k.shape[2] and block
-            and jax.default_backend() == "tpu"
-            and not os.environ.get("MVTPU_NO_FLASH")):
+    on_tpu = jax.default_backend() == "tpu"
+    force = os.environ.get("MVTPU_FORCE_FLASH", "")
+    use_flash = (q_offset == 0 and k_offset == 0 and T == k.shape[2]
+                 and block and not os.environ.get("MVTPU_NO_FLASH")
+                 and (on_tpu or force))
+    if use_flash:
         from ..ops import flash_attention
 
         return flash_attention(q, k, v, scale=scale, causal=causal,
-                               block_q=block, block_k=block)
+                               block_q=block, block_k=block,
+                               interpret=not on_tpu)
     o = jnp.zeros(q.shape, jnp.float32)
     m = jnp.full((B, H, T, 1), _NEG, jnp.float32)
     l = jnp.zeros((B, H, T, 1), jnp.float32)

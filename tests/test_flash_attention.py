@@ -45,3 +45,147 @@ def test_local_attention_cpu_fallback_is_jnp():
     got = blockwise_attention_local(q, q, q, 32 ** -0.5)
     want = dense_attention_ref(q, q, q)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Gradient coverage (round-1 verdict: the missing tests that would have
+# caught the non-differentiable kernel voiding the TPU bench).
+# ---------------------------------------------------------------------------
+
+def _dense_loss(q, k, v, causal):
+    return jnp.sum(jnp.square(dense_attention_ref(q, k, v, causal)))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("T,bq,bk", [(128, 64, 64), (256, 128, 128)])
+def test_flash_grad_matches_dense(causal, T, bq, bk):
+    rng = np.random.RandomState(2)
+    B, H, D = 1, 2, 32
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.3
+
+    def flash_loss(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk,
+                            interpret=True)
+        return jnp.sum(jnp.square(o))
+
+    gq, gk, gv = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    wq, wk, wv = jax.grad(_dense_loss, argnums=(0, 1, 2))(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(wq), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(wk), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(wv), atol=2e-4)
+
+
+def test_flash_lse_matches_dense():
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 2, 128, 32).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 2, 128, 32).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 2, 128, 32).astype(np.float32))
+    scale = 32 ** -0.5
+    _, lse = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                             interpret=True, return_lse=True)
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k) * scale
+    mask = jnp.tril(jnp.ones((128, 128), bool))
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    want = jax.nn.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want), atol=2e-5)
+
+
+def test_flash_lse_combination_rule():
+    """Two normalized partials combined via lse == attention over the
+    concatenated keys — the identity the ring schedule relies on — and
+    its gradient flows through the lse output's custom_vjp path."""
+    rng = np.random.RandomState(4)
+    B, H, T, D = 1, 1, 128, 32
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.randn(B, H, 2 * T, D).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.randn(B, H, 2 * T, D).astype(np.float32)) * 0.5
+
+    def combined_loss(q, k, v):
+        o1, l1 = flash_attention(q, k[:, :, :T], v[:, :, :T], causal=False,
+                                 block_q=64, block_k=64, interpret=True,
+                                 return_lse=True)
+        o2, l2 = flash_attention(q, k[:, :, T:], v[:, :, T:], causal=False,
+                                 block_q=64, block_k=64, interpret=True,
+                                 return_lse=True)
+        lse = jnp.logaddexp(l1, l2)
+        o = (o1 * jnp.exp(l1 - lse)[..., None]
+             + o2 * jnp.exp(l2 - lse)[..., None])
+        return jnp.sum(jnp.square(o))
+
+    def dense_loss(q, k, v):
+        return jnp.sum(jnp.square(dense_attention_ref(q, k, v, causal=False)))
+
+    got = combined_loss(q, k, v)
+    want = dense_loss(q, k, v)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    gq, gk, gv = jax.grad(combined_loss, argnums=(0, 1, 2))(q, k, v)
+    wq, wk, wv = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(wq), atol=3e-4)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(wk), atol=3e-4)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(wv), atol=3e-4)
+
+
+def test_flash_grad_bf16():
+    """bf16 inputs differentiate without error and track the f32 grads."""
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 2, 128, 64).astype(np.float32)) * 0.3
+
+    def loss(x, interp_dtype):
+        x = x.astype(interp_dtype)
+        o = flash_attention(x, x, x, causal=True, block_q=64, block_k=64,
+                            interpret=True)
+        return jnp.sum(jnp.square(o.astype(jnp.float32)))
+
+    g16 = jax.grad(lambda x: loss(x, jnp.bfloat16))(q)
+    g32 = jax.grad(lambda x: loss(x, jnp.float32))(q)
+    np.testing.assert_allclose(np.asarray(g16), np.asarray(g32),
+                               atol=0.15, rtol=0.1)
+
+
+def test_forced_flash_dispatch_under_value_and_grad(monkeypatch):
+    """CI coverage of the exact line that killed round-1's bench: the
+    dispatcher sends the transformer's attention to the Pallas kernel and
+    value_and_grad must work through it."""
+    from multiverso_tpu.parallel.ring_attention import (
+        blockwise_attention_local)
+
+    monkeypatch.setenv("MVTPU_FORCE_FLASH", "interpret")
+    rng = np.random.RandomState(6)
+    q = jnp.asarray(rng.randn(1, 2, 128, 32).astype(np.float32)) * 0.4
+
+    def loss(x):
+        o = blockwise_attention_local(x, x, x, 32 ** -0.5, causal=True)
+        return jnp.sum(jnp.square(o))
+
+    val, grad = jax.value_and_grad(loss)(q)
+
+    def dense(x):
+        return jnp.sum(jnp.square(dense_attention_ref(x, x, x, True)))
+
+    wval, wgrad = jax.value_and_grad(dense)(q)
+    np.testing.assert_allclose(float(val), float(wval), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(wgrad),
+                               atol=2e-4)
+
+
+def test_forced_flash_transformer_train_step(monkeypatch):
+    """Full train_step with the flash kernel force-dispatched (interpret):
+    the end-to-end path the TPU bench runs."""
+    monkeypatch.setenv("MVTPU_FORCE_FLASH", "interpret")
+    from jax.sharding import Mesh
+    from multiverso_tpu.models.transformer import (
+        TransformerConfig, TransformerTrainer)
+
+    devs = np.array(jax.devices()[:1]).reshape(1, 1, 1)
+    mesh = Mesh(devs, ("dp", "sp", "tp"))
+    cfg = TransformerConfig(vocab_size=64, dim=64, n_layers=1, n_heads=2,
+                            hidden=128, max_seq=128,
+                            compute_dtype=jnp.float32)
+    tr = TransformerTrainer(cfg, mesh, updater_type="sgd")
+    toks = np.random.RandomState(7).randint(0, 64, (2, 128), dtype=np.int64)
+    l0 = tr.train_step(toks)
+    l1 = tr.train_step(toks)
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert l1 < l0
