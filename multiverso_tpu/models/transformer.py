@@ -216,14 +216,21 @@ class TransformerTrainer:
 
         return step, place_tokens
 
-    def train_step(self, tokens) -> float:
+    def train_step_async(self, tokens) -> jax.Array:
+        """Enqueue one step; returns the device loss scalar (no host
+        sync).  Back-to-back callers (the bench loop) pipeline dispatches
+        and fetch once at the end — on remote-tunneled devices a per-step
+        host sync costs more than the step itself."""
         if self._step is None:
             self._step = self._build_step()
         step, place = self._step
+        self.params, self.state, loss = step(self.params, self.state,
+                                             place(tokens))
+        return loss
+
+    def train_step(self, tokens) -> float:
         with dashboard.monitor("Transformer::train_step"):
-            self.params, self.state, loss = step(self.params, self.state,
-                                                 place(tokens))
-        return float(loss)
+            return float(self.train_step_async(tokens))
 
     def loss(self, tokens) -> float:
         if self._eval is None:
