@@ -9,10 +9,12 @@ with an online (streaming) softmax.  Peak memory per device is O(T_local²)
 instead of O(T_global²), and the K/V transfer overlaps compute around the
 ring — the standard TPU recipe for million-token contexts.
 
-Implementation: pure ``shard_map`` + ``lax.fori_loop`` + ``ppermute`` —
-compiler-friendly (static shapes, no data-dependent control flow), no
-Pallas required; XLA overlaps the collective-permute with the block matmuls
-on TPU.
+Implementation: ``shard_map`` + ``lax.fori_loop`` + ``ppermute`` with
+static shapes; each ring step computes a normalized ``(o, lse)`` piece —
+on TPU via the differentiable Pallas flash kernel
+(``ops/flash_attention.py``), elsewhere via the fused jnp streaming
+path — and pieces combine with the logsumexp identity.  XLA overlaps the
+collective-permute with the block compute on TPU.
 """
 
 from __future__ import annotations
@@ -100,6 +102,55 @@ def blockwise_attention_local(q, k, v, scale: float, causal: bool = True,
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def _attn_piece(q, k, v, scale, causal: bool):
+    """Normalized attention over one K/V block, plus row logsumexp.
+
+    Returns ``(o [B,H,Tq,D] in q.dtype, lse [B,H,Tq] float32)``.  Pieces
+    compose across ring steps: ``lse' = logaddexp(lse1, lse2); o' =
+    o1·e^{lse1-lse'} + o2·e^{lse2-lse'}`` — so each ring step can run the
+    Pallas flash kernel at full kernel speed and the combination stays
+    pure jnp (fused by XLA).  On non-TPU backends (unless
+    ``MVTPU_FORCE_FLASH``) the jnp streaming path computes the same pair.
+    ``causal=True`` requires Tq == Tk (aligned diagonal), matching the
+    kernel's contract.
+    """
+    import os
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bq, bk = _flash_block(Tq), _flash_block(Tk)
+    on_tpu = jax.default_backend() == "tpu"
+    force = os.environ.get("MVTPU_FORCE_FLASH", "")
+    if (bq and bk and not os.environ.get("MVTPU_NO_FLASH")
+            and (on_tpu or force)):
+        from ..ops import flash_attention
+
+        return flash_attention(q, k, v, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk,
+                               interpret=not on_tpu, return_lse=True)
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full((B, H, Tq, 1), _NEG, jnp.float32)
+    l = jnp.zeros((B, H, Tq, 1), jnp.float32)
+    o, m, l = _online_block(q, k, v, o, m, l, jnp.arange(Tq),
+                            jnp.arange(Tk), scale, causal)
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype), lse
+
+
+def _combine_pieces(o_acc, lse_acc, o_i, lse_i):
+    """Fold one (o, lse) piece into the float32 accumulators."""
+    new_lse = jnp.logaddexp(lse_acc, lse_i)
+    o_acc = (o_acc * jnp.exp(lse_acc - new_lse)[..., None]
+             + o_i.astype(jnp.float32) * jnp.exp(lse_i - new_lse)[..., None])
+    return o_acc, new_lse
+
+
+def _empty_piece(q):
+    """A contributes-nothing piece (fully masked ring step)."""
+    return (jnp.zeros(q.shape, q.dtype),
+            jnp.full(q.shape[:3], _NEG, jnp.float32))
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                    causal: bool = True,
                    batch_axis: Optional[str] = "dp",
@@ -162,85 +213,98 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         if sp == 1:
             return blockwise_attention_local(q_l, k_l, v_l, scale, causal)
         idx = jax.lax.axis_index(axis_name)
-        o = jnp.zeros(q_l.shape, jnp.float32)
-        m = jnp.full((B, H, T, 1), _NEG, jnp.float32)
-        l = jnp.zeros((B, H, T, 1), jnp.float32)
-        q_pos = idx * T + jnp.arange(T)
+        o_acc = jnp.zeros(q_l.shape, jnp.float32)
+        lse_acc = jnp.full((B, H, T), _NEG, jnp.float32)
         ring = [(j, (j + 1) % sp) for j in range(sp)]
 
         def body(i, carry):
-            o, m, l, k_blk, v_blk = carry
+            o_acc, lse_acc, k_blk, v_blk = carry
             src = (idx - i) % sp          # owner of the current K/V block
-            k_pos = src * T + jnp.arange(T)
-            o, m, l = _online_block(q_l, k_blk, v_blk, o, m, l,
-                                    q_pos, k_pos, scale, causal)
+            if causal:
+                # src == idx: aligned diagonal (causal kernel); src < idx:
+                # every position valid (full kernel); src > idx: fully
+                # masked — skip the matmuls entirely.
+                o_i, lse_i = jax.lax.cond(
+                    src == idx,
+                    lambda kv: _attn_piece(q_l, kv[0], kv[1], scale, True),
+                    lambda kv: jax.lax.cond(
+                        src < idx,
+                        lambda kv2: _attn_piece(q_l, kv2[0], kv2[1],
+                                                scale, False),
+                        lambda kv2: _empty_piece(q_l),
+                        kv),
+                    (k_blk, v_blk))
+            else:
+                o_i, lse_i = _attn_piece(q_l, k_blk, v_blk, scale, False)
+            o_acc, lse_acc = _combine_pieces(o_acc, lse_acc, o_i, lse_i)
             # rotate AFTER consuming; the last rotation is harmless and
             # keeps the loop body uniform (XLA overlaps it with compute)
             k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
             v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
-            return o, m, l, k_blk, v_blk
+            return o_acc, lse_acc, k_blk, v_blk
 
-        o, m, l, _, _ = jax.lax.fori_loop(0, sp, body, (o, m, l, k_l, v_l))
-        return (o / jnp.maximum(l, 1e-30)).astype(q_l.dtype)
+        o_acc, lse_acc, _, _ = jax.lax.fori_loop(
+            0, sp, body, (o_acc, lse_acc, k_l, v_l))
+        return o_acc.astype(q_l.dtype)
 
     def local_zigzag(q_l, k_l, v_l):
         B, H, T, D = q_l.shape                      # T == 2c
         idx = jax.lax.axis_index(axis_name)
-        o = jnp.zeros(q_l.shape, jnp.float32)
-        m = jnp.full((B, H, T, 1), _NEG, jnp.float32)
-        l = jnp.zeros((B, H, T, 1), jnp.float32)
+        o_acc = jnp.zeros(q_l.shape, jnp.float32)
+        lse_acc = jnp.full((B, H, T), _NEG, jnp.float32)
         ring = [(j, (j + 1) % sp) for j in range(sp)]
 
-        def pos_of(d):
-            """Global positions of device d's zigzag chunk pair."""
-            ar = jnp.arange(c)
-            return jnp.concatenate([d * c + ar, (2 * sp - 1 - d) * c + ar])
+        def self_step(k_blk, v_blk):
+            # Own chunk pair (low, high): low attends k_low causally;
+            # high attends k_low fully and k_high causally — three
+            # aligned kernel pieces, no bespoke mask.
+            ql, qh = q_l[:, :, :c], q_l[:, :, c:]
+            kl, kh = k_blk[:, :, :c], k_blk[:, :, c:]
+            vl, vh = v_blk[:, :, :c], v_blk[:, :, c:]
+            o_lo, lse_lo = _attn_piece(ql, kl, vl, scale, True)
+            o_h1, lse_h1 = _attn_piece(qh, kl, vl, scale, False)
+            o_h2, lse_h2 = _attn_piece(qh, kh, vh, scale, True)
+            o_hi, lse_hi = _combine_pieces(o_h1.astype(jnp.float32),
+                                           lse_h1, o_h2, lse_h2)
+            return (jnp.concatenate([o_lo.astype(jnp.float32), o_hi], 2)
+                    .astype(q_l.dtype),
+                    jnp.concatenate([lse_lo, lse_hi], axis=2))
 
-        q_pos = pos_of(idx)
-
-        def self_step(o, m, l, k_blk, v_blk, src):
-            # Own block: general masked update (runs once; the position
-            # vectors make the diagonal-chunk masks correct automatically).
-            return _online_block(q_l, k_blk, v_blk, o, m, l,
-                                 q_pos, pos_of(src), scale, True)
-
-        def low_step(o, m, l, k_blk, v_blk, src):
+        def low_step(k_blk, v_blk):
             # src < idx: BOTH local chunks attend to src's LOW chunk only;
             # every score is valid — no mask, half the block FLOPs.
-            kl = k_blk[:, :, :c]
-            vl = v_blk[:, :, :c]
-            return _online_block(q_l, kl, vl, o, m, l,
-                                 q_pos, pos_of(src)[:c], scale, False)
+            return _attn_piece(q_l, k_blk[:, :, :c], v_blk[:, :, :c],
+                               scale, False)
 
-        def high_step(o, m, l, k_blk, v_blk, src):
+        def high_step(k_blk, v_blk):
             # src > idx: only the local HIGH chunk attends, to BOTH of
             # src's chunks; every score is valid — no mask.
-            qh = q_l[:, :, c:]
-            oh, mh, lh = o[:, :, c:], m[:, :, c:], l[:, :, c:]
-            oh, mh, lh = _online_block(qh, k_blk, v_blk, oh, mh, lh,
-                                       q_pos[c:], pos_of(src), scale, False)
-            return (jnp.concatenate([o[:, :, :c], oh], axis=2),
-                    jnp.concatenate([m[:, :, :c], mh], axis=2),
-                    jnp.concatenate([l[:, :, :c], lh], axis=2))
+            o_hi, lse_hi = _attn_piece(q_l[:, :, c:], k_blk, v_blk,
+                                       scale, False)
+            o_lo, lse_lo = _empty_piece(q_l[:, :, :c])
+            return (jnp.concatenate([o_lo, o_hi], axis=2),
+                    jnp.concatenate([lse_lo, lse_hi], axis=2))
 
         def body(i, carry):
-            o, m, l, k_blk, v_blk = carry
+            o_acc, lse_acc, k_blk, v_blk = carry
             src = (idx - i) % sp
-            o, m, l = jax.lax.cond(
+            o_i, lse_i = jax.lax.cond(
                 i == 0,
-                lambda a: self_step(*a),
-                lambda a: jax.lax.cond(
-                    a[5] < idx,
-                    lambda b: low_step(*b),
-                    lambda b: high_step(*b),
-                    a),
-                (o, m, l, k_blk, v_blk, src))
+                lambda kv: self_step(*kv),
+                lambda kv: jax.lax.cond(
+                    src < idx,
+                    lambda kv2: low_step(*kv2),
+                    lambda kv2: high_step(*kv2),
+                    kv),
+                (k_blk, v_blk))
+            o_acc, lse_acc = _combine_pieces(o_acc, lse_acc, o_i, lse_i)
             k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
             v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
-            return o, m, l, k_blk, v_blk
+            return o_acc, lse_acc, k_blk, v_blk
 
-        o, m, l, _, _ = jax.lax.fori_loop(0, sp, body, (o, m, l, k_l, v_l))
-        return (o / jnp.maximum(l, 1e-30)).astype(q_l.dtype)
+        o_acc, lse_acc, _, _ = jax.lax.fori_loop(
+            0, sp, body, (o_acc, lse_acc, k_l, v_l))
+        return o_acc.astype(q_l.dtype)
 
     local = local_zigzag if use_zigzag else local_contiguous
     out = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
