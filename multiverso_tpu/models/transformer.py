@@ -41,6 +41,12 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     compute_dtype: Any = jnp.bfloat16
+    # Mixture-of-Experts: 0 experts = dense SwiGLU MLP; >0 replaces every
+    # MLP with a top_k-routed expert layer (models/moe.py), experts
+    # sharded over the mesh's ``ep`` axis.
+    num_experts: int = 0
+    top_k: int = 2
+    aux_loss_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -57,17 +63,27 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
 
     layers = []
     for _ in range(cfg.n_layers):
-        layers.append({
+        lyr = {
             "wq": w(cfg.dim, cfg.dim),
             "wk": w(cfg.dim, cfg.dim),
             "wv": w(cfg.dim, cfg.dim),
             "wo": w(cfg.dim, cfg.dim),
-            "w1": w(cfg.dim, cfg.hidden),   # gate
-            "w3": w(cfg.dim, cfg.hidden),   # up
-            "w2": w(cfg.hidden, cfg.dim),   # down
             "attn_norm": np.ones(cfg.dim, np.float32),
             "mlp_norm": np.ones(cfg.dim, np.float32),
-        })
+        }
+        if cfg.num_experts:
+            from .moe import init_moe_params
+
+            lyr["moe"] = init_moe_params(cfg.dim, cfg.hidden,
+                                         cfg.num_experts,
+                                         seed=rng.randint(2 ** 31))
+        else:
+            lyr.update({
+                "w1": w(cfg.dim, cfg.hidden),   # gate
+                "w3": w(cfg.dim, cfg.hidden),   # up
+                "w2": w(cfg.hidden, cfg.dim),   # down
+            })
+        layers.append(lyr)
     return {
         "embed": w(cfg.vocab_size, cfg.dim, scale=0.02),
         "out_norm": np.ones(cfg.dim, np.float32),
@@ -87,9 +103,15 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
     layer = {
         "wq": s(None, tp), "wk": s(None, tp), "wv": s(None, tp),
         "wo": s(tp, None),
-        "w1": s(None, tp), "w3": s(None, tp), "w2": s(tp, None),
         "attn_norm": s(None), "mlp_norm": s(None),
     }
+    if cfg.num_experts:
+        from .moe import moe_shardings
+
+        layer["moe"] = moe_shardings(mesh)
+    else:
+        layer.update({"w1": s(None, tp), "w3": s(None, tp),
+                      "w2": s(tp, None)})
     return {
         "embed": s(None, None),
         "out_norm": s(None),
@@ -116,8 +138,12 @@ def _rope(x, theta: float):
 
 
 def transformer_forward(params, tokens, cfg: TransformerConfig,
-                        mesh: Optional[Mesh] = None):
-    """tokens [B, T] int32 → logits [B, T, vocab] (compute dtype)."""
+                        mesh: Optional[Mesh] = None,
+                        return_aux: bool = False):
+    """tokens [B, T] int32 → logits [B, T, vocab] (compute dtype).
+
+    With ``return_aux=True`` also returns the summed MoE load-balancing
+    auxiliary loss (zero for dense configs)."""
     from ..parallel.ring_attention import blockwise_attention_local, ring_attention
 
     if tokens.shape[1] > cfg.max_seq:
@@ -129,6 +155,7 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
     B, T, _ = x.shape
     scale = cfg.head_dim ** -0.5
     use_ring = mesh is not None and int(mesh.shape.get("sp", 1)) > 1
+    aux_total = jnp.float32(0)
 
     for lyr in params["layers"]:
         h = _rms_norm(x, lyr["attn_norm"].astype(dt), cfg.norm_eps)
@@ -147,22 +174,41 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
         x = x + o @ lyr["wo"].astype(dt)
 
         h = _rms_norm(x, lyr["mlp_norm"].astype(dt), cfg.norm_eps)
-        gated = jax.nn.silu(h @ lyr["w1"].astype(dt)) * (h @ lyr["w3"].astype(dt))
-        x = x + gated @ lyr["w2"].astype(dt)
+        if cfg.num_experts:
+            from .moe import moe_ffn
+
+            out, aux = moe_ffn(lyr["moe"], h, top_k=cfg.top_k,
+                               compute_dtype=dt)
+            x = x + out
+            aux_total = aux_total + aux
+        else:
+            gated = (jax.nn.silu(h @ lyr["w1"].astype(dt))
+                     * (h @ lyr["w3"].astype(dt)))
+            x = x + gated @ lyr["w2"].astype(dt)
 
     x = _rms_norm(x, params["out_norm"].astype(dt), cfg.norm_eps)
-    return x @ params["head"].astype(dt)
+    logits = x @ params["head"].astype(dt)
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def lm_loss(params, tokens, cfg: TransformerConfig,
             mesh: Optional[Mesh] = None):
-    """Next-token cross-entropy, mean over all positions (float32)."""
-    logits = transformer_forward(params, tokens, cfg, mesh)[:, :-1]
+    """Next-token cross-entropy, mean over all positions (float32).
+
+    MoE configs add ``aux_loss_coef`` × the summed load-balancing loss."""
+    logits, aux = transformer_forward(params, tokens, cfg, mesh,
+                                      return_aux=True)
+    logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     ll = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - ll)
+    ce = jnp.mean(logz - ll)
+    if cfg.num_experts:
+        return ce + cfg.aux_loss_coef * aux
+    return ce
 
 
 class TransformerTrainer:
