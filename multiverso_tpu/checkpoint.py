@@ -29,20 +29,22 @@ _MAGIC = b"MVTPUCKPT1"
 def save(uri: str, extra: Optional[Dict[str, Any]] = None) -> None:
     """Snapshot all registered tables + clock to ``uri`` (one file).
 
-    Only rank 0 materializes and writes the snapshot.  Multi-host note:
-    ``store_state`` device-gets each table; tables sharded across hosts
-    need a cross-host gather first (wire ``multihost_utils.
-    process_allgather`` into ``store_state`` when deploying multi-host —
-    single-controller runs, the only mode testable here, are complete).
+    Multi-host: ``store_state`` is collective (tables sharded across
+    hosts gather via ``process_allgather`` in ``tables.base.host_fetch``),
+    so EVERY process materializes the snapshot; only rank 0 writes it.
+    The local write goes to a temp file and renames into place, so a
+    crash mid-write never leaves a truncated file at the final path.
     """
     ctx = core_context.get_context()
+    # Collective on multi-host meshes — all ranks must run it together.
+    tables_snap = {t.name: t.store_state() for t in ctx.tables()}
     if ctx.node.rank == 0:
         snap = {
             "clock": ctx.clock,
             "extra": extra or {},
-            "tables": {t.name: t.store_state() for t in ctx.tables()},
+            "tables": tables_snap,
         }
-        with StreamFactory.open(uri, "wb") as s:
+        with StreamFactory.open(uri, "wb", atomic=True) as s:
             s.write(_MAGIC)
             s.write(pickle.dumps(snap, protocol=4))
         Log.info("checkpoint saved: %s (%d tables, clock=%d)",
@@ -56,6 +58,15 @@ def restore(uri: str, strict: bool = True) -> Dict[str, Any]:
 
     ``strict=True`` raises if any registered table has no snapshot entry or
     vice versa (the reference's Load aborts on shard mismatch).
+
+    Trust boundary: the snapshot body is a pickle — restoring a
+    checkpoint executes code chosen by whoever wrote the file.  Only
+    restore checkpoints from storage you control, exactly as you would
+    only load model weights you trust.
+
+    Multi-host: every process reads ``uri`` (the reference's HDFS model —
+    checkpoint storage is shared); rank-0-only distribution of the bytes
+    would need a broadcast seam here.
     """
     ctx = core_context.get_context()
     with StreamFactory.open(uri, "rb") as s:

@@ -22,7 +22,7 @@ import numpy as np
 
 from ..parallel.sharding import shard_along, table_mesh
 from ..updaters import AddOption
-from .base import Table
+from .base import Table, host_fetch, host_put
 
 __all__ = ["ArrayTable"]
 
@@ -43,10 +43,10 @@ class ArrayTable(Table):
         host = np.zeros(self._padded, dtype=self.dtype)
         if init is not None:
             host[: self.size] = np.asarray(init, dtype=self.dtype)
-        self._data = jax.device_put(host, self._sharding)
+        self._data = host_put(host, self._sharding)
         self._state = tuple(
-            jax.device_put(np.zeros(self._padded, dtype=self.dtype),
-                           self._sharding)
+            host_put(np.zeros(self._padded, dtype=self.dtype),
+                     self._sharding)
             for _ in range(self.updater.num_slots))
         # BSP clock buffers, bucketed per AddOption so a flush applies each
         # option's aggregate with the right hyper-parameters.
@@ -56,7 +56,7 @@ class ArrayTable(Table):
     def get(self, option=None) -> np.ndarray:
         """Pull the whole array (reference ``ArrayWorker<T>::Get``; §3.2)."""
         with self._monitor("Get"):
-            return np.asarray(jax.device_get(self._data))[: self.size]
+            return host_fetch(self._data)[: self.size]
 
     # ------------------------------------------------------------------ Add
     def add(self, delta, option: Optional[AddOption] = None,
@@ -121,14 +121,14 @@ class ArrayTable(Table):
         return {
             "kind": self.kind,
             "size": self.size,
-            "data": np.asarray(jax.device_get(self._data)),
-            "state": [np.asarray(jax.device_get(s)) for s in self._state],
+            "data": host_fetch(self._data),
+            "state": [host_fetch(s) for s in self._state],
         }
 
     def load_state(self, snap: Any) -> None:
         assert snap["kind"] == self.kind and snap["size"] == self.size
-        self._data = jax.device_put(
-            snap["data"].astype(self.dtype), self._sharding)
+        self._data = host_put(snap["data"].astype(self.dtype),
+                              self._sharding)
         self._state = tuple(
-            jax.device_put(s.astype(self.dtype), self._sharding)
+            host_put(s.astype(self.dtype), self._sharding)
             for s in snap["state"])

@@ -39,7 +39,73 @@ from .. import config, dashboard
 from ..core import context as core_context
 from ..updaters import AddOption, get_updater
 
-__all__ = ["Table"]
+__all__ = ["Table", "host_fetch", "host_put", "is_multiprocess"]
+
+
+def is_multiprocess() -> bool:
+    """One predicate for every lockstep-collective guard in the tables.
+
+    All multi-host paths (``host_fetch``/``multihost_sum``/the sparse
+    union) MUST use this same test — two spellings that ever diverged
+    would leave one rank inside a collective the other skipped: deadlock.
+    """
+    import jax
+
+    return jax.process_count() > 1
+
+
+def host_fetch(arr):
+    """Device->host materialization that also works multi-host.
+
+    Single-controller arrays ``device_get`` directly; a ``jax.Array``
+    with shards on other hosts (``process_count() > 1``) is first
+    gathered with a cross-host ``process_allgather`` — the reference's
+    server->worker Reply_Get hop (SURVEY.md §3.2), here one collective.
+    Collective: under multi-host every process must call it together.
+    """
+    import jax
+    import numpy as np
+
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return np.asarray(jax.device_get(arr))
+
+
+def multihost_sum(host_delta):
+    """Sum per-process host deltas across processes (collective).
+
+    Multi-host SPMD mapping of the reference's many-workers-Add semantics
+    (SURVEY.md §3.3): every worker process pushes its own delta, the
+    "server" applies the sum.  Under a single controller this is the
+    identity; under ``process_count() > 1`` every process MUST call adds
+    in lockstep (eager adds become collective), and each then applies the
+    identical summed delta, keeping the global jax.Array consistent.
+    """
+    import numpy as np
+
+    if not is_multiprocess():
+        return host_delta
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(host_delta)).sum(axis=0)
+
+
+def host_put(host, sharding):
+    """Host->device placement that also works multi-host.
+
+    ``device_put`` requires every target device to be addressable; on a
+    multi-host mesh each process instead contributes its addressable
+    shards of the (replicated) host array via ``make_array_from_callback``.
+    """
+    import jax
+
+    if sharding.is_fully_addressable:
+        return jax.device_put(host, sharding)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
 
 
 class Table:
@@ -96,7 +162,8 @@ class Table:
         padded_shape = self._data.shape
         padded = np.zeros(padded_shape, dtype=self.dtype)
         padded[tuple(slice(0, s) for s in delta.shape)] = delta
-        d = jax.device_put(padded, self._sharding)
+        padded = multihost_sum(padded)
+        d = host_put(padded, self._sharding)
         with self._lock:
             self._data, self._state = fn(self._data, self._state, d)
 

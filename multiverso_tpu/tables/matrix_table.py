@@ -24,7 +24,7 @@ import numpy as np
 
 from ..parallel.sharding import shard_along, table_mesh
 from ..updaters import AddOption
-from .base import Table
+from .base import Table, host_fetch, host_put
 
 __all__ = ["MatrixTable"]
 
@@ -53,9 +53,9 @@ class MatrixTable(Table):
         host = np.zeros((self._padded_rows, self.num_cols), dtype=self.dtype)
         if init is not None:
             host[: self.num_rows] = np.asarray(init, dtype=self.dtype)
-        self._data = jax.device_put(host, self._sharding)
+        self._data = host_put(host, self._sharding)
         self._state = tuple(
-            jax.device_put(
+            host_put(
                 np.zeros((self._padded_rows, self.num_cols), dtype=self.dtype),
                 self._sharding)
             for _ in range(self.updater.num_slots))
@@ -72,7 +72,7 @@ class MatrixTable(Table):
     def get(self, option=None) -> np.ndarray:
         """Whole-matrix pull (reference ``MatrixWorkerTable::Get`` all-rows)."""
         with self._monitor("Get"):
-            return np.asarray(jax.device_get(self._data))[: self.num_rows]
+            return host_fetch(self._data)[: self.num_rows]
 
     def get_rows(self, row_ids, option=None) -> np.ndarray:
         """Row-subset pull — the sparse hot read path.
@@ -89,7 +89,7 @@ class MatrixTable(Table):
             padded = np.zeros(b, dtype=np.int32)
             padded[:k] = rows
             out = self._gather_fn(self._data, jnp.asarray(padded))
-            return np.asarray(jax.device_get(out))[:k]
+            return host_fetch(out)[:k]
 
     # ------------------------------------------------------------------ Add
     def add(self, delta, option: Optional[AddOption] = None,
@@ -150,6 +150,42 @@ class MatrixTable(Table):
             self._pending_sparse = []
 
     # ----------------------------------------------------------- internals
+    def _multihost_union(self, uniq: np.ndarray, agg: np.ndarray):
+        """Union per-process (rows, deltas) across hosts (collective).
+
+        Multi-host SPMD mapping of per-worker sparse Adds: each process
+        contributes its row batch, every process applies the identical
+        union batch (duplicates re-aggregated), keeping the global array
+        consistent.  Ranks pad to a common bucket first because
+        ``process_allgather`` needs one shape on every process; padding
+        rows carry the scatter-drop sentinel and zero deltas, so the
+        re-aggregation keeps them inert.
+        """
+        from .base import is_multiprocess
+
+        if not is_multiprocess():
+            return uniq, agg
+        from jax.experimental import multihost_utils
+
+        # Two collective rounds, not three: a tiny size probe (ranks must
+        # agree on one gather shape), then rows and deltas packed into a
+        # single float64 buffer (f64 holds row ids exactly to 2^53).
+        kmax = int(np.max(multihost_utils.process_allgather(
+            np.array([uniq.shape[0]], np.int64))))
+        b = _bucket(max(kmax, 1))
+        packed = np.zeros((b, self.num_cols + 1), dtype=np.float64)
+        packed[:, 0] = self._padded_rows           # scatter-drop sentinel
+        packed[: uniq.shape[0], 0] = uniq
+        packed[: uniq.shape[0], 1:] = agg
+        all_packed = np.asarray(
+            multihost_utils.process_allgather(packed)).reshape(
+                -1, self.num_cols + 1)
+        uniq2, inv2 = np.unique(
+            all_packed[:, 0].astype(np.int64), return_inverse=True)
+        agg2 = np.zeros((uniq2.shape[0], self.num_cols), dtype=self.dtype)
+        np.add.at(agg2, inv2, all_packed[:, 1:].astype(self.dtype))
+        return uniq2, agg2
+
     def _apply_dense_now(self, delta: np.ndarray,
                          option: Optional[AddOption]) -> None:
         self._apply_dense_padded(delta, option)
@@ -163,6 +199,7 @@ class MatrixTable(Table):
         uniq, inv = np.unique(rows, return_inverse=True)
         agg = np.zeros((uniq.shape[0], self.num_cols), dtype=self.dtype)
         np.add.at(agg, inv, delta)
+        uniq, agg = self._multihost_union(uniq, agg)
 
         k = uniq.shape[0]
         b = _bucket(k)
@@ -204,15 +241,15 @@ class MatrixTable(Table):
         return {
             "kind": self.kind,
             "shape": (self.num_rows, self.num_cols),
-            "data": np.asarray(jax.device_get(self._data)),
-            "state": [np.asarray(jax.device_get(s)) for s in self._state],
+            "data": host_fetch(self._data),
+            "state": [host_fetch(s) for s in self._state],
         }
 
     def load_state(self, snap: Any) -> None:
         assert snap["kind"] == self.kind
         assert tuple(snap["shape"]) == (self.num_rows, self.num_cols)
-        self._data = jax.device_put(
-            snap["data"].astype(self.dtype), self._sharding)
+        self._data = host_put(snap["data"].astype(self.dtype),
+                              self._sharding)
         self._state = tuple(
-            jax.device_put(s.astype(self.dtype), self._sharding)
+            host_put(s.astype(self.dtype), self._sharding)
             for s in snap["state"])
