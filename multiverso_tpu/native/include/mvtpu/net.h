@@ -1,0 +1,67 @@
+// TcpNet — point-to-point transport between runtime processes.
+// Capability parity with include/multiverso/net/zmq_net.h (SURVEY.md
+// §2.18): peers come from a machine file (one "host:port" per line, line
+// index = rank), frames are length-prefixed serialized Messages, and the
+// receive side hands decoded messages to a router callback.  Plain POSIX
+// TCP instead of libzmq: same dealer-style lazy connect, no external
+// dependency.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "mvtpu/message.h"
+
+namespace mvtpu {
+
+class TcpNet {
+ public:
+  using InboundFn = std::function<void(Message&&)>;
+
+  ~TcpNet() { Stop(); }
+
+  // Parse a machine file into "host:port" endpoints; empty on error.
+  static std::vector<std::string> ParseMachineFile(const std::string& path);
+
+  // Bind + listen on endpoints[rank]'s port, start the accept loop,
+  // deliver every inbound message to `fn` (called from reader threads).
+  bool Init(const std::vector<std::string>& endpoints, int rank,
+            InboundFn fn);
+
+  // Serialize + frame + write to the peer (lazy connect with retries —
+  // peers start in any order).  Returns false on a dead peer.
+  bool Send(int dst_rank, const Message& msg);
+
+  void Stop();
+
+  int rank() const { return rank_; }
+  int size() const { return static_cast<int>(endpoints_.size()); }
+
+ private:
+  void AcceptLoop();
+  void ReadLoop(int fd);
+  int ConnectTo(int dst_rank);
+
+  std::vector<std::string> endpoints_;
+  int rank_ = 0;
+  InboundFn inbound_;
+
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  std::vector<std::thread> readers_;
+  std::vector<int> accepted_fds_;
+  std::mutex readers_mu_;
+
+  std::vector<int> send_fds_;
+  std::vector<std::unique_ptr<std::mutex>> send_mus_;
+
+  bool running_ = false;
+  std::mutex mu_;
+};
+
+}  // namespace mvtpu

@@ -1,12 +1,16 @@
 // Table layer: worker-side stubs + server-side shards.
 // Capability parity with include/multiverso/table_interface.h and
 // include/multiverso/table/ (SURVEY.md §2.10–2.12): ArrayTable (dense 1-D)
-// and MatrixTable (2-D, row-addressable) in float32. The worker stub turns
-// Get/Add into request messages answered by the Server actor; a Waiter
-// blocks the caller until the reply lands — the reference's §3.2/§3.3 hot
-// path, in-process.
+// and MatrixTable (2-D, row-addressable) in float32.  The worker stub
+// turns Get/Add into request messages answered by Server actors; a Waiter
+// blocks the caller until every contacted shard replied — the reference's
+// §3.2/§3.3 hot path.  Sharding matches the reference: server rank r owns
+// a contiguous array chunk / matrix row block computed by ShardRange, the
+// worker partitions each request across owners (WorkerTable::Partition
+// semantics) and reassembles replies by the reply's src rank.
 #pragma once
 
+#include <algorithm>
 #include <cstdint>
 #include <memory>
 #include <mutex>
@@ -20,6 +24,31 @@
 
 namespace mvtpu {
 
+// Contiguous balanced partition of n elements over `size` shards; the
+// same formula on worker and server sides is the partition contract.
+struct ShardRange {
+  int64_t begin = 0;
+  int64_t end = 0;
+  int64_t len() const { return end - begin; }
+};
+
+inline ShardRange ShardOf(int64_t n, int rank, int size) {
+  int64_t base = n / size;
+  int64_t rem = n % size;
+  int64_t b = rank * base + std::min<int64_t>(rank, rem);
+  return {b, b + base + (rank < rem ? 1 : 0)};
+}
+
+inline int OwnerOf(int64_t index, int64_t n, int size) {
+  // Inverse of ShardOf: first `rem` shards have base+1 elements.
+  int64_t base = n / size;
+  int64_t rem = n % size;
+  int64_t big = (base + 1) * rem;  // elements held by the larger shards
+  if (base == 0) return static_cast<int>(index);  // n < size degenerate
+  if (index < big) return static_cast<int>(index / (base + 1));
+  return static_cast<int>(rem + (index - big) / base);
+}
+
 // ---------------------------------------------------------------- server
 class ServerTable {
  public:
@@ -27,13 +56,16 @@ class ServerTable {
   // Fill reply blobs for a get request.
   virtual void ProcessGet(const Message& req, Message* reply) = 0;
   virtual void ProcessAdd(const Message& req) = 0;
+  // Store/Load operate on the LOCAL shard (multi-process callers keep
+  // one file per rank, the reference's per-server dump model).
   virtual bool Store(Stream* out) const = 0;
   virtual bool Load(Stream* in) = 0;
 };
 
 class ArrayServerTable : public ServerTable {
  public:
-  ArrayServerTable(int64_t size, UpdaterType updater);
+  ArrayServerTable(int64_t global_size, UpdaterType updater, int rank = 0,
+                   int size = 1);
   void ProcessGet(const Message& req, Message* reply) override;
   void ProcessAdd(const Message& req) override;
   bool Store(Stream* out) const override;
@@ -41,28 +73,31 @@ class ArrayServerTable : public ServerTable {
   int64_t size() const { return static_cast<int64_t>(data_.size()); }
 
  private:
-  std::vector<float> data_;
+  ShardRange range_;
+  std::vector<float> data_;    // the local shard
   std::vector<float> slot0_;
   UpdaterType updater_;
-  std::mutex mu_;
+  mutable std::mutex mu_;
 };
 
 class MatrixServerTable : public ServerTable {
  public:
-  MatrixServerTable(int64_t rows, int64_t cols, UpdaterType updater);
+  MatrixServerTable(int64_t rows, int64_t cols, UpdaterType updater,
+                    int rank = 0, int size = 1);
   void ProcessGet(const Message& req, Message* reply) override;
   void ProcessAdd(const Message& req) override;
   bool Store(Stream* out) const override;
   bool Load(Stream* in) override;
-  int64_t rows() const { return rows_; }
+  int64_t rows() const { return range_.len(); }
   int64_t cols() const { return cols_; }
 
  private:
-  int64_t rows_, cols_;
-  std::vector<float> data_;   // rows*cols, row-major
+  int64_t global_rows_, cols_;
+  ShardRange range_;           // the row block this rank owns
+  std::vector<float> data_;    // range_.len() * cols, row-major
   std::vector<float> slot0_;
   UpdaterType updater_;
-  std::mutex mu_;
+  mutable std::mutex mu_;
 };
 
 // ---------------------------------------------------------------- worker
@@ -77,8 +112,10 @@ class WorkerTable {
   void Notify(int64_t msg_id, const Message& reply);
 
  protected:
-  // Send req via the Zoo, block until the reply is consumed by `consume`.
-  void RoundTrip(MessagePtr req,
+  // Send all reqs (same msg_id) via the Zoo, block until each got its
+  // reply; `consume` runs once per reply (serialized — one worker-actor
+  // thread drains replies).
+  void RoundTrip(std::vector<MessagePtr> reqs,
                  void (*consume)(void*, const Message&), void* arg);
 
   int32_t table_id_;
@@ -89,22 +126,31 @@ class WorkerTable {
     Waiter* waiter;
     void (*consume)(void*, const Message&);
     void* arg;
+    int remaining;
   };
   std::unordered_map<int64_t, Pending> pending_;
 };
 
 class ArrayWorkerTable : public WorkerTable {
  public:
-  using WorkerTable::WorkerTable;
+  ArrayWorkerTable(int32_t table_id, int64_t global_size, int num_servers)
+      : WorkerTable(table_id), global_(global_size),
+        servers_(num_servers) {}
   void Get(float* data, int64_t size);
   void Add(const float* delta, int64_t size, const AddOption& opt,
            bool blocking);
+
+ private:
+  int64_t global_;
+  int servers_;
 };
 
 class MatrixWorkerTable : public WorkerTable {
  public:
-  MatrixWorkerTable(int32_t table_id, int64_t rows, int64_t cols)
-      : WorkerTable(table_id), rows_(rows), cols_(cols) {}
+  MatrixWorkerTable(int32_t table_id, int64_t rows, int64_t cols,
+                    int num_servers = 1)
+      : WorkerTable(table_id), rows_(rows), cols_(cols),
+        servers_(num_servers) {}
   void GetAll(float* data);                       // [rows*cols]
   void GetRows(const int32_t* row_ids, int64_t k, float* data);  // [k*cols]
   void AddAll(const float* delta, const AddOption& opt, bool blocking);
@@ -113,6 +159,7 @@ class MatrixWorkerTable : public WorkerTable {
 
  private:
   int64_t rows_, cols_;
+  int servers_;
 };
 
 }  // namespace mvtpu

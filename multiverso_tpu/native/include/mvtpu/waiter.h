@@ -17,10 +17,12 @@ class Waiter {
   }
 
   void Notify() {
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      --count_;
-    }
+    // notify_all runs WHILE holding mu_: Waiters are stack-allocated by
+    // their waiting caller (RoundTrip, Barrier), so notifying after the
+    // unlock would race the waiter observing count_<=0, returning, and
+    // destroying this object mid-notify (use-after-free).
+    std::lock_guard<std::mutex> lk(mu_);
+    --count_;
     cv_.notify_all();
   }
 

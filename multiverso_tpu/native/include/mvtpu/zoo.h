@@ -1,14 +1,17 @@
-// Zoo — the runtime registry/singleton: owns the actors, routes messages,
-// registers tables, answers barrier.
+// Zoo — the runtime registry/singleton: owns the actors and the
+// transport, routes messages, registers tables, answers barrier.
 // Capability parity with include/multiverso/zoo.h (SURVEY.md §2.2, §3.1).
 //
-// Placement note (TPU-native design): the reference's Zoo also owns the
-// MPI/ZMQ transport between processes. In this framework cross-host data
-// movement is XLA collectives over ICI/DCN (the Python/JAX layer); the
-// native Zoo is the HOST control plane — a real actor runtime running the
-// worker/server/controller message path in-process (the reference's
-// Role::ALL degenerate mode, which is also its test mode), serving the C
-// API for FFI parity.
+// Placement note (TPU-native design): the TPU data plane is XLA
+// collectives over ICI/DCN (the Python/JAX layer); this native runtime is
+// the HOST control/parity plane — a real actor pipeline with a real TCP
+// transport (net.h).  With no machine file it runs the reference's
+// Role::ALL single-process degenerate mode; with `-machine_file=F
+// -rank=N` it becomes N cooperating processes: tables shard across the
+// server roles (arrays by contiguous chunk, matrices by row block), the
+// worker stubs partition requests per shard owner, and rank 0's
+// controller answers the barrier — the reference's §3.1–§3.3 call stacks
+// across OS processes.
 #pragma once
 
 #include <atomic>
@@ -19,29 +22,38 @@
 #include <vector>
 
 #include "mvtpu/actor.h"
+#include "mvtpu/net.h"
 #include "mvtpu/table.h"
 
 namespace mvtpu {
+
+class Waiter;
 
 class Zoo {
  public:
   static Zoo* Get();
 
-  // argc/argv parsed through configure; spawns actors; idempotent.
+  // argc/argv parsed through configure; spawns actors (+ transport when a
+  // machine file names more than one process); idempotent.
   bool Start(int argc, const char* const* argv);
   void Stop();
   bool started() const { return started_; }
 
-  int rank() const { return 0; }   // single-process control plane
-  int size() const { return 1; }
-  int num_workers() const { return 1; }
-  int worker_id() const { return 0; }
-  int server_id() const { return 0; }
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+  int num_workers() const { return size_; }
+  int worker_id() const { return rank_; }
+  int server_id() const { return rank_; }
 
   void Barrier();
 
-  // Deliver to a local actor's mailbox (the communicator's routing).
+  // Deliver to a LOCAL actor's mailbox.
   void SendTo(const std::string& actor_name, MessagePtr msg);
+
+  // Deliver to msg->dst's `actor_name` actor — local mailbox when dst is
+  // this rank (or unset), the TCP transport otherwise (the Communicator
+  // routing of SURVEY.md §2.6; inbound routing is RouteInbound).
+  void Deliver(const std::string& actor_name, MessagePtr msg);
 
   int64_t NextMsgId() { return next_msg_id_.fetch_add(1); }
 
@@ -55,15 +67,25 @@ class Zoo {
 
   UpdaterType updater_type() const { return updater_type_; }
 
+  // ---- barrier plumbing (internal) ------------------------------------
+  void OnBarrierArrive(int src_rank);   // rank-0 controller counting
+  void OnBarrierRelease();              // local waiter release
+
  private:
   Zoo() = default;
 
+  void RouteInbound(Message&& m);       // transport reader threads
+
   bool started_ = false;
-  std::mutex mu_;         // lifecycle (Start/Stop)
+  std::mutex mu_;         // lifecycle (Start/Stop) + actor pointers
   std::mutex tables_mu_;  // table registry — actors query it mid-Stop, so
                           // it must never be held across a thread join
   std::atomic<int64_t> next_msg_id_{0};
   UpdaterType updater_type_ = UpdaterType::kDefault;
+
+  int rank_ = 0;
+  int size_ = 1;
+  std::unique_ptr<TcpNet> net_;
 
   std::unique_ptr<Actor> worker_actor_;
   std::unique_ptr<Actor> server_actor_;
@@ -71,6 +93,11 @@ class Zoo {
 
   std::vector<std::unique_ptr<ServerTable>> server_tables_;
   std::vector<std::unique_ptr<WorkerTable>> worker_tables_;
+
+  // Barrier state: one outstanding barrier per rank; rank 0 counts.
+  std::mutex barrier_mu_;
+  Waiter* barrier_waiter_ = nullptr;
+  int barrier_arrivals_ = 0;
 };
 
 }  // namespace mvtpu

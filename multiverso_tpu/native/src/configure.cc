@@ -134,7 +134,9 @@ void RegisterDefaults() {
     DefineBool("sync", false, "BSP (true) vs ASP (false) training");
     DefineString("updater_type", "default",
                  "default|sgd|adagrad|momentum|smooth_gradient");
-    DefineString("machine_file", "", "host list (transport parity flag)");
+    DefineString("machine_file", "",
+                 "host:port per line; >1 line enables the TCP transport");
+    DefineInt("rank", 0, "this process's line index in machine_file");
     DefineInt("port", 55555, "base port (transport parity flag)");
     DefineDouble("backup_worker_ratio", 0.0, "straggler slack (parity flag)");
     DefineString("log_level", "info", "debug|info|error|fatal");

@@ -1,0 +1,238 @@
+#include "mvtpu/net.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <fstream>
+
+#include "mvtpu/log.h"
+
+namespace mvtpu {
+
+namespace {
+
+bool SplitHostPort(const std::string& ep, std::string* host, int* port) {
+  auto colon = ep.rfind(':');
+  if (colon == std::string::npos) return false;
+  *host = ep.substr(0, colon);
+  try {
+    *port = std::stoi(ep.substr(colon + 1));
+  } catch (...) {
+    return false;
+  }
+  return *port > 0 && *port < 65536;
+}
+
+bool WriteAll(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool ReadAll(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+std::vector<std::string> TcpNet::ParseMachineFile(const std::string& path) {
+  std::vector<std::string> eps;
+  std::ifstream in(path);
+  if (!in) return eps;
+  std::string line;
+  while (std::getline(in, line)) {
+    // strip whitespace and comments
+    auto hash = line.find('#');
+    if (hash != std::string::npos) line = line.substr(0, hash);
+    size_t b = line.find_first_not_of(" \t\r");
+    if (b == std::string::npos) continue;
+    size_t e = line.find_last_not_of(" \t\r");
+    eps.push_back(line.substr(b, e - b + 1));
+  }
+  return eps;
+}
+
+bool TcpNet::Init(const std::vector<std::string>& endpoints, int rank,
+                  InboundFn fn) {
+  endpoints_ = endpoints;
+  rank_ = rank;
+  inbound_ = std::move(fn);
+  send_fds_.assign(endpoints_.size(), -1);
+  send_mus_.clear();
+  for (size_t i = 0; i < endpoints_.size(); ++i)
+    send_mus_.push_back(std::make_unique<std::mutex>());
+
+  std::string host;
+  int port = 0;
+  if (rank_ < 0 || rank_ >= static_cast<int>(endpoints_.size()) ||
+      !SplitHostPort(endpoints_[rank_], &host, &port)) {
+    Log::Error("TcpNet: bad rank %d / endpoint list (%zu entries)", rank_,
+               endpoints_.size());
+    return false;
+  }
+
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return false;
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      ::listen(listen_fd_, 64) < 0) {
+    Log::Error("TcpNet: cannot listen on port %d", port);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return false;
+  }
+  running_ = true;
+  accept_thread_ = std::thread([this] { AcceptLoop(); });
+  Log::Info("TcpNet: rank %d/%zu listening on :%d", rank_,
+            endpoints_.size(), port);
+  return true;
+}
+
+void TcpNet::AcceptLoop() {
+  while (true) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;  // listen_fd_ closed by Stop
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> lk(readers_mu_);
+    if (!running_) {
+      ::close(fd);
+      return;
+    }
+    accepted_fds_.push_back(fd);
+    readers_.emplace_back([this, fd] { ReadLoop(fd); });
+  }
+}
+
+void TcpNet::ReadLoop(int fd) {
+  while (true) {
+    int64_t len = 0;
+    if (!ReadAll(fd, &len, sizeof(len)) || len <= 0 ||
+        len > (int64_t{1} << 40)) {
+      ::close(fd);
+      return;
+    }
+    Blob buf(static_cast<size_t>(len));
+    if (!ReadAll(fd, buf.data(), buf.size())) {
+      ::close(fd);
+      return;
+    }
+    if (inbound_) inbound_(Message::Deserialize(buf));
+  }
+}
+
+int TcpNet::ConnectTo(int dst_rank) {
+  std::string host;
+  int port = 0;
+  if (!SplitHostPort(endpoints_[dst_rank], &host, &port)) return -1;
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                    &res) != 0 ||
+      !res)
+    return -1;
+  // Peers start in any order: retry for up to ~15 s before giving up.
+  int fd = -1;
+  for (int attempt = 0; attempt < 150; ++attempt) {
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) break;
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      break;
+    }
+    ::close(fd);
+    fd = -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!running_) break;
+    }
+  }
+  ::freeaddrinfo(res);
+  return fd;
+}
+
+bool TcpNet::Send(int dst_rank, const Message& msg) {
+  if (dst_rank < 0 || dst_rank >= static_cast<int>(endpoints_.size()))
+    return false;
+  Blob wire = msg.Serialize();
+  int64_t len = static_cast<int64_t>(wire.size());
+  std::lock_guard<std::mutex> lk(*send_mus_[dst_rank]);
+  if (send_fds_[dst_rank] < 0)
+    send_fds_[dst_rank] = ConnectTo(dst_rank);
+  int fd = send_fds_[dst_rank];
+  if (fd < 0) {
+    Log::Error("TcpNet: cannot reach rank %d (%s)", dst_rank,
+               endpoints_[dst_rank].c_str());
+    return false;
+  }
+  if (!WriteAll(fd, &len, sizeof(len)) ||
+      !WriteAll(fd, wire.data(), wire.size())) {
+    ::close(fd);
+    send_fds_[dst_rank] = -1;
+    Log::Error("TcpNet: send to rank %d failed", dst_rank);
+    return false;
+  }
+  return true;
+}
+
+void TcpNet::Stop() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!running_ && listen_fd_ < 0) return;
+    running_ = false;
+  }
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  for (size_t i = 0; i < send_fds_.size(); ++i) {
+    std::lock_guard<std::mutex> lk(*send_mus_[i]);
+    if (send_fds_[i] >= 0) {
+      ::shutdown(send_fds_[i], SHUT_RDWR);
+      ::close(send_fds_[i]);
+      send_fds_[i] = -1;
+    }
+  }
+  std::vector<std::thread> readers;
+  {
+    std::lock_guard<std::mutex> lk(readers_mu_);
+    // Unblock readers stuck in recv() even if the peer never closes.
+    for (int fd : accepted_fds_) ::shutdown(fd, SHUT_RDWR);
+    accepted_fds_.clear();
+    readers.swap(readers_);
+  }
+  for (auto& t : readers)
+    if (t.joinable()) t.join();
+}
+
+}  // namespace mvtpu

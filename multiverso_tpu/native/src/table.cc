@@ -1,11 +1,14 @@
-// Wire conventions between worker stubs and server tables (in-process):
-//   Array Get      req: no blobs                 reply: [float data]
-//   Array Add      req: [AddOption][float delta]
-//   Matrix GetAll  req: no blobs                 reply: [float data]
-//   Matrix GetRows req: [int32 ids]              reply: [float rows-packed]
-//   Matrix AddAll  req: [AddOption][float delta]
-//   Matrix AddRows req: [AddOption][int32 ids][float rows-packed]
-// msg_id >= 0 means the caller blocks on a reply; msg_id < 0 is async.
+// Wire conventions between worker stubs and server shards:
+//   Array Get      req: no blobs                 reply: [float local-shard]
+//   Array Add      req: [AddOption][float shard-slice]
+//   Matrix GetAll  req: no blobs                 reply: [float row-block]
+//   Matrix GetRows req: [int32 global ids]       reply: [float rows-packed]
+//   Matrix AddAll  req: [AddOption][float row-block-slice]
+//   Matrix AddRows req: [AddOption][int32 global ids][float rows-packed]
+// The worker partitions every request across shard owners (ShardOf /
+// OwnerOf are the partition contract) and reassembles replies by the
+// reply's src rank.  msg_id >= 0 means the caller blocks until every
+// contacted shard replied; msg_id < 0 is async.
 #include "mvtpu/table.h"
 
 #include <cstring>
@@ -18,8 +21,10 @@ namespace mvtpu {
 
 // ---------------------------------------------------------------- server
 
-ArrayServerTable::ArrayServerTable(int64_t size, UpdaterType updater)
-    : data_(static_cast<size_t>(size), 0.0f), updater_(updater) {
+ArrayServerTable::ArrayServerTable(int64_t global_size, UpdaterType updater,
+                                   int rank, int size)
+    : range_(ShardOf(global_size, rank, size)),
+      data_(static_cast<size_t>(range_.len()), 0.0f), updater_(updater) {
   if (NumSlots(updater_) > 0) slot0_.assign(data_.size(), 0.0f);
 }
 
@@ -45,7 +50,8 @@ void ArrayServerTable::ProcessAdd(const Message& req) {
 }
 
 bool ArrayServerTable::Store(Stream* out) const {
-  int64_t n = size();
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t n = static_cast<int64_t>(data_.size());
   return out->Write(&n, sizeof(n)) == sizeof(n) &&
          out->Write(data_.data(), n * sizeof(float)) == n * sizeof(float) &&
          (slot0_.empty() ||
@@ -53,8 +59,11 @@ bool ArrayServerTable::Store(Stream* out) const {
 }
 
 bool ArrayServerTable::Load(Stream* in) {
+  std::lock_guard<std::mutex> lk(mu_);
   int64_t n = 0;
-  if (in->Read(&n, sizeof(n)) != sizeof(n) || n != size()) return false;
+  if (in->Read(&n, sizeof(n)) != sizeof(n) ||
+      n != static_cast<int64_t>(data_.size()))
+    return false;
   if (in->Read(data_.data(), n * sizeof(float)) !=
       static_cast<size_t>(n) * sizeof(float))
     return false;
@@ -66,16 +75,17 @@ bool ArrayServerTable::Load(Stream* in) {
 }
 
 MatrixServerTable::MatrixServerTable(int64_t rows, int64_t cols,
-                                     UpdaterType updater)
-    : rows_(rows), cols_(cols),
-      data_(static_cast<size_t>(rows * cols), 0.0f), updater_(updater) {
+                                     UpdaterType updater, int rank, int size)
+    : global_rows_(rows), cols_(cols), range_(ShardOf(rows, rank, size)),
+      data_(static_cast<size_t>(range_.len() * cols), 0.0f),
+      updater_(updater) {
   if (NumSlots(updater_) > 0) slot0_.assign(data_.size(), 0.0f);
 }
 
 void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
   Monitor mon("MatrixServer::ProcessGet");
   std::lock_guard<std::mutex> lk(mu_);
-  if (req.data.empty()) {  // GetAll
+  if (req.data.empty()) {  // GetAll: reply with the local row block
     reply->data.emplace_back(data_.data(), data_.size() * sizeof(float));
     return;
   }
@@ -84,8 +94,9 @@ void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
   Blob out(k * cols_ * sizeof(float));
   float* dst = out.As<float>();
   for (size_t i = 0; i < k; ++i) {
-    int64_t r = ids[i];
-    if (r < 0 || r >= rows_) {  // out-of-range rows read as zeros
+    int64_t r = ids[i] - range_.begin;  // global -> local row
+    if (ids[i] < 0 || ids[i] >= global_rows_ || r < 0 || r >= range_.len()) {
+      // out-of-range / mis-routed rows read as zeros
       std::memset(dst + i * cols_, 0, cols_ * sizeof(float));
       continue;
     }
@@ -100,7 +111,7 @@ void MatrixServerTable::ProcessAdd(const Message& req) {
   const AddOption* opt = req.data[0].As<AddOption>();
   std::lock_guard<std::mutex> lk(mu_);
   float* slots = slot0_.empty() ? nullptr : slot0_.data();
-  if (req.data.size() == 2) {  // AddAll
+  if (req.data.size() == 2) {  // AddAll: the local row-block slice
     const float* delta = req.data[1].As<float>();
     if (req.data[1].count<float>() != data_.size()) {
       Log::Error("MatrixServerTable: AddAll size mismatch");
@@ -109,8 +120,6 @@ void MatrixServerTable::ProcessAdd(const Message& req) {
     ApplyUpdate(updater_, *opt, data_.data(), slots, delta, data_.size());
     return;
   }
-  // AddRows: rows applied sequentially — duplicate ids compose like
-  // consecutive reference Adds.
   const int32_t* ids = req.data[1].As<int32_t>();
   size_t k = req.data[1].count<int32_t>();
   const float* delta = req.data[2].As<float>();
@@ -118,17 +127,41 @@ void MatrixServerTable::ProcessAdd(const Message& req) {
     Log::Error("MatrixServerTable: AddRows size mismatch");
     return;
   }
+  if (!slots) {
+    // Stateless add: sequential application composes like consecutive
+    // reference Adds (duplicates sum).
+    for (size_t i = 0; i < k; ++i) {
+      int64_t r = ids[i] - range_.begin;
+      if (ids[i] < 0 || ids[i] >= global_rows_ || r < 0 || r >= range_.len())
+        continue;
+      ApplyUpdate(updater_, *opt, data_.data() + r * cols_, nullptr,
+                  delta + i * cols_, static_cast<size_t>(cols_));
+    }
+    return;
+  }
+  // Stateful updaters (adagrad/momentum/...): pre-aggregate duplicate row
+  // ids so the math matches the JAX plane, which segment-sums duplicates
+  // before one updater call per row (tables/matrix_table.py).
+  std::unordered_map<int64_t, std::vector<float>> agg;
   for (size_t i = 0; i < k; ++i) {
-    int64_t r = ids[i];
-    if (r < 0 || r >= rows_) continue;  // out-of-range rows dropped
-    ApplyUpdate(updater_, *opt, data_.data() + r * cols_,
-                slots ? slots + r * cols_ : nullptr, delta + i * cols_,
+    int64_t r = ids[i] - range_.begin;
+    if (ids[i] < 0 || ids[i] >= global_rows_ || r < 0 || r >= range_.len())
+      continue;
+    auto& acc = agg[r];
+    if (acc.empty()) acc.assign(static_cast<size_t>(cols_), 0.0f);
+    const float* src = delta + i * cols_;
+    for (int64_t c = 0; c < cols_; ++c) acc[c] += src[c];
+  }
+  for (auto& kv : agg) {
+    ApplyUpdate(updater_, *opt, data_.data() + kv.first * cols_,
+                slots + kv.first * cols_, kv.second.data(),
                 static_cast<size_t>(cols_));
   }
 }
 
 bool MatrixServerTable::Store(Stream* out) const {
-  int64_t hdr[2] = {rows_, cols_};
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t hdr[2] = {range_.len(), cols_};
   size_t bytes = data_.size() * sizeof(float);
   return out->Write(hdr, sizeof(hdr)) == sizeof(hdr) &&
          out->Write(data_.data(), bytes) == bytes &&
@@ -136,8 +169,9 @@ bool MatrixServerTable::Store(Stream* out) const {
 }
 
 bool MatrixServerTable::Load(Stream* in) {
+  std::lock_guard<std::mutex> lk(mu_);
   int64_t hdr[2];
-  if (in->Read(hdr, sizeof(hdr)) != sizeof(hdr) || hdr[0] != rows_ ||
+  if (in->Read(hdr, sizeof(hdr)) != sizeof(hdr) || hdr[0] != range_.len() ||
       hdr[1] != cols_)
     return false;
   size_t bytes = data_.size() * sizeof(float);
@@ -150,6 +184,7 @@ bool MatrixServerTable::Load(Stream* in) {
 
 void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
   Pending p;
+  bool done = false;
   {
     std::lock_guard<std::mutex> lk(mu_);
     auto it = pending_.find(msg_id);
@@ -159,101 +194,177 @@ void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
       return;
     }
     p = it->second;
-    pending_.erase(it);
+    done = (--it->second.remaining == 0);
+    if (done) pending_.erase(it);
   }
   if (p.consume) p.consume(p.arg, reply);
   p.waiter->Notify();
+  (void)done;
 }
 
-void WorkerTable::RoundTrip(MessagePtr req,
+void WorkerTable::RoundTrip(std::vector<MessagePtr> reqs,
                             void (*consume)(void*, const Message&),
                             void* arg) {
-  Waiter waiter(1);
+  if (reqs.empty()) return;
+  Waiter waiter(static_cast<int>(reqs.size()));
+  int64_t msg_id = reqs[0]->msg_id;
   {
     std::lock_guard<std::mutex> lk(mu_);
-    pending_[req->msg_id] = Pending{&waiter, consume, arg};
+    pending_[msg_id] =
+        Pending{&waiter, consume, arg, static_cast<int>(reqs.size())};
   }
-  Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+  for (auto& req : reqs)
+    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
   waiter.Wait();
 }
 
 namespace {
-struct CopyDest {
-  float* dst;
-  size_t count;
-};
-void CopyReply(void* arg, const Message& reply) {
-  auto* d = static_cast<CopyDest*>(arg);
-  size_t n = reply.data.empty() ? 0 : reply.data[0].count<float>();
-  if (n > d->count) n = d->count;
-  if (n) std::memcpy(d->dst, reply.data[0].As<float>(), n * sizeof(float));
+
+MessagePtr MakeReq(MsgType type, int32_t table_id, int64_t msg_id, int dst) {
+  auto req = std::make_unique<Message>();
+  req->type = type;
+  req->table_id = table_id;
+  req->msg_id = msg_id;
+  req->src = Zoo::Get()->rank();
+  req->dst = dst;
+  return req;
 }
+
+// Assemble contiguous-shard replies into the caller's buffer: the reply's
+// src rank names the shard, ShardOf names its offsets.
+struct GatherDest {
+  float* dst;
+  size_t cap;        // caller buffer length (floats)
+  int64_t global;    // partitioned length (array elems or matrix rows)
+  int servers;
+  int64_t stride;    // floats per partitioned element (1 or cols)
+};
+
+void GatherReply(void* arg, const Message& reply) {
+  auto* d = static_cast<GatherDest*>(arg);
+  if (reply.data.empty()) return;
+  ShardRange rg = ShardOf(d->global, reply.src, d->servers);
+  size_t off = static_cast<size_t>(rg.begin * d->stride);
+  size_t n = reply.data[0].count<float>();
+  if (off >= d->cap) return;
+  n = std::min(n, d->cap - off);
+  std::memcpy(d->dst + off, reply.data[0].As<float>(), n * sizeof(float));
+}
+
+// Scatter row-subset replies: positions[src] lists, per contacted rank,
+// the caller-order slots its rows fill (in request order).
+struct RowsDest {
+  float* dst;
+  int64_t cols;
+  const std::vector<std::vector<int64_t>>* positions;
+};
+
+void ScatterRowsReply(void* arg, const Message& reply) {
+  auto* d = static_cast<RowsDest*>(arg);
+  if (reply.data.empty()) return;
+  const auto& pos = (*d->positions)[static_cast<size_t>(reply.src)];
+  const float* src = reply.data[0].As<float>();
+  size_t have = reply.data[0].count<float>() / d->cols;
+  for (size_t i = 0; i < pos.size() && i < have; ++i) {
+    std::memcpy(d->dst + pos[i] * d->cols, src + i * d->cols,
+                d->cols * sizeof(float));
+  }
+}
+
 void DiscardReply(void*, const Message&) {}
+
 }  // namespace
 
 void ArrayWorkerTable::Get(float* data, int64_t size) {
   Monitor mon("ArrayWorker::Get");
-  auto req = std::make_unique<Message>();
-  req->type = MsgType::RequestGet;
-  req->table_id = table_id_;
-  req->msg_id = Zoo::Get()->NextMsgId();
-  CopyDest d{data, static_cast<size_t>(size)};
-  RoundTrip(std::move(req), CopyReply, &d);
+  int64_t msg_id = Zoo::Get()->NextMsgId();
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r)
+    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r));
+  GatherDest d{data, static_cast<size_t>(size), global_, servers_, 1};
+  RoundTrip(std::move(reqs), GatherReply, &d);
 }
 
 void ArrayWorkerTable::Add(const float* delta, int64_t size,
                            const AddOption& opt, bool blocking) {
   Monitor mon("ArrayWorker::Add");
-  auto req = std::make_unique<Message>();
-  req->type = MsgType::RequestAdd;
-  req->table_id = table_id_;
-  req->data.emplace_back(&opt, sizeof(opt));
-  req->data.emplace_back(delta, size * sizeof(float));
+  int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r) {
+    ShardRange rg = ShardOf(global_, r, servers_);
+    if (rg.begin >= size) continue;
+    auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
+    req->data.emplace_back(&opt, sizeof(opt));
+    req->data.emplace_back(delta + rg.begin,
+                           std::min(rg.len(), size - rg.begin) *
+                               sizeof(float));
+    reqs.push_back(std::move(req));
+  }
   if (blocking) {
-    req->msg_id = Zoo::Get()->NextMsgId();
-    RoundTrip(std::move(req), DiscardReply, nullptr);
+    RoundTrip(std::move(reqs), DiscardReply, nullptr);
   } else {
-    req->msg_id = -1;
-    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+    for (auto& req : reqs)
+      Zoo::Get()->SendTo(actor::kWorker, std::move(req));
   }
 }
 
 void MatrixWorkerTable::GetAll(float* data) {
   Monitor mon("MatrixWorker::GetAll");
-  auto req = std::make_unique<Message>();
-  req->type = MsgType::RequestGet;
-  req->table_id = table_id_;
-  req->msg_id = Zoo::Get()->NextMsgId();
-  CopyDest d{data, static_cast<size_t>(rows_ * cols_)};
-  RoundTrip(std::move(req), CopyReply, &d);
+  int64_t msg_id = Zoo::Get()->NextMsgId();
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r)
+    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r));
+  GatherDest d{data, static_cast<size_t>(rows_ * cols_), rows_, servers_,
+               cols_};
+  RoundTrip(std::move(reqs), GatherReply, &d);
 }
 
 void MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
                                 float* data) {
   Monitor mon("MatrixWorker::GetRows");
-  auto req = std::make_unique<Message>();
-  req->type = MsgType::RequestGet;
-  req->table_id = table_id_;
-  req->msg_id = Zoo::Get()->NextMsgId();
-  req->data.emplace_back(row_ids, k * sizeof(int32_t));
-  CopyDest d{data, static_cast<size_t>(k * cols_)};
-  RoundTrip(std::move(req), CopyReply, &d);
+  // Partition ids by owner; remember which caller slots each owner fills.
+  std::vector<std::vector<int32_t>> per_rank_ids(servers_);
+  std::vector<std::vector<int64_t>> positions(servers_);
+  for (int64_t i = 0; i < k; ++i) {
+    int owner = (row_ids[i] >= 0 && row_ids[i] < rows_)
+                    ? OwnerOf(row_ids[i], rows_, servers_)
+                    : 0;  // out-of-range: any shard answers zeros
+    per_rank_ids[owner].push_back(row_ids[i]);
+    positions[owner].push_back(i);
+  }
+  std::memset(data, 0, static_cast<size_t>(k * cols_) * sizeof(float));
+  int64_t msg_id = Zoo::Get()->NextMsgId();
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r) {
+    if (per_rank_ids[r].empty()) continue;
+    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r);
+    req->data.emplace_back(per_rank_ids[r].data(),
+                           per_rank_ids[r].size() * sizeof(int32_t));
+    reqs.push_back(std::move(req));
+  }
+  RowsDest d{data, cols_, &positions};
+  RoundTrip(std::move(reqs), ScatterRowsReply, &d);
 }
 
 void MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,
                                bool blocking) {
   Monitor mon("MatrixWorker::AddAll");
-  auto req = std::make_unique<Message>();
-  req->type = MsgType::RequestAdd;
-  req->table_id = table_id_;
-  req->data.emplace_back(&opt, sizeof(opt));
-  req->data.emplace_back(delta, rows_ * cols_ * sizeof(float));
+  int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r) {
+    ShardRange rg = ShardOf(rows_, r, servers_);
+    if (rg.len() == 0) continue;
+    auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
+    req->data.emplace_back(&opt, sizeof(opt));
+    req->data.emplace_back(delta + rg.begin * cols_,
+                           rg.len() * cols_ * sizeof(float));
+    reqs.push_back(std::move(req));
+  }
   if (blocking) {
-    req->msg_id = Zoo::Get()->NextMsgId();
-    RoundTrip(std::move(req), DiscardReply, nullptr);
+    RoundTrip(std::move(reqs), DiscardReply, nullptr);
   } else {
-    req->msg_id = -1;
-    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+    for (auto& req : reqs)
+      Zoo::Get()->SendTo(actor::kWorker, std::move(req));
   }
 }
 
@@ -261,18 +372,34 @@ void MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
                                 const float* delta, const AddOption& opt,
                                 bool blocking) {
   Monitor mon("MatrixWorker::AddRows");
-  auto req = std::make_unique<Message>();
-  req->type = MsgType::RequestAdd;
-  req->table_id = table_id_;
-  req->data.emplace_back(&opt, sizeof(opt));
-  req->data.emplace_back(row_ids, k * sizeof(int32_t));
-  req->data.emplace_back(delta, k * cols_ * sizeof(float));
+  std::vector<std::vector<int32_t>> per_rank_ids(servers_);
+  std::vector<std::vector<float>> per_rank_delta(servers_);
+  for (int64_t i = 0; i < k; ++i) {
+    if (row_ids[i] < 0 || row_ids[i] >= rows_) continue;  // dropped
+    int owner = OwnerOf(row_ids[i], rows_, servers_);
+    per_rank_ids[owner].push_back(row_ids[i]);
+    per_rank_delta[owner].insert(per_rank_delta[owner].end(),
+                                 delta + i * cols_,
+                                 delta + (i + 1) * cols_);
+  }
+  int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r) {
+    if (per_rank_ids[r].empty()) continue;
+    auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
+    req->data.emplace_back(&opt, sizeof(opt));
+    req->data.emplace_back(per_rank_ids[r].data(),
+                           per_rank_ids[r].size() * sizeof(int32_t));
+    req->data.emplace_back(per_rank_delta[r].data(),
+                           per_rank_delta[r].size() * sizeof(float));
+    reqs.push_back(std::move(req));
+  }
+  if (reqs.empty()) return;
   if (blocking) {
-    req->msg_id = Zoo::Get()->NextMsgId();
-    RoundTrip(std::move(req), DiscardReply, nullptr);
+    RoundTrip(std::move(reqs), DiscardReply, nullptr);
   } else {
-    req->msg_id = -1;
-    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+    for (auto& req : reqs)
+      Zoo::Get()->SendTo(actor::kWorker, std::move(req));
   }
 }
 

@@ -9,23 +9,21 @@ namespace mvtpu {
 
 namespace {
 
-// Barrier messages carry the requester's Waiter through the actor chain
-// worker → server → controller so every request enqueued before the
-// barrier is processed before it completes (the flush guarantee).
-struct BarrierPayload {
-  Waiter* waiter;
-};
-
+// The actor chain worker → server → controller carries barrier messages
+// so every request enqueued before the barrier is processed before it
+// completes (the flush guarantee); across processes the server leg
+// forwards to rank 0's controller over TCP.
 class WorkerActor : public Actor {
  public:
   WorkerActor() : Actor(actor::kWorker) {
     RegisterHandler(MsgType::RequestGet, [](MessagePtr& m) {
-      Zoo::Get()->SendTo(actor::kServer, std::move(m));
+      Zoo::Get()->Deliver(actor::kServer, std::move(m));
     });
     RegisterHandler(MsgType::RequestAdd, [](MessagePtr& m) {
-      Zoo::Get()->SendTo(actor::kServer, std::move(m));
+      Zoo::Get()->Deliver(actor::kServer, std::move(m));
     });
     RegisterHandler(MsgType::ControlBarrier, [](MessagePtr& m) {
+      // Local pipeline flush leg: worker → (local) server.
       Zoo::Get()->SendTo(actor::kServer, std::move(m));
     });
     RegisterHandler(MsgType::ReplyGet, [](MessagePtr& m) {
@@ -46,8 +44,10 @@ class ServerActor : public Actor {
       reply->type = MsgType::ReplyGet;
       reply->table_id = m->table_id;
       reply->msg_id = m->msg_id;
+      reply->src = Zoo::Get()->rank();
+      reply->dst = m->src;
       table->ProcessGet(*m, reply.get());
-      Zoo::Get()->SendTo(actor::kWorker, std::move(reply));
+      Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
     });
     RegisterHandler(MsgType::RequestAdd, [](MessagePtr& m) {
       Zoo::Get()->server_table(m->table_id)->ProcessAdd(*m);
@@ -56,11 +56,14 @@ class ServerActor : public Actor {
         reply->type = MsgType::ReplyAdd;
         reply->table_id = m->table_id;
         reply->msg_id = m->msg_id;
-        Zoo::Get()->SendTo(actor::kWorker, std::move(reply));
+        reply->src = Zoo::Get()->rank();
+        reply->dst = m->src;
+        Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
       }
     });
     RegisterHandler(MsgType::ControlBarrier, [](MessagePtr& m) {
-      Zoo::Get()->SendTo(actor::kController, std::move(m));
+      m->dst = 0;  // the controller authority lives on rank 0
+      Zoo::Get()->Deliver(actor::kController, std::move(m));
     });
   }
 };
@@ -69,8 +72,11 @@ class ControllerActor : public Actor {
  public:
   ControllerActor() : Actor(actor::kController) {
     RegisterHandler(MsgType::ControlBarrier, [](MessagePtr& m) {
-      // Single-process control plane: all (one) participants arrived.
-      m->data[0].As<BarrierPayload>()->waiter->Notify();
+      Zoo::Get()->OnBarrierArrive(m->src);
+    });
+    RegisterHandler(MsgType::ControlBarrierReply, [](MessagePtr& m) {
+      (void)m;
+      Zoo::Get()->OnBarrierRelease();
     });
   }
 };
@@ -100,6 +106,23 @@ bool Zoo::Start(int argc, const char* const* argv) {
                                  : LogLevel::kInfo);
   Log::ResetLogFile(configure::GetString("log_file"));
 
+  rank_ = 0;
+  size_ = 1;
+  std::string machine_file = configure::GetString("machine_file");
+  if (!machine_file.empty()) {
+    auto endpoints = TcpNet::ParseMachineFile(machine_file);
+    if (endpoints.size() > 1) {
+      rank_ = static_cast<int>(configure::GetInt("rank"));
+      size_ = static_cast<int>(endpoints.size());
+      net_ = std::make_unique<TcpNet>();
+      if (!net_->Init(endpoints, rank_,
+                      [this](Message&& m) { RouteInbound(std::move(m)); })) {
+        net_.reset();
+        return false;
+      }
+    }
+  }
+
   worker_actor_ = std::make_unique<WorkerActor>();
   server_actor_ = std::make_unique<ServerActor>();
   controller_actor_ = std::make_unique<ControllerActor>();
@@ -107,7 +130,8 @@ bool Zoo::Start(int argc, const char* const* argv) {
   server_actor_->Start();
   controller_actor_->Start();
   started_ = true;
-  Log::Info("mvtpu native runtime started (updater=%s)", upd.c_str());
+  Log::Info("mvtpu native runtime started (rank %d/%d, updater=%s)", rank_,
+            size_, upd.c_str());
   return true;
 }
 
@@ -115,6 +139,12 @@ void Zoo::Stop() {
   {
     std::lock_guard<std::mutex> lk(mu_);
     if (!started_) return;
+  }
+  // Cross-process: no rank may tear down while peers still need its
+  // server shard — rendezvous first (also flushes every pipeline).
+  if (size_ > 1) Barrier();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
     started_ = false;
   }
   // Join OUTSIDE mu_: a draining handler may query the table registry.
@@ -122,31 +152,71 @@ void Zoo::Stop() {
   worker_actor_->Stop();
   server_actor_->Stop();
   controller_actor_->Stop();
+  if (net_) net_->Stop();
   std::lock_guard<std::mutex> lk(mu_);
   worker_actor_.reset();
   server_actor_.reset();
   controller_actor_.reset();
+  net_.reset();
   {
     std::lock_guard<std::mutex> tlk(tables_mu_);
     server_tables_.clear();
     worker_tables_.clear();
   }
+  rank_ = 0;
+  size_ = 1;
   Log::Info("%s", Dashboard::Report().c_str());
 }
 
 void Zoo::Barrier() {
   Monitor mon("Zoo::Barrier");
   Waiter waiter(1);
-  BarrierPayload payload{&waiter};
+  {
+    std::lock_guard<std::mutex> lk(barrier_mu_);
+    barrier_waiter_ = &waiter;
+  }
   auto msg = std::make_unique<Message>();
   msg->type = MsgType::ControlBarrier;
   msg->msg_id = NextMsgId();
-  msg->data.emplace_back(&payload, sizeof(payload));
+  msg->src = rank_;
+  msg->dst = 0;
   SendTo(actor::kWorker, std::move(msg));
   waiter.Wait();
+  std::lock_guard<std::mutex> lk(barrier_mu_);
+  barrier_waiter_ = nullptr;
+}
+
+void Zoo::OnBarrierArrive(int src_rank) {
+  (void)src_rank;
+  std::vector<int> release;
+  {
+    std::lock_guard<std::mutex> lk(barrier_mu_);
+    if (++barrier_arrivals_ < size_) return;
+    barrier_arrivals_ = 0;
+    for (int r = 0; r < size_; ++r) release.push_back(r);
+  }
+  for (int r : release) {
+    if (r == rank_) {
+      OnBarrierRelease();
+    } else {
+      Message reply;
+      reply.type = MsgType::ControlBarrierReply;
+      reply.src = rank_;
+      reply.dst = r;
+      net_->Send(r, reply);
+    }
+  }
+}
+
+void Zoo::OnBarrierRelease() {
+  std::lock_guard<std::mutex> lk(barrier_mu_);
+  if (barrier_waiter_) barrier_waiter_->Notify();
 }
 
 void Zoo::SendTo(const std::string& actor_name, MessagePtr msg) {
+  // Snapshot the pointer AND push under mu_ so a concurrent Stop cannot
+  // free the actor between the lookup and the mailbox push.
+  std::lock_guard<std::mutex> lk(mu_);
   Actor* a = nullptr;
   if (actor_name == actor::kWorker) a = worker_actor_.get();
   else if (actor_name == actor::kServer) a = server_actor_.get();
@@ -158,22 +228,52 @@ void Zoo::SendTo(const std::string& actor_name, MessagePtr msg) {
   a->Receive(std::move(msg));
 }
 
+void Zoo::Deliver(const std::string& actor_name, MessagePtr msg) {
+  if (msg->dst < 0 || msg->dst == rank_ || !net_) {
+    SendTo(actor_name, std::move(msg));
+    return;
+  }
+  net_->Send(msg->dst, *msg);
+}
+
+void Zoo::RouteInbound(Message&& m) {
+  auto msg = std::make_unique<Message>(std::move(m));
+  switch (msg->type) {
+    case MsgType::RequestGet:
+    case MsgType::RequestAdd:
+      SendTo(actor::kServer, std::move(msg));
+      break;
+    case MsgType::ReplyGet:
+    case MsgType::ReplyAdd:
+      SendTo(actor::kWorker, std::move(msg));
+      break;
+    case MsgType::ControlBarrier:
+    case MsgType::ControlBarrierReply:
+      SendTo(actor::kController, std::move(msg));
+      break;
+    default:
+      Log::Error("RouteInbound: unhandled message type %d",
+                 static_cast<int>(msg->type));
+  }
+}
+
 int32_t Zoo::RegisterArrayTable(int64_t size) {
   std::lock_guard<std::mutex> lk(tables_mu_);
   int32_t id = static_cast<int32_t>(server_tables_.size());
   server_tables_.push_back(
-      std::make_unique<ArrayServerTable>(size, updater_type_));
-  worker_tables_.push_back(std::make_unique<ArrayWorkerTable>(id));
+      std::make_unique<ArrayServerTable>(size, updater_type_, rank_, size_));
+  worker_tables_.push_back(
+      std::make_unique<ArrayWorkerTable>(id, size, size_));
   return id;
 }
 
 int32_t Zoo::RegisterMatrixTable(int64_t rows, int64_t cols) {
   std::lock_guard<std::mutex> lk(tables_mu_);
   int32_t id = static_cast<int32_t>(server_tables_.size());
-  server_tables_.push_back(
-      std::make_unique<MatrixServerTable>(rows, cols, updater_type_));
+  server_tables_.push_back(std::make_unique<MatrixServerTable>(
+      rows, cols, updater_type_, rank_, size_));
   worker_tables_.push_back(
-      std::make_unique<MatrixWorkerTable>(id, rows, cols));
+      std::make_unique<MatrixWorkerTable>(id, rows, cols, size_));
   return id;
 }
 

@@ -191,7 +191,64 @@ static int TestThreads() {
   return 0;
 }
 
+static int NetChild(const char* machine_file, const char* rank) {
+  // Two-process scenario (spawned twice by tests/test_native.py): sharded
+  // tables over the TCP transport — Add/Get round-trips cross the process
+  // boundary, MV_Barrier rendezvouses through rank 0's controller.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+                         "-log_level=error"};
+  CHECK(MV_Init(4, argv2) == 0);
+  int me = MV_WorkerId();
+  CHECK(MV_NumWorkers() == 2);
+
+  int32_t h;
+  CHECK(MV_NewArrayTable(10, &h) == 0);
+  int32_t hm;
+  CHECK(MV_NewMatrixTable(8, 4, &hm) == 0);
+  CHECK(MV_Barrier() == 0);  // both ranks registered both tables
+
+  // Each rank pushes its own delta; shards live on BOTH ranks, so every
+  // Add crosses the wire for the remote shard. After the barrier both
+  // ranks must read the sum.
+  std::vector<float> delta(10, (float)(me + 1)), out(10, -1.0f);
+  CHECK(MV_AddArrayTable(h, delta.data(), 10) == 0);
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
+  for (float v : out) CHECK(v == 3.0f);
+
+  // Async add flushes through the pipeline before the barrier completes.
+  CHECK(MV_AddAsyncArrayTable(h, delta.data(), 10) == 0);
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
+  for (float v : out) CHECK(v == (float)(3 + 3));
+
+  // Matrix rows: rank r touches rows {r, 4+r} — rows 0..3 live on rank
+  // 0's shard, 4..7 on rank 1's, so half of each batch is remote.
+  int32_t rows[2] = {me, 4 + me};
+  std::vector<float> rd(8, (float)(me + 1));
+  CHECK(MV_AddMatrixTableByRows(hm, rd.data(), rows, 2, 4) == 0);
+  CHECK(MV_Barrier() == 0);
+  int32_t qrows[4] = {0, 1, 4, 5};
+  std::vector<float> rout(16, -1.0f);
+  CHECK(MV_GetMatrixTableByRows(hm, rout.data(), qrows, 4, 4) == 0);
+  for (int c = 0; c < 4; ++c) {
+    CHECK(rout[c] == 1.0f);        // row 0: rank 0 wrote 1s
+    CHECK(rout[4 + c] == 2.0f);    // row 1: rank 1 wrote 2s
+    CHECK(rout[8 + c] == 1.0f);    // row 4: rank 0
+    CHECK(rout[12 + c] == 2.0f);   // row 5: rank 1
+  }
+
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("NET_CHILD_OK %d\n", me);
+  return 0;
+}
+
 int main(int argc, char** argv) {
+  if (argc == 4 && std::string(argv[1]) == "net_child")
+    return NetChild(argv[2], argv[3]);
   struct Case {
     const char* name;
     int (*fn)();
