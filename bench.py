@@ -235,7 +235,9 @@ def _transformer_train_flops(cfg, batch: int, seq: int) -> float:
     """
     p_mat = cfg.n_layers * (4 * cfg.dim * cfg.dim
                             + 3 * cfg.dim * cfg.hidden)
-    p_mat += 2 * cfg.vocab_size * cfg.dim  # embed (gather ~free) + head
+    # Output head only: the embed forward is a gather (no matmul FLOPs)
+    # and its backward a scatter-add, so it contributes no MXU work.
+    p_mat += cfg.vocab_size * cfg.dim
     tokens = batch * seq
     weight_flops = 6 * p_mat * tokens
     attn_flops = 3 * (4 * batch * cfg.n_heads * seq * seq * cfg.head_dim) / 2

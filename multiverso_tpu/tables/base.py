@@ -39,7 +39,17 @@ from .. import config, dashboard
 from ..core import context as core_context
 from ..updaters import AddOption, get_updater
 
-__all__ = ["Table", "host_fetch", "host_put", "is_multiprocess"]
+__all__ = ["Table", "host_fetch", "host_put", "is_multiprocess",
+           "bucket_size", "multihost_allgather_list"]
+
+
+def bucket_size(k: int, floor: int = 8) -> int:
+    """Round ``k`` up to a power-of-two bucket (shape-stable collectives:
+    ``process_allgather`` jits per shape, so bucketing caps recompiles)."""
+    b = floor
+    while b < k:
+        b *= 2
+    return b
 
 
 def is_multiprocess() -> bool:
@@ -91,6 +101,33 @@ def multihost_sum(host_delta):
 
     return np.asarray(
         multihost_utils.process_allgather(host_delta)).sum(axis=0)
+
+
+def multihost_allgather_list(arr):
+    """Allgather variable-length per-rank arrays; returns one array per rank.
+
+    THE one spelling of the "size probe + pad + gather" collective every
+    table-layer multi-host path uses (a second divergent spelling that
+    skipped the probe on some rank would deadlock the job).  Two rounds:
+    a length probe so ranks agree on one padded gather shape, then the
+    payload.  ``arr`` is per-rank [k_r, ...]; the result list holds each
+    rank's trimmed contribution in rank order.  Collective: every process
+    must call it together (even with ``k_r == 0``).
+    """
+    import numpy as np
+
+    if not is_multiprocess():
+        return [arr]
+    from jax.experimental import multihost_utils
+
+    n = arr.shape[0]
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.array([n], np.int64))).ravel()
+    b = bucket_size(max(int(lens.max()), 1))
+    padded = np.zeros((b,) + arr.shape[1:], dtype=arr.dtype)
+    padded[:n] = arr
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return [gathered[r, : int(lens[r])] for r in range(lens.shape[0])]
 
 
 def host_put(host, sharding):

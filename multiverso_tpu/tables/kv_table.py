@@ -7,7 +7,14 @@ it from the server, ``Add`` pushes deltas.
 TPU-native: KV data is control-plane metadata (vocabulary counts, clocks,
 small stats) — it stays on the host.  Values are numpy arrays; updater math
 runs vectorized per key in numpy (the server-side hot loop is trivial at
-this scale).  Multi-host consistency rides the barrier like every table.
+this scale).
+
+Multi-host: like every table, eager ``add`` (and the barrier-driven
+``flush``) is a lockstep collective under ``process_count() > 1`` — each
+rank's update dict is allgathered (pickled bytes, padded to a common
+length) and the per-key delta *sums* are applied identically on every
+rank, so stores converge exactly as the Array/Matrix collective-add
+paths do.
 """
 
 from __future__ import annotations
@@ -91,10 +98,10 @@ class KVTable(Table):
             self._pending = []
 
     def flush(self) -> None:
+        from .base import is_multiprocess
+
         with self._lock:
             pending, self._pending = self._pending, []
-        if not pending:
-            return
         # Aggregate per AddOption so each bucket flushes with its own
         # hyper-parameters.
         merged: Dict[Optional[AddOption], Dict[Any, np.ndarray]] = {}
@@ -105,11 +112,65 @@ class KVTable(Table):
                     bucket[k] = bucket[k] + v
                 else:
                     bucket[k] = v.copy()
+        if is_multiprocess():
+            # ONE collective for the whole flush, entered by every rank
+            # even with nothing pending (a rank that early-returned while
+            # peers allgathered would deadlock the job), carrying the
+            # (option, ups) buckets so ranks whose clocks used different
+            # AddOptions still merge per matching option.
+            merged = self._multihost_merge_buckets(merged)
         for option, ups in merged.items():
-            self._apply_now(ups, option)
+            self._apply_local(ups, option)
+
+    def _allgather_payload(self, payload: Any) -> List[Any]:
+        """Pickle → byte-allgather → unpickle per rank (one collective).
+
+        Same semantic mapping as ``tables.base.multihost_sum``: every
+        rank contributes its own payload, every rank sees the identical
+        rank-ordered list and merges deterministically.
+        """
+        import pickle
+
+        from .base import multihost_allgather_list
+
+        blob = np.frombuffer(pickle.dumps(payload, protocol=4), np.uint8)
+        return [pickle.loads(part.tobytes())
+                for part in multihost_allgather_list(blob)]
+
+    def _multihost_merge_buckets(
+            self, merged: Dict[Optional[AddOption], Dict[Any, np.ndarray]],
+    ) -> Dict[Optional[AddOption], Dict[Any, np.ndarray]]:
+        """Merge every rank's option-keyed flush buckets (collective)."""
+        all_buckets = self._allgather_payload(list(merged.items()))
+        out: Dict[Optional[AddOption], Dict[Any, np.ndarray]] = {}
+        for rank_buckets in all_buckets:
+            for option, ups in rank_buckets:
+                bucket = out.setdefault(option, {})
+                for k, v in ups.items():
+                    if k in bucket:
+                        bucket[k] = bucket[k] + v
+                    else:
+                        bucket[k] = np.asarray(v, dtype=self.dtype).copy()
+        return out
 
     def _apply_now(self, ups: Dict[Any, np.ndarray],
                    option: Optional[AddOption]) -> None:
+        from .base import is_multiprocess
+
+        if is_multiprocess():
+            # Eager-path collective: sum every rank's dict, apply the sum.
+            merged: Dict[Any, np.ndarray] = {}
+            for rank_ups in self._allgather_payload(ups):
+                for k, v in rank_ups.items():
+                    if k in merged:
+                        merged[k] = merged[k] + v
+                    else:
+                        merged[k] = np.asarray(v, dtype=self.dtype).copy()
+            ups = merged
+        self._apply_local(ups, option)
+
+    def _apply_local(self, ups: Dict[Any, np.ndarray],
+                     option: Optional[AddOption]) -> None:
         opt = option or self.default_option
         with self._lock:
             for k, d in ups.items():

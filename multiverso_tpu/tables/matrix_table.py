@@ -24,16 +24,10 @@ import numpy as np
 
 from ..parallel.sharding import shard_along, table_mesh
 from ..updaters import AddOption
-from .base import Table, host_fetch, host_put
+from .base import (Table, bucket_size as _bucket, host_fetch, host_put,
+                   multihost_allgather_list)
 
 __all__ = ["MatrixTable"]
-
-
-def _bucket(k: int, floor: int = 8) -> int:
-    b = floor
-    while b < k:
-        b *= 2
-    return b
 
 
 class MatrixTable(Table):
@@ -79,17 +73,44 @@ class MatrixTable(Table):
 
         Reference: ``MatrixWorkerTable::Get(row_ids)`` partitions ids across
         servers; here it is one compiled gather over the sharded array.
+
+        Multi-host: ranks may ask for different (or no) rows, but the
+        gather + fetch are collectives over the non-fully-addressable
+        array — so the ids are first unioned across processes and every
+        rank runs the identical gather, then slices out its own rows.
         """
+        from .base import is_multiprocess
+
         with self._monitor("GetRows"):
-            rows = np.asarray(row_ids, dtype=np.int32)
-            k = rows.shape[0]
-            if k == 0:
+            rows = np.asarray(row_ids, dtype=np.int64)
+            if is_multiprocess():
+                union = self._allgather_row_ids(rows)
+                k = union.shape[0]
+                if k == 0:
+                    return np.zeros((0, self.num_cols), dtype=self.dtype)
+                fetched = self._gather_host(union.astype(np.int32))
+                if rows.shape[0] == 0:
+                    return np.zeros((0, self.num_cols), dtype=self.dtype)
+                return fetched[np.searchsorted(union, rows)]
+            if rows.shape[0] == 0:
                 return np.zeros((0, self.num_cols), dtype=self.dtype)
-            b = _bucket(k)
-            padded = np.zeros(b, dtype=np.int32)
-            padded[:k] = rows
-            out = self._gather_fn(self._data, jnp.asarray(padded))
-            return host_fetch(out)[:k]
+            return self._gather_host(rows.astype(np.int32))
+
+    def _gather_host(self, rows: np.ndarray) -> np.ndarray:
+        """Bucketed compiled gather + host fetch of ``rows`` (all ranks
+        must call with identical ids under multi-host)."""
+        k = rows.shape[0]
+        b = _bucket(k)
+        padded = np.zeros(b, dtype=np.int32)
+        padded[:k] = rows
+        out = self._gather_fn(self._data, jnp.asarray(padded))
+        return host_fetch(out)[:k]
+
+    @staticmethod
+    def _allgather_row_ids(rows: np.ndarray) -> np.ndarray:
+        """Sorted union of every rank's requested row ids (collective)."""
+        parts = multihost_allgather_list(rows)
+        return np.unique(np.concatenate(parts))
 
     # ------------------------------------------------------------------ Add
     def add(self, delta, option: Optional[AddOption] = None,
@@ -156,30 +177,19 @@ class MatrixTable(Table):
         Multi-host SPMD mapping of per-worker sparse Adds: each process
         contributes its row batch, every process applies the identical
         union batch (duplicates re-aggregated), keeping the global array
-        consistent.  Ranks pad to a common bucket first because
-        ``process_allgather`` needs one shape on every process; padding
-        rows carry the scatter-drop sentinel and zero deltas, so the
-        re-aggregation keeps them inert.
+        consistent.  Rows and deltas ride one float64 buffer through the
+        shared padded-allgather (f64 holds row ids exactly to 2^53).
         """
         from .base import is_multiprocess
 
         if not is_multiprocess():
             return uniq, agg
-        from jax.experimental import multihost_utils
 
-        # Two collective rounds, not three: a tiny size probe (ranks must
-        # agree on one gather shape), then rows and deltas packed into a
-        # single float64 buffer (f64 holds row ids exactly to 2^53).
-        kmax = int(np.max(multihost_utils.process_allgather(
-            np.array([uniq.shape[0]], np.int64))))
-        b = _bucket(max(kmax, 1))
-        packed = np.zeros((b, self.num_cols + 1), dtype=np.float64)
-        packed[:, 0] = self._padded_rows           # scatter-drop sentinel
-        packed[: uniq.shape[0], 0] = uniq
-        packed[: uniq.shape[0], 1:] = agg
-        all_packed = np.asarray(
-            multihost_utils.process_allgather(packed)).reshape(
-                -1, self.num_cols + 1)
+        packed = np.empty((uniq.shape[0], self.num_cols + 1),
+                          dtype=np.float64)
+        packed[:, 0] = uniq
+        packed[:, 1:] = agg
+        all_packed = np.concatenate(multihost_allgather_list(packed))
         uniq2, inv2 = np.unique(
             all_packed[:, 0].astype(np.int64), return_inverse=True)
         agg2 = np.zeros((uniq2.shape[0], self.num_cols), dtype=self.dtype)

@@ -13,7 +13,7 @@ device round-trip until the row is invalidated by an add or a clock tick.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Optional
 
 import numpy as np
 
@@ -28,38 +28,76 @@ class SparseMatrixTable(MatrixTable):
     def __init__(self, *args, cache: bool = True, **kw):
         super().__init__(*args, **kw)
         self._cache_enabled = cache
-        self._row_cache: Dict[int, np.ndarray] = {}
+        # Vectorized cache: a dense [rows, cols] mirror plus a validity
+        # bitmap — no per-row Python objects, so hit/miss classification
+        # is one boolean mask and assembly one fancy-index.  Allocated on
+        # first use so ``cache=False`` tables cost nothing.
+        # Memory note: the mirror is num_rows × num_cols on the host; for
+        # LightLDA-scale word-topic tables that is the same footprint the
+        # reference's worker-side row cache converges to on a hot table.
+        self._cache_valid: Optional[np.ndarray] = None
+        self._cache_data: Optional[np.ndarray] = None
         self._cache_lock = threading.Lock()
 
     def get_rows(self, row_ids, option=None) -> np.ndarray:
+        from .base import is_multiprocess
+
         rows = np.asarray(row_ids, dtype=np.int64)
         if not self._cache_enabled:
             return super().get_rows(rows, option)
-        if rows.shape[0] == 0:
+        multi = is_multiprocess()
+        if rows.shape[0] == 0 and not multi:
             return np.zeros((0, self.num_cols), dtype=self.dtype)
+        # Ids outside [0, num_rows) read the zero padded region on the
+        # device path (static-shape TPU semantics); mirror that here
+        # rather than letting them index the cache arrays.
+        in_range = (rows >= 0) & (rows < self.num_rows)
         # _cache_lock held across the fetch: a concurrent add_rows must not
-        # invalidate entries between the miss check and the stack below.
+        # invalidate entries between the miss check and the assembly below.
         # (Distinct from self._lock, which the inherited add path takes —
         # holding that one here would serialize against device applies.)
         with self._cache_lock:
-            missing = [int(r) for r in rows if int(r) not in self._row_cache]
-            if missing:
-                fetched = super().get_rows(np.asarray(missing), option)
-                for r, v in zip(missing, fetched):
-                    self._row_cache[r] = v
-            return np.stack([self._row_cache[int(r)] for r in rows])
+            if self._cache_valid is None:
+                self._cache_valid = np.zeros(self.num_rows, dtype=bool)
+                self._cache_data = np.zeros(
+                    (self.num_rows, self.num_cols), dtype=self.dtype)
+            safe = rows[in_range]
+            missing = np.unique(safe[~self._cache_valid[safe]])
+            # Multi-host the base fetch is a lockstep collective, so every
+            # rank must join it even with zero local misses (peers may
+            # miss different rows; the union path merges the id sets).
+            if missing.shape[0] or multi:
+                fetched = super().get_rows(missing, option)
+                self._cache_data[missing] = fetched
+                self._cache_valid[missing] = True
+            if in_range.all():
+                return self._cache_data[rows]      # fancy index = fresh copy
+            out = np.zeros((rows.shape[0], self.num_cols), dtype=self.dtype)
+            out[in_range] = self._cache_data[safe]
+            return out
 
     def _invalidate(self, rows: Optional[np.ndarray] = None) -> None:
         with self._cache_lock:
+            if self._cache_valid is None:
+                return
             if rows is None:
-                self._row_cache.clear()
+                self._cache_valid[:] = False
             else:
-                for r in rows:
-                    self._row_cache.pop(int(r), None)
+                rows = np.asarray(rows, dtype=np.int64)
+                rows = rows[(rows >= 0) & (rows < self.num_rows)]
+                self._cache_valid[rows] = False
 
     def add_rows(self, row_ids, delta, option=None, sync: bool = False) -> None:
+        from .base import is_multiprocess
+
         super().add_rows(row_ids, delta, option=option, sync=sync)
-        self._invalidate(np.asarray(row_ids, dtype=np.int64))
+        if is_multiprocess():
+            # The collective apply touched the UNION of every rank's rows
+            # (matrix_table._multihost_union); invalidating only the local
+            # ids would serve peers' updated rows stale from the cache.
+            self._invalidate()
+        else:
+            self._invalidate(np.asarray(row_ids, dtype=np.int64))
 
     def add(self, delta, option=None, sync: bool = False) -> None:
         super().add(delta, option=option, sync=sync)

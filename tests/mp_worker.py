@@ -62,6 +62,42 @@ for r in range(nprocs):
     want[4 + r] = r + 1.0
 np.testing.assert_allclose(gm, want)
 
+# --- MatrixTable.get_rows: per-rank id sets union into one collective ------
+got = m.get_rows(np.array([pid, 7 - pid]))
+np.testing.assert_allclose(got, want[[pid, 7 - pid]])
+# A rank with no rows still joins the collective (empty-id lockstep).
+got = m.get_rows(np.array([], np.int64) if pid == 0 else np.array([3]))
+if pid == 0:
+    assert got.shape == (0, 4)
+else:
+    np.testing.assert_allclose(got, want[[3]])
+
+# --- KVTable: per-rank dict adds allgather-merge into identical stores -----
+kv = mv.KVTable(value_shape=(2,), name="mp_kv")
+kv.add({f"k{pid}": np.full(2, float(pid + 1)), "shared": np.ones(2)})
+g = kv.get([f"k{r}" for r in range(nprocs)] + ["shared"])
+for r in range(nprocs):
+    np.testing.assert_allclose(g[f"k{r}"], np.full(2, float(r + 1)))
+np.testing.assert_allclose(g["shared"], np.full(2, float(nprocs)))
+
+# --- SparseMatrixTable: cached get_rows stays collective-safe across ranks -
+sp = mv.SparseMatrixTable(8, 4, name="mp_sp")
+sp.add_rows(np.array([pid]), np.full((1, 4), float(pid + 1)))
+got = sp.get_rows(np.arange(nprocs))          # miss → collective fill
+want_sp = np.zeros((nprocs, 4), np.float32)
+for r in range(nprocs):
+    want_sp[r] = r + 1.0
+np.testing.assert_allclose(got, want_sp)
+# Second read: rank 0 all-hit, others ask an extra row — every rank must
+# still join the miss collective or the job deadlocks.
+got = sp.get_rows(np.arange(nprocs) if pid == 0
+                  else np.array([pid, nprocs]))
+if pid == 0:
+    np.testing.assert_allclose(got, want_sp)
+else:
+    np.testing.assert_allclose(got[0], want_sp[pid])
+    np.testing.assert_allclose(got[1], 0.0)
+
 # --- BSP: pending until the clock boundary, then one merged apply ----------
 ts = mv.ArrayTable(4, name="mp_sync", sync=True)
 ts.add(np.ones(4, np.float32) * (pid + 1))
