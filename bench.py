@@ -240,40 +240,74 @@ def _transformer_train_flops(cfg, batch: int, seq: int) -> float:
     p_mat += cfg.vocab_size * cfg.dim
     tokens = batch * seq
     weight_flops = 6 * p_mat * tokens
-    attn_flops = 3 * (4 * batch * cfg.n_heads * seq * seq * cfg.head_dim) / 2
+    attn_flops = (cfg.n_layers * 3
+                  * (4 * batch * cfg.n_heads * seq * seq * cfg.head_dim) / 2)
     return weight_flops + attn_flops
 
 
-def bench_transformer(batch: int = 8, seq: int = 2048):
-    """Flagship LM train-step throughput, tokens/sec + MFU (bf16)."""
+_PEAK_CACHE = {}
+
+
+def _peak_flops() -> float:
+    if "v" not in _PEAK_CACHE:
+        _PEAK_CACHE["v"] = _measured_matmul_peak_flops()
+    return _PEAK_CACHE["v"]
+
+
+def _bench_transformer_cfg(cfg, batch, seq, prefix, *, steps=10):
     import jax
     import numpy as np
     from jax.sharding import Mesh
 
-    from multiverso_tpu.models import TransformerConfig, TransformerTrainer
+    from multiverso_tpu.models import TransformerTrainer
 
-    cfg = TransformerConfig(vocab_size=8192, dim=512, n_layers=4, n_heads=8,
-                            hidden=1408, max_seq=seq)
     mesh = Mesh(np.asarray(jax.devices()), ("dp",))
     tr = TransformerTrainer(cfg, mesh, updater_type="sgd")
     toks = np.random.RandomState(0).randint(
-        8192, size=(batch, seq)).astype(np.int32)
+        cfg.vocab_size, size=(batch, seq)).astype(np.int32)
 
     sec = _time_pipelined(lambda: tr.train_step_async(toks),
-                          steps=10, warmup=2, reps=3)
-    out = {"transformer_tokens_per_sec": batch * seq / sec}
+                          steps=steps, warmup=2, reps=3)
+    out = {f"{prefix}_tokens_per_sec": batch * seq / sec}
     try:
-        peak = _measured_matmul_peak_flops()
+        peak = _peak_flops()
         flops = _transformer_train_flops(cfg, batch, seq)
-        out["transformer_model_tflops_per_sec"] = flops / sec / 1e12
+        out[f"{prefix}_model_tflops_per_sec"] = flops / sec / 1e12
         out["matmul_peak_tflops_per_sec"] = peak / 1e12
-        out["transformer_mfu_pct"] = 100.0 * flops / sec / peak
+        out[f"{prefix}_mfu_pct"] = 100.0 * flops / sec / peak
     except Exception:
         traceback.print_exc()
+    del tr
     return out
 
 
-_SECTIONS = [bench_lr, bench_w2v, bench_add_get, bench_transformer]
+def bench_transformer(batch: int = 8, seq: int = 2048):
+    """Flagship LM train-step throughput, tokens/sec + MFU (bf16)."""
+    from multiverso_tpu.models import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=8192, dim=512, n_layers=4, n_heads=8,
+                            hidden=1408, max_seq=seq)
+    return _bench_transformer_cfg(cfg, batch, seq, "transformer")
+
+
+def bench_transformer_large(batch: int = 8, seq: int = 2048):
+    """MXU-sized flagship config: ~0.96B params (dim 2048, 16 layers,
+    vocab 32768), bf16, scan-over-layers + remat — the MFU headline.
+
+    Model FLOPs counted at the standard 6·P·tokens (remat's extra forward
+    recompute is billed as overhead, not as useful FLOPs, so the reported
+    MFU is the honest end-to-end number)."""
+    from multiverso_tpu.models import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=32768, dim=2048, n_layers=16,
+                            n_heads=16, hidden=5632, max_seq=seq,
+                            scan_layers=True, remat=True)
+    return _bench_transformer_cfg(cfg, batch, seq, "transformer_large",
+                                  steps=5)
+
+
+_SECTIONS = [bench_lr, bench_w2v, bench_add_get, bench_transformer,
+             bench_transformer_large]
 
 _PRIMARY = [
     ("lr_fused_samples_per_sec", "samples/sec", "lr_fused_vs_pushpull"),

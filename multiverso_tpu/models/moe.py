@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["init_moe_params", "moe_ffn", "moe_shardings"]
+__all__ = ["init_moe_params", "moe_ffn", "moe_pspecs", "moe_shardings"]
 
 
 def init_moe_params(dim: int, hidden: int, num_experts: int,
@@ -43,15 +43,20 @@ def init_moe_params(dim: int, hidden: int, num_experts: int,
     }
 
 
-def moe_shardings(mesh: Mesh) -> Dict[str, Any]:
-    """Experts shard over ``ep`` when the mesh has one; router replicated."""
+def moe_pspecs(mesh: Mesh) -> Dict[str, Any]:
+    """PartitionSpecs: experts shard over ``ep`` when the mesh has one."""
     ep = "ep" if "ep" in mesh.shape else None
     return {
-        "router": NamedSharding(mesh, P(None, None)),
-        "w1": NamedSharding(mesh, P(ep, None, None)),
-        "w3": NamedSharding(mesh, P(ep, None, None)),
-        "w2": NamedSharding(mesh, P(ep, None, None)),
+        "router": P(None, None),
+        "w1": P(ep, None, None),
+        "w3": P(ep, None, None),
+        "w2": P(ep, None, None),
     }
+
+
+def moe_shardings(mesh: Mesh) -> Dict[str, Any]:
+    """Experts shard over ``ep`` when the mesh has one; router replicated."""
+    return {k: NamedSharding(mesh, s) for k, s in moe_pspecs(mesh).items()}
 
 
 def moe_ffn(params: Dict[str, Any], x: jax.Array, top_k: int = 2,

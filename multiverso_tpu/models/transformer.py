@@ -26,8 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..updaters import AddOption, get_updater
 from .. import dashboard
 
-__all__ = ["TransformerConfig", "init_params", "transformer_forward",
-           "TransformerTrainer"]
+__all__ = ["TransformerConfig", "init_params", "stack_layer_params",
+           "transformer_forward", "TransformerTrainer"]
 
 
 @dataclass(frozen=True)
@@ -47,6 +47,15 @@ class TransformerConfig:
     num_experts: int = 0
     top_k: int = 2
     aux_loss_coef: float = 0.01
+    # remat: gradient checkpointing — recompute each layer's forward during
+    # the backward pass instead of saving activations.  Trades ~1/3 more
+    # matmul FLOPs for O(layers·B·T·dim) activation memory, the knob that
+    # lets batch·seq scale to MXU-bound sizes on one chip.
+    remat: bool = False
+    # scan_layers: stack the per-layer params into [L, ...] arrays and run
+    # ``lax.scan`` over them — O(1) trace/compile time in depth and the
+    # natural pairing with remat (XLA sees one layer body once).
+    scan_layers: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -84,6 +93,8 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
                 "w2": w(cfg.hidden, cfg.dim),   # down
             })
         layers.append(lyr)
+    if cfg.scan_layers:
+        layers = stack_layer_params(layers)
     return {
         "embed": w(cfg.vocab_size, cfg.dim, scale=0.02),
         "out_norm": np.ones(cfg.dim, np.float32),
@@ -92,31 +103,58 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
     }
 
 
+def stack_layer_params(layers):
+    """List of per-layer param dicts → one dict of stacked [L, ...] arrays.
+
+    The scan-format params: leaf k holds ``stack([lyr[k] for lyr in
+    layers])``.  Works on numpy or jax leaves (nested dicts included, e.g.
+    MoE); used by ``init_params(scan_layers=True)`` and by tests converting
+    loop-format params for parity checks.
+    """
+    return jax.tree_util.tree_map(
+        lambda *xs: (np.stack(xs) if isinstance(xs[0], np.ndarray)
+                     else jnp.stack(xs)), *layers)
+
+
 def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
     """TP layout: attention io dims, MLP hidden, and vocab shard over ``tp``;
-    everything else replicated (dp/sp shard activations, not weights)."""
+    everything else replicated (dp/sp shard activations, not weights).
+
+    Scan-format params get the same per-layer specs with an unsharded
+    leading layer dim."""
     tp = "tp" if "tp" in mesh.shape else None
+
+    layer = {
+        "wq": P(None, tp), "wk": P(None, tp), "wv": P(None, tp),
+        "wo": P(tp, None),
+        "attn_norm": P(None), "mlp_norm": P(None),
+    }
+    if cfg.num_experts:
+        from .moe import moe_pspecs
+
+        layer["moe"] = moe_pspecs(mesh)
+    else:
+        layer.update({"w1": P(None, tp), "w3": P(None, tp),
+                      "w2": P(tp, None)})
+
+    is_spec = lambda x: isinstance(x, P)
+    if cfg.scan_layers:
+        layers = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, P(None, *spec)), layer,
+            is_leaf=is_spec)
+    else:
+        layers = [jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), layer, is_leaf=is_spec)
+            for _ in range(cfg.n_layers)]
 
     def s(*spec):
         return NamedSharding(mesh, P(*spec))
 
-    layer = {
-        "wq": s(None, tp), "wk": s(None, tp), "wv": s(None, tp),
-        "wo": s(tp, None),
-        "attn_norm": s(None), "mlp_norm": s(None),
-    }
-    if cfg.num_experts:
-        from .moe import moe_shardings
-
-        layer["moe"] = moe_shardings(mesh)
-    else:
-        layer.update({"w1": s(None, tp), "w3": s(None, tp),
-                      "w2": s(tp, None)})
     return {
         "embed": s(None, None),
         "out_norm": s(None),
         "head": s(None, tp),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "layers": layers,
     }
 
 
@@ -155,9 +193,9 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
     B, T, _ = x.shape
     scale = cfg.head_dim ** -0.5
     use_ring = mesh is not None and int(mesh.shape.get("sp", 1)) > 1
-    aux_total = jnp.float32(0)
 
-    for lyr in params["layers"]:
+    def block(x, lyr):
+        """One decoder layer: attn + residual, MLP/MoE + residual."""
         h = _rms_norm(x, lyr["attn_norm"].astype(dt), cfg.norm_eps)
         q = (h @ lyr["wq"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
         k = (h @ lyr["wk"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
@@ -179,12 +217,31 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
 
             out, aux = moe_ffn(lyr["moe"], h, top_k=cfg.top_k,
                                compute_dtype=dt)
-            x = x + out
-            aux_total = aux_total + aux
-        else:
-            gated = (jax.nn.silu(h @ lyr["w1"].astype(dt))
-                     * (h @ lyr["w3"].astype(dt)))
-            x = x + gated @ lyr["w2"].astype(dt)
+            return x + out, aux
+        gated = (jax.nn.silu(h @ lyr["w1"].astype(dt))
+                 * (h @ lyr["w3"].astype(dt)))
+        return x + gated @ lyr["w2"].astype(dt), jnp.float32(0)
+
+    if cfg.remat:
+        # Save only the layer boundary; the backward pass re-runs the layer
+        # forward (flash kernel included — its custom_vjp composes with
+        # checkpoint).  Under scan the body already blocks CSE, so the
+        # anti-CSE barriers are pure overhead there.
+        block = jax.checkpoint(block, prevent_cse=not cfg.scan_layers)
+
+    if cfg.scan_layers:
+        def scan_body(carry, lyr):
+            x, aux = carry
+            x, a = block(x, lyr)
+            return (x, aux + a), None
+
+        (x, aux_total), _ = jax.lax.scan(
+            scan_body, (x, jnp.float32(0)), params["layers"])
+    else:
+        aux_total = jnp.float32(0)
+        for lyr in params["layers"]:
+            x, a = block(x, lyr)
+            aux_total = aux_total + a
 
     x = _rms_norm(x, params["out_norm"].astype(dt), cfg.norm_eps)
     logits = x @ params["head"].astype(dt)
