@@ -53,9 +53,16 @@ class ArrayTable(Table):
         self._pending: Dict[Optional[AddOption], np.ndarray] = {}
 
     # ------------------------------------------------------------------ Get
-    def get(self, option=None) -> np.ndarray:
-        """Pull the whole array (reference ``ArrayWorker<T>::Get``; §3.2)."""
+    def get(self, option=None, device: bool = False):
+        """Pull the whole array (reference ``ArrayWorker<T>::Get``; §3.2).
+
+        ``device=True`` returns a fresh device ``jax.Array`` instead of a
+        host copy — the TPU-native Get for callers whose next op runs on
+        device (no wire hop; pairs with passing a device delta to ``add``).
+        """
         with self._monitor("Get"):
+            if device:
+                return self._slice_device((self.size,))
             return host_fetch(self._data)[: self.size]
 
     # ------------------------------------------------------------------ Add
@@ -68,7 +75,23 @@ class ArrayTable(Table):
         blocks until the device commit completes (the reference's blocking
         Add vs AddAsync).
         """
+        from .base import is_multiprocess
+
         with self._monitor("Add"):
+            if (isinstance(delta, jax.Array) and not self.sync
+                    and not is_multiprocess()):
+                # Device-resident fast path: no host round-trip.  (BSP
+                # buffering and the multi-host sum are host-side; those
+                # modes fall through to the parity path below.)
+                if delta.ndim == 2:
+                    delta = delta.sum(axis=0)
+                if delta.shape != (self.size,):
+                    raise ValueError(
+                        f"delta shape {delta.shape} != ({self.size},)")
+                self._apply_dense_device(delta, option)
+                if sync:
+                    jax.block_until_ready(self._data)
+                return
             delta = np.asarray(delta, dtype=self.dtype)
             if delta.ndim == 2:
                 delta = delta.sum(axis=0)

@@ -204,6 +204,57 @@ class Table:
         with self._lock:
             self._data, self._state = fn(self._data, self._state, d)
 
+    def _apply_dense_device(self, delta, option) -> None:
+        """Device-resident eager add: the delta is already a ``jax.Array``.
+
+        No host padding, no host→device ship — one jitted pad+cast+apply
+        with donated table buffers, so Add runs at HBM speed (the reference
+        server's ProcessAdd with the network hop removed; SURVEY.md §3.3).
+        Single-controller only: multi-host adds need the cross-process sum
+        and take the host path.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        opt = option or self.default_option
+        key = (opt, "device")
+        fn = self._dense_cache.get(key)
+        if fn is None:
+            updater = self.updater
+            padded_shape = self._data.shape
+
+            def _apply(data, state, d):
+                if d.shape != padded_shape:
+                    d = jnp.pad(d, [(0, p - s) for p, s in
+                                    zip(padded_shape, d.shape)])
+                return updater.apply_dense(data, state,
+                                           d.astype(data.dtype), opt)
+
+            fn = jax.jit(_apply, donate_argnums=(0, 1))
+            self._dense_cache[key] = fn
+        with self._lock:
+            self._data, self._state = fn(self._data, self._state, delta)
+
+    def _slice_device(self, limits) -> Any:
+        """Device-resident Get: compiled slice to the live region (a fresh
+        buffer, so later adds don't mutate what the caller holds).
+
+        Single-controller only: under multi-host the table spans hosts
+        (not fully addressable) and the caller could neither ``np.asarray``
+        the result nor call out of lockstep safely — use ``get()``."""
+        import jax
+
+        if is_multiprocess():
+            raise RuntimeError(
+                "get(device=True) is a single-controller fast path; under "
+                "process_count() > 1 use get() (collective host fetch)")
+        fn = self._dense_cache.get(("slice", limits))
+        if fn is None:
+            fn = jax.jit(
+                lambda d: d[tuple(slice(0, s) for s in limits)])
+            self._dense_cache[("slice", limits)] = fn
+        return fn(self._data)
+
     # -- BSP clock boundary --------------------------------------------------
     def flush(self) -> None:
         """Apply buffered (sync-mode) adds; called by ``barrier()``."""

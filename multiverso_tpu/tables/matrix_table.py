@@ -63,9 +63,13 @@ class MatrixTable(Table):
         self._gather_fn = jax.jit(lambda data, r: data[r])
 
     # ------------------------------------------------------------------ Get
-    def get(self, option=None) -> np.ndarray:
-        """Whole-matrix pull (reference ``MatrixWorkerTable::Get`` all-rows)."""
+    def get(self, option=None, device: bool = False):
+        """Whole-matrix pull (reference ``MatrixWorkerTable::Get`` all-rows).
+
+        ``device=True`` returns a fresh device ``jax.Array`` (no wire hop)."""
         with self._monitor("Get"):
+            if device:
+                return self._slice_device((self.num_rows, self.num_cols))
             return host_fetch(self._data)[: self.num_rows]
 
     def get_rows(self, row_ids, option=None) -> np.ndarray:
@@ -116,7 +120,20 @@ class MatrixTable(Table):
     def add(self, delta, option: Optional[AddOption] = None,
             sync: bool = False) -> None:
         """Whole-matrix add (reference ``Add`` all-rows path)."""
+        from .base import is_multiprocess
+
         with self._monitor("Add"):
+            if (isinstance(delta, jax.Array) and not self.sync
+                    and not is_multiprocess()):
+                # Device-resident fast path (see ArrayTable.add).
+                if delta.shape != (self.num_rows, self.num_cols):
+                    raise ValueError(
+                        f"delta shape {delta.shape} != "
+                        f"({self.num_rows}, {self.num_cols})")
+                self._apply_dense_device(delta, option)
+                if sync:
+                    jax.block_until_ready(self._data)
+                return
             delta = np.asarray(delta, dtype=self.dtype)
             if delta.shape != (self.num_rows, self.num_cols):
                 raise ValueError(

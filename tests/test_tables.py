@@ -317,3 +317,80 @@ def test_array_concurrent_adds_threadsafe(mv):
     for th in threads:
         th.join()
     np.testing.assert_allclose(t.get(), 40.0)
+
+
+# ---------------------------------------------------- device-resident eager
+
+def test_array_device_add_and_get(mv):
+    """Device-resident fast path: jax.Array delta in, device array out,
+    same math as the host parity path (no wire hop in between)."""
+    import jax
+    import jax.numpy as jnp
+
+    mv.init()
+    t = mv.ArrayTable(100)
+    d = np.random.RandomState(1).randn(100).astype(np.float32)
+    t.add(jnp.asarray(d))                 # device delta
+    t.add(d)                              # host delta, same table
+    np.testing.assert_allclose(t.get(), 2 * d, rtol=1e-5)
+    dev = t.get(device=True)
+    assert isinstance(dev, jax.Array) and dev.shape == (100,)
+    np.testing.assert_allclose(np.asarray(dev), 2 * d, rtol=1e-5)
+    # the returned buffer is a snapshot: later adds must not mutate it
+    t.add(d)
+    np.testing.assert_allclose(np.asarray(dev), 2 * d, rtol=1e-5)
+
+
+def test_array_device_add_respects_updater(mv):
+    import jax.numpy as jnp
+
+    mv.init(updater_type="sgd")
+    t = mv.ArrayTable(8)
+    g = np.ones(8, np.float32)
+    t.add(jnp.asarray(g), option=mv.AddOption(learning_rate=0.5))
+    np.testing.assert_allclose(t.get(), -0.5 * g, rtol=1e-6)
+
+
+def test_array_device_add_stacked(mv):
+    import jax.numpy as jnp
+
+    mv.init()
+    t = mv.ArrayTable(16)
+    d = np.random.RandomState(2).randn(4, 16).astype(np.float32)
+    t.add(jnp.asarray(d))                 # [k, size] worker stack, device
+    np.testing.assert_allclose(t.get(), d.sum(0), rtol=1e-5)
+
+
+def test_array_device_add_bsp_falls_back(mv):
+    """sync=True tables buffer device deltas like host ones (BSP clock)."""
+    import jax.numpy as jnp
+
+    mv.init()
+    t = mv.ArrayTable(8, sync=True)
+    t.add(jnp.ones(8, dtype=jnp.float32))
+    np.testing.assert_allclose(t.get(), 0.0)     # invisible pre-barrier
+    mv.barrier()
+    np.testing.assert_allclose(t.get(), 1.0)
+
+
+def test_matrix_device_add_and_get(mv):
+    import jax
+    import jax.numpy as jnp
+
+    mv.init()
+    t = mv.MatrixTable(10, 4)
+    d = np.random.RandomState(3).randn(10, 4).astype(np.float32)
+    t.add(jnp.asarray(d))
+    dev = t.get(device=True)
+    assert isinstance(dev, jax.Array) and dev.shape == (10, 4)
+    np.testing.assert_allclose(np.asarray(dev), d, rtol=1e-5)
+    np.testing.assert_allclose(t.get(), d, rtol=1e-5)
+
+
+def test_array_device_add_shape_error(mv):
+    import jax.numpy as jnp
+
+    mv.init()
+    t = mv.ArrayTable(8)
+    with pytest.raises(ValueError, match="delta shape"):
+        t.add(jnp.ones(9, dtype=jnp.float32))
