@@ -14,11 +14,13 @@ the TPU-native tables, each with
 
 from .lightlda import LightLDA, synthetic_documents
 from .logistic_regression import LogisticRegression, synthetic_classification
+from .skipgram_mixture import SkipGramMixture, synthetic_homonym_corpus
 from .word2vec import SkipGram, synthetic_corpus
 
 __all__ = [
     "LogisticRegression", "synthetic_classification",
     "SkipGram", "synthetic_corpus",
+    "SkipGramMixture", "synthetic_homonym_corpus",
     "LightLDA", "synthetic_documents",
     # torch-dependent (import from .resnet directly): ResNet20DataParallel
 ]

@@ -75,22 +75,10 @@ class ArrayTable(Table):
         blocks until the device commit completes (the reference's blocking
         Add vs AddAsync).
         """
-        from .base import is_multiprocess
-
         with self._monitor("Add"):
-            if (isinstance(delta, jax.Array) and not self.sync
-                    and not is_multiprocess()):
-                # Device-resident fast path: no host round-trip.  (BSP
-                # buffering and the multi-host sum are host-side; those
-                # modes fall through to the parity path below.)
-                if delta.ndim == 2:
-                    delta = delta.sum(axis=0)
-                if delta.shape != (self.size,):
-                    raise ValueError(
-                        f"delta shape {delta.shape} != ({self.size},)")
-                self._apply_dense_device(delta, option)
-                if sync:
-                    jax.block_until_ready(self._data)
+            if isinstance(delta, jax.Array) and delta.ndim == 2:
+                delta = delta.sum(axis=0)      # worker stack, on device
+            if self._try_device_add(delta, (self.size,), option, sync):
                 return
             delta = np.asarray(delta, dtype=self.dtype)
             if delta.ndim == 2:

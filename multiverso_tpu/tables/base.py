@@ -235,6 +235,27 @@ class Table:
         with self._lock:
             self._data, self._state = fn(self._data, self._state, delta)
 
+    def _try_device_add(self, delta, expected_shape, option,
+                        blocking: bool) -> bool:
+        """Route a ``jax.Array`` delta to the device-resident apply.
+
+        Returns False when the delta is host-side or the mode needs the
+        host path (BSP buffering, the multi-host collective sum) — the ONE
+        spelling of that guard for every dense table ``add``.
+        """
+        import jax
+
+        if (not isinstance(delta, jax.Array) or self.sync
+                or is_multiprocess()):
+            return False
+        if delta.shape != expected_shape:
+            raise ValueError(
+                f"delta shape {delta.shape} != {expected_shape}")
+        self._apply_dense_device(delta, option)
+        if blocking:
+            jax.block_until_ready(self._data)
+        return True
+
     def _slice_device(self, limits) -> Any:
         """Device-resident Get: compiled slice to the live region (a fresh
         buffer, so later adds don't mutate what the caller holds).
@@ -253,7 +274,10 @@ class Table:
             fn = jax.jit(
                 lambda d: d[tuple(slice(0, s) for s in limits)])
             self._dense_cache[("slice", limits)] = fn
-        return fn(self._data)
+        # Under _lock: a concurrent add's donated apply deletes the buffer
+        # it replaces, and launching the slice on a deleted Array throws.
+        with self._lock:
+            return fn(self._data)
 
     # -- BSP clock boundary --------------------------------------------------
     def flush(self) -> None:

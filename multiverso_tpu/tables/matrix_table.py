@@ -120,19 +120,9 @@ class MatrixTable(Table):
     def add(self, delta, option: Optional[AddOption] = None,
             sync: bool = False) -> None:
         """Whole-matrix add (reference ``Add`` all-rows path)."""
-        from .base import is_multiprocess
-
         with self._monitor("Add"):
-            if (isinstance(delta, jax.Array) and not self.sync
-                    and not is_multiprocess()):
-                # Device-resident fast path (see ArrayTable.add).
-                if delta.shape != (self.num_rows, self.num_cols):
-                    raise ValueError(
-                        f"delta shape {delta.shape} != "
-                        f"({self.num_rows}, {self.num_cols})")
-                self._apply_dense_device(delta, option)
-                if sync:
-                    jax.block_until_ready(self._data)
+            if self._try_device_add(delta, (self.num_rows, self.num_cols),
+                                    option, sync):
                 return
             delta = np.asarray(delta, dtype=self.dtype)
             if delta.shape != (self.num_rows, self.num_cols):

@@ -188,3 +188,100 @@ def test_w2v_fused_matches_parity_stateful_duplicates(mv):
     np.testing.assert_allclose(
         np.asarray(a.table_out.raw_value()[1][0]),
         np.asarray(b.table_out.raw_value()[1][0]), rtol=1e-4, atol=1e-6)
+
+
+# ------------------------------------------------------- skipgram mixture
+
+def test_sgmix_fused_senses_separate(mv):
+    """The flagship multi-sense check: train on a synthetic homonym corpus
+    (token 0 lives in two disjoint context worlds) and assert the two
+    senses specialize — opposite posteriors under A-contexts vs
+    B-contexts, and a roughly balanced prior."""
+    mv.init(updater_type="sgd")
+    from multiverso_tpu.apps import (SkipGramMixture,
+                                     synthetic_homonym_corpus)
+
+    corpus = synthetic_homonym_corpus(4000, vocab_size=21,
+                                      groups=((1, 10), (11, 20)), seed=0)
+    sg = SkipGramMixture(21, dim=16, senses=2, learning_rate=0.3,
+                         negatives=3, window=3, seed=3)
+    for epoch in range(12):
+        _, loss = sg.train_epoch_fused(corpus, batch_size=256,
+                                       seed=epoch)
+    assert np.isfinite(loss)
+
+    ctx_a = np.arange(1, 11)
+    ctx_b = np.arange(11, 21)
+    post_a = sg.sense_posterior(0, ctx_a)
+    post_b = sg.sense_posterior(0, ctx_b)
+    # each context world picks one dominant sense, and different ones
+    assert post_a.max() > 0.8, post_a
+    assert post_b.max() > 0.8, post_b
+    assert post_a.argmax() != post_b.argmax(), (post_a, post_b)
+    # the homonym saw both worlds, so neither sense starved
+    prior = sg.sense_priors(0)
+    assert prior.min() > 0.2, prior
+    # a single-sense word collapses onto one sense
+    sv_a = sg.sense_vector(0, int(post_a.argmax()))
+    sv_b = sg.sense_vector(0, int(post_b.argmax()))
+    cos = (sv_a @ sv_b) / (np.linalg.norm(sv_a) * np.linalg.norm(sv_b)
+                           + 1e-12)
+    assert cos < 0.9, cos            # senses are not the same vector
+
+
+def test_sgmix_parity_matches_fused_single_batch(mv):
+    """Push-pull EM batch == fused EM batch on all three tables."""
+    import jax.numpy as jnp
+
+    mv.init(updater_type="sgd")
+    from multiverso_tpu.apps import SkipGramMixture
+
+    rng = np.random.RandomState(0)
+    B, K, C = 64, 3, 4
+    c = rng.randint(21, size=B).astype(np.int32)
+    bags = rng.randint(21, size=(B, C)).astype(np.int32)
+    mask = rng.rand(B, C) < 0.8
+    mask[:, 0] = True                      # every example has context
+    neg = rng.randint(21, size=(B, K)).astype(np.int32)
+
+    a = SkipGramMixture(21, dim=8, senses=2, window=2, name="sgm_a", seed=5)
+    b = SkipGramMixture(21, dim=8, senses=2, window=2, name="sgm_b", seed=5)
+
+    a.train_batch(c, bags, mask, neg)
+
+    step, place = b.make_fused_step()
+    ds, ss = b.table_sense.raw_value()
+    do, so = b.table_out.raw_value()
+    dp, sp_ = b.table_prior.raw_value()
+    ds, ss, do, so, dp, sp_, _ = step(ds, ss, do, so, dp, sp_,
+                                      place(c), place(bags),
+                                      jnp.asarray(mask), place(neg))
+    b.table_sense.raw_assign(ds, ss)
+    b.table_out.raw_assign(do, so)
+    b.table_prior.raw_assign(dp, sp_)
+
+    np.testing.assert_allclose(a.table_sense.get(), b.table_sense.get(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(a.table_out.get(), b.table_out.get(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(a.table_prior.get(), b.table_prior.get(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sgmix_prior_counts_accumulate(mv):
+    """Prior rows take plain-add responsibility counts (not sgd deltas):
+    every batch adds exactly B responsibilities across touched rows."""
+    mv.init(updater_type="sgd")
+    from multiverso_tpu.apps import SkipGramMixture
+
+    sg = SkipGramMixture(10, dim=4, senses=3, window=2, name="sgm_c",
+                         seed=1)
+    before = sg.table_prior.get().sum()
+    rng = np.random.RandomState(2)
+    B = 32
+    sg.train_batch(rng.randint(10, size=B).astype(np.int32),
+                   rng.randint(10, size=(B, 4)).astype(np.int32),
+                   np.ones((B, 4), bool),
+                   rng.randint(10, size=(B, 2)).astype(np.int32))
+    after = sg.table_prior.get().sum()
+    np.testing.assert_allclose(after - before, B, rtol=1e-4)
