@@ -141,8 +141,12 @@ class SkipGramMixture:
 
     def batches(self, corpus: np.ndarray, batch_size: int, seed: int = 0):
         """Whole-occurrence examples, static shapes: center [B], context
-        bag [B, C] (C = 2·window, zero-padded), mask [B, C], negatives
-        [B, K]."""
+        bag [B, C] (C = 2·window), mask [B, C], negatives [B, K].
+
+        Padding slots carry ``vocab_size`` — past the visible rows, so
+        their (zero-masked) scatter lands in the table's invisible padded
+        region instead of touching word 0's state under a non-linear
+        updater."""
         rng = np.random.RandomState(seed)
         n = corpus.shape[0]
         C = self.bag_width
@@ -151,7 +155,7 @@ class SkipGramMixture:
             w = 1 + rng.randint(self.window)
             ctx = np.concatenate([corpus[max(0, i - w):i],
                                   corpus[i + 1:min(n, i + w + 1)]])
-            bag = np.zeros(C, np.int32)
+            bag = np.full(C, self.vocab_size, np.int32)
             m = np.zeros(C, bool)
             bag[:ctx.shape[0]] = ctx
             m[:ctx.shape[0]] = True
@@ -214,20 +218,13 @@ class SkipGramMixture:
         ctx = core_context.get_context()
         from ..parallel.sharding import batch_placer
         _, place = batch_placer(ctx.mesh, batch_axis, dtype=jnp.int32)
-        from ..updaters.base import aggregate_rows
+        from ..updaters.base import scatter_apply
 
         upd_sense = self.table_sense.updater
         upd_out = self.table_out.updater
         upd_prior = self.table_prior.updater
         opt = self.option
         S, D = self.senses, self.dim
-
-        def scatter(upd, data, state, rows, delta, option):
-            if upd.linear:
-                return upd.apply_rows(data, state, rows, delta, option)
-            uniq, agg, mask_ = aggregate_rows(rows, delta)
-            return upd.apply_rows(data, state, uniq, agg, option,
-                                  mask=mask_)
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
         def step(ds, ss, do, so, dp, sp_, c, bags, mask, neg):
@@ -244,14 +241,15 @@ class SkipGramMixture:
                 _weighted_sgns_loss, argnums=(0, 1, 2))(vs, uc, un, mask,
                                                         resp)
             dvs, duc, dun = grads
-            ds, ss = scatter(upd_sense, ds, ss, sense_rows,
-                             dvs.reshape(B * S, D), opt)
+            ds, ss = scatter_apply(upd_sense, ds, ss, sense_rows,
+                                   dvs.reshape(B * S, D), opt)
             out_rows = jnp.concatenate([bags.reshape(-1), neg.reshape(-1)])
             out_delta = jnp.concatenate([duc.reshape(B * C, D),
                                          dun.reshape(B * K, D)])
-            do, so = scatter(upd_out, do, so, out_rows, out_delta, opt)
-            dp, sp_ = scatter(upd_prior, dp, sp_, c, resp,
-                              self.table_prior.default_option)
+            do, so = scatter_apply(upd_out, do, so, out_rows, out_delta,
+                                   opt)
+            dp, sp_ = scatter_apply(upd_prior, dp, sp_, c, resp,
+                                    self.table_prior.default_option)
             return ds, ss, do, so, dp, sp_, loss
 
         self._fused_cache[batch_axis] = (step, place)

@@ -167,15 +167,7 @@ class SkipGram:
         opt = self.option
         D = self.dim
 
-        from ..updaters.base import aggregate_rows
-
-        def scatter(upd, data, state, rows, delta):
-            # Non-linear updaters need duplicate rows segment-summed first
-            # (matches the eager path's host-side np.unique aggregation).
-            if upd.linear:
-                return upd.apply_rows(data, state, rows, delta, opt)
-            uniq, agg, mask = aggregate_rows(rows, delta)
-            return upd.apply_rows(data, state, uniq, agg, opt, mask=mask)
+        from ..updaters.base import scatter_apply
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def step(din, sin, dout, sout, c, o, neg):
@@ -186,10 +178,11 @@ class SkipGram:
             loss, grads = jax.value_and_grad(
                 _sgns_loss, argnums=(0, 1, 2))(vc, uo, un)
             dvc, duo, dun = grads
-            din, sin = scatter(upd_in, din, sin, c, dvc)
+            din, sin = scatter_apply(upd_in, din, sin, c, dvc, opt)
             out_rows = jnp.concatenate([o, neg.reshape(-1)])
             out_delta = jnp.concatenate([duo, dun.reshape(B * K, D)])
-            dout, sout = scatter(upd_out, dout, sout, out_rows, out_delta)
+            dout, sout = scatter_apply(upd_out, dout, sout, out_rows,
+                                       out_delta, opt)
             return din, sin, dout, sout, loss
 
         self._fused_cache[batch_axis] = (step, place)

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["init_moe_params", "moe_ffn", "moe_pspecs", "moe_shardings"]
+__all__ = ["init_moe_params", "moe_capacity", "moe_ffn", "moe_pspecs",
+           "moe_shardings"]
 
 
 def init_moe_params(dim: int, hidden: int, num_experts: int,
@@ -59,29 +60,69 @@ def moe_shardings(mesh: Mesh) -> Dict[str, Any]:
     return {k: NamedSharding(mesh, s) for k, s in moe_pspecs(mesh).items()}
 
 
-def moe_ffn(params: Dict[str, Any], x: jax.Array, top_k: int = 2,
-            compute_dtype=None) -> tuple[jax.Array, jax.Array]:
-    """x [B, T, dim] → (out [B, T, dim], aux_loss scalar).
+def _routing(params, x, top_k: int):
+    """Shared router: probs, normalized top-k weights/indices, aux loss.
 
-    Top-k softmax routing with a load-balancing auxiliary loss (the
-    standard switch/GShard formulation: E · Σ_e fraction_e · prob_e).
+    Aux is the standard switch/GShard load-balancing term
+    (E · Σ_e fraction_e · prob_e), computed on the routing decisions
+    (pre-drop, so the capacity path optimizes the same objective).
     """
-    dt = compute_dtype or x.dtype
     E = params["router"].shape[1]
     logits = (x.astype(jnp.float32)
               @ params["router"].astype(jnp.float32))        # [B,T,E]
     probs = jax.nn.softmax(logits, axis=-1)
     top_p, top_idx = jax.lax.top_k(probs, top_k)             # [B,T,k]
     top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    routed = jnp.sum(jax.nn.one_hot(top_idx, E, dtype=jnp.float32), axis=2)
+    frac_tokens = jnp.mean((routed > 0).astype(jnp.float32), axis=(0, 1))
+    frac_prob = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(frac_tokens * frac_prob)
+    return probs, top_p, top_idx, aux
+
+
+def moe_capacity(num_tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Static per-expert bucket size (rounded up to the fp32 sublane 8)."""
+    c = int(np.ceil(num_tokens * top_k / num_experts * capacity_factor))
+    return max(8, -(-c // 8) * 8)
+
+
+def moe_ffn(params: Dict[str, Any], x: jax.Array, top_k: int = 2,
+            compute_dtype=None, dispatch: str = "dense",
+            capacity_factor: float = 1.25) -> tuple[jax.Array, jax.Array]:
+    """x [B, T, dim] → (out [B, T, dim], aux_loss scalar).
+
+    Two dispatch schedules:
+
+    - ``"dense"`` — every expert computes every token, scaled post-hoc by
+      the combine weights.  Exact (no token ever dropped), E/top_k× the
+      useful FLOPs; the correctness oracle the capacity path is tested
+      against.
+    - ``"capacity"`` — GShard-style static buckets: each expert takes at
+      most C = ceil(N·top_k/E · capacity_factor) tokens (scatter in,
+      batched [E, C, ·] expert FFN on the MXU, gather out).  FLOPs scale
+      with top_k·capacity_factor/E instead of 1; tokens overflowing a
+      bucket lose that expert's contribution (their other routes and the
+      residual still apply).  Static shapes throughout — the capacity is
+      a trace-time constant, so this jits/scans/pjits like any dense op.
+    """
+    if dispatch == "dense":
+        return _moe_dense(params, x, top_k, compute_dtype)
+    if dispatch == "capacity":
+        return _moe_capacity_dispatch(params, x, top_k, compute_dtype,
+                                      capacity_factor)
+    raise ValueError(f"unknown moe dispatch '{dispatch}' "
+                     "(expected dense|capacity)")
+
+
+def _moe_dense(params, x, top_k, compute_dtype):
+    dt = compute_dtype or x.dtype
+    E = params["router"].shape[1]
+    probs, top_p, top_idx, aux = _routing(params, x, top_k)
     # combine [B,T,E]: routing weight per expert (0 for unrouted)
     combine = jnp.sum(
         jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
         * top_p[..., None], axis=2)
-
-    # load-balancing aux loss
-    frac_tokens = jnp.mean((combine > 0).astype(jnp.float32), axis=(0, 1))
-    frac_prob = jnp.mean(probs, axis=(0, 1))
-    aux = E * jnp.sum(frac_tokens * frac_prob)
 
     # dense dispatch: every expert sees every token, scaled post-hoc.
     xc = x.astype(dt)
@@ -92,4 +133,41 @@ def moe_ffn(params: Dict[str, Any], x: jax.Array, top_k: int = 2,
                             params["w2"].astype(dt))          # [B,E,T,d]
     out = jnp.einsum("betd,bte->btd", expert_out,
                      combine.astype(dt))
+    return out.astype(x.dtype), aux
+
+
+def _moe_capacity_dispatch(params, x, top_k, compute_dtype,
+                           capacity_factor):
+    dt = compute_dtype or x.dtype
+    B, T, D = x.shape
+    N = B * T
+    E = params["router"].shape[1]
+    _, top_p, top_idx, aux = _routing(params, x, top_k)
+    C = moe_capacity(N, E, top_k, capacity_factor)
+
+    # Slot assignment, token-major (earlier tokens win bucket slots, the
+    # reference-free standard tie-break).  [N·k] flat routes.
+    e_flat = top_idx.reshape(-1)                       # [N*k]
+    onehot = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
+    valid = pos < C                                    # dropped = overflow
+    slot = jnp.where(valid, e_flat * C + jnp.minimum(pos, C - 1), E * C)
+
+    # Scatter tokens into [E·C (+1 overflow row), D] buckets.
+    x_rep = jnp.repeat(x.reshape(N, D), top_k, axis=0).astype(dt)
+    buckets = jnp.zeros((E * C + 1, D), dt).at[slot].add(
+        x_rep * valid[:, None].astype(dt))
+    xe = buckets[:E * C].reshape(E, C, D)
+
+    # Batched expert FFN — one [E, C, ·] einsum chain on the MXU.
+    gate = jax.nn.silu(jnp.einsum("ecd,edh->ech", xe,
+                                  params["w1"].astype(dt)))
+    up = jnp.einsum("ecd,edh->ech", xe, params["w3"].astype(dt))
+    ye = jnp.einsum("ech,ehd->ecd", gate * up,
+                    params["w2"].astype(dt)).reshape(E * C, D)
+
+    # Gather back, weight, and sum each token's surviving routes.
+    w = (top_p.reshape(-1) * valid.astype(jnp.float32)).astype(dt)
+    y_tok = ye[jnp.minimum(slot, E * C - 1)] * w[:, None]
+    out = jnp.sum(y_tok.reshape(N, top_k, D), axis=1).reshape(B, T, D)
     return out.astype(x.dtype), aux

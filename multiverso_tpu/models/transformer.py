@@ -47,6 +47,10 @@ class TransformerConfig:
     num_experts: int = 0
     top_k: int = 2
     aux_loss_coef: float = 0.01
+    # "dense" = exact all-experts dispatch (the oracle); "capacity" =
+    # GShard-style static buckets, FLOPs ∝ top_k·capacity_factor/E.
+    moe_dispatch: str = "dense"
+    capacity_factor: float = 1.25
     # remat: gradient checkpointing — recompute each layer's forward during
     # the backward pass instead of saving activations.  Trades ~1/3 more
     # matmul FLOPs for O(layers·B·T·dim) activation memory, the knob that
@@ -216,7 +220,9 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
             from .moe import moe_ffn
 
             out, aux = moe_ffn(lyr["moe"], h, top_k=cfg.top_k,
-                               compute_dtype=dt)
+                               compute_dtype=dt,
+                               dispatch=cfg.moe_dispatch,
+                               capacity_factor=cfg.capacity_factor)
             return x + out, aux
         gated = (jax.nn.silu(h @ lyr["w1"].astype(dt))
                  * (h @ lyr["w3"].astype(dt)))

@@ -63,7 +63,8 @@ class ArrayTable(Table):
         with self._monitor("Get"):
             if device:
                 return self._slice_device((self.size,))
-            return host_fetch(self._data)[: self.size]
+            return self._locked_read(
+                lambda d, s: host_fetch(d))[: self.size]
 
     # ------------------------------------------------------------------ Add
     def add(self, delta, option: Optional[AddOption] = None,
@@ -129,11 +130,13 @@ class ArrayTable(Table):
 
     # ------------------------------------------------------------ checkpoint
     def store_state(self) -> Any:
+        data, state = self._locked_read(
+            lambda d, s: (host_fetch(d), [host_fetch(x) for x in s]))
         return {
             "kind": self.kind,
             "size": self.size,
-            "data": host_fetch(self._data),
-            "state": [host_fetch(s) for s in self._state],
+            "data": data,
+            "state": state,
         }
 
     def load_state(self, snap: Any) -> None:

@@ -256,6 +256,18 @@ class Table:
             jax.block_until_ready(self._data)
         return True
 
+    def _locked_read(self, reader):
+        """Run ``reader(data, state)`` under the table lock.
+
+        Every eager read of ``_data``/``_state`` must go through this: a
+        concurrent add's donated jitted apply deletes the buffer it
+        replaces, and launching a gather/fetch on a deleted Array throws.
+        (Multi-host callers still follow the SPMD lockstep contract —
+        the lock serializes only this process's threads.)
+        """
+        with self._lock:
+            return reader(self._data, self._state)
+
     def _slice_device(self, limits) -> Any:
         """Device-resident Get: compiled slice to the live region (a fresh
         buffer, so later adds don't mutate what the caller holds).

@@ -70,7 +70,8 @@ class MatrixTable(Table):
         with self._monitor("Get"):
             if device:
                 return self._slice_device((self.num_rows, self.num_cols))
-            return host_fetch(self._data)[: self.num_rows]
+            return self._locked_read(
+                lambda d, s: host_fetch(d))[: self.num_rows]
 
     def get_rows(self, row_ids, option=None) -> np.ndarray:
         """Row-subset pull — the sparse hot read path.
@@ -107,7 +108,8 @@ class MatrixTable(Table):
         b = _bucket(k)
         padded = np.zeros(b, dtype=np.int32)
         padded[:k] = rows
-        out = self._gather_fn(self._data, jnp.asarray(padded))
+        out = self._locked_read(
+            lambda d, s: self._gather_fn(d, jnp.asarray(padded)))
         return host_fetch(out)[:k]
 
     @staticmethod
@@ -255,11 +257,13 @@ class MatrixTable(Table):
 
     # ------------------------------------------------------------ checkpoint
     def store_state(self) -> Any:
+        data, state = self._locked_read(
+            lambda d, s: (host_fetch(d), [host_fetch(x) for x in s]))
         return {
             "kind": self.kind,
             "shape": (self.num_rows, self.num_cols),
-            "data": host_fetch(self._data),
-            "state": [host_fetch(s) for s in self._state],
+            "data": data,
+            "state": state,
         }
 
     def load_state(self, snap: Any) -> None:

@@ -13,7 +13,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["AddOption", "GetOption", "Updater", "register_updater",
-           "get_updater", "updater_names", "aggregate_rows"]
+           "get_updater", "updater_names", "aggregate_rows",
+           "scatter_apply"]
 
 
 @dataclass(frozen=True)
@@ -126,6 +127,21 @@ def aggregate_rows(rows: jax.Array, delta: jax.Array
     uniq = jnp.zeros_like(r).at[seg].set(r)
     mask = jnp.zeros(r.shape, bool).at[seg].set(True)
     return uniq, agg, mask
+
+
+def scatter_apply(upd: "Updater", data, state, rows, delta, opt: AddOption):
+    """In-jit row scatter with the linear/non-linear dispatch.
+
+    THE one spelling of "apply a row batch through an updater inside a
+    fused step": linear updaters scatter duplicates directly (adds
+    commute); non-linear ones get duplicates segment-summed first via
+    ``aggregate_rows`` — matching the eager path's host-side np.unique
+    aggregation.  Used by every app's fused step.
+    """
+    if upd.linear:
+        return upd.apply_rows(data, state, rows, delta, opt)
+    uniq, agg, mask = aggregate_rows(rows, delta)
+    return upd.apply_rows(data, state, uniq, agg, opt, mask=mask)
 
 
 def masked(delta: jax.Array, mask: Optional[jax.Array]) -> jax.Array:

@@ -285,3 +285,26 @@ def test_sgmix_prior_counts_accumulate(mv):
                    rng.randint(10, size=(B, 2)).astype(np.int32))
     after = sg.table_prior.get().sum()
     np.testing.assert_allclose(after - before, B, rtol=1e-4)
+
+
+def test_sgmix_padded_slots_do_not_touch_word0(mv):
+    """Padding bag slots carry a sentinel past the visible rows, so a
+    non-linear updater (momentum decays state even on zero deltas) never
+    perturbs real word 0 through padding."""
+    mv.init(updater_type="momentum")
+    from multiverso_tpu.apps import SkipGramMixture
+
+    sg = SkipGramMixture(12, dim=4, senses=2, window=3, name="sgm_pad",
+                         updater_type="momentum", seed=2)
+    w0_before = sg.table_out.get()[0].copy()
+    rng = np.random.RandomState(3)
+    B, C = 16, 6
+    c = rng.randint(1, 12, size=B).astype(np.int32)    # centers != 0
+    bags = np.full((B, C), 12, np.int32)               # sentinel pad
+    bags[:, 0] = rng.randint(1, 12, size=B)            # contexts != 0
+    mask = np.zeros((B, C), bool)
+    mask[:, 0] = True
+    neg = rng.randint(1, 12, size=(B, 2)).astype(np.int32)
+    for _ in range(3):
+        sg.train_batch(c, bags, mask, neg)
+    np.testing.assert_array_equal(sg.table_out.get()[0], w0_before)
