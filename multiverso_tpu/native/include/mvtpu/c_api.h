@@ -2,7 +2,10 @@
 // Capability parity with include/multiverso/c_api.h (SURVEY.md §2.19):
 // init/shutdown/barrier, ids, array + matrix tables with sync and async
 // Add variants. float32 payloads (the reference's binding-facing type).
-// All functions return 0 on success, negative on error, unless noted.
+// All functions return 0 on success, negative on error, unless noted:
+// -1 bad args / not started, -2 unknown handle, -3 unreachable peer or
+// `-rpc_timeout_ms`/`-barrier_timeout_ms` deadline expired (fail-fast
+// instead of hanging on a dead rank).
 #pragma once
 
 #include <stdint.h>

@@ -15,6 +15,9 @@ enum class MsgType : int32_t {
   RequestAdd = 2,
   ReplyGet = 3,
   ReplyAdd = 4,
+  // Synthesized locally when the transport cannot deliver a request —
+  // unblocks the pending RoundTrip with an error instead of a hang.
+  ReplyError = 5,
   ControlRegister = 16,
   ControlReply = 17,
   ControlBarrier = 18,

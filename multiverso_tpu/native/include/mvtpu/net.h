@@ -30,8 +30,9 @@ class TcpNet {
 
   // Bind + listen on endpoints[rank]'s port, start the accept loop,
   // deliver every inbound message to `fn` (called from reader threads).
+  // `connect_retry_ms` bounds each lazy-connect's retry budget.
   bool Init(const std::vector<std::string>& endpoints, int rank,
-            InboundFn fn);
+            InboundFn fn, int64_t connect_retry_ms = 15000);
 
   // Serialize + frame + write to the peer (lazy connect with retries —
   // peers start in any order).  Returns false on a dead peer.
@@ -50,6 +51,7 @@ class TcpNet {
   std::vector<std::string> endpoints_;
   int rank_ = 0;
   InboundFn inbound_;
+  int64_t connect_retry_ms_ = 15000;
 
   int listen_fd_ = -1;
   std::thread accept_thread_;

@@ -114,8 +114,10 @@ class WorkerTable {
  protected:
   // Send all reqs (same msg_id) via the Zoo, block until each got its
   // reply; `consume` runs once per reply (serialized — one worker-actor
-  // thread drains replies).
-  void RoundTrip(std::vector<MessagePtr> reqs,
+  // thread drains replies).  Returns false when a shard was unreachable
+  // (a synthesized ReplyError arrived) or the `-rpc_timeout_ms` deadline
+  // passed — the caller fails fast instead of hanging on a dead peer.
+  bool RoundTrip(std::vector<MessagePtr> reqs,
                  void (*consume)(void*, const Message&), void* arg);
 
   int32_t table_id_;
@@ -127,6 +129,7 @@ class WorkerTable {
     void (*consume)(void*, const Message&);
     void* arg;
     int remaining;
+    bool* failed;
   };
   std::unordered_map<int64_t, Pending> pending_;
 };
@@ -136,8 +139,8 @@ class ArrayWorkerTable : public WorkerTable {
   ArrayWorkerTable(int32_t table_id, int64_t global_size, int num_servers)
       : WorkerTable(table_id), global_(global_size),
         servers_(num_servers) {}
-  void Get(float* data, int64_t size);
-  void Add(const float* delta, int64_t size, const AddOption& opt,
+  bool Get(float* data, int64_t size);
+  bool Add(const float* delta, int64_t size, const AddOption& opt,
            bool blocking);
 
  private:
@@ -151,10 +154,10 @@ class MatrixWorkerTable : public WorkerTable {
                     int num_servers = 1)
       : WorkerTable(table_id), rows_(rows), cols_(cols),
         servers_(num_servers) {}
-  void GetAll(float* data);                       // [rows*cols]
-  void GetRows(const int32_t* row_ids, int64_t k, float* data);  // [k*cols]
-  void AddAll(const float* delta, const AddOption& opt, bool blocking);
-  void AddRows(const int32_t* row_ids, int64_t k, const float* delta,
+  bool GetAll(float* data);                       // [rows*cols]
+  bool GetRows(const int32_t* row_ids, int64_t k, float* data);  // [k*cols]
+  bool AddAll(const float* delta, const AddOption& opt, bool blocking);
+  bool AddRows(const int32_t* row_ids, int64_t k, const float* delta,
                const AddOption& opt, bool blocking);
 
  private:

@@ -2,6 +2,7 @@
 // Capability parity with include/multiverso/util/waiter.h (SURVEY.md §2.23).
 #pragma once
 
+#include <chrono>
 #include <condition_variable>
 #include <mutex>
 
@@ -14,6 +15,18 @@ class Waiter {
   void Wait() {
     std::unique_lock<std::mutex> lk(mu_);
     cv_.wait(lk, [&] { return count_ <= 0; });
+  }
+
+  // Deadline wait: true when the count reached zero, false on timeout.
+  // timeout_ms <= 0 means wait forever (the reference's only mode).
+  bool WaitFor(int64_t timeout_ms) {
+    if (timeout_ms <= 0) {
+      Wait();
+      return true;
+    }
+    std::unique_lock<std::mutex> lk(mu_);
+    return cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                        [&] { return count_ <= 0; });
   }
 
   void Notify() {

@@ -45,7 +45,9 @@ class Zoo {
   int worker_id() const { return rank_; }
   int server_id() const { return rank_; }
 
-  void Barrier();
+  // Blocks until every rank arrived; false when `-barrier_timeout_ms`
+  // (default: infinite) expired or the barrier authority is unreachable.
+  bool Barrier();
 
   // Deliver to a LOCAL actor's mailbox.
   void SendTo(const std::string& actor_name, MessagePtr msg);
@@ -94,10 +96,14 @@ class Zoo {
   std::vector<std::unique_ptr<ServerTable>> server_tables_;
   std::vector<std::unique_ptr<WorkerTable>> worker_tables_;
 
-  // Barrier state: one outstanding barrier per rank; rank 0 counts.
+  // Barrier state: one outstanding barrier per rank; rank 0 tracks
+  // arrivals PER RANK (a retry after an abandoned round must not double
+  // count toward the quorum).  barrier_failed_ latches transport
+  // failures so Barrier() reports them instead of a false release.
   std::mutex barrier_mu_;
   Waiter* barrier_waiter_ = nullptr;
-  int barrier_arrivals_ = 0;
+  std::vector<bool> barrier_arrived_;
+  bool barrier_failed_ = false;
 };
 
 }  // namespace mvtpu

@@ -31,8 +31,7 @@ int MV_ShutDown() {
 
 int MV_Barrier() {
   if (RequireStarted()) return -1;
-  Zoo::Get()->Barrier();
-  return 0;
+  return Zoo::Get()->Barrier() ? 0 : -3;  // -3: timeout / peer death
 }
 
 int MV_NumWorkers() { return Zoo::Get()->num_workers(); }
@@ -59,8 +58,7 @@ int MV_GetArrayTable(int32_t handle, float* data, int64_t size) {
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->array_worker(handle);
   if (!t) return -2;
-  t->Get(data, size);
-  return 0;
+  return t->Get(data, size) ? 0 : -3;
 }
 
 static int AddArray(int32_t handle, const float* delta, int64_t size,
@@ -68,8 +66,7 @@ static int AddArray(int32_t handle, const float* delta, int64_t size,
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->array_worker(handle);
   if (!t) return -2;
-  t->Add(delta, size, g_add_option, blocking);
-  return 0;
+  return t->Add(delta, size, g_add_option, blocking) ? 0 : -3;
 }
 
 int MV_AddArrayTable(int32_t h, const float* d, int64_t n) {
@@ -89,16 +86,14 @@ int MV_GetMatrixTableAll(int32_t handle, float* data, int64_t size) {
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->matrix_worker(handle);
   if (!t) return -2;
-  t->GetAll(data);
-  return 0;
+  return t->GetAll(data) ? 0 : -3;
 }
 
 static int AddMatrixAll(int32_t handle, const float* delta, bool blocking) {
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->matrix_worker(handle);
   if (!t) return -2;
-  t->AddAll(delta, g_add_option, blocking);
-  return 0;
+  return t->AddAll(delta, g_add_option, blocking) ? 0 : -3;
 }
 
 int MV_AddMatrixTableAll(int32_t h, const float* d, int64_t) {
@@ -114,8 +109,7 @@ int MV_GetMatrixTableByRows(int32_t handle, float* data,
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->matrix_worker(handle);
   if (!t) return -2;
-  t->GetRows(row_ids, num_rows, data);
-  return 0;
+  return t->GetRows(row_ids, num_rows, data) ? 0 : -3;
 }
 
 static int AddMatrixRows(int32_t handle, const float* delta,
@@ -124,8 +118,9 @@ static int AddMatrixRows(int32_t handle, const float* delta,
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->matrix_worker(handle);
   if (!t) return -2;
-  t->AddRows(row_ids, num_rows, delta, g_add_option, blocking);
-  return 0;
+  return t->AddRows(row_ids, num_rows, delta, g_add_option, blocking)
+             ? 0
+             : -3;
 }
 
 int MV_AddMatrixTableByRows(int32_t h, const float* d, const int32_t* ids,

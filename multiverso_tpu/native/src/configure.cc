@@ -139,6 +139,12 @@ void RegisterDefaults() {
     DefineInt("rank", 0, "this process's line index in machine_file");
     DefineInt("port", 55555, "base port (transport parity flag)");
     DefineDouble("backup_worker_ratio", 0.0, "straggler slack (parity flag)");
+    DefineInt("rpc_timeout_ms", 30000,
+              "blocking Get/Add deadline; <=0 waits forever");
+    DefineInt("connect_retry_ms", 15000,
+              "per-destination connect retry budget");
+    DefineInt("barrier_timeout_ms", 0,
+              "barrier deadline; <=0 (default) waits forever (BSP)");
     DefineString("log_level", "info", "debug|info|error|fatal");
     DefineString("log_file", "", "optional log sink path");
   });

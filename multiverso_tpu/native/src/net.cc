@@ -7,6 +7,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstring>
 #include <fstream>
@@ -71,10 +72,11 @@ std::vector<std::string> TcpNet::ParseMachineFile(const std::string& path) {
 }
 
 bool TcpNet::Init(const std::vector<std::string>& endpoints, int rank,
-                  InboundFn fn) {
+                  InboundFn fn, int64_t connect_retry_ms) {
   endpoints_ = endpoints;
   rank_ = rank;
   inbound_ = std::move(fn);
+  connect_retry_ms_ = connect_retry_ms;
   send_fds_.assign(endpoints_.size(), -1);
   send_mus_.clear();
   for (size_t i = 0; i < endpoints_.size(); ++i)
@@ -157,9 +159,11 @@ int TcpNet::ConnectTo(int dst_rank) {
                     &res) != 0 ||
       !res)
     return -1;
-  // Peers start in any order: retry for up to ~15 s before giving up.
+  // Peers start in any order: retry within the configured budget.
   int fd = -1;
-  for (int attempt = 0; attempt < 150; ++attempt) {
+  int attempts = static_cast<int>(std::max<int64_t>(
+      1, connect_retry_ms_ / 100));
+  for (int attempt = 0; attempt < attempts; ++attempt) {
     fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) break;
     if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
@@ -184,9 +188,25 @@ bool TcpNet::Send(int dst_rank, const Message& msg) {
     return false;
   Blob wire = msg.Serialize();
   int64_t len = static_cast<int64_t>(wire.size());
+  // Connect OUTSIDE the per-destination send mutex: the retry loop can
+  // take seconds, and holding the mutex through it would stall Stop()
+  // (which closes fds under the same mutex) and serialize every sender
+  // to this rank behind the retries.
+  bool need_connect;
+  {
+    std::lock_guard<std::mutex> lk(*send_mus_[dst_rank]);
+    need_connect = send_fds_[dst_rank] < 0;
+  }
+  if (need_connect) {
+    int nfd = ConnectTo(dst_rank);
+    std::lock_guard<std::mutex> lk(*send_mus_[dst_rank]);
+    if (send_fds_[dst_rank] < 0) {
+      send_fds_[dst_rank] = nfd;       // install (may still be -1)
+    } else if (nfd >= 0) {
+      ::close(nfd);                    // raced: another sender connected
+    }
+  }
   std::lock_guard<std::mutex> lk(*send_mus_[dst_rank]);
-  if (send_fds_[dst_rank] < 0)
-    send_fds_[dst_rank] = ConnectTo(dst_rank);
   int fd = send_fds_[dst_rank];
   if (fd < 0) {
     Log::Error("TcpNet: cannot reach rank %d (%s)", dst_rank,

@@ -13,6 +13,7 @@
 
 #include <cstring>
 
+#include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/log.h"
 #include "mvtpu/zoo.h"
@@ -183,39 +184,57 @@ bool MatrixServerTable::Load(Stream* in) {
 // ---------------------------------------------------------------- worker
 
 void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
-  Pending p;
-  bool done = false;
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    auto it = pending_.find(msg_id);
-    if (it == pending_.end()) {
-      Log::Error("WorkerTable %d: reply for unknown msg %lld", table_id_,
-                 static_cast<long long>(msg_id));
-      return;
-    }
-    p = it->second;
-    done = (--it->second.remaining == 0);
-    if (done) pending_.erase(it);
+  // Everything — lookup, consume, waiter notify — runs under mu_ so it
+  // serializes with RoundTrip's timeout path: once the timeout erases
+  // the entry, a late reply finds nothing and cannot touch the (gone)
+  // stack waiter or the caller's output buffers.
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = pending_.find(msg_id);
+  if (it == pending_.end()) {
+    Log::Error("WorkerTable %d: reply for unknown/expired msg %lld",
+               table_id_, static_cast<long long>(msg_id));
+    return;
   }
-  if (p.consume) p.consume(p.arg, reply);
-  p.waiter->Notify();
-  (void)done;
+  Pending& p = it->second;
+  if (reply.type == MsgType::ReplyError) {
+    *p.failed = true;                   // shard unreachable — no payload
+  } else if (p.consume) {
+    p.consume(p.arg, reply);
+  }
+  Waiter* waiter = p.waiter;
+  if (--p.remaining == 0) pending_.erase(it);
+  waiter->Notify();
 }
 
-void WorkerTable::RoundTrip(std::vector<MessagePtr> reqs,
+bool WorkerTable::RoundTrip(std::vector<MessagePtr> reqs,
                             void (*consume)(void*, const Message&),
                             void* arg) {
-  if (reqs.empty()) return;
+  if (reqs.empty()) return true;
   Waiter waiter(static_cast<int>(reqs.size()));
+  bool failed = false;
   int64_t msg_id = reqs[0]->msg_id;
   {
     std::lock_guard<std::mutex> lk(mu_);
-    pending_[msg_id] =
-        Pending{&waiter, consume, arg, static_cast<int>(reqs.size())};
+    pending_[msg_id] = Pending{&waiter, consume, arg,
+                               static_cast<int>(reqs.size()), &failed};
   }
   for (auto& req : reqs)
     Zoo::Get()->SendTo(actor::kWorker, std::move(req));
-  waiter.Wait();
+  int64_t timeout_ms = configure::GetInt("rpc_timeout_ms");
+  if (waiter.WaitFor(timeout_ms)) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return !failed;
+  }
+  // Deadline passed: withdraw the pending entry so late replies are
+  // dropped at the door instead of touching dead stack frames.
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = pending_.find(msg_id);
+  if (it == pending_.end()) return !failed;  // raced: replies completed
+  pending_.erase(it);
+  Log::Error("WorkerTable %d: request %lld timed out after %lld ms",
+             table_id_, static_cast<long long>(msg_id),
+             static_cast<long long>(timeout_ms));
+  return false;
 }
 
 namespace {
@@ -275,17 +294,17 @@ void DiscardReply(void*, const Message&) {}
 
 }  // namespace
 
-void ArrayWorkerTable::Get(float* data, int64_t size) {
+bool ArrayWorkerTable::Get(float* data, int64_t size) {
   Monitor mon("ArrayWorker::Get");
   int64_t msg_id = Zoo::Get()->NextMsgId();
   std::vector<MessagePtr> reqs;
   for (int r = 0; r < servers_; ++r)
     reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r));
   GatherDest d{data, static_cast<size_t>(size), global_, servers_, 1};
-  RoundTrip(std::move(reqs), GatherReply, &d);
+  return RoundTrip(std::move(reqs), GatherReply, &d);
 }
 
-void ArrayWorkerTable::Add(const float* delta, int64_t size,
+bool ArrayWorkerTable::Add(const float* delta, int64_t size,
                            const AddOption& opt, bool blocking) {
   Monitor mon("ArrayWorker::Add");
   int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
@@ -300,15 +319,14 @@ void ArrayWorkerTable::Add(const float* delta, int64_t size,
                                sizeof(float));
     reqs.push_back(std::move(req));
   }
-  if (blocking) {
-    RoundTrip(std::move(reqs), DiscardReply, nullptr);
-  } else {
-    for (auto& req : reqs)
-      Zoo::Get()->SendTo(actor::kWorker, std::move(req));
-  }
+  if (blocking)
+    return RoundTrip(std::move(reqs), DiscardReply, nullptr);
+  for (auto& req : reqs)
+    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+  return true;
 }
 
-void MatrixWorkerTable::GetAll(float* data) {
+bool MatrixWorkerTable::GetAll(float* data) {
   Monitor mon("MatrixWorker::GetAll");
   int64_t msg_id = Zoo::Get()->NextMsgId();
   std::vector<MessagePtr> reqs;
@@ -316,10 +334,10 @@ void MatrixWorkerTable::GetAll(float* data) {
     reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r));
   GatherDest d{data, static_cast<size_t>(rows_ * cols_), rows_, servers_,
                cols_};
-  RoundTrip(std::move(reqs), GatherReply, &d);
+  return RoundTrip(std::move(reqs), GatherReply, &d);
 }
 
-void MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
+bool MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
                                 float* data) {
   Monitor mon("MatrixWorker::GetRows");
   // Partition ids by owner; remember which caller slots each owner fills.
@@ -343,10 +361,10 @@ void MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
     reqs.push_back(std::move(req));
   }
   RowsDest d{data, cols_, &positions};
-  RoundTrip(std::move(reqs), ScatterRowsReply, &d);
+  return RoundTrip(std::move(reqs), ScatterRowsReply, &d);
 }
 
-void MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,
+bool MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,
                                bool blocking) {
   Monitor mon("MatrixWorker::AddAll");
   int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
@@ -360,15 +378,14 @@ void MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,
                            rg.len() * cols_ * sizeof(float));
     reqs.push_back(std::move(req));
   }
-  if (blocking) {
-    RoundTrip(std::move(reqs), DiscardReply, nullptr);
-  } else {
-    for (auto& req : reqs)
-      Zoo::Get()->SendTo(actor::kWorker, std::move(req));
-  }
+  if (blocking)
+    return RoundTrip(std::move(reqs), DiscardReply, nullptr);
+  for (auto& req : reqs)
+    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+  return true;
 }
 
-void MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
+bool MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
                                 const float* delta, const AddOption& opt,
                                 bool blocking) {
   Monitor mon("MatrixWorker::AddRows");
@@ -394,13 +411,12 @@ void MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
                            per_rank_delta[r].size() * sizeof(float));
     reqs.push_back(std::move(req));
   }
-  if (reqs.empty()) return;
-  if (blocking) {
-    RoundTrip(std::move(reqs), DiscardReply, nullptr);
-  } else {
-    for (auto& req : reqs)
-      Zoo::Get()->SendTo(actor::kWorker, std::move(req));
-  }
+  if (reqs.empty()) return true;
+  if (blocking)
+    return RoundTrip(std::move(reqs), DiscardReply, nullptr);
+  for (auto& req : reqs)
+    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+  return true;
 }
 
 }  // namespace mvtpu

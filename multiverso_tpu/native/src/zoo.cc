@@ -32,6 +32,11 @@ class WorkerActor : public Actor {
     RegisterHandler(MsgType::ReplyAdd, [](MessagePtr& m) {
       Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
     });
+    RegisterHandler(MsgType::ReplyError, [](MessagePtr& m) {
+      // Synthesized by Deliver when a request's peer was unreachable:
+      // unblocks the pending RoundTrip with an error.
+      Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
+    });
   }
 };
 
@@ -116,7 +121,8 @@ bool Zoo::Start(int argc, const char* const* argv) {
       size_ = static_cast<int>(endpoints.size());
       net_ = std::make_unique<TcpNet>();
       if (!net_->Init(endpoints, rank_,
-                      [this](Message&& m) { RouteInbound(std::move(m)); })) {
+                      [this](Message&& m) { RouteInbound(std::move(m)); },
+                      configure::GetInt("connect_retry_ms"))) {
         net_.reset();
         return false;
       }
@@ -165,15 +171,21 @@ void Zoo::Stop() {
   }
   rank_ = 0;
   size_ = 1;
+  {
+    std::lock_guard<std::mutex> blk(barrier_mu_);
+    barrier_arrived_.clear();
+    barrier_failed_ = false;
+  }
   Log::Info("%s", Dashboard::Report().c_str());
 }
 
-void Zoo::Barrier() {
+bool Zoo::Barrier() {
   Monitor mon("Zoo::Barrier");
   Waiter waiter(1);
   {
     std::lock_guard<std::mutex> lk(barrier_mu_);
     barrier_waiter_ = &waiter;
+    barrier_failed_ = false;
   }
   auto msg = std::make_unique<Message>();
   msg->type = MsgType::ControlBarrier;
@@ -181,18 +193,32 @@ void Zoo::Barrier() {
   msg->src = rank_;
   msg->dst = 0;
   SendTo(actor::kWorker, std::move(msg));
-  waiter.Wait();
+  // Default (<=0) waits forever — BSP semantics; a deadline turns a dead
+  // peer into an error return instead of a hang (the release message may
+  // still arrive later: OnBarrierRelease tolerates a cleared waiter).
+  bool ok = waiter.WaitFor(configure::GetInt("barrier_timeout_ms"));
+  if (!ok)
+    Log::Error("Zoo::Barrier: timed out waiting for release (rank %d)",
+               rank_);
   std::lock_guard<std::mutex> lk(barrier_mu_);
   barrier_waiter_ = nullptr;
+  return ok && !barrier_failed_;
 }
 
 void Zoo::OnBarrierArrive(int src_rank) {
-  (void)src_rank;
   std::vector<int> release;
   {
     std::lock_guard<std::mutex> lk(barrier_mu_);
-    if (++barrier_arrivals_ < size_) return;
-    barrier_arrivals_ = 0;
+    if (barrier_arrived_.size() != static_cast<size_t>(size_))
+      barrier_arrived_.assign(size_, false);
+    if (src_rank < 0 || src_rank >= size_) return;
+    // Per-rank, not per-message: a retry after an abandoned (timed-out)
+    // round must not double-count toward the quorum.
+    if (barrier_arrived_[src_rank]) return;
+    barrier_arrived_[src_rank] = true;
+    for (bool a : barrier_arrived_)
+      if (!a) return;
+    barrier_arrived_.assign(size_, false);
     for (int r = 0; r < size_; ++r) release.push_back(r);
   }
   for (int r : release) {
@@ -233,7 +259,38 @@ void Zoo::Deliver(const std::string& actor_name, MessagePtr msg) {
     SendTo(actor_name, std::move(msg));
     return;
   }
-  net_->Send(msg->dst, *msg);
+  if (net_->Send(msg->dst, *msg)) return;
+  // Unreachable peer: fail blocking callers fast instead of hanging.
+  switch (msg->type) {
+    case MsgType::RequestGet:
+    case MsgType::RequestAdd: {
+      if (msg->msg_id < 0) return;  // async add: nothing waits
+      auto err = std::make_unique<Message>();
+      err->type = MsgType::ReplyError;
+      err->table_id = msg->table_id;
+      err->msg_id = msg->msg_id;
+      err->src = msg->dst;          // "from" the dead shard
+      err->dst = rank_;
+      SendTo(actor::kWorker, std::move(err));
+      break;
+    }
+    case MsgType::ControlBarrier: {
+      // Rank 0 unreachable: latch the failure, then release the local
+      // waiter so Barrier() returns FALSE immediately instead of either
+      // hanging or (worse) reporting a successful rendezvous.
+      Log::Error("Zoo::Deliver: barrier authority (rank 0) unreachable");
+      {
+        std::lock_guard<std::mutex> lk(barrier_mu_);
+        barrier_failed_ = true;
+      }
+      OnBarrierRelease();
+      break;
+    }
+    default:
+      // Reply to a dead requester / release to a dead peer: that
+      // process's state is gone — drop, the log already has the error.
+      break;
+  }
 }
 
 void Zoo::RouteInbound(Message&& m) {

@@ -2,7 +2,10 @@
 // named scenarios + unit checks in one binary. Run all: ./mvtpu_test
 // Run one: ./mvtpu_test blob|queue|configure|message|array|matrix|
 //                        updater|checkpoint|threads
+#include <unistd.h>
+
 #include <cassert>
+#include <chrono>
 #include <cmath>
 #include <cstdio>
 #include <cstring>
@@ -246,9 +249,77 @@ static int NetChild(const char* machine_file, const char* rank) {
   return 0;
 }
 
+static int DeadPeerChild(const char* machine_file, const char* rank) {
+  // One live rank; the OTHER endpoint has nothing listening.  Every
+  // blocking call that needs the dead rank must ERROR within its
+  // deadline — the round-2 behavior was an infinite hang.  Rank 0
+  // exercises the quorum-timeout path (it is its own barrier
+  // authority); rank 1 exercises the unreachable-authority path
+  // (Deliver latches barrier_failed_ — a false "success" here would
+  // silently break BSP).
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(),       rk.c_str(),
+                         "-updater_type=default", "-log_level=error",
+                         "-connect_retry_ms=300", "-rpc_timeout_ms=3000",
+                         "-barrier_timeout_ms=1000"};
+  CHECK(MV_Init(7, argv2) == 0);
+  int32_t h;
+  CHECK(MV_NewArrayTable(10, &h) == 0);
+
+  auto t0 = std::chrono::steady_clock::now();
+  std::vector<float> out(10, 0.0f);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == -3);  // peer unreachable
+  std::vector<float> d(10, 1.0f);
+  CHECK(MV_AddArrayTable(h, d.data(), 10) == -3);
+  CHECK(MV_Barrier() == -3);
+  CHECK(MV_Barrier() == -3);  // a retry must not fake a quorum either
+  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
+  CHECK(ms < 20000);  // fail-fast, not rpc_timeout*calls hang
+  CHECK(MV_ShutDown() == 0);  // barrier inside times out and proceeds
+  printf("DEAD_PEER_OK\n");
+  return 0;
+}
+
+static int DeadServerChild(const char* machine_file, const char* rank) {
+  // Both ranks start and rendezvous; rank 1 then dies WITHOUT shutdown
+  // (a crash).  Rank 0's next blocking Get must error within the
+  // deadline instead of waiting forever on the never-coming reply.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(),       rk.c_str(),
+                         "-updater_type=default", "-log_level=error",
+                         "-connect_retry_ms=500", "-rpc_timeout_ms=2500",
+                         "-barrier_timeout_ms=2000"};
+  CHECK(MV_Init(7, argv2) == 0);
+  int me = MV_WorkerId();
+  int32_t h;
+  CHECK(MV_NewArrayTable(10, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+  if (me == 1) _exit(0);  // simulated crash: no shutdown, no goodbye
+
+  std::this_thread::sleep_for(std::chrono::milliseconds(800));
+  auto t0 = std::chrono::steady_clock::now();
+  std::vector<float> out(10, 0.0f);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == -3);
+  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
+  CHECK(ms < 10000);
+  CHECK(MV_ShutDown() == 0);
+  printf("DEAD_SERVER_OK\n");
+  return 0;
+}
+
 int main(int argc, char** argv) {
   if (argc == 4 && std::string(argv[1]) == "net_child")
     return NetChild(argv[2], argv[3]);
+  if (argc == 4 && std::string(argv[1]) == "dead_peer")
+    return DeadPeerChild(argv[2], argv[3]);
+  if (argc == 4 && std::string(argv[1]) == "dead_server")
+    return DeadServerChild(argv[2], argv[3]);
   struct Case {
     const char* name;
     int (*fn)();

@@ -119,3 +119,78 @@ print("NATIVE_SGD_OK")
     t.add(np.full(8, 2.0, np.float32),
           option=m.AddOption(learning_rate=0.5))
     np.testing.assert_allclose(t.get(), -1.0)
+
+
+# ------------------------------------------------- multi-process scenarios
+
+def _machine_file(tmp_path, n=2):
+    import socket
+
+    ports = []
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    mf = tmp_path / "machines.txt"
+    mf.write_text("".join(f"127.0.0.1:{p}\n" for p in ports))
+    return str(mf)
+
+
+def _binary():
+    b = os.path.join(NATIVE_DIR, "build", "mvtpu_test")
+    subprocess.run(["make", "-C", NATIVE_DIR, "-j4", "build/mvtpu_test"],
+                   check=True, capture_output=True)
+    return b
+
+
+def test_native_two_process_net(native, tmp_path):
+    """Two OS processes, sharded tables over the TCP transport: Add/Get
+    round trips cross the process boundary, barriers rendezvous through
+    rank 0 (the reference's mpirun scenario, SURVEY.md §4)."""
+    mf = _machine_file(tmp_path)
+    b = _binary()
+    procs = [subprocess.Popen([b, "net_child", mf, str(r)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"NET_CHILD_OK {r}" in out, out[-2000:]
+
+
+@pytest.mark.parametrize("live_rank", ["0", "1"])
+def test_native_dead_peer_fails_fast(native, tmp_path, live_rank):
+    """Only one rank exists: blocking Get/Add/Barrier must all return
+    rc=-3 within their deadlines instead of hanging (round-2's behavior
+    was an infinite Waiter wait).  rank 0 = quorum-timeout path, rank 1 =
+    unreachable-barrier-authority path."""
+    import time
+
+    mf = _machine_file(tmp_path)
+    b = _binary()
+    t0 = time.time()
+    out = subprocess.run([b, "dead_peer", mf, live_rank],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "DEAD_PEER_OK" in out.stdout
+    assert time.time() - t0 < 45
+
+
+def test_native_dead_server_fails_fast(native, tmp_path):
+    """Rank 1 crashes (exit without shutdown) after the rendezvous; rank
+    0's next blocking Get errors within -rpc_timeout_ms."""
+    mf = _machine_file(tmp_path)
+    b = _binary()
+    procs = [subprocess.Popen([b, "dead_server", mf, str(r)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert procs[0].returncode == 0, outs[0][-3000:]
+    assert "DEAD_SERVER_OK" in outs[0]
+    assert procs[1].returncode == 0, outs[1][-3000:]  # _exit(0) crash sim
