@@ -195,57 +195,102 @@ static int TestThreads() {
 }
 
 static int NetChild(const char* machine_file, const char* rank) {
-  // Two-process scenario (spawned twice by tests/test_native.py): sharded
+  // N-process scenario (spawned N times by tests/test_native.py): sharded
   // tables over the TCP transport — Add/Get round-trips cross the process
   // boundary, MV_Barrier rendezvouses through rank 0's controller.
+  // N comes from the machine file (2 and 4 in CI); N <= 4.
   std::string mf = std::string("-machine_file=") + machine_file;
   std::string rk = std::string("-rank=") + rank;
+  // Bounded deadlines: an infra failure (stolen port, dead sibling)
+  // must fail a CHECK quickly, not hang the rank past pytest's timeout.
   const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
-                         "-log_level=error"};
-  CHECK(MV_Init(4, argv2) == 0);
+                         "-log_level=error", "-rpc_timeout_ms=60000",
+                         "-barrier_timeout_ms=60000"};
+  CHECK(MV_Init(6, argv2) == 0);
   int me = MV_WorkerId();
-  CHECK(MV_NumWorkers() == 2);
+  int n = MV_NumWorkers();
+  CHECK(n >= 2 && n <= 4);
+  float total = (float)(n * (n + 1) / 2);  // sum over ranks of (r+1)
 
   int32_t h;
   CHECK(MV_NewArrayTable(10, &h) == 0);
   int32_t hm;
   CHECK(MV_NewMatrixTable(8, 4, &hm) == 0);
-  CHECK(MV_Barrier() == 0);  // both ranks registered both tables
+  CHECK(MV_Barrier() == 0);  // every rank registered both tables
 
-  // Each rank pushes its own delta; shards live on BOTH ranks, so every
-  // Add crosses the wire for the remote shard. After the barrier both
+  // Each rank pushes its own delta; shards live on EVERY rank, so every
+  // Add crosses the wire for the remote shards. After the barrier all
   // ranks must read the sum.
   std::vector<float> delta(10, (float)(me + 1)), out(10, -1.0f);
   CHECK(MV_AddArrayTable(h, delta.data(), 10) == 0);
   CHECK(MV_Barrier() == 0);
   CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
-  for (float v : out) CHECK(v == 3.0f);
+  for (float v : out) CHECK(v == total);
 
   // Async add flushes through the pipeline before the barrier completes.
   CHECK(MV_AddAsyncArrayTable(h, delta.data(), 10) == 0);
   CHECK(MV_Barrier() == 0);
   CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
-  for (float v : out) CHECK(v == (float)(3 + 3));
+  for (float v : out) CHECK(v == 2 * total);
 
-  // Matrix rows: rank r touches rows {r, 4+r} — rows 0..3 live on rank
-  // 0's shard, 4..7 on rank 1's, so half of each batch is remote.
+  // Matrix rows: rank r touches rows {r, 4+r}, so row blocks from every
+  // shard see both local and remote writes.
   int32_t rows[2] = {me, 4 + me};
   std::vector<float> rd(8, (float)(me + 1));
   CHECK(MV_AddMatrixTableByRows(hm, rd.data(), rows, 2, 4) == 0);
   CHECK(MV_Barrier() == 0);
-  int32_t qrows[4] = {0, 1, 4, 5};
-  std::vector<float> rout(16, -1.0f);
-  CHECK(MV_GetMatrixTableByRows(hm, rout.data(), qrows, 4, 4) == 0);
-  for (int c = 0; c < 4; ++c) {
-    CHECK(rout[c] == 1.0f);        // row 0: rank 0 wrote 1s
-    CHECK(rout[4 + c] == 2.0f);    // row 1: rank 1 wrote 2s
-    CHECK(rout[8 + c] == 1.0f);    // row 4: rank 0
-    CHECK(rout[12 + c] == 2.0f);   // row 5: rank 1
+  for (int r = 0; r < n; ++r) {
+    int32_t qrows[2] = {r, 4 + r};
+    std::vector<float> rout(8, -1.0f);
+    CHECK(MV_GetMatrixTableByRows(hm, rout.data(), qrows, 2, 4) == 0);
+    for (float v : rout) CHECK(v == (float)(r + 1));
   }
 
   CHECK(MV_Barrier() == 0);
   CHECK(MV_ShutDown() == 0);
   printf("NET_CHILD_OK %d\n", me);
+  return 0;
+}
+
+static int NetUpdaterChild(const char* machine_file, const char* rank,
+                           const char* updater) {
+  // Stateful-updater cross-rank scenario: every rank pushes identical
+  // blocking deltas, the server shards apply them SEQUENTIALLY through
+  // the stateful updater (slot state lives with the shard), and every
+  // rank must read the same deterministic result.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  std::string up = std::string("-updater_type=") + updater;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), up.c_str(),
+                         "-log_level=error", "-rpc_timeout_ms=60000",
+                         "-barrier_timeout_ms=60000"};
+  CHECK(MV_Init(6, argv2) == 0);
+  int me = MV_WorkerId();
+  int n = MV_NumWorkers();
+  CHECK(MV_SetAddOption(0.1f, 0.9f, 0.9f, 1e-8f) == 0);
+
+  int32_t h;
+  CHECK(MV_NewArrayTable(6, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+  std::vector<float> ones(6, 1.0f), out(6, -1.0f);
+  CHECK(MV_AddArrayTable(h, ones.data(), 6) == 0);  // blocking
+  CHECK(MV_Barrier() == 0);                         // all n adds applied
+  CHECK(MV_GetArrayTable(h, out.data(), 6) == 0);
+
+  float want = 0.0f;
+  if (std::string(updater) == "sgd") {
+    want = -0.1f * n;                       // linear: order-free
+  } else if (std::string(updater) == "adagrad") {
+    // n sequential g=1 applies: w -= lr * g / sqrt(h_i), h_i = i
+    for (int i = 1; i <= n; ++i) want -= 0.1f / sqrtf((float)i);
+  } else {
+    CHECK(false);
+  }
+  for (float v : out) CHECK(fabsf(v - want) < 1e-4f);
+
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("NET_UPDATER_OK %d\n", me);
   return 0;
 }
 
@@ -316,6 +361,8 @@ static int DeadServerChild(const char* machine_file, const char* rank) {
 int main(int argc, char** argv) {
   if (argc == 4 && std::string(argv[1]) == "net_child")
     return NetChild(argv[2], argv[3]);
+  if (argc == 5 && std::string(argv[1]) == "net_updater")
+    return NetUpdaterChild(argv[2], argv[3], argv[4]);
   if (argc == 4 && std::string(argv[1]) == "dead_peer")
     return DeadPeerChild(argv[2], argv[3]);
   if (argc == 4 && std::string(argv[1]) == "dead_server")

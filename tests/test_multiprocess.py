@@ -27,9 +27,8 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_distributed_runtime(tmp_path):
+def _run_workers(tmp_path, nprocs):
     port = _free_port()
-    nprocs = 2
     env = dict(os.environ)
     # The workers set their own JAX_PLATFORMS/XLA_FLAGS before importing
     # jax; scrub this (conftest-polluted) process's values out.
@@ -46,7 +45,7 @@ def test_two_process_distributed_runtime(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=300)
             outs.append(out)
     finally:
         for p in procs:
@@ -56,3 +55,52 @@ def test_two_process_distributed_runtime(tmp_path):
         assert p.returncode == 0, (
             f"worker {i} failed (rc={p.returncode}):\n{out[-4000:]}")
         assert f"WORKER_OK {i}" in out, out[-2000:]
+
+
+@pytest.fixture(scope="module")
+def two_proc_scratch(tmp_path_factory):
+    """Run the n=2 worker job ONCE; its scratch (with mp.ckpt) serves both
+    the runtime test and the cross-process-count restore test."""
+    scratch = tmp_path_factory.mktemp("mp2")
+    _run_workers(scratch, 2)
+    return scratch
+
+
+def test_multi_process_distributed_runtime_n2(two_proc_scratch):
+    pass  # the fixture already asserted WORKER_OK for both ranks
+
+
+def test_multi_process_distributed_runtime_n4(tmp_path):
+    _run_workers(tmp_path, 4)
+
+
+def test_restore_multiprocess_checkpoint_into_single_process(
+        two_proc_scratch, mv):
+    """A checkpoint saved by the n=2 job restores into an n=1 session:
+    the snapshot is process-count-independent (global table state, not
+    per-shard files — unlike the reference's per-server dump model)."""
+    import numpy as np
+
+    path = os.path.join(str(two_proc_scratch), "mp.ckpt")
+    assert os.path.exists(path)
+
+    import multiverso_tpu as m
+    from multiverso_tpu import checkpoint
+
+    mv.init()
+    total = 3.0                              # sum of (r+1) over 2 ranks
+    t = m.ArrayTable(10, name="mp_a")
+    mat = m.MatrixTable(8, 4, name="mp_m")
+    kv = m.KVTable(value_shape=(2,), name="mp_kv")
+    sp = m.SparseMatrixTable(8, 4, name="mp_sp")
+    ts = m.ArrayTable(4, name="mp_sync", sync=True)
+    extra = checkpoint.restore(path)
+    assert extra == {"step": 7}
+    np.testing.assert_allclose(t.get(), total)
+    np.testing.assert_allclose(ts.get(), total)
+    got = mat.get()
+    for r in range(2):
+        np.testing.assert_allclose(got[r], r + 1.0)
+        np.testing.assert_allclose(got[4 + r], r + 1.0)
+    np.testing.assert_allclose(kv.get(["shared"])["shared"], 2.0)
+    np.testing.assert_allclose(sp.get_rows(np.array([0]))[0], 1.0)

@@ -147,20 +147,49 @@ def _binary():
     return b
 
 
-def test_native_two_process_net(native, tmp_path):
-    """Two OS processes, sharded tables over the TCP transport: Add/Get
-    round trips cross the process boundary, barriers rendezvous through
-    rank 0 (the reference's mpirun scenario, SURVEY.md §4)."""
-    mf = _machine_file(tmp_path)
-    b = _binary()
-    procs = [subprocess.Popen([b, "net_child", mf, str(r)],
+def _run_ranks(binary, scenario, mf, n, extra=()):
+    procs = [subprocess.Popen([binary, scenario, mf, str(r), *extra],
                               stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True)
-             for r in range(2)]
-    outs = [p.communicate(timeout=120)[0] for p in procs]
+             for r in range(n)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+    finally:
+        # A hung rank must not leak its siblings (they hold listen ports
+        # the rest of the pytest session would collide with).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs, procs
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_native_multi_process_net(native, tmp_path, nprocs):
+    """N OS processes, sharded tables over the TCP transport: Add/Get
+    round trips cross the process boundary, barriers rendezvous through
+    rank 0 (the reference's mpirun -n N scenario, SURVEY.md §4)."""
+    mf = _machine_file(tmp_path, nprocs)
+    b = _binary()
+    outs, procs = _run_ranks(b, "net_child", mf, nprocs)
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
         assert f"NET_CHILD_OK {r}" in out, out[-2000:]
+
+
+@pytest.mark.parametrize("updater", ["sgd", "adagrad"])
+def test_native_stateful_updater_cross_rank(native, tmp_path, updater):
+    """Stateful updaters across ranks: every rank's blocking add applies
+    sequentially through the shard-resident slot state; all ranks read
+    the same deterministic result (fills the round-2 gap where the net
+    scenario pinned -updater_type=default)."""
+    mf = _machine_file(tmp_path, 2)
+    b = _binary()
+    outs, procs = _run_ranks(b, "net_updater", mf, 2, extra=(updater,))
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"NET_UPDATER_OK {r}" in out, out[-2000:]
 
 
 @pytest.mark.parametrize("live_rank", ["0", "1"])
