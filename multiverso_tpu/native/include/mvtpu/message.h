@@ -18,6 +18,14 @@ enum class MsgType : int32_t {
   // Synthesized locally when the transport cannot deliver a request —
   // unblocks the pending RoundTrip with an error instead of a hang.
   ReplyError = 5,
+  // Pipeline flush marker: rides each worker→server connection BEHIND
+  // any earlier async adds (per-connection FIFO), acked after the
+  // server processed everything before it.  Barrier() drains one per
+  // remote server shard before announcing arrival — the mechanism that
+  // makes "async adds apply before the barrier completes" true for
+  // n >= 3 (two connections to different peers have no mutual order).
+  RequestFlush = 6,
+  ReplyFlush = 7,
   ControlRegister = 16,
   ControlReply = 17,
   ControlBarrier = 18,

@@ -28,6 +28,33 @@ class TcpNet {
   // Parse a machine file into "host:port" endpoints; empty on error.
   static std::vector<std::string> ParseMachineFile(const std::string& path);
 
+  // One length-prefixed serialized Message over a raw fd (used by the
+  // dynamic-registration handshake, which runs before the transport).
+  static bool SendFramed(int fd, const Message& msg);
+  static bool RecvFramed(int fd, Message* msg);
+
+  // Dynamic registration (reference src/controller.cpp Control_Register,
+  // SURVEY.md §2.7/§3.1): the controller listens on `ctrl_endpoint`,
+  // collects `num_nodes - 1` ControlRegister messages (each carrying the
+  // registrant's endpoint + role bitmask), assigns ranks in arrival
+  // order, and answers every registrant with the full node table.
+  // Registrants block until the table arrives.  On success: endpoints
+  // and roles are rank-indexed, *my_rank is set (controller == 0), and
+  // every registration socket is closed — the regular transport then
+  // starts from the returned table.
+  // `timeout_ms` bounds the whole collection (a crashed registrant must
+  // not hang MV_Init forever); silent clients are bounded per-read.
+  static bool RegisterController(const std::string& ctrl_endpoint,
+                                 int num_nodes, int my_role,
+                                 std::vector<std::string>* endpoints,
+                                 std::vector<int>* roles,
+                                 int64_t timeout_ms = 30000);
+  static bool RegisterWithController(const std::string& ctrl_endpoint,
+                                     const std::string& my_endpoint,
+                                     int my_role, int64_t retry_ms,
+                                     std::vector<std::string>* endpoints,
+                                     std::vector<int>* roles, int* my_rank);
+
   // Bind + listen on endpoints[rank]'s port, start the accept loop,
   // deliver every inbound message to `fn` (called from reader threads).
   // `connect_retry_ms` bounds each lazy-connect's retry budget.

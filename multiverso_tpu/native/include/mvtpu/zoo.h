@@ -41,9 +41,21 @@ class Zoo {
 
   int rank() const { return rank_; }
   int size() const { return size_; }
-  int num_workers() const { return size_; }
-  int worker_id() const { return rank_; }
-  int server_id() const { return rank_; }
+  // Role bitmasks (reference Role enum): 1 = worker, 2 = server.
+  // Static (machine-file) mode gives every rank both roles; dynamic
+  // registration (-controller_endpoint/-role) can create worker-only or
+  // server-only processes — tables shard across SERVER ranks only.
+  static constexpr int kRoleWorker = 1;
+  static constexpr int kRoleServer = 2;
+  int num_workers() const { return static_cast<int>(worker_ranks_.size()); }
+  int num_servers() const { return static_cast<int>(server_ranks_.size()); }
+  // Index among the worker/server ranks, or -1 when this rank lacks the
+  // role (matches the reference's worker_id/server_id semantics).
+  int worker_id() const { return IndexIn(worker_ranks_, rank_); }
+  int server_id() const { return IndexIn(server_ranks_, rank_); }
+  // shard index <-> global rank translation for the table layer.
+  int server_rank(int idx) const { return server_ranks_[idx]; }
+  int server_index(int rank) const { return IndexIn(server_ranks_, rank); }
 
   // Blocks until every rank arrived; false when `-barrier_timeout_ms`
   // (default: infinite) expired or the barrier authority is unreachable.
@@ -72,9 +84,22 @@ class Zoo {
   // ---- barrier plumbing (internal) ------------------------------------
   void OnBarrierArrive(int src_rank);   // rank-0 controller counting
   void OnBarrierRelease();              // local waiter release
+  void OnFlushReply(int64_t msg_id);    // per-server flush ack
 
  private:
   Zoo() = default;
+
+  static int IndexIn(const std::vector<int>& v, int rank) {
+    for (size_t i = 0; i < v.size(); ++i)
+      if (v[i] == rank) return static_cast<int>(i);
+    return -1;
+  }
+
+  void SetRoles(const std::vector<int>& roles);
+
+  // Blocking: one RequestFlush per remote server shard, acked when that
+  // server drained every earlier message on the same connection.
+  bool FlushPipelines();
 
   void RouteInbound(Message&& m);       // transport reader threads
 
@@ -87,6 +112,8 @@ class Zoo {
 
   int rank_ = 0;
   int size_ = 1;
+  std::vector<int> worker_ranks_{0};   // ranks holding the worker role
+  std::vector<int> server_ranks_{0};   // ranks holding the server role
   std::unique_ptr<TcpNet> net_;
 
   std::unique_ptr<Actor> worker_actor_;
@@ -104,6 +131,11 @@ class Zoo {
   Waiter* barrier_waiter_ = nullptr;
   std::vector<bool> barrier_arrived_;
   bool barrier_failed_ = false;
+
+  // Outstanding pipeline flushes (msg_id → waiter); acks notify under
+  // flush_mu_ so a timed-out flush cannot race its stack waiter.
+  std::mutex flush_mu_;
+  std::unordered_map<int64_t, Waiter*> flush_pending_;
 };
 
 }  // namespace mvtpu

@@ -137,6 +137,15 @@ void RegisterDefaults() {
     DefineString("machine_file", "",
                  "host:port per line; >1 line enables the TCP transport");
     DefineInt("rank", 0, "this process's line index in machine_file");
+    DefineString("controller_endpoint", "",
+                 "dynamic registration: rank 0's host:port (no machine "
+                 "file / -rank needed; reference Control_Register)");
+    DefineBool("is_controller", false,
+               "this process IS the registration controller (rank 0)");
+    DefineInt("num_nodes", 0, "dynamic registration: total process count");
+    DefineString("role", "all", "worker|server|all — this node's roles");
+    DefineString("node_host", "127.0.0.1",
+                 "dynamic registration: address peers reach this node at");
     DefineInt("port", 55555, "base port (transport parity flag)");
     DefineDouble("backup_worker_ratio", 0.0, "straggler slack (parity flag)");
     DefineInt("rpc_timeout_ms", 30000,

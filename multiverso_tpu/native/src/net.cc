@@ -4,6 +4,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -69,6 +70,192 @@ std::vector<std::string> TcpNet::ParseMachineFile(const std::string& path) {
     eps.push_back(line.substr(b, e - b + 1));
   }
   return eps;
+}
+
+bool TcpNet::SendFramed(int fd, const Message& msg) {
+  Blob wire = msg.Serialize();
+  int64_t len = static_cast<int64_t>(wire.size());
+  return WriteAll(fd, &len, sizeof(len)) &&
+         WriteAll(fd, wire.data(), wire.size());
+}
+
+bool TcpNet::RecvFramed(int fd, Message* msg) {
+  int64_t len = 0;
+  if (!ReadAll(fd, &len, sizeof(len)) || len <= 0 || len > (int64_t{1} << 30))
+    return false;
+  Blob buf(static_cast<size_t>(len));
+  if (!ReadAll(fd, buf.data(), buf.size())) return false;
+  *msg = Message::Deserialize(buf);
+  return true;
+}
+
+namespace {
+
+// Node-table wire format inside ControlReply: blob0 = int32 assigned
+// rank, blob1 = int32 roles[num], blob2 = '\n'-joined endpoints.
+Blob PackEndpoints(const std::vector<std::string>& endpoints) {
+  std::string joined;
+  for (const auto& e : endpoints) {
+    joined += e;
+    joined += '\n';
+  }
+  return Blob(joined.data(), joined.size());
+}
+
+std::vector<std::string> UnpackEndpoints(const Blob& b) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (size_t i = 0; i < b.size(); ++i) {
+    char c = b.data()[i];
+    if (c == '\n') {
+      out.push_back(cur);
+      cur.clear();
+    } else {
+      cur += c;
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+bool TcpNet::RegisterController(const std::string& ctrl_endpoint,
+                                int num_nodes, int my_role,
+                                std::vector<std::string>* endpoints,
+                                std::vector<int>* roles,
+                                int64_t timeout_ms) {
+  std::string host;
+  int port = 0;
+  if (num_nodes < 1 || !SplitHostPort(ctrl_endpoint, &host, &port))
+    return false;
+  endpoints->assign(num_nodes, "");
+  roles->assign(num_nodes, 0);
+  (*endpoints)[0] = ctrl_endpoint;
+  (*roles)[0] = my_role;
+  if (num_nodes == 1) return true;
+
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return false;
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(lfd, 64) < 0) {
+    Log::Error("RegisterController: cannot listen on %s",
+               ctrl_endpoint.c_str());
+    ::close(lfd);
+    return false;
+  }
+  // Ranks assigned in arrival order, 1..num_nodes-1.  The collection is
+  // deadline-bounded (poll on the listener) and each accepted client is
+  // read under SO_RCVTIMEO so a silent connection cannot park the
+  // single-threaded loop and starve real registrants.
+  std::vector<int> fds;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (int next = 1; next < num_nodes;) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    if (left <= 0) {
+      Log::Error("RegisterController: %d/%d nodes after %lld ms", next - 1,
+                 num_nodes - 1, static_cast<long long>(timeout_ms));
+      break;
+    }
+    pollfd pfd{lfd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, static_cast<int>(std::min<int64_t>(left, 500)));
+    if (pr < 0) break;
+    if (pr == 0) continue;
+    int fd = ::accept(lfd, nullptr, nullptr);
+    if (fd < 0) break;
+    timeval tv{5, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    Message reg;
+    if (!RecvFramed(fd, &reg) || reg.type != MsgType::ControlRegister ||
+        reg.data.size() < 2) {
+      ::close(fd);
+      continue;
+    }
+    (*endpoints)[next] = std::string(reg.data[0].data(), reg.data[0].size());
+    (*roles)[next] = *reg.data[1].As<int32_t>();
+    fds.push_back(fd);
+    ++next;
+  }
+  ::close(lfd);
+  if (static_cast<int>(fds.size()) != num_nodes - 1) {
+    for (int fd : fds) ::close(fd);
+    return false;
+  }
+  bool ok = true;
+  std::vector<int32_t> roles32(roles->begin(), roles->end());
+  for (size_t i = 0; i < fds.size(); ++i) {
+    Message reply;
+    reply.type = MsgType::ControlReply;
+    int32_t rank = static_cast<int32_t>(i + 1);
+    reply.data.emplace_back(&rank, sizeof(rank));
+    reply.data.emplace_back(roles32.data(), roles32.size() * sizeof(int32_t));
+    reply.data.push_back(PackEndpoints(*endpoints));
+    ok = SendFramed(fds[i], reply) && ok;
+    ::close(fds[i]);
+  }
+  Log::Info("controller: %d nodes registered", num_nodes);
+  return ok;
+}
+
+bool TcpNet::RegisterWithController(const std::string& ctrl_endpoint,
+                                    const std::string& my_endpoint,
+                                    int my_role, int64_t retry_ms,
+                                    std::vector<std::string>* endpoints,
+                                    std::vector<int>* roles, int* my_rank) {
+  std::string host;
+  int port = 0;
+  if (!SplitHostPort(ctrl_endpoint, &host, &port)) return false;
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                    &res) != 0 ||
+      !res)
+    return false;
+  int fd = -1;
+  int attempts = static_cast<int>(std::max<int64_t>(1, retry_ms / 100));
+  for (int a = 0; a < attempts; ++a) {
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) break;
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) {
+    Log::Error("RegisterWithController: cannot reach %s",
+               ctrl_endpoint.c_str());
+    return false;
+  }
+  Message reg;
+  reg.type = MsgType::ControlRegister;
+  reg.data.emplace_back(my_endpoint.data(), my_endpoint.size());
+  int32_t role32 = my_role;
+  reg.data.emplace_back(&role32, sizeof(role32));
+  Message reply;
+  bool ok = SendFramed(fd, reg) && RecvFramed(fd, &reply) &&
+            reply.type == MsgType::ControlReply && reply.data.size() >= 3;
+  if (ok) {
+    *my_rank = *reply.data[0].As<int32_t>();
+    size_t n = reply.data[1].count<int32_t>();
+    roles->assign(reply.data[1].As<int32_t>(),
+                  reply.data[1].As<int32_t>() + n);
+    *endpoints = UnpackEndpoints(reply.data[2]);
+    ok = endpoints->size() == n && *my_rank > 0 &&
+         *my_rank < static_cast<int>(n);
+  }
+  ::close(fd);
+  return ok;
 }
 
 bool TcpNet::Init(const std::vector<std::string>& endpoints, int rank,

@@ -239,13 +239,16 @@ bool WorkerTable::RoundTrip(std::vector<MessagePtr> reqs,
 
 namespace {
 
-MessagePtr MakeReq(MsgType type, int32_t table_id, int64_t msg_id, int dst) {
+MessagePtr MakeReq(MsgType type, int32_t table_id, int64_t msg_id,
+                   int shard_idx) {
+  // Requests address SHARD indices; the wire needs the owning global
+  // rank (they differ when worker-only/server-only roles exist).
   auto req = std::make_unique<Message>();
   req->type = type;
   req->table_id = table_id;
   req->msg_id = msg_id;
   req->src = Zoo::Get()->rank();
-  req->dst = dst;
+  req->dst = Zoo::Get()->server_rank(shard_idx);
   return req;
 }
 
@@ -262,7 +265,9 @@ struct GatherDest {
 void GatherReply(void* arg, const Message& reply) {
   auto* d = static_cast<GatherDest*>(arg);
   if (reply.data.empty()) return;
-  ShardRange rg = ShardOf(d->global, reply.src, d->servers);
+  int shard = Zoo::Get()->server_index(reply.src);
+  if (shard < 0) return;  // reply from a rank that owns no shard
+  ShardRange rg = ShardOf(d->global, shard, d->servers);
   size_t off = static_cast<size_t>(rg.begin * d->stride);
   size_t n = reply.data[0].count<float>();
   if (off >= d->cap) return;
@@ -281,7 +286,9 @@ struct RowsDest {
 void ScatterRowsReply(void* arg, const Message& reply) {
   auto* d = static_cast<RowsDest*>(arg);
   if (reply.data.empty()) return;
-  const auto& pos = (*d->positions)[static_cast<size_t>(reply.src)];
+  int shard = Zoo::Get()->server_index(reply.src);
+  if (shard < 0) return;
+  const auto& pos = (*d->positions)[static_cast<size_t>(shard)];
   const float* src = reply.data[0].As<float>();
   size_t have = reply.data[0].count<float>() / d->cols;
   for (size_t i = 0; i < pos.size() && i < have; ++i) {

@@ -22,6 +22,12 @@ class WorkerActor : public Actor {
     RegisterHandler(MsgType::RequestAdd, [](MessagePtr& m) {
       Zoo::Get()->Deliver(actor::kServer, std::move(m));
     });
+    RegisterHandler(MsgType::RequestFlush, [](MessagePtr& m) {
+      Zoo::Get()->Deliver(actor::kServer, std::move(m));
+    });
+    RegisterHandler(MsgType::ReplyFlush, [](MessagePtr& m) {
+      Zoo::Get()->OnFlushReply(m->msg_id);
+    });
     RegisterHandler(MsgType::ControlBarrier, [](MessagePtr& m) {
       // Local pipeline flush leg: worker → (local) server.
       Zoo::Get()->SendTo(actor::kServer, std::move(m));
@@ -45,6 +51,11 @@ class ServerActor : public Actor {
   ServerActor() : Actor(actor::kServer) {
     RegisterHandler(MsgType::RequestGet, [](MessagePtr& m) {
       auto* table = Zoo::Get()->server_table(m->table_id);
+      if (!table) {  // misrouted: this rank has no server role/shard
+        Log::Error("RequestGet for table %d on non-server rank",
+                   m->table_id);
+        return;
+      }
       auto reply = std::make_unique<Message>();
       reply->type = MsgType::ReplyGet;
       reply->table_id = m->table_id;
@@ -55,7 +66,13 @@ class ServerActor : public Actor {
       Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
     });
     RegisterHandler(MsgType::RequestAdd, [](MessagePtr& m) {
-      Zoo::Get()->server_table(m->table_id)->ProcessAdd(*m);
+      auto* table = Zoo::Get()->server_table(m->table_id);
+      if (!table) {
+        Log::Error("RequestAdd for table %d on non-server rank",
+                   m->table_id);
+        return;
+      }
+      table->ProcessAdd(*m);
       if (m->msg_id >= 0) {  // blocking add wants an ack
         auto reply = std::make_unique<Message>();
         reply->type = MsgType::ReplyAdd;
@@ -65,6 +82,16 @@ class ServerActor : public Actor {
         reply->dst = m->src;
         Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
       }
+    });
+    RegisterHandler(MsgType::RequestFlush, [](MessagePtr& m) {
+      // Reaching here means every earlier message on the requester's
+      // connection was processed — ack so its Barrier can proceed.
+      auto reply = std::make_unique<Message>();
+      reply->type = MsgType::ReplyFlush;
+      reply->msg_id = m->msg_id;
+      reply->src = Zoo::Get()->rank();
+      reply->dst = m->src;
+      Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
     });
     RegisterHandler(MsgType::ControlBarrier, [](MessagePtr& m) {
       m->dst = 0;  // the controller authority lives on rank 0
@@ -113,12 +140,63 @@ bool Zoo::Start(int argc, const char* const* argv) {
 
   rank_ = 0;
   size_ = 1;
+  worker_ranks_ = {0};
+  server_ranks_ = {0};
   std::string machine_file = configure::GetString("machine_file");
-  if (!machine_file.empty()) {
+  std::string ctrl = configure::GetString("controller_endpoint");
+  if (!ctrl.empty()) {
+    // Dynamic registration (reference Control_Register, SURVEY §2.7):
+    // no machine file, no -rank — the controller assigns ranks and
+    // broadcasts the node table; roles can differ per process.
+    std::string role_str = configure::GetString("role");
+    if (role_str != "worker" && role_str != "server" && role_str != "all") {
+      // A typo must not silently become a full worker+server node (it
+      // would host an unintended shard and shift every worker_id).
+      Log::Error("unknown -role '%s' (expected worker|server|all)",
+                 role_str.c_str());
+      return false;
+    }
+    int role = role_str == "worker" ? kRoleWorker
+               : role_str == "server" ? kRoleServer
+                                      : (kRoleWorker | kRoleServer);
+    int num = static_cast<int>(configure::GetInt("num_nodes"));
+    std::vector<std::string> endpoints;
+    std::vector<int> roles;
+    bool ok;
+    if (configure::GetBool("is_controller")) {
+      rank_ = 0;
+      ok = TcpNet::RegisterController(ctrl, num, role, &endpoints, &roles,
+                                      configure::GetInt("rpc_timeout_ms"));
+    } else {
+      std::string me = configure::GetString("node_host") + ":" +
+                       std::to_string(configure::GetInt("port"));
+      ok = TcpNet::RegisterWithController(
+          ctrl, me, role, configure::GetInt("connect_retry_ms"),
+          &endpoints, &roles, &rank_);
+    }
+    if (!ok) {
+      Log::Error("dynamic registration failed (controller=%s)",
+                 ctrl.c_str());
+      return false;
+    }
+    size_ = static_cast<int>(endpoints.size());
+    SetRoles(roles);
+    if (size_ > 1) {
+      net_ = std::make_unique<TcpNet>();
+      if (!net_->Init(endpoints, rank_,
+                      [this](Message&& m) { RouteInbound(std::move(m)); },
+                      configure::GetInt("connect_retry_ms"))) {
+        net_.reset();
+        return false;
+      }
+    }
+  } else if (!machine_file.empty()) {
     auto endpoints = TcpNet::ParseMachineFile(machine_file);
     if (endpoints.size() > 1) {
       rank_ = static_cast<int>(configure::GetInt("rank"));
       size_ = static_cast<int>(endpoints.size());
+      // Static mode: every rank is worker + server (reference Role::All).
+      SetRoles(std::vector<int>(size_, kRoleWorker | kRoleServer));
       net_ = std::make_unique<TcpNet>();
       if (!net_->Init(endpoints, rank_,
                       [this](Message&& m) { RouteInbound(std::move(m)); },
@@ -171,6 +249,8 @@ void Zoo::Stop() {
   }
   rank_ = 0;
   size_ = 1;
+  worker_ranks_ = {0};
+  server_ranks_ = {0};
   {
     std::lock_guard<std::mutex> blk(barrier_mu_);
     barrier_arrived_.clear();
@@ -179,13 +259,52 @@ void Zoo::Stop() {
   Log::Info("%s", Dashboard::Report().c_str());
 }
 
+bool Zoo::FlushPipelines() {
+  if (!net_) return true;
+  std::vector<int> targets;
+  for (int s : server_ranks_)
+    if (s != rank_) targets.push_back(s);
+  if (targets.empty()) return true;
+  int64_t id = NextMsgId();
+  Waiter waiter(static_cast<int>(targets.size()));
+  {
+    std::lock_guard<std::mutex> lk(flush_mu_);
+    flush_pending_[id] = &waiter;
+  }
+  for (int s : targets) {
+    auto msg = std::make_unique<Message>();
+    msg->type = MsgType::RequestFlush;
+    msg->msg_id = id;
+    msg->src = rank_;
+    msg->dst = s;
+    SendTo(actor::kWorker, std::move(msg));
+  }
+  bool ok = waiter.WaitFor(configure::GetInt("rpc_timeout_ms"));
+  std::lock_guard<std::mutex> lk(flush_mu_);
+  flush_pending_.erase(id);
+  if (!ok)
+    Log::Error("Zoo::FlushPipelines: timed out (rank %d)", rank_);
+  return ok;
+}
+
+void Zoo::OnFlushReply(int64_t msg_id) {
+  std::lock_guard<std::mutex> lk(flush_mu_);
+  auto it = flush_pending_.find(msg_id);
+  if (it != flush_pending_.end()) it->second->Notify();
+}
+
 bool Zoo::Barrier() {
   Monitor mon("Zoo::Barrier");
+  // First drain this rank's async pipeline INTO EVERY REMOTE SHARD:
+  // barrier-arrive rides the connection to rank 0 only, so without this
+  // an async add to a third rank could still be in flight when the
+  // release lands (observed at n=4).
+  bool flushed = FlushPipelines();
   Waiter waiter(1);
   {
     std::lock_guard<std::mutex> lk(barrier_mu_);
     barrier_waiter_ = &waiter;
-    barrier_failed_ = false;
+    barrier_failed_ = !flushed;
   }
   auto msg = std::make_unique<Message>();
   msg->type = MsgType::ControlBarrier;
@@ -239,6 +358,17 @@ void Zoo::OnBarrierRelease() {
   if (barrier_waiter_) barrier_waiter_->Notify();
 }
 
+void Zoo::SetRoles(const std::vector<int>& roles) {
+  worker_ranks_.clear();
+  server_ranks_.clear();
+  for (size_t r = 0; r < roles.size(); ++r) {
+    if (roles[r] & kRoleWorker) worker_ranks_.push_back(static_cast<int>(r));
+    if (roles[r] & kRoleServer) server_ranks_.push_back(static_cast<int>(r));
+  }
+  if (server_ranks_.empty())
+    Log::Error("no server-role rank registered — tables have no shards");
+}
+
 void Zoo::SendTo(const std::string& actor_name, MessagePtr msg) {
   // Snapshot the pointer AND push under mu_ so a concurrent Stop cannot
   // free the actor between the lookup and the mailbox push.
@@ -274,6 +404,16 @@ void Zoo::Deliver(const std::string& actor_name, MessagePtr msg) {
       SendTo(actor::kWorker, std::move(err));
       break;
     }
+    case MsgType::RequestFlush: {
+      // Dead shard: nothing to drain there — ack so Barrier proceeds,
+      // but latch the failure so it reports false.
+      {
+        std::lock_guard<std::mutex> lk(barrier_mu_);
+        barrier_failed_ = true;
+      }
+      OnFlushReply(msg->msg_id);
+      break;
+    }
     case MsgType::ControlBarrier: {
       // Rank 0 unreachable: latch the failure, then release the local
       // waiter so Barrier() returns FALSE immediately instead of either
@@ -298,10 +438,12 @@ void Zoo::RouteInbound(Message&& m) {
   switch (msg->type) {
     case MsgType::RequestGet:
     case MsgType::RequestAdd:
+    case MsgType::RequestFlush:
       SendTo(actor::kServer, std::move(msg));
       break;
     case MsgType::ReplyGet:
     case MsgType::ReplyAdd:
+    case MsgType::ReplyFlush:
       SendTo(actor::kWorker, std::move(msg));
       break;
     case MsgType::ControlBarrier:
@@ -317,20 +459,28 @@ void Zoo::RouteInbound(Message&& m) {
 int32_t Zoo::RegisterArrayTable(int64_t size) {
   std::lock_guard<std::mutex> lk(tables_mu_);
   int32_t id = static_cast<int32_t>(server_tables_.size());
+  // Shards live on server-role ranks only; a worker-only rank registers
+  // a null server slot (ids must line up across every rank).
+  int sid = server_id();
   server_tables_.push_back(
-      std::make_unique<ArrayServerTable>(size, updater_type_, rank_, size_));
+      sid < 0 ? nullptr
+              : std::make_unique<ArrayServerTable>(size, updater_type_,
+                                                   sid, num_servers()));
   worker_tables_.push_back(
-      std::make_unique<ArrayWorkerTable>(id, size, size_));
+      std::make_unique<ArrayWorkerTable>(id, size, num_servers()));
   return id;
 }
 
 int32_t Zoo::RegisterMatrixTable(int64_t rows, int64_t cols) {
   std::lock_guard<std::mutex> lk(tables_mu_);
   int32_t id = static_cast<int32_t>(server_tables_.size());
-  server_tables_.push_back(std::make_unique<MatrixServerTable>(
-      rows, cols, updater_type_, rank_, size_));
+  int sid = server_id();
+  server_tables_.push_back(
+      sid < 0 ? nullptr
+              : std::make_unique<MatrixServerTable>(
+                    rows, cols, updater_type_, sid, num_servers()));
   worker_tables_.push_back(
-      std::make_unique<MatrixWorkerTable>(id, rows, cols, size_));
+      std::make_unique<MatrixWorkerTable>(id, rows, cols, num_servers()));
   return id;
 }
 

@@ -226,12 +226,16 @@ static int NetChild(const char* machine_file, const char* rank) {
   CHECK(MV_Barrier() == 0);
   CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
   for (float v : out) CHECK(v == total);
+  // Rendezvous between rounds: without it, a slow rank's verify-Get
+  // races the fast ranks' next-round async adds (observed at n=4).
+  CHECK(MV_Barrier() == 0);
 
   // Async add flushes through the pipeline before the barrier completes.
   CHECK(MV_AddAsyncArrayTable(h, delta.data(), 10) == 0);
   CHECK(MV_Barrier() == 0);
   CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
   for (float v : out) CHECK(v == 2 * total);
+  CHECK(MV_Barrier() == 0);  // same read-vs-next-round fence as above
 
   // Matrix rows: rank r touches rows {r, 4+r}, so row blocks from every
   // shard see both local and remote writes.
@@ -358,15 +362,83 @@ static int DeadServerChild(const char* machine_file, const char* rank) {
   return 0;
 }
 
+static int RegisterChild(const char* ctrl, const char* port,
+                         const char* role, const char* num,
+                         const char* is_ctrl) {
+  // Dynamic registration scenario (reference Control_Register): three
+  // processes — controller (role all), a worker-only node, a
+  // server-only node — find each other through the controller alone (no
+  // machine file, no -rank).  Tables shard across the TWO server-role
+  // ranks; only the TWO worker-role ranks push/pull.
+  std::string a_ctrl = std::string("-controller_endpoint=") + ctrl;
+  std::string a_port = std::string("-port=") + port;
+  std::string a_role = std::string("-role=") + role;
+  std::string a_num = std::string("-num_nodes=") + num;
+  std::string a_isc = std::string("-is_controller=") + is_ctrl;
+  const char* argv2[] = {a_ctrl.c_str(), a_port.c_str(), a_role.c_str(),
+                         a_num.c_str(),  a_isc.c_str(),
+                         "-updater_type=default", "-log_level=error",
+                         "-rpc_timeout_ms=60000",
+                         "-barrier_timeout_ms=60000"};
+  CHECK(MV_Init(9, argv2) == 0);
+  int wid = MV_WorkerId(), sid = MV_ServerId();
+  if (std::string(role) == "worker") CHECK(sid == -1 && wid >= 0);
+  if (std::string(role) == "server") CHECK(wid == -1 && sid >= 0);
+  if (std::string(role) == "all") CHECK(wid == 0 && sid == 0);
+  CHECK(MV_NumWorkers() == 2);
+
+  int32_t h;
+  CHECK(MV_NewArrayTable(12, &h) == 0);
+  int32_t hm;
+  CHECK(MV_NewMatrixTable(6, 2, &hm) == 0);
+  CHECK(MV_Barrier() == 0);
+
+  if (wid >= 0) {
+    std::vector<float> d(12, (float)(wid + 1));
+    CHECK(MV_AddArrayTable(h, d.data(), 12) == 0);
+    int32_t row = wid;
+    std::vector<float> rd(2, (float)(wid + 1));
+    CHECK(MV_AddMatrixTableByRows(hm, rd.data(), &row, 1, 2) == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  if (wid >= 0) {
+    std::vector<float> out(12, -1.0f);
+    CHECK(MV_GetArrayTable(h, out.data(), 12) == 0);
+    for (float v : out) CHECK(v == 3.0f);   // worker ids 0,1 → 1+2
+    int32_t qrows[2] = {0, 1};
+    std::vector<float> rout(4, -1.0f);
+    CHECK(MV_GetMatrixTableByRows(hm, rout.data(), qrows, 2, 2) == 0);
+    CHECK(rout[0] == 1.0f && rout[1] == 1.0f);
+    CHECK(rout[2] == 2.0f && rout[3] == 2.0f);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("REGISTER_OK %s\n", role);
+  return 0;
+}
+
+// Scenario children: a CHECK failure returns without MV_ShutDown, and
+// live runtime threads then crash during normal process exit (rc=-11),
+// masking the CHECK diagnostic — _exit skips teardown and keeps rc=1.
+static int ScenarioExit(int rc) {
+  fflush(stdout);
+  fflush(stderr);
+  if (rc) _exit(rc);
+  return 0;
+}
+
 int main(int argc, char** argv) {
   if (argc == 4 && std::string(argv[1]) == "net_child")
-    return NetChild(argv[2], argv[3]);
+    return ScenarioExit(NetChild(argv[2], argv[3]));
   if (argc == 5 && std::string(argv[1]) == "net_updater")
-    return NetUpdaterChild(argv[2], argv[3], argv[4]);
+    return ScenarioExit(NetUpdaterChild(argv[2], argv[3], argv[4]));
+  if (argc == 7 && std::string(argv[1]) == "register")
+    return ScenarioExit(
+        RegisterChild(argv[2], argv[3], argv[4], argv[5], argv[6]));
   if (argc == 4 && std::string(argv[1]) == "dead_peer")
-    return DeadPeerChild(argv[2], argv[3]);
+    return ScenarioExit(DeadPeerChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "dead_server")
-    return DeadServerChild(argv[2], argv[3]);
+    return ScenarioExit(DeadServerChild(argv[2], argv[3]));
   struct Case {
     const char* name;
     int (*fn)();

@@ -130,27 +130,14 @@ class ArrayTable(Table):
 
     # ------------------------------------------------------------ checkpoint
     def store_state(self) -> Any:
-        # Snapshot only the LIVE region: the padding is a mesh-size
-        # artifact, and baking it in would pin the checkpoint to the
-        # process/device count that wrote it.
-        data, state = self._locked_read(
-            lambda d, s: (host_fetch(d), [host_fetch(x) for x in s]))
+        data, state = self._dense_snapshot(self.size)
         return {
             "kind": self.kind,
             "size": self.size,
-            "data": data[: self.size],
-            "state": [s[: self.size] for s in state],
+            "data": data,
+            "state": state,
         }
-
-    def _pad(self, host: np.ndarray) -> np.ndarray:
-        out = np.zeros(self._padded, dtype=self.dtype)
-        out[: self.size] = host[: self.size]
-        return out
 
     def load_state(self, snap: Any) -> None:
         assert snap["kind"] == self.kind and snap["size"] == self.size
-        self._data = host_put(self._pad(snap["data"].astype(self.dtype)),
-                              self._sharding)
-        self._state = tuple(
-            host_put(self._pad(s.astype(self.dtype)), self._sharding)
-            for s in snap["state"])
+        self._dense_restore(snap["data"], snap["state"], self.size)

@@ -256,6 +256,30 @@ class Table:
             jax.block_until_ready(self._data)
         return True
 
+    def _dense_snapshot(self, live: int):
+        """Checkpoint the LIVE region of ``_data``/``_state``: padding is
+        a mesh-size artifact, and baking it in would pin the snapshot to
+        the process/device count that wrote it."""
+        return self._locked_read(
+            lambda d, s: (host_fetch(d)[:live],
+                          [host_fetch(x)[:live] for x in s]))
+
+    def _dense_restore(self, data, state, live: int) -> None:
+        """Re-pad a live-region snapshot for THIS mesh and place it."""
+        import numpy as np
+
+        padded_shape = tuple(self._data.shape)
+
+        def pad(h):
+            out = np.zeros(padded_shape, dtype=self.dtype)
+            out[:live] = np.asarray(h, dtype=self.dtype)[:live]
+            return out
+
+        with self._lock:
+            self._data = host_put(pad(data), self._sharding)
+            self._state = tuple(host_put(pad(s), self._sharding)
+                                for s in state)
+
     def _locked_read(self, reader):
         """Run ``reader(data, state)`` under the table lock.
 

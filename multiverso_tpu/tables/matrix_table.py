@@ -257,27 +257,15 @@ class MatrixTable(Table):
 
     # ------------------------------------------------------------ checkpoint
     def store_state(self) -> Any:
-        # Live rows only — row padding is a mesh-size artifact and would
-        # pin the checkpoint to the writing process/device count.
-        data, state = self._locked_read(
-            lambda d, s: (host_fetch(d), [host_fetch(x) for x in s]))
+        data, state = self._dense_snapshot(self.num_rows)
         return {
             "kind": self.kind,
             "shape": (self.num_rows, self.num_cols),
-            "data": data[: self.num_rows],
-            "state": [s[: self.num_rows] for s in state],
+            "data": data,
+            "state": state,
         }
-
-    def _pad(self, host: np.ndarray) -> np.ndarray:
-        out = np.zeros((self._padded_rows, self.num_cols), dtype=self.dtype)
-        out[: self.num_rows] = host[: self.num_rows]
-        return out
 
     def load_state(self, snap: Any) -> None:
         assert snap["kind"] == self.kind
         assert tuple(snap["shape"]) == (self.num_rows, self.num_cols)
-        self._data = host_put(self._pad(snap["data"].astype(self.dtype)),
-                              self._sharding)
-        self._state = tuple(
-            host_put(self._pad(s.astype(self.dtype)), self._sharding)
-            for s in snap["state"])
+        self._dense_restore(snap["data"], snap["state"], self.num_rows)

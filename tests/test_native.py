@@ -223,3 +223,41 @@ def test_native_dead_server_fails_fast(native, tmp_path):
     assert procs[0].returncode == 0, outs[0][-3000:]
     assert "DEAD_SERVER_OK" in outs[0]
     assert procs[1].returncode == 0, outs[1][-3000:]  # _exit(0) crash sim
+
+
+def test_native_dynamic_registration(native, tmp_path):
+    """Control_Register parity (SURVEY.md §2.7): no machine file, no
+    -rank — two nodes register with the controller, which assigns ranks
+    and broadcasts the node table with per-node ROLE bitmasks.  The
+    worker-only and server-only processes prove tables shard across
+    server-role ranks while only worker-role ranks push/pull."""
+    import socket
+
+    b = _binary()
+    ports = []
+    socks = []
+    for _ in range(3):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    ctrl = f"127.0.0.1:{ports[0]}"
+    spec = [(ports[0], "all", "true"), (ports[1], "worker", "false"),
+            (ports[2], "server", "false")]
+    procs = [subprocess.Popen(
+        [b, "register", ctrl, str(port), role, "3", is_ctrl],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for port, role, is_ctrl in spec]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for (port, role, _), p, out in zip(spec, procs, outs):
+        assert p.returncode == 0, f"{role}:\n{out[-3000:]}"
+        assert f"REGISTER_OK {role}" in out, out[-2000:]
