@@ -1,0 +1,204 @@
+--- multiverso_tpu Lua binding (LuaJIT FFI over the C API).
+--
+-- Capability parity with the reference's binding/lua/ Lua module
+-- (SURVEY.md §2.33): init/shutdown/barrier, ids, and Array/Matrix table
+-- handlers, loaded straight over libmvtpu.so's flat C surface
+-- (native/include/mvtpu/c_api.h).  Usage:
+--
+--   package.path  = package.path .. ";<repo>/multiverso_tpu/binding/lua/?.lua"
+--   local mv = require("multiverso")
+--   mv.init({"-updater_type=sgd"})
+--   local t = mv.ArrayTableHandler:new(100)
+--   t:add(delta)                  -- delta: Lua array or FFI float[]
+--   local w = t:get()             -- FFI float[size]
+--   mv.barrier()
+--   mv.shutdown()
+--
+-- Error convention: C rc < 0 raises a Lua error naming the call and rc
+-- (rc=-3 means an unreachable peer / expired deadline — see c_api.h).
+
+local ffi = require("ffi")
+
+ffi.cdef[[
+int MV_Init(int argc, const char* const* argv);
+int MV_ShutDown();
+int MV_Barrier();
+int MV_NumWorkers();
+int MV_WorkerId();
+int MV_ServerId();
+int MV_SetFlag(const char* name, const char* value);
+int MV_NewArrayTable(int64_t size, int32_t* handle);
+int MV_GetArrayTable(int32_t handle, float* data, int64_t size);
+int MV_AddArrayTable(int32_t handle, const float* delta, int64_t size);
+int MV_AddAsyncArrayTable(int32_t handle, const float* delta, int64_t size);
+int MV_NewMatrixTable(int64_t rows, int64_t cols, int32_t* handle);
+int MV_GetMatrixTableAll(int32_t handle, float* data, int64_t size);
+int MV_AddMatrixTableAll(int32_t handle, const float* delta, int64_t size);
+int MV_AddAsyncMatrixTableAll(int32_t handle, const float* delta, int64_t size);
+int MV_GetMatrixTableByRows(int32_t handle, float* data, const int32_t* row_ids,
+                            int64_t num_rows, int64_t cols);
+int MV_AddMatrixTableByRows(int32_t handle, const float* delta,
+                            const int32_t* row_ids, int64_t num_rows,
+                            int64_t cols);
+int MV_AddAsyncMatrixTableByRows(int32_t handle, const float* delta,
+                                 const int32_t* row_ids, int64_t num_rows,
+                                 int64_t cols);
+int MV_SetAddOption(float learning_rate, float momentum, float rho, float eps);
+int MV_StoreTable(int32_t handle, const char* path);
+int MV_LoadTable(int32_t handle, const char* path);
+]]
+
+-- libmvtpu.so sits two directories up from this file (native/build/).
+local function lib_path()
+  local src = debug.getinfo(1, "S").source:sub(2)
+  local here = src:match("(.*)/") or "."
+  return here .. "/../../native/build/libmvtpu.so"
+end
+
+local C = ffi.load(os.getenv("MVTPU_NATIVE_LIB") or lib_path())
+
+local mv = {}
+
+local function check(rc, what)
+  if rc < 0 then
+    error(string.format("%s failed with rc=%d", what, rc))
+  end
+  return rc
+end
+
+--- Convert a Lua array (or pass through an FFI array) to float[n].
+local function to_floats(data, n)
+  if type(data) == "cdata" then return data end
+  local buf = ffi.new("float[?]", n)
+  for i = 1, n do buf[i - 1] = data[i] end
+  return buf
+end
+
+local function to_ints(data, n)
+  if type(data) == "cdata" then return data end
+  local buf = ffi.new("int32_t[?]", n)
+  for i = 1, n do buf[i - 1] = data[i] end
+  return buf
+end
+
+--- init(args): args is an optional Lua array of "-flag=value" strings.
+function mv.init(args)
+  args = args or {}
+  local argv = ffi.new("const char*[?]", #args)
+  for i = 1, #args do argv[i - 1] = args[i] end
+  check(C.MV_Init(#args, argv), "MV_Init")
+end
+
+function mv.shutdown() check(C.MV_ShutDown(), "MV_ShutDown") end
+function mv.barrier() check(C.MV_Barrier(), "MV_Barrier") end
+function mv.num_workers() return C.MV_NumWorkers() end
+function mv.worker_id() return C.MV_WorkerId() end
+function mv.server_id() return C.MV_ServerId() end
+
+function mv.set_flag(name, value)
+  check(C.MV_SetFlag(name, tostring(value)), "MV_SetFlag")
+end
+
+function mv.set_add_option(lr, momentum, rho, eps)
+  check(C.MV_SetAddOption(lr or 0.1, momentum or 0.9, rho or 0.9,
+                          eps or 1e-8), "MV_SetAddOption")
+end
+
+-- ---------------------------------------------------------------- Array
+
+mv.ArrayTableHandler = {}
+mv.ArrayTableHandler.__index = mv.ArrayTableHandler
+
+function mv.ArrayTableHandler:new(size)
+  local h = ffi.new("int32_t[1]")
+  check(C.MV_NewArrayTable(size, h), "MV_NewArrayTable")
+  return setmetatable({ handle = h[0], size = size }, self)
+end
+
+function mv.ArrayTableHandler:get()
+  local buf = ffi.new("float[?]", self.size)
+  check(C.MV_GetArrayTable(self.handle, buf, self.size), "MV_GetArrayTable")
+  return buf
+end
+
+function mv.ArrayTableHandler:add(delta, opts)
+  local buf = to_floats(delta, self.size)
+  if opts and opts.async then
+    check(C.MV_AddAsyncArrayTable(self.handle, buf, self.size),
+          "MV_AddAsyncArrayTable")
+  else
+    check(C.MV_AddArrayTable(self.handle, buf, self.size),
+          "MV_AddArrayTable")
+  end
+end
+
+function mv.ArrayTableHandler:store(path)
+  check(C.MV_StoreTable(self.handle, path), "MV_StoreTable")
+end
+
+function mv.ArrayTableHandler:load(path)
+  check(C.MV_LoadTable(self.handle, path), "MV_LoadTable")
+end
+
+-- --------------------------------------------------------------- Matrix
+
+mv.MatrixTableHandler = {}
+mv.MatrixTableHandler.__index = mv.MatrixTableHandler
+
+function mv.MatrixTableHandler:new(rows, cols)
+  local h = ffi.new("int32_t[1]")
+  check(C.MV_NewMatrixTable(rows, cols, h), "MV_NewMatrixTable")
+  return setmetatable({ handle = h[0], rows = rows, cols = cols }, self)
+end
+
+function mv.MatrixTableHandler:get()
+  local n = self.rows * self.cols
+  local buf = ffi.new("float[?]", n)
+  check(C.MV_GetMatrixTableAll(self.handle, buf, n), "MV_GetMatrixTableAll")
+  return buf
+end
+
+function mv.MatrixTableHandler:add(delta, opts)
+  local n = self.rows * self.cols
+  local buf = to_floats(delta, n)
+  if opts and opts.async then
+    check(C.MV_AddAsyncMatrixTableAll(self.handle, buf, n),
+          "MV_AddAsyncMatrixTableAll")
+  else
+    check(C.MV_AddMatrixTableAll(self.handle, buf, n),
+          "MV_AddMatrixTableAll")
+  end
+end
+
+--- #x raises on cdata, so FFI-array callers must pass the count.
+local function row_count(row_ids, k)
+  if k then return k end
+  assert(type(row_ids) ~= "cdata",
+         "pass the row count when row_ids is an FFI array")
+  return #row_ids
+end
+
+function mv.MatrixTableHandler:get_rows(row_ids, k)
+  k = row_count(row_ids, k)
+  local ids = to_ints(row_ids, k)
+  local buf = ffi.new("float[?]", k * self.cols)
+  check(C.MV_GetMatrixTableByRows(self.handle, buf, ids, k, self.cols),
+        "MV_GetMatrixTableByRows")
+  return buf
+end
+
+function mv.MatrixTableHandler:add_rows(row_ids, delta, opts, k)
+  k = row_count(row_ids, k)
+  local ids = to_ints(row_ids, k)
+  local buf = to_floats(delta, k * self.cols)
+  if opts and opts.async then
+    check(C.MV_AddAsyncMatrixTableByRows(self.handle, buf, ids, k,
+                                         self.cols),
+          "MV_AddAsyncMatrixTableByRows")
+  else
+    check(C.MV_AddMatrixTableByRows(self.handle, buf, ids, k, self.cols),
+          "MV_AddMatrixTableByRows")
+  end
+end
+
+return mv

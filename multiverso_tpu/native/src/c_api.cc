@@ -143,9 +143,15 @@ int MV_SetAddOption(float learning_rate, float momentum, float rho,
 
 int MV_StoreTable(int32_t handle, const char* path) {
   if (RequireStarted()) return -1;
+  // Validity via the worker stub (exists on every rank for every id);
+  // the server shard may legitimately be null on worker-only ranks.
+  if (!Zoo::Get()->worker_table(handle)) return -2;
+  // The barrier (flushing pending adds) is collective over EVERY rank —
+  // it must run before the no-shard early-out, or a worker-only rank
+  // returning -2 here would strand the server ranks inside it.
+  if (!Zoo::Get()->Barrier()) return -3;
   auto* t = Zoo::Get()->server_table(handle);
-  if (!t) return -2;
-  Zoo::Get()->Barrier();  // flush pending adds first
+  if (!t) return 0;  // worker-only rank: joined the collective, no shard
   auto s = mvtpu::StreamFactory::Open(path, "wb");
   if (!s) return -3;
   return t->Store(s.get()) ? 0 : -4;
@@ -153,9 +159,10 @@ int MV_StoreTable(int32_t handle, const char* path) {
 
 int MV_LoadTable(int32_t handle, const char* path) {
   if (RequireStarted()) return -1;
+  if (!Zoo::Get()->worker_table(handle)) return -2;
+  if (!Zoo::Get()->Barrier()) return -3;
   auto* t = Zoo::Get()->server_table(handle);
-  if (!t) return -2;
-  Zoo::Get()->Barrier();
+  if (!t) return 0;  // worker-only rank: joined the collective, no shard
   auto s = mvtpu::StreamFactory::Open(path, "rb");
   if (!s) return -3;
   return t->Load(s.get()) ? 0 : -4;

@@ -72,6 +72,12 @@ std::vector<std::string> TcpNet::ParseMachineFile(const std::string& path) {
   return eps;
 }
 
+namespace {
+// One frame-size cap for the transport AND the registration handshake —
+// two diverging caps would make a message traverse one but not the other.
+constexpr int64_t kMaxFrameBytes = int64_t{1} << 40;
+}  // namespace
+
 bool TcpNet::SendFramed(int fd, const Message& msg) {
   Blob wire = msg.Serialize();
   int64_t len = static_cast<int64_t>(wire.size());
@@ -81,7 +87,7 @@ bool TcpNet::SendFramed(int fd, const Message& msg) {
 
 bool TcpNet::RecvFramed(int fd, Message* msg) {
   int64_t len = 0;
-  if (!ReadAll(fd, &len, sizeof(len)) || len <= 0 || len > (int64_t{1} << 30))
+  if (!ReadAll(fd, &len, sizeof(len)) || len <= 0 || len > kMaxFrameBytes)
     return false;
   Blob buf(static_cast<size_t>(len));
   if (!ReadAll(fd, buf.data(), buf.size())) return false;
@@ -319,18 +325,12 @@ void TcpNet::AcceptLoop() {
 
 void TcpNet::ReadLoop(int fd) {
   while (true) {
-    int64_t len = 0;
-    if (!ReadAll(fd, &len, sizeof(len)) || len <= 0 ||
-        len > (int64_t{1} << 40)) {
+    Message m;
+    if (!RecvFramed(fd, &m)) {
       ::close(fd);
       return;
     }
-    Blob buf(static_cast<size_t>(len));
-    if (!ReadAll(fd, buf.data(), buf.size())) {
-      ::close(fd);
-      return;
-    }
-    if (inbound_) inbound_(Message::Deserialize(buf));
+    if (inbound_) inbound_(std::move(m));
   }
 }
 
@@ -373,8 +373,6 @@ int TcpNet::ConnectTo(int dst_rank) {
 bool TcpNet::Send(int dst_rank, const Message& msg) {
   if (dst_rank < 0 || dst_rank >= static_cast<int>(endpoints_.size()))
     return false;
-  Blob wire = msg.Serialize();
-  int64_t len = static_cast<int64_t>(wire.size());
   // Connect OUTSIDE the per-destination send mutex: the retry loop can
   // take seconds, and holding the mutex through it would stall Stop()
   // (which closes fds under the same mutex) and serialize every sender
@@ -400,8 +398,7 @@ bool TcpNet::Send(int dst_rank, const Message& msg) {
                endpoints_[dst_rank].c_str());
     return false;
   }
-  if (!WriteAll(fd, &len, sizeof(len)) ||
-      !WriteAll(fd, wire.data(), wire.size())) {
+  if (!SendFramed(fd, msg)) {
     ::close(fd);
     send_fds_[dst_rank] = -1;
     Log::Error("TcpNet: send to rank %d failed", dst_rank);

@@ -295,6 +295,10 @@ void Zoo::OnFlushReply(int64_t msg_id) {
 
 bool Zoo::Barrier() {
   Monitor mon("Zoo::Barrier");
+  {
+    std::lock_guard<std::mutex> lk(barrier_mu_);
+    barrier_failed_ = false;  // fresh round; flush may re-latch it
+  }
   // First drain this rank's async pipeline INTO EVERY REMOTE SHARD:
   // barrier-arrive rides the connection to rank 0 only, so without this
   // an async add to a third rank could still be in flight when the
@@ -304,7 +308,9 @@ bool Zoo::Barrier() {
   {
     std::lock_guard<std::mutex> lk(barrier_mu_);
     barrier_waiter_ = &waiter;
-    barrier_failed_ = !flushed;
+    // OR, don't assign: a dead shard latched barrier_failed_ during the
+    // flush (Deliver's RequestFlush case) and that must survive.
+    barrier_failed_ = barrier_failed_ || !flushed;
   }
   auto msg = std::make_unique<Message>();
   msg->type = MsgType::ControlBarrier;

@@ -1,0 +1,90 @@
+"""Lua FFI binding surface (reference ``binding/lua/``, SURVEY.md §2.33).
+
+No Lua runtime ships in this image, so the always-on test is a
+sync-contract check: every C function the Lua module cdefs must exist in
+``c_api.h`` with the identical declaration, and every binding-facing
+``MV_*`` declaration must be cdef'd — the drift that would break the
+module at ``ffi.load`` time.  When a ``luajit`` binary IS available the
+smoke test runs the module for real.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LUA = os.path.join(_ROOT, "multiverso_tpu", "binding", "lua",
+                    "multiverso.lua")
+_HDR = os.path.join(_ROOT, "multiverso_tpu", "native", "include", "mvtpu",
+                    "c_api.h")
+
+
+def _normalize(decl: str) -> str:
+    return re.sub(r"\s+", " ", decl).strip()
+
+
+def _decls(text: str):
+    """{name: normalized declaration} for every ``int MV_*(...);``."""
+    out = {}
+    for m in re.finditer(r"int\s+(MV_\w+)\s*\(([^;]*?)\)\s*;", text,
+                         re.DOTALL):
+        out[m.group(1)] = _normalize(f"int {m.group(1)}({m.group(2)})")
+    return out
+
+
+def test_lua_cdef_matches_c_api_header():
+    lua = open(_LUA).read()
+    cdef = re.search(r"ffi\.cdef\[\[(.*?)\]\]", lua, re.DOTALL)
+    assert cdef, "no ffi.cdef block in multiverso.lua"
+    lua_decls = _decls(cdef.group(1))
+    hdr_decls = _decls(open(_HDR).read())
+
+    assert lua_decls, "cdef block parsed to zero declarations"
+    missing = set(hdr_decls) - set(lua_decls)
+    assert not missing, f"c_api.h functions absent from the Lua cdef: " \
+                        f"{sorted(missing)}"
+    for name, decl in lua_decls.items():
+        assert name in hdr_decls, f"cdef declares unknown function {name}"
+        assert decl == hdr_decls[name], (
+            f"{name} signature drift:\n  lua: {decl}\n  hdr: "
+            f"{hdr_decls[name]}")
+
+
+def test_lua_module_wraps_every_cdef_function():
+    """Each cdef'd C function is actually used by the wrapper (no dead
+    surface), and the module exposes the reference handler API."""
+    lua = open(_LUA).read()
+    cdef = re.search(r"ffi\.cdef\[\[(.*?)\]\]", lua, re.DOTALL).group(1)
+    body = lua.replace(cdef, "")
+    for name in _decls(cdef):
+        assert f"C.{name}" in body, f"{name} cdef'd but never called"
+    for api in ("mv.init", "mv.shutdown", "mv.barrier",
+                "mv.ArrayTableHandler", "mv.MatrixTableHandler"):
+        assert api in body, f"missing reference API surface: {api}"
+
+
+@pytest.mark.skipif(shutil.which("luajit") is None, reason="no luajit")
+def test_lua_smoke(tmp_path):
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    script = tmp_path / "smoke.lua"
+    script.write_text("""
+package.path = package.path .. ";%s/?.lua"
+local mv = require("multiverso")
+mv.init({"-updater_type=default", "-log_level=error"})
+local t = mv.ArrayTableHandler:new(8)
+t:add({1, 1, 1, 1, 1, 1, 1, 1})
+local w = t:get()
+assert(math.abs(w[0] - 1.0) < 1e-6)
+mv.barrier()
+mv.shutdown()
+print("LUA_SMOKE_OK")
+""" % os.path.dirname(_LUA))
+    out = subprocess.run(["luajit", str(script)], capture_output=True,
+                         text=True, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "LUA_SMOKE_OK" in out.stdout
