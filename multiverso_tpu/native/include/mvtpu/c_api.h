@@ -5,7 +5,8 @@
 // All functions return 0 on success, negative on error, unless noted:
 // -1 bad args / not started, -2 unknown handle, -3 unreachable peer or
 // `-rpc_timeout_ms`/`-barrier_timeout_ms` deadline expired (fail-fast
-// instead of hanging on a dead rank).
+// instead of hanging on a dead rank), -4 shard (de)serialization
+// failed, -5 local stream open failed (an IO problem, NOT peer death).
 #pragma once
 
 #include <stdint.h>

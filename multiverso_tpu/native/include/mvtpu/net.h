@@ -29,9 +29,14 @@ class TcpNet {
   static std::vector<std::string> ParseMachineFile(const std::string& path);
 
   // One length-prefixed serialized Message over a raw fd (used by the
-  // dynamic-registration handshake, which runs before the transport).
+  // dynamic-registration handshake, which runs before the transport,
+  // and by the transport's own ReadLoop/Send).  `max_bytes <= 0` means
+  // the transport-wide frame cap; the handshake passes a tight bound so
+  // a hostile/garbled registration connection cannot force a huge
+  // allocation on the controller.
   static bool SendFramed(int fd, const Message& msg);
-  static bool RecvFramed(int fd, Message* msg);
+  static bool SendFramed(int fd, const Blob& wire);   // pre-serialized
+  static bool RecvFramed(int fd, Message* msg, int64_t max_bytes = 0);
 
   // Dynamic registration (reference src/controller.cpp Control_Register,
   // SURVEY.md §2.7/§3.1): the controller listens on `ctrl_endpoint`,

@@ -146,26 +146,38 @@ int MV_StoreTable(int32_t handle, const char* path) {
   // Validity via the worker stub (exists on every rank for every id);
   // the server shard may legitimately be null on worker-only ranks.
   if (!Zoo::Get()->worker_table(handle)) return -2;
-  // The barrier (flushing pending adds) is collective over EVERY rank —
-  // it must run before the no-shard early-out, or a worker-only rank
-  // returning -2 here would strand the server ranks inside it.
+  // Collective on EVERY rank: the leading barrier flushes pending adds
+  // (and must run before the no-shard early-out, or a worker-only rank
+  // returning early would strand the server ranks inside it); the
+  // trailing barrier fences the snapshot — no rank's post-store adds
+  // can land before every shard finished writing.
   if (!Zoo::Get()->Barrier()) return -3;
+  int rc = 0;
   auto* t = Zoo::Get()->server_table(handle);
-  if (!t) return 0;  // worker-only rank: joined the collective, no shard
-  auto s = mvtpu::StreamFactory::Open(path, "wb");
-  if (!s) return -3;
-  return t->Store(s.get()) ? 0 : -4;
+  if (t) {  // worker-only rank: joined the collective, no shard
+    auto s = mvtpu::StreamFactory::Open(path, "wb");
+    if (!s) rc = -5;                          // local IO, not peer death
+    else if (!t->Store(s.get())) rc = -4;
+  }
+  if (!Zoo::Get()->Barrier()) return rc ? rc : -3;
+  return rc;
 }
 
 int MV_LoadTable(int32_t handle, const char* path) {
   if (RequireStarted()) return -1;
   if (!Zoo::Get()->worker_table(handle)) return -2;
   if (!Zoo::Get()->Barrier()) return -3;
+  int rc = 0;
   auto* t = Zoo::Get()->server_table(handle);
-  if (!t) return 0;  // worker-only rank: joined the collective, no shard
-  auto s = mvtpu::StreamFactory::Open(path, "rb");
-  if (!s) return -3;
-  return t->Load(s.get()) ? 0 : -4;
+  if (t) {  // worker-only rank: joined the collective, no shard
+    auto s = mvtpu::StreamFactory::Open(path, "rb");
+    if (!s) rc = -5;                          // local IO, not peer death
+    else if (!t->Load(s.get())) rc = -4;
+  }
+  // Trailing fence: no rank reads/writes restored state before every
+  // shard finished loading.
+  if (!Zoo::Get()->Barrier()) return rc ? rc : -3;
+  return rc;
 }
 
 char* MV_DashboardReport() {

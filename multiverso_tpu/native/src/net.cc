@@ -73,21 +73,27 @@ std::vector<std::string> TcpNet::ParseMachineFile(const std::string& path) {
 }
 
 namespace {
-// One frame-size cap for the transport AND the registration handshake —
-// two diverging caps would make a message traverse one but not the other.
+// Transport-wide frame cap (table shard payloads).  The registration
+// handshake passes RecvFramed a much tighter bound — its frames are
+// tiny, and a garbled/hostile connection must not be able to force a
+// huge allocation on the controller.
 constexpr int64_t kMaxFrameBytes = int64_t{1} << 40;
 }  // namespace
 
 bool TcpNet::SendFramed(int fd, const Message& msg) {
-  Blob wire = msg.Serialize();
+  return SendFramed(fd, msg.Serialize());
+}
+
+bool TcpNet::SendFramed(int fd, const Blob& wire) {
   int64_t len = static_cast<int64_t>(wire.size());
   return WriteAll(fd, &len, sizeof(len)) &&
          WriteAll(fd, wire.data(), wire.size());
 }
 
-bool TcpNet::RecvFramed(int fd, Message* msg) {
+bool TcpNet::RecvFramed(int fd, Message* msg, int64_t max_bytes) {
+  if (max_bytes <= 0) max_bytes = kMaxFrameBytes;
   int64_t len = 0;
-  if (!ReadAll(fd, &len, sizeof(len)) || len <= 0 || len > kMaxFrameBytes)
+  if (!ReadAll(fd, &len, sizeof(len)) || len <= 0 || len > max_bytes)
     return false;
   Blob buf(static_cast<size_t>(len));
   if (!ReadAll(fd, buf.data(), buf.size())) return false;
@@ -180,7 +186,8 @@ bool TcpNet::RegisterController(const std::string& ctrl_endpoint,
     timeval tv{5, 0};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     Message reg;
-    if (!RecvFramed(fd, &reg) || reg.type != MsgType::ControlRegister ||
+    if (!RecvFramed(fd, &reg, int64_t{1} << 20) ||
+        reg.type != MsgType::ControlRegister ||
         reg.data.size() < 2) {
       ::close(fd);
       continue;
@@ -249,7 +256,8 @@ bool TcpNet::RegisterWithController(const std::string& ctrl_endpoint,
   int32_t role32 = my_role;
   reg.data.emplace_back(&role32, sizeof(role32));
   Message reply;
-  bool ok = SendFramed(fd, reg) && RecvFramed(fd, &reply) &&
+  bool ok = SendFramed(fd, reg) &&
+            RecvFramed(fd, &reply, int64_t{1} << 20) &&
             reply.type == MsgType::ControlReply && reply.data.size() >= 3;
   if (ok) {
     *my_rank = *reply.data[0].As<int32_t>();
@@ -373,6 +381,10 @@ int TcpNet::ConnectTo(int dst_rank) {
 bool TcpNet::Send(int dst_rank, const Message& msg) {
   if (dst_rank < 0 || dst_rank >= static_cast<int>(endpoints_.size()))
     return false;
+  // Serialize BEFORE taking the send mutex — a full-payload copy inside
+  // the critical section would queue every concurrent sender to this
+  // rank behind it.
+  Blob wire = msg.Serialize();
   // Connect OUTSIDE the per-destination send mutex: the retry loop can
   // take seconds, and holding the mutex through it would stall Stop()
   // (which closes fds under the same mutex) and serialize every sender
@@ -398,7 +410,7 @@ bool TcpNet::Send(int dst_rank, const Message& msg) {
                endpoints_[dst_rank].c_str());
     return false;
   }
-  if (!SendFramed(fd, msg)) {
+  if (!SendFramed(fd, wire)) {
     ::close(fd);
     send_fds_[dst_rank] = -1;
     Log::Error("TcpNet: send to rank %d failed", dst_rank);

@@ -411,6 +411,24 @@ static int RegisterChild(const char* ctrl, const char* port,
     CHECK(rout[0] == 1.0f && rout[1] == 1.0f);
     CHECK(rout[2] == 2.0f && rout[3] == 2.0f);
   }
+  // Store/Load are collective (internal barrier): EVERY rank calls them,
+  // the worker-only rank contributes no shard but must not deadlock the
+  // server ranks (each rank stores its own shard file, reference model).
+  std::string ck = std::string("/tmp/mvtpu_register_ck_") + port + ".bin";
+  CHECK(MV_StoreTable(h, ck.c_str()) == 0);
+  if (wid >= 0) {
+    std::vector<float> d(12, 100.0f);
+    CHECK(MV_AddArrayTable(h, d.data(), 12) == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_LoadTable(h, ck.c_str()) == 0);
+  CHECK(MV_Barrier() == 0);
+  if (wid >= 0) {
+    std::vector<float> out(12, -1.0f);
+    CHECK(MV_GetArrayTable(h, out.data(), 12) == 0);
+    for (float v : out) CHECK(v == 3.0f);  // post-store adds rolled back
+  }
+
   CHECK(MV_Barrier() == 0);
   CHECK(MV_ShutDown() == 0);
   printf("REGISTER_OK %s\n", role);
