@@ -244,6 +244,17 @@ def bench_add_get(size: int = 16 * 1024 * 1024):
     sec_half = host_get_sec(t_half)
     out["get_host_gbps"] = _diff_gbps(nbytes / 2, sec_full, sec_half, nbytes)
 
+    # --- 1-bit compressed host tier (32x fewer wire bytes + feedback) --
+    def host_add_1bit_sec(table, d):
+        def once():
+            table.add(d, sync=True, compress="1bit")
+        return _time_loop(once, warmup=1, iters=3)
+
+    sec_full = host_add_1bit_sec(t, host_delta)
+    sec_half = host_add_1bit_sec(t_half, host_delta[:half])
+    out["add_host_1bit_gbps"] = _diff_gbps(nbytes / 2, sec_full, sec_half,
+                                           nbytes)
+
     # --- wire calibration ----------------------------------------------
     probe = jax.device_put(np.zeros(1, np.float32))
 

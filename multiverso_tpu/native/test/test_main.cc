@@ -287,6 +287,20 @@ static int NetUpdaterChild(const char* machine_file, const char* rank,
   } else if (std::string(updater) == "adagrad") {
     // n sequential g=1 applies: w -= lr * g / sqrt(h_i), h_i = i
     for (int i = 1; i <= n; ++i) want -= 0.1f / sqrtf((float)i);
+  } else if (std::string(updater) == "momentum") {
+    // v_i = mu*v_{i-1} + lr;  w -= v_i  (identical g=1 deltas)
+    float v = 0.0f;
+    for (int i = 0; i < n; ++i) {
+      v = 0.9f * v + 0.1f;
+      want -= v;
+    }
+  } else if (std::string(updater) == "smooth_gradient") {
+    // s_i = rho*s_{i-1} + (1-rho);  w -= lr*s_i
+    float sgd_s = 0.0f;
+    for (int i = 0; i < n; ++i) {
+      sgd_s = 0.9f * sgd_s + 0.1f;
+      want -= 0.1f * sgd_s;
+    }
   } else {
     CHECK(false);
   }

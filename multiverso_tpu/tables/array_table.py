@@ -68,18 +68,22 @@ class ArrayTable(Table):
 
     # ------------------------------------------------------------------ Add
     def add(self, delta, option: Optional[AddOption] = None,
-            sync: bool = False) -> None:
+            sync: bool = False, compress: Optional[str] = None) -> None:
         """Push a delta/gradient (reference ``ArrayWorker<T>::Add``; §3.3).
 
         ``delta`` is [size] or [k, size] (stacked per-worker contributions,
         summed before the updater — the server receiving k Adds).  ``sync``
         blocks until the device commit completes (the reference's blocking
-        Add vs AddAsync).
+        Add vs AddAsync).  ``compress="1bit"`` sends sign bits + scales
+        with error feedback (1/32 the wire bytes; lossy per add, SGD-safe
+        — SURVEY.md §5 quantization lineage).
         """
         with self._monitor("Add"):
-            if isinstance(delta, jax.Array) and delta.ndim == 2:
+            if compress is None and isinstance(delta, jax.Array) \
+                    and delta.ndim == 2:
                 delta = delta.sum(axis=0)      # worker stack, on device
-            if self._try_device_add(delta, (self.size,), option, sync):
+            if compress is None and self._try_device_add(
+                    delta, (self.size,), option, sync):
                 return
             delta = np.asarray(delta, dtype=self.dtype)
             if delta.ndim == 2:
@@ -87,6 +91,9 @@ class ArrayTable(Table):
             if delta.shape != (self.size,):
                 raise ValueError(
                     f"delta shape {delta.shape} != ({self.size},)")
+            if compress is not None:
+                self._add_compressed(delta, option, compress, sync)
+                return
             if self.sync:
                 # BSP: buffer until the clock boundary (barrier → flush).
                 with self._lock:

@@ -175,13 +175,17 @@ class Table:
                     f"{other.kind} table); pass a unique name=")
         self._lock = threading.Lock()
         self._dense_cache: dict = {}
+        self._compressor = None  # lazy OneBitCompressor (error feedback)
 
-    def _apply_dense_padded(self, delta, option) -> None:
+    def _apply_dense_padded(self, delta, option, *,
+                            presummed: bool = False) -> None:
         """Shared eager dense-apply: pad to the sharded shape, ship, update.
 
         Used by the Array/Matrix ``add`` paths.  The jitted apply donates
         ``_data``/``_state``, so the swap holds ``_lock`` — a concurrent
         eager add reading a donated (deleted) buffer would crash otherwise.
+        ``presummed`` marks a delta already merged across ranks (the
+        compressed path) — it skips the multi-host sum collective.
         """
         import jax
         import numpy as np
@@ -199,10 +203,107 @@ class Table:
         padded_shape = self._data.shape
         padded = np.zeros(padded_shape, dtype=self.dtype)
         padded[tuple(slice(0, s) for s in delta.shape)] = delta
-        padded = multihost_sum(padded)
+        if not presummed:
+            padded = multihost_sum(padded)
         d = host_put(padded, self._sharding)
         with self._lock:
             self._data, self._state = fn(self._data, self._state, d)
+
+    def _add_compressed(self, delta, option, compress: str,
+                        blocking: bool) -> None:
+        """Shared compress= dispatch for the dense table ``add`` paths:
+        validation (codec name, BSP incompatibility, float dtype) in ONE
+        place, then the 1-bit apply."""
+        import jax
+        import jax.numpy as jnp
+
+        if compress != "1bit":
+            raise ValueError(
+                f"unknown compress '{compress}' (expected '1bit')")
+        if self.sync:
+            raise ValueError(
+                "compress='1bit' is incompatible with BSP buffering "
+                "(the residual is per-wire-message)")
+        if not jnp.issubdtype(self.dtype, jnp.floating):
+            # Fractional quantization scales would truncate into an int
+            # table and the residual could never compensate.
+            raise ValueError(
+                f"compress='1bit' requires a floating table, got "
+                f"{self.dtype}")
+        self._apply_dense_compressed(delta, option)
+        if blocking:
+            jax.block_until_ready(self._data)
+
+    def _apply_dense_compressed(self, delta, option) -> None:
+        """1-bit-SGD eager add (SURVEY.md §5 quantization lineage).
+
+        Quantize (with this table's error-feedback residual), move only
+        sign bits + two scales over the wire — under multi-host, the
+        allgather ships 1/32 the bytes — then every rank dequantizes the
+        identical payloads and applies the identical sum.  Lossy per
+        add; the residual re-injects the loss into the next add, which
+        is what keeps SGD convergent (Seide et al. 2014).
+        """
+        import numpy as np
+
+        from ..util.quantization import OneBitCompressor, dequantize_1bit
+
+        # Residual read-modify-write under the table lock: concurrent
+        # compressed adds racing it would double-inject one residual and
+        # drop another — silently wrong values.
+        with self._lock:
+            if self._compressor is None:
+                self._compressor = OneBitCompressor()
+            packed, p, m = self._compressor.compress(delta)
+        shape = delta.shape
+        if is_multiprocess():
+            header = np.frombuffer(
+                np.asarray([p, m], np.float64).tobytes(), np.uint8)
+            parts = multihost_allgather_list(
+                np.concatenate([header, packed]))
+            total = np.zeros(int(np.prod(shape)), np.float32)
+            for part in parts:
+                ps, ms = np.frombuffer(part[:16].tobytes(), np.float64)
+                total += dequantize_1bit(part[16:], float(ps), float(ms),
+                                         total.size)
+            self._apply_dense_padded(total.reshape(shape), option,
+                                     presummed=True)
+            return
+        # Single controller: ship the PACKED BITS to the device (1/32 the
+        # host->device bytes — the tunnel/PCIe is this path's bottleneck)
+        # and unpack + scale + apply in one jitted program.
+        self._apply_packed_device(packed, p, m, shape, option)
+
+    def _apply_packed_device(self, packed, pos_scale, neg_scale, shape,
+                             option) -> None:
+        """Jitted 1-bit decode + updater apply (donated table buffers)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        opt = option or self.default_option
+        key = (opt, "packed", tuple(shape))
+        fn = self._dense_cache.get(key)
+        if fn is None:
+            updater = self.updater
+            padded_shape = self._data.shape
+            n = int(np.prod(shape))
+
+            def _apply(data, state, bits_u8, scales):
+                bits = jnp.unpackbits(bits_u8, count=n).astype(bool)
+                d = jnp.where(bits, scales[0], scales[1]).reshape(shape)
+                if d.shape != padded_shape:
+                    d = jnp.pad(d, [(0, ps - s) for ps, s in
+                                    zip(padded_shape, d.shape)])
+                return updater.apply_dense(data, state,
+                                           d.astype(data.dtype), opt)
+
+            fn = jax.jit(_apply, donate_argnums=(0, 1))
+            self._dense_cache[key] = fn
+        scales = np.asarray([pos_scale, neg_scale], np.float32)
+        with self._lock:
+            self._data, self._state = fn(self._data, self._state,
+                                         packed, scales)
 
     def _apply_dense_device(self, delta, option) -> None:
         """Device-resident eager add: the delta is already a ``jax.Array``.
@@ -279,6 +380,9 @@ class Table:
             self._data = host_put(pad(data), self._sharding)
             self._state = tuple(host_put(pad(s), self._sharding)
                                 for s in state)
+        if self._compressor is not None:
+            # Carried quantization error belongs to the abandoned timeline.
+            self._compressor.reset()
 
     def _locked_read(self, reader):
         """Run ``reader(data, state)`` under the table lock.

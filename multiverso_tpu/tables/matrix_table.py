@@ -120,17 +120,23 @@ class MatrixTable(Table):
 
     # ------------------------------------------------------------------ Add
     def add(self, delta, option: Optional[AddOption] = None,
-            sync: bool = False) -> None:
-        """Whole-matrix add (reference ``Add`` all-rows path)."""
+            sync: bool = False, compress: Optional[str] = None) -> None:
+        """Whole-matrix add (reference ``Add`` all-rows path).
+
+        ``compress="1bit"``: sign-bit wire format with error feedback
+        (see ``ArrayTable.add``)."""
         with self._monitor("Add"):
-            if self._try_device_add(delta, (self.num_rows, self.num_cols),
-                                    option, sync):
+            if compress is None and self._try_device_add(
+                    delta, (self.num_rows, self.num_cols), option, sync):
                 return
             delta = np.asarray(delta, dtype=self.dtype)
             if delta.shape != (self.num_rows, self.num_cols):
                 raise ValueError(
                     f"delta shape {delta.shape} != "
                     f"({self.num_rows}, {self.num_cols})")
+            if compress is not None:
+                self._add_compressed(delta, option, compress, sync)
+                return
             if self.sync:
                 with self._lock:
                     if option in self._pending_dense:

@@ -98,6 +98,15 @@ else:
     np.testing.assert_allclose(got[0], want_sp[pid])
     np.testing.assert_allclose(got[1], 0.0)
 
+# --- 1-bit compressed eager add: 1/32-size allgather, identical merges -----
+tq = mv.ArrayTable(64, name="mp_q")
+dq = np.full(64, float(pid + 1), np.float32)
+tq.add(dq, compress="1bit")                 # collective (packed bytes)
+got_q = tq.get()
+# every rank decoded the identical payload set -> identical stores; the
+# per-rank constant deltas quantize exactly (one bucket, exact mean)
+np.testing.assert_allclose(got_q, float(total), rtol=1e-5)
+
 # --- BSP: pending until the clock boundary, then one merged apply ----------
 ts = mv.ArrayTable(4, name="mp_sync", sync=True)
 ts.add(np.ones(4, np.float32) * (pid + 1))

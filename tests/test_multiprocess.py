@@ -94,9 +94,11 @@ def test_restore_multiprocess_checkpoint_into_single_process(
     kv = m.KVTable(value_shape=(2,), name="mp_kv")
     sp = m.SparseMatrixTable(8, 4, name="mp_sp")
     ts = m.ArrayTable(4, name="mp_sync", sync=True)
+    tq = m.ArrayTable(64, name="mp_q")
     extra = checkpoint.restore(path)
     assert extra == {"step": 7}
     np.testing.assert_allclose(t.get(), total)
+    np.testing.assert_allclose(tq.get(), total)   # 1-bit adds, exact here
     np.testing.assert_allclose(ts.get(), total)
     got = mat.get()
     for r in range(2):

@@ -178,7 +178,8 @@ def test_native_multi_process_net(native, tmp_path, nprocs):
         assert f"NET_CHILD_OK {r}" in out, out[-2000:]
 
 
-@pytest.mark.parametrize("updater", ["sgd", "adagrad"])
+@pytest.mark.parametrize("updater",
+                         ["sgd", "adagrad", "momentum", "smooth_gradient"])
 def test_native_stateful_updater_cross_rank(native, tmp_path, updater):
     """Stateful updaters across ranks: every rank's blocking add applies
     sequentially through the shard-resident slot state; all ranks read
