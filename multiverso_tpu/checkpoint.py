@@ -21,9 +21,95 @@ from .core import context as core_context
 from .io import StreamFactory
 from .log import Log
 
-__all__ = ["save", "restore"]
+__all__ = ["save", "restore", "save_pytree", "restore_pytree"]
 
 _MAGIC = b"MVTPUCKPT1"
+_MAGIC_TREE = b"MVTPUTREE1"
+
+
+def _write_snapshot(uri: str, magic: bytes, obj: Any) -> None:
+    """THE one framing for every checkpoint file: magic + pickle body,
+    written through an atomic Stream (temp + rename)."""
+    with StreamFactory.open(uri, "wb", atomic=True) as s:
+        s.write(magic)
+        s.write(pickle.dumps(obj, protocol=4))
+
+
+def _read_snapshot(uri: str, magic: bytes, what: str) -> Any:
+    with StreamFactory.open(uri, "rb") as s:
+        got = s.read(len(magic))
+        if got != magic:
+            raise ValueError(f"{uri}: not a multiverso_tpu {what}")
+        return pickle.loads(s.read())
+
+
+def save_pytree(uri: str, tree: Any) -> None:
+    """Snapshot an arbitrary pytree of arrays (model params, optimizer
+    state — anything that is NOT a registered table) to ``uri``.
+
+    Same write discipline as :func:`save`: device arrays materialize to
+    host (collectively under multi-host), rank 0 writes atomically,
+    every rank syncs before returning.  Used by
+    ``TransformerTrainer.save`` — the flagship model's params live in a
+    sharded pytree, not a table, but deserve the same durability.
+    """
+    import jax
+
+    from .tables.base import host_fetch
+
+    ctx = core_context.get_context()
+    # Only device arrays materialize; other leaves (scalars, strings,
+    # configs) pickle natively and round-trip with their own types.
+    host_tree = jax.tree_util.tree_map(
+        lambda a: host_fetch(a) if isinstance(a, jax.Array) else a, tree)
+    if ctx.node.rank == 0:
+        _write_snapshot(uri, _MAGIC_TREE, host_tree)
+        Log.info("pytree checkpoint saved: %s", uri)
+    ctx.host_sync("mvtpu_pytree_save")
+
+
+def restore_pytree(uri: str, like: Any = None) -> Any:
+    """Load a pytree snapshot.  With ``like`` (a pytree of placed
+    ``jax.Array`` leaves), each loaded leaf is ``device_put`` with the
+    matching leaf's sharding — restoring a trainer onto any mesh.
+
+    Trust boundary: pickle body — restore only checkpoints you control
+    (same caveat as :func:`restore`).
+    """
+    import numpy as np
+
+    ctx = core_context.get_context()
+    host_tree = _read_snapshot(uri, _MAGIC_TREE, "pytree snapshot")
+    ctx.host_sync("mvtpu_pytree_restore")
+    if like is None:
+        return host_tree
+    import jax
+
+    from .tables.base import host_put
+
+    class _LeafMismatch(ValueError):
+        pass
+
+    def place(path, h, ref):
+        if not isinstance(ref, jax.Array):
+            return h
+        h = np.asarray(h)
+        if h.shape != ref.shape or h.dtype != ref.dtype:
+            raise _LeafMismatch(
+                f"snapshot leaf {jax.tree_util.keystr(path)} is "
+                f"{h.shape}/{h.dtype} but the live tree expects "
+                f"{ref.shape}/{ref.dtype} — wrong config/updater for "
+                f"this checkpoint?")
+        return host_put(h, ref.sharding)
+
+    try:
+        return jax.tree_util.tree_map_with_path(place, host_tree, like)
+    except _LeafMismatch:
+        raise
+    except Exception as exc:
+        raise ValueError(
+            f"{uri}: snapshot tree structure does not match the live "
+            f"tree (different model config or updater?): {exc}") from exc
 
 
 def save(uri: str, extra: Optional[Dict[str, Any]] = None) -> None:
@@ -44,9 +130,7 @@ def save(uri: str, extra: Optional[Dict[str, Any]] = None) -> None:
             "extra": extra or {},
             "tables": tables_snap,
         }
-        with StreamFactory.open(uri, "wb", atomic=True) as s:
-            s.write(_MAGIC)
-            s.write(pickle.dumps(snap, protocol=4))
+        _write_snapshot(uri, _MAGIC, snap)
         Log.info("checkpoint saved: %s (%d tables, clock=%d)",
                  uri, len(snap["tables"]), ctx.clock)
     ctx.host_sync("mvtpu_checkpoint_save")
@@ -69,11 +153,7 @@ def restore(uri: str, strict: bool = True) -> Dict[str, Any]:
     would need a broadcast seam here.
     """
     ctx = core_context.get_context()
-    with StreamFactory.open(uri, "rb") as s:
-        magic = s.read(len(_MAGIC))
-        if magic != _MAGIC:
-            raise ValueError(f"{uri}: not a multiverso_tpu checkpoint")
-        snap = pickle.loads(s.read())
+    snap = _read_snapshot(uri, _MAGIC, "checkpoint")
 
     tables = {t.name: t for t in ctx.tables()}
     missing = set(tables) - set(snap["tables"])

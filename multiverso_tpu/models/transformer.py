@@ -348,3 +348,22 @@ class TransformerTrainer:
                 lambda p, t: lm_loss(p, t, cfg, mesh))
         return float(self._eval(self.params,
                                 jnp.asarray(tokens, jnp.int32)))
+
+    # ------------------------------------------------------------ checkpoint
+    def save(self, uri: str) -> None:
+        """Snapshot params + updater state (collective; rank-0 atomic
+        write — same durability as the table checkpoints)."""
+        from .. import checkpoint
+
+        checkpoint.save_pytree(uri, {"params": self.params,
+                                     "state": self.state})
+
+    def restore(self, uri: str) -> None:
+        """Load a snapshot onto THIS trainer's mesh/shardings (the
+        writing mesh need not match — leaves re-place by the current
+        params' shardings)."""
+        from .. import checkpoint
+
+        snap = checkpoint.restore_pytree(
+            uri, like={"params": self.params, "state": self.state})
+        self.params, self.state = snap["params"], snap["state"]

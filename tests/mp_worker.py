@@ -131,5 +131,28 @@ merged = sv.mv_sync()                                 # push delta/N, pull
 np.testing.assert_allclose(
     merged, np.full(4, total / float(nprocs)), rtol=1e-6)
 
+# --- flagship trainer: collective step + pytree checkpoint round trip ------
+from jax.sharding import Mesh  # noqa: E402
+
+from multiverso_tpu.models import (TransformerConfig,  # noqa: E402
+                                   TransformerTrainer)
+
+cfg_t = TransformerConfig(vocab_size=64, dim=16, n_layers=1, n_heads=2,
+                          hidden=32, max_seq=16)
+mesh_t = Mesh(np.asarray(jax.devices()), ("dp",))
+tr = TransformerTrainer(cfg_t, mesh_t, updater_type="sgd")
+toks = np.random.RandomState(0).randint(
+    64, size=(2 * len(jax.devices()), 16)).astype(np.int32)
+assert np.isfinite(tr.train_step(toks))
+tpath = os.path.join(scratch, "trainer.ckpt")
+tr.save(tpath)                                   # collective, rank-0 write
+from multiverso_tpu.tables.base import host_fetch  # noqa: E402
+
+want_head = host_fetch(tr.params["head"])        # collective materialize
+tr.train_step(toks)                              # diverge
+tr.restore(tpath)                                # collective restore
+np.testing.assert_array_equal(host_fetch(tr.params["head"]), want_head)
+assert np.isfinite(tr.train_step(toks))          # trains on from restore
+
 mv.shutdown()
 print("WORKER_OK", pid, flush=True)

@@ -223,3 +223,31 @@ def test_custom_scheme_old_contract_still_works(tmp_path, mv):
             assert s.read() == b"ok"
     finally:
         StreamFactory._schemes.pop("twoarg", None)
+
+
+def test_restore_pytree_validates_shapes(mv, tmp_path):
+    """A checkpoint from one config must refuse to load into another,
+    naming the offending leaf — not corrupt silently."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from multiverso_tpu import checkpoint
+
+    mv.init()
+    path = str(tmp_path / "tree.ckpt")
+    tree = {"w": jnp.ones((4, 4)), "step": 3, "run": "exp1"}
+    checkpoint.save_pytree(path, tree)
+
+    # non-array leaves round-trip with their own types
+    back = checkpoint.restore_pytree(path)
+    assert back["step"] == 3 and back["run"].startswith("exp")
+
+    like_bad = {"w": jnp.ones((8, 8)), "step": 0, "run": ""}
+    with _pytest.raises(ValueError, match="expects"):
+        checkpoint.restore_pytree(path, like=like_bad)
+
+    like_wrong_tree = {"w": jnp.ones((4, 4)), "extra_key": jnp.ones(2),
+                      "step": 0, "run": ""}
+    with _pytest.raises(ValueError, match="structure"):
+        checkpoint.restore_pytree(path, like=like_wrong_tree)
