@@ -54,7 +54,10 @@ class TransformerConfig:
     # remat: gradient checkpointing — recompute each layer's forward during
     # the backward pass instead of saving activations.  Trades ~1/3 more
     # matmul FLOPs for O(layers·B·T·dim) activation memory, the knob that
-    # lets batch·seq scale to MXU-bound sizes on one chip.
+    # lets batch·seq scale to MXU-bound sizes on one chip.  (A save-the-
+    # attention-output policy was tried and REMOVED: saving the output
+    # prunes no backward recompute — grads w.r.t. wq/wk/wv still need the
+    # attention internals — so it only added residual memory.)
     remat: bool = False
     # scan_layers: stack the per-layer params into [L, ...] arrays and run
     # ``lax.scan`` over them — O(1) trace/compile time in depth and the
@@ -229,10 +232,10 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
         return x + gated @ lyr["w2"].astype(dt), jnp.float32(0)
 
     if cfg.remat:
-        # Save only the layer boundary; the backward pass re-runs the layer
-        # forward (flash kernel included — its custom_vjp composes with
-        # checkpoint).  Under scan the body already blocks CSE, so the
-        # anti-CSE barriers are pure overhead there.
+        # Save only the layer boundary; the backward pass re-runs the
+        # layer forward (flash kernel included — its custom_vjp composes
+        # with checkpoint).  Under scan the body already blocks CSE, so
+        # the anti-CSE barriers are pure overhead there.
         block = jax.checkpoint(block, prevent_cse=not cfg.scan_layers)
 
     if cfg.scan_layers:

@@ -265,3 +265,4 @@ def test_trainer_checkpoint_roundtrip_cross_mesh(mv, tmp_path):
     # and training continues from the restored point
     loss = tr3.train_step(toks)
     assert np.isfinite(loss)
+
