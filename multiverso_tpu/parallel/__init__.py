@@ -1,3 +1,4 @@
+from .pipeline import gpipe, stage_pspec
 from .sharding import (
     make_mesh,
     table_mesh,
@@ -12,4 +13,6 @@ __all__ = [
     "replicated",
     "shard_along",
     "host_to_global",
+    "gpipe",
+    "stage_pspec",
 ]

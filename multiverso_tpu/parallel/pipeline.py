@@ -1,0 +1,96 @@
+"""Pipeline parallelism — GPipe over a mesh axis.
+
+Not in the reference (a 2016 parameter server predates pipeline-parallel
+training); included because PP completes this framework's parallelism
+matrix (dp / tp / sp / ep / pp).
+
+TPU-first design: the classic GPipe schedule expressed as pure SPMD —
+``shard_map`` over the ``pp`` axis, stage weights stacked [pp, ...] and
+sharded on the leading dim, and ONE ``lax.scan`` over
+``num_micro + pp - 1`` ticks.  Every tick each stage applies its layers
+to the activation it holds, then the activations rotate one stage
+forward via ``ppermute`` (ICI neighbor exchange).  Stage 0 injects a
+fresh microbatch per tick; the last stage banks its finished
+microbatches.  Idle ticks (the pipeline bubble, (pp-1)/(M+pp-1) of the
+work) compute on garbage and are masked out — the standard SPMD trade:
+uniform code, no data-dependent control flow, XLA overlaps the permute
+with compute.  Everything is differentiable: ``ppermute`` transposes to
+the reverse rotation, so ``jax.grad`` yields exactly the backward
+pipeline schedule.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["gpipe", "stage_pspec"]
+
+
+def stage_pspec(ndim: int, axis_name: str = "pp"):
+    """PartitionSpec for stacked stage params: [pp, ...] over ``axis_name``."""
+    return P(axis_name, *([None] * (ndim - 1)))
+
+
+def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
+          stage_params: Any, x: jax.Array, mesh: Mesh,
+          axis_name: str = "pp",
+          batch_axis: str | None = "dp") -> jax.Array:
+    """Run ``x`` through ``pp`` pipeline stages, microbatched.
+
+    - ``stage_fn(params_slice, h) -> h``: one stage's compute (e.g. a
+      scan over its layer block); must preserve ``h``'s shape/dtype.
+    - ``stage_params``: pytree whose leaves lead with the stage dim
+      [pp, ...] (sharded over ``axis_name`` — use :func:`stage_pspec`).
+    - ``x``: [M, Bm, ...] microbatched input.  Returns [M, Bm, ...]
+      outputs — microbatch m's activations after ALL pp stages.
+    - ``batch_axis``: mesh axis the microbatch dim Bm is sharded over
+      (data parallel inside each stage), or None.
+    """
+    pp = int(mesh.shape[axis_name])
+    M = int(x.shape[0])
+    b_ax = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
+    x_spec = P(None, b_ax, *([None] * (x.ndim - 2)))
+    p_spec = jax.tree_util.tree_map(
+        lambda l: stage_pspec(l.ndim, axis_name), stage_params)
+    ring = [(s, (s + 1) % pp) for s in range(pp)]
+
+    def local(params_s, x_all):
+        # params_s leaves: [1, ...] (this stage's slice); drop the dim.
+        params_s = jax.tree_util.tree_map(lambda l: l[0], params_s)
+        idx = jax.lax.axis_index(axis_name)
+        buf = jnp.zeros_like(x_all[0])          # activation held right now
+        outs = jnp.zeros_like(x_all)            # last stage's bank
+
+        def tick(carry, t):
+            buf, outs = carry
+            # Stage 0 starts microbatch t (while t < M); other stages
+            # work on what the previous tick's rotation handed them.
+            inject = x_all[jnp.minimum(t, M - 1)]
+            h = jnp.where(idx == 0, inject, buf)
+            h = stage_fn(params_s, h)
+            m = t - idx                         # microbatch this stage did
+            bank = (idx == pp - 1) & (m >= 0) & (m < M)
+            # Mask the ROW, not the whole bank — a full-buffer where()
+            # would copy [M, Bm, d] every tick and defeat aliasing.
+            pos = jnp.clip(m, 0, M - 1)
+            outs = outs.at[pos].set(jnp.where(bank, h, outs[pos]))
+            buf = jax.lax.ppermute(h, axis_name, ring)
+            return (buf, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (buf, outs),
+                                    jnp.arange(M + pp - 1))
+        # Only the last stage holds real outputs; replicate over pp so
+        # the caller sees one logical array (psum of one-hot banks).
+        outs = jax.lax.psum(
+            jnp.where(idx == pp - 1, outs, jnp.zeros_like(outs)),
+            axis_name)
+        return outs
+
+    return shard_map(local, mesh=mesh, in_specs=(p_spec, x_spec),
+                     out_specs=x_spec, check_vma=False)(stage_params, x)

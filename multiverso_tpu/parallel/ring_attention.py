@@ -24,7 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["ring_attention", "blockwise_attention_local"]
@@ -308,7 +308,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
 
     local = local_zigzag if use_zigzag else local_contiguous
     out = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                    out_specs=spec, check_rep=False)(q, k, v)
+                    out_specs=spec, check_vma=False)(q, k, v)
     if use_zigzag:
         out = jnp.take(out, inv_perm, axis=2)
     return out

@@ -1,0 +1,100 @@
+"""Pipeline parallelism (GPipe over a mesh axis): exactness against the
+sequential stack, gradient parity, training through the pipeline, and
+the (dp, pp) combined layout."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.parallel.pipeline import gpipe, stage_pspec
+
+
+def _mlp_stage(params, h):
+    """One stage = Lp dense+tanh layers, scanned."""
+    def body(h, w):
+        return jnp.tanh(h @ w), None
+
+    h, _ = jax.lax.scan(body, h, params)
+    return h
+
+
+def _make(pp, layers_per_stage, d, seed=0):
+    rng = np.random.RandomState(seed)
+    w = (rng.randn(pp, layers_per_stage, d, d) / np.sqrt(d)).astype(
+        np.float32)
+    return jnp.asarray(w)
+
+
+def _sequential(w, x_flat):
+    h = x_flat
+    for s in range(w.shape[0]):
+        h = _mlp_stage(w[s], h)
+    return h
+
+
+@pytest.mark.parametrize("pp,micro", [(4, 4), (8, 3), (2, 6)])
+def test_gpipe_matches_sequential(pp, micro):
+    d = 16
+    mesh = Mesh(np.asarray(jax.devices()[:pp]), ("pp",))
+    w = _make(pp, 2, d)
+    x = jnp.asarray(np.random.RandomState(1)
+                    .randn(micro, 4, d).astype(np.float32))
+    got = gpipe(_mlp_stage, w, x, mesh, batch_axis=None)
+    want = jnp.stack([_sequential(w, x[m]) for m in range(micro)])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_gpipe_grads_match_sequential():
+    pp, micro, d = 4, 3, 8
+    mesh = Mesh(np.asarray(jax.devices()[:pp]), ("pp",))
+    w = _make(pp, 2, d, seed=2)
+    x = jnp.asarray(np.random.RandomState(3)
+                    .randn(micro, 4, d).astype(np.float32))
+    tgt = jnp.asarray(np.random.RandomState(4)
+                      .randn(micro, 4, d).astype(np.float32))
+
+    def loss_pipe(w):
+        return jnp.mean(jnp.square(gpipe(_mlp_stage, w, x, mesh,
+                                         batch_axis=None) - tgt))
+
+    def loss_seq(w):
+        out = jnp.stack([_sequential(w, x[m]) for m in range(micro)])
+        return jnp.mean(jnp.square(out - tgt))
+
+    g_pipe = jax.grad(loss_pipe)(w)
+    g_seq = jax.grad(loss_seq)(w)
+    np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_seq),
+                               atol=1e-5)
+
+
+def test_gpipe_trains_on_dp_pp_mesh():
+    """Combined layout: microbatch batch dim sharded over dp, stages over
+    pp — the full jitted train step updates sharded stage weights and the
+    loss falls."""
+    dp, pp, d, micro = 2, 4, 8, 4
+    mesh = Mesh(np.asarray(jax.devices()).reshape(dp, pp), ("dp", "pp"))
+    w = jax.device_put(_make(pp, 2, d, seed=5),
+                       NamedSharding(mesh, stage_pspec(4)))
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(micro, 4, d).astype(np.float32))
+    tgt = jnp.tanh(jnp.asarray(rng.randn(micro, 4, d).astype(np.float32)))
+
+    def loss(w):
+        out = gpipe(_mlp_stage, w, x, mesh)
+        return jnp.mean(jnp.square(out - tgt))
+
+    @jax.jit
+    def train(w):
+        def body(w, _):
+            l, g = jax.value_and_grad(loss)(w)
+            return w - 0.5 * g, l
+
+        return jax.lax.scan(body, w, None, length=300)
+
+    w, losses = train(w)
+    first, last = float(losses[0]), float(losses[-1])
+    assert last < first * 0.5, (first, last)
+    assert w.sharding.spec == stage_pspec(4)
