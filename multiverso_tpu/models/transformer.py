@@ -63,6 +63,12 @@ class TransformerConfig:
     # ``lax.scan`` over them — O(1) trace/compile time in depth and the
     # natural pairing with remat (XLA sees one layer body once).
     scan_layers: bool = False
+    # Pipeline parallelism: with a ``pp`` mesh axis and M > 0, the layer
+    # stack splits into pp stages and batches flow through the GPipe
+    # microbatch schedule (``parallel/pipeline.py``).  Requires
+    # scan_layers (stages slice the stacked params), dense MLPs, sp == 1,
+    # and batch divisible by M.
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -146,8 +152,13 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
 
     is_spec = lambda x: isinstance(x, P)
     if cfg.scan_layers:
+        # With pipeline parallelism the stacked layer dim shards over
+        # ``pp`` (each stage holds its own layers); otherwise replicated.
+        lead = ("pp" if ("pp" in mesh.shape and cfg.pipeline_microbatches
+                         and cfg.n_layers % mesh.shape["pp"] == 0)
+                else None)
         layers = jax.tree_util.tree_map(
-            lambda spec: NamedSharding(mesh, P(None, *spec)), layer,
+            lambda spec: NamedSharding(mesh, P(lead, *spec)), layer,
             is_leaf=is_spec)
     else:
         layers = [jax.tree_util.tree_map(
@@ -202,11 +213,18 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
     use_ring = mesh is not None and int(mesh.shape.get("sp", 1)) > 1
 
     def block(x, lyr):
-        """One decoder layer: attn + residual, MLP/MoE + residual."""
+        """One decoder layer: attn + residual, MLP/MoE + residual.
+
+        Shapes derive from ``x`` itself — under pipeline parallelism the
+        block sees microbatches, not the full batch."""
+        Bb, Tb, _ = x.shape
         h = _rms_norm(x, lyr["attn_norm"].astype(dt), cfg.norm_eps)
-        q = (h @ lyr["wq"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
-        k = (h @ lyr["wk"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
-        v = (h @ lyr["wv"].astype(dt)).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        q = (h @ lyr["wq"].astype(dt)).reshape(Bb, Tb, cfg.n_heads,
+                                               cfg.head_dim)
+        k = (h @ lyr["wk"].astype(dt)).reshape(Bb, Tb, cfg.n_heads,
+                                               cfg.head_dim)
+        v = (h @ lyr["wv"].astype(dt)).reshape(Bb, Tb, cfg.n_heads,
+                                               cfg.head_dim)
         q = _rope(q.transpose(0, 2, 1, 3), cfg.rope_theta)
         k = _rope(k.transpose(0, 2, 1, 3), cfg.rope_theta)
         v = v.transpose(0, 2, 1, 3)
@@ -215,7 +233,7 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
                                scale=scale)
         else:
             o = blockwise_attention_local(q, k, v, scale, causal=True)
-        o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.dim)
+        o = o.transpose(0, 2, 1, 3).reshape(Bb, Tb, cfg.dim)
         x = x + o @ lyr["wo"].astype(dt)
 
         h = _rms_norm(x, lyr["mlp_norm"].astype(dt), cfg.norm_eps)
@@ -238,7 +256,56 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
         # the anti-CSE barriers are pure overhead there.
         block = jax.checkpoint(block, prevent_cse=not cfg.scan_layers)
 
-    if cfg.scan_layers:
+    use_pp = (mesh is not None and cfg.pipeline_microbatches > 0
+              and int(mesh.shape.get("pp", 1)) > 1)
+    if use_pp:
+        # GPipe over the layer stack: embed/head stay replicated, the
+        # [L, ...] params reshape to [pp, L/pp, ...] stages, microbatches
+        # ride the schedule in parallel/pipeline.py.
+        from ..parallel.pipeline import gpipe
+
+        if not cfg.scan_layers or cfg.num_experts:
+            raise ValueError(
+                "pipeline_microbatches requires scan_layers=True and a "
+                "dense MLP (num_experts=0)")
+        if use_ring or int(mesh.shape.get("tp", 1)) > 1:
+            # Inside gpipe's shard_map the stage weights are manual SPMD:
+            # tp-sharded matmuls would need hand-written psums in the
+            # stage body.  pp composes with dp; tp/sp stay at 1.
+            raise ValueError(
+                "pipeline parallelism composes with dp only (tp/sp must "
+                "be 1 — tensor parallel inside pipeline stages needs "
+                "manual collectives)")
+        pp = int(mesh.shape["pp"])
+        dp = int(mesh.shape.get("dp", 1))
+        M = cfg.pipeline_microbatches
+        if cfg.n_layers % pp or B % (M * dp):
+            raise ValueError(
+                f"n_layers ({cfg.n_layers}) must divide into pp ({pp}) "
+                f"stages and batch ({B}) into {M} microbatches x dp "
+                f"({dp}) shards")
+        stages = jax.tree_util.tree_map(
+            lambda l: l.reshape(pp, cfg.n_layers // pp, *l.shape[1:]),
+            params["layers"])
+
+        def stage_fn(stage_params, h):
+            def body(h, lyr):
+                h, _ = block(h, lyr)
+                return h, None
+
+            h, _ = jax.lax.scan(body, h, stage_params)
+            return h
+
+        # INTERLEAVED microbatch assignment (row r -> microbatch r % M):
+        # each microbatch's rows stay evenly spread over the contiguous
+        # dp batch shards, so no cross-device reshard per step — a
+        # contiguous split would all-to-all the whole activation tensor.
+        xm = x.reshape(B // M, M, T, cfg.dim).swapaxes(0, 1)
+        xm = gpipe(stage_fn, stages, xm, mesh, axis_name="pp",
+                   batch_axis="dp")
+        x = xm.swapaxes(0, 1).reshape(B, T, cfg.dim)
+        aux_total = jnp.float32(0)
+    elif cfg.scan_layers:
         def scan_body(carry, lyr):
             x, aux = carry
             x, a = block(x, lyr)

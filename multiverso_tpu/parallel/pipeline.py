@@ -51,6 +51,10 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
       outputs — microbatch m's activations after ALL pp stages.
     - ``batch_axis``: mesh axis the microbatch dim Bm is sharded over
       (data parallel inside each stage), or None.
+
+    Non-stage weight dims are REPLICATED inside the pipeline (the stage
+    body is manual SPMD — tensor-parallel weights would need explicit
+    psums in ``stage_fn``); pp composes with data parallelism.
     """
     pp = int(mesh.shape[axis_name])
     M = int(x.shape[0])
