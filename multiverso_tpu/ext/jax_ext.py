@@ -62,11 +62,15 @@ class MVSharedVariable:
     def set_value(self, value) -> None:
         self._value = np.asarray(value, dtype=np.float32).reshape(self.shape)
 
-    def mv_sync(self) -> np.ndarray:
-        """Push local delta, pull merged value (reference protocol)."""
+    def mv_sync(self, compress: Optional[str] = None) -> np.ndarray:
+        """Push local delta, pull merged value (reference protocol).
+
+        ``compress="1bit"`` sends the delta as sign bits + scales with
+        error feedback (1/32 the wire bytes) — the delta-sync is exactly
+        the wire-bound path the quantizer targets."""
         scale = (1.0 / core_context.workers_num()) if self._average else 1.0
         delta = (self._value - self._synced).ravel() * scale
-        self.table.add(delta)
+        self.table.add(delta, compress=compress)
         merged = self.table.get().reshape(self.shape)
         self._value = merged.copy()
         self._synced = merged.copy()
@@ -79,18 +83,19 @@ def mv_shared(value, name: Optional[str] = None,
     return MVSharedVariable(value, name=name, average=average)
 
 
-def sync_all_mv_shared_vars() -> None:
+def sync_all_mv_shared_vars(compress: Optional[str] = None) -> None:
     """Sync every shared variable (reference helper of the same name).
 
     Variables created under an earlier (shut-down) runtime are pruned —
-    their tables died with that context.
+    their tables died with that context.  ``compress`` forwards to each
+    variable's ``mv_sync`` (e.g. ``"1bit"``).
     """
     live = core_context._CONTEXT
     with _ALL_LOCK:
         _ALL_SHARED[:] = [s for s in _ALL_SHARED if s.table._ctx is live]
         shared = list(_ALL_SHARED)
     for s in shared:
-        s.mv_sync()
+        s.mv_sync(compress=compress)
 
 
 class SharedParamManager:
@@ -130,11 +135,13 @@ class SharedParamManager:
             ofs += size
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
-    def sync(self, params: Any) -> Any:
-        """Push ``(params - last_synced)/workers``, pull the merged pytree."""
+    def sync(self, params: Any, compress: Optional[str] = None) -> Any:
+        """Push ``(params - last_synced)/workers``, pull the merged pytree.
+
+        ``compress="1bit"``: see ``MVSharedVariable.mv_sync``."""
         flat = self._flatten(params)
         scale = (1.0 / core_context.workers_num()) if self._average else 1.0
-        self.table.add((flat - self._synced) * scale)
+        self.table.add((flat - self._synced) * scale, compress=compress)
         merged = self.table.get()
         self._synced = merged.copy()
         return self._unflatten(merged)

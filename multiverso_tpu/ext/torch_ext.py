@@ -79,17 +79,19 @@ class TorchParamManager:
                 p.copy_(self._torch.from_numpy(chunk.copy()))
                 ofs += n
 
-    def sync_all_param(self) -> None:
+    def sync_all_param(self, compress=None) -> None:
         """Push local progress, pull merged params into the module.
 
         Reference protocol (Lua binding docs): each worker contributes
         ``(local - last_synced) / workers``; the merged value overwrites the
-        module's parameters in place.
+        module's parameters in place.  ``compress="1bit"``: sign-bit wire
+        format with error feedback (see ``tables``), same knob as the JAX
+        ext managers.
         """
         flat = self._flatten()
         peers = self._peers or core_context.workers_num()
         scale = (1.0 / peers) if self._average else 1.0
-        self.table.add((flat - self._synced) * scale)
+        self.table.add((flat - self._synced) * scale, compress=compress)
         merged = self.table.get()
         self._synced = merged.copy()
         self._write_back(merged)

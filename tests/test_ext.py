@@ -168,3 +168,34 @@ def test_torch_param_manager_shared_table_shape_check(mv):
     a = TorchParamManager(torch.nn.Linear(4, 2), name="shape_a")
     with pytest.raises(ValueError, match="shared table"):
         TorchParamManager(torch.nn.Linear(8, 2), table=a.table)
+
+
+def test_mv_shared_compressed_sync_converges(mv):
+    """Repeated drift + compressed delta-sync tracks the true value via
+    error feedback (the wire-bound ext path riding the 1-bit codec)."""
+    mv.init()
+    from multiverso_tpu.ext.jax_ext import mv_shared
+
+    sv = mv_shared(np.zeros(32, np.float32), name="ext_q")
+    target = np.linspace(-1, 1, 32).astype(np.float32)
+    v = np.zeros(32, np.float32)
+    for _ in range(60):
+        v = v + 0.2 * (target - v)          # local training drift
+        sv.set_value(v)
+        v = sv.mv_sync(compress="1bit")      # push 1-bit delta, pull
+    np.testing.assert_allclose(v, target, atol=0.05)
+
+
+def test_shared_param_manager_compressed_sync(mv):
+    mv.init()
+    from multiverso_tpu.ext.jax_ext import SharedParamManager
+
+    params = {"w": np.ones((4, 4), np.float32),
+              "b": np.zeros(4, np.float32)}
+    mgr = SharedParamManager(params, name="ext_qm")
+    params["w"] += 0.5
+    params["b"] += 0.5
+    merged = mgr.sync(params, compress="1bit")
+    # single worker, UNIFORM delta (one bucket, exact mean): lossless
+    np.testing.assert_allclose(np.asarray(merged["w"]), 1.5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(merged["b"]), 0.5, atol=1e-5)
