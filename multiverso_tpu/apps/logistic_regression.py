@@ -19,7 +19,7 @@ TPU-native: the model is pure JAX.  Two training paths:
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

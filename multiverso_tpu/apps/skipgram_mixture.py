@@ -29,7 +29,7 @@ not differentiated through — exactly EM).
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,7 @@ on the worker too).
 from __future__ import annotations
 
 from functools import partial
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Tuple
 
 import jax
 import jax.numpy as jnp

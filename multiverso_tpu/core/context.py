@@ -32,7 +32,7 @@ Identity mapping (kept name-compatible with the reference C API):
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 import jax

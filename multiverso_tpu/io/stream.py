@@ -3,9 +3,8 @@
 
 from __future__ import annotations
 
-import io
 import os
-from typing import BinaryIO, Optional
+from typing import BinaryIO
 
 __all__ = ["Stream", "LocalStream", "HDFSStream", "StreamFactory"]
 

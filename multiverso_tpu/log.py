@@ -12,8 +12,6 @@ from __future__ import annotations
 
 import logging
 import sys
-import time
-from typing import Optional
 
 __all__ = ["Log", "LogLevel", "configure"]
 

@@ -8,7 +8,7 @@ XLA materializes the partitioning and the collectives.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np

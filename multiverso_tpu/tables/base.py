@@ -35,7 +35,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-from .. import config, dashboard
+from .. import dashboard
 from ..core import context as core_context
 from ..updaters import AddOption, get_updater
 

@@ -7,7 +7,7 @@ Reference: ``include/multiverso/updater/updater.h`` — base ``Update``/
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Dict, Optional, Tuple, Type
+from typing import Dict, Optional, Tuple, Type
 
 import jax
 import jax.numpy as jnp

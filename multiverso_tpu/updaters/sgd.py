@@ -2,12 +2,11 @@
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
-from .base import AddOption, State, Updater, effective_rows, masked, register_updater
+from .base import AddOption, Updater, effective_rows, masked, register_updater
 
 
 @register_updater

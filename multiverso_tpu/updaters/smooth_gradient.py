@@ -7,7 +7,6 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
 from .base import AddOption, Updater, effective_rows, masked, register_updater
 
