@@ -176,6 +176,7 @@ class Table:
         self._lock = threading.Lock()
         self._dense_cache: dict = {}
         self._compressor = None  # lazy OneBitCompressor (error feedback)
+        self._closed = False
 
     def _apply_dense_padded(self, delta, option, *,
                             presummed: bool = False) -> None:
@@ -419,6 +420,25 @@ class Table:
         with self._lock:
             return fn(self._data)
 
+    def close(self) -> None:
+        """Unregister from the runtime and drop the device buffers.
+
+        The context registry holds a strong reference to every table (it
+        drives flush/checkpoint/shutdown), so ``del table`` alone never
+        frees HBM — long-lived processes that create scratch tables (the
+        bench, notebooks) call ``close()``.  The name is released for
+        reuse; buffered BSP adds are discarded (they could never flush —
+        the table left the registry barrier() walks); any later eager op
+        on the closed table raises.
+        """
+        self._ctx.unregister_table(self.table_id)
+        self.discard_pending()
+        self._closed = True
+        with self._lock:
+            self._data = None
+            self._state = ()
+            self._dense_cache.clear()
+
     # -- BSP clock boundary --------------------------------------------------
     def flush(self) -> None:
         """Apply buffered (sync-mode) adds; called by ``barrier()``."""
@@ -441,4 +461,10 @@ class Table:
         raise NotImplementedError
 
     def _monitor(self, op: str):
+        # Every public eager op opens with this — it doubles as the
+        # closed-table guard (a closed table's sync buffers would
+        # otherwise swallow adds silently).
+        if self._closed:
+            raise RuntimeError(
+                f"table '{self.name}' is closed (close() was called)")
         return dashboard.monitor(f"{type(self).__name__}::{op}")

@@ -114,3 +114,9 @@ class SparseMatrixTable(MatrixTable):
     def raw_assign(self, data, state=None) -> None:
         super().raw_assign(data, state)
         self._invalidate()
+
+    def close(self) -> None:
+        super().close()
+        with self._cache_lock:
+            self._cache_valid = None
+            self._cache_data = None   # the host mirror can be table-sized
