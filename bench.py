@@ -195,10 +195,14 @@ def bench_add_get(size: int = 16 * 1024 * 1024):
     Three tiers, all slope-corrected so the tunnel's fixed round-trip
     cancels:
 
-    - ``add_gbps``/``get_gbps`` — the TPU-native path: device-resident
-      delta into ``add`` (jitted donate-in-place update), compiled-slice
-      ``get(device=True)``.  This is the param-sync rate a training loop
-      on this chip actually sees (HBM-bound).
+    - ``add_dev_gbps``/``get_dev_gbps`` — the TPU-native path:
+      device-resident delta into ``add`` (jitted donate-in-place
+      update), compiled-slice ``get(device=True)``.  This is the
+      param-sync rate a training loop on this chip actually sees
+      (HBM-bound).  Also reported under the legacy ``add_gbps``/
+      ``get_gbps`` names (which meant the HOST path in rounds 1-2 and
+      the device path since round 3 — hence the explicit ``_dev`` keys
+      plus the ``bench_schema`` version field for cross-round tooling).
     - ``add_host_gbps``/``get_host_gbps`` — the eager host parity path
       (bindings / reference C-API semantics): wire-bound here.
     - ``wire_put_gbps``/``wire_get_gbps``/``wire_rtt_ms`` — raw
@@ -225,14 +229,17 @@ def bench_add_get(size: int = 16 * 1024 * 1024):
 
     # Wide step spread: the per-add device time (~1 ms) must dominate the
     # tunnel's ~110 ms fixed cost in the slope, or jitter swamps it.
-    out["add_gbps"] = nbytes / _slope_seconds(timed_dev_add, 8, 88) / 1e9
+    out["add_dev_gbps"] = nbytes / _slope_seconds(timed_dev_add, 8, 88) / 1e9
 
     def timed_dev_get(steps):
         def once():
             return t.get(device=True)[:1]
         return _time_pipelined(once, steps=steps, warmup=2, reps=3) * steps
 
-    out["get_gbps"] = nbytes / _slope_seconds(timed_dev_get, 8, 88) / 1e9
+    out["get_dev_gbps"] = nbytes / _slope_seconds(timed_dev_get, 8, 88) / 1e9
+    # Legacy names (device tier since round 3); see docstring.
+    out["add_gbps"] = out["add_dev_gbps"]
+    out["get_gbps"] = out["get_dev_gbps"]
 
     # --- host parity tier (slope over payload size) --------------------
     half = size // 2
@@ -293,6 +300,39 @@ def bench_add_get(size: int = 16 * 1024 * 1024):
                                       get_sec(half), nbytes)
     out["wire_rtt_ms"] = 1e3 * _time_loop(lambda: float(probe[0]),
                                           warmup=2, iters=5)
+
+    # --- PAIRED host-vs-wire ratio -------------------------------------
+    # The tunnel's rate drifts minute to minute (2x swings observed), so
+    # comparing the host-tier section against a wire section measured
+    # minutes apart mostly measures tunnel weather.  Interleave one raw
+    # put/fetch with one table add/get per rep and report the median
+    # per-pair ratio — the table-layer overhead with the tunnel factored
+    # OUT.  1.0 = the parity path runs at the wire limit.
+    def pair_once(wire_fn, table_fn):
+        t0 = time.perf_counter(); wire_fn(); tw = time.perf_counter() - t0
+        t0 = time.perf_counter(); table_fn(); ta = time.perf_counter() - t0
+        return tw / ta
+
+    wire_put_once = lambda: float(jax.device_put(host_delta)[0])
+    add_once = lambda: t.add(host_delta, sync=True)
+    add_once()  # warm the jitted apply out of the measurement
+    out["add_host_vs_wire"] = float(np.median(
+        [pair_once(wire_put_once, add_once) for _ in range(3)]))
+
+    d_wire = jax.device_put(np.ones(size, np.float32))
+    wire_get_once = lambda: np.asarray(bump(d_wire))
+
+    def table_get_once():
+        # Touch the device data first: jax.Array caches its host copy,
+        # so a get() of unchanged data would skip the wire entirely.
+        t.raw_assign(bump(t.raw_value()[0]))
+        return t.get()
+
+    table_get_once()
+    out["get_host_vs_wire"] = float(np.median(
+        [pair_once(wire_get_once, table_get_once) for _ in range(3)]))
+    t.close()        # scratch tables: release the ~100 MB of HBM before
+    t_half.close()   # the multi-GB transformer sections
     return out
 
 
@@ -407,18 +447,98 @@ def bench_transformer(batch: int = 8, seq: int = 2048):
 
 def bench_transformer_large(batch: int = 8, seq: int = 2048):
     """MXU-sized flagship config: ~0.96B params (dim 2048, 16 layers,
-    vocab 32768), bf16, scan-over-layers + remat — the MFU headline.
+    vocab 32768), bf16, scan-over-layers — the MFU headline.
 
-    Model FLOPs counted at the standard 6·P·tokens (remat's extra forward
-    recompute is billed as overhead, not as useful FLOPs, so the reported
-    MFU is the honest end-to-end number)."""
+    Model FLOPs counted at the standard 6·P·tokens (remat recompute is
+    billed as overhead, not as useful FLOPs, so reported MFU is the
+    honest end-to-end number).  Two remat policies:
+
+    - ``transformer_large_mfu_pct`` (headline) — selective remat
+      (remat_policy="dots": matmul outputs saved, attention recomputed)
+      at the batch that fits; recompute tax ≈ attention only.
+    - ``transformer_large_fullremat_mfu_pct`` — full-layer remat at 2×
+      the batch (the rounds-1..3 configuration; billed MFU capped at
+      ~6/8 of hardware utilization by the 2P recompute).
+
+    Plus an in-run roofline decomposition so the MFU gap is numbers,
+    not guesses:
+
+    - ``roofline_fwd_mfu_pct`` — forward-only billed MFU (2P·tokens /
+      fwd time / peak): everything above this lost in the full step is
+      backward/remat-side.
+    - ``roofline_flash_fwd_pct_of_peak`` — the Pallas flash forward
+      kernel alone at this config's [B, H, T, D], its causal FLOPs vs
+      the calibrated matmul peak: how much of the step's attention time
+      is kernel inefficiency vs shape-inherent.
+    - ``roofline_remat_tax_pct`` — (full-remat step − selective step) /
+      full-remat step at equal tokens: the wall-clock share full remat
+      burns on recompute.
+    """
+    import jax
+    import jax.numpy as jnp
+
     from multiverso_tpu.models import TransformerConfig
 
-    cfg = TransformerConfig(vocab_size=32768, dim=2048, n_layers=16,
-                            n_heads=16, hidden=5632, max_seq=seq,
-                            scan_layers=True, remat=True)
-    return _bench_transformer_cfg(cfg, batch, seq, "transformer_large",
-                                  steps=5)
+    base = dict(vocab_size=32768, dim=2048, n_layers=16,
+                n_heads=16, hidden=5632, max_seq=seq, scan_layers=True)
+    out = {}
+
+    # Selective remat headline: dots policy fits batch//2 on one v5e.
+    sel_batch = max(batch // 2, 1)
+    cfg_sel = TransformerConfig(**base, remat=True, remat_policy="dots")
+    out.update(_bench_transformer_cfg(cfg_sel, sel_batch, seq,
+                                      "transformer_large", steps=5))
+
+    cfg_full = TransformerConfig(**base, remat=True)
+    full = _bench_transformer_cfg(cfg_full, batch, seq,
+                                  "transformer_large_fullremat", steps=5)
+    out.update(full)
+
+    # ---- roofline decomposition ---------------------------------------
+    try:
+        peak = _peak_flops()
+        # Forward-only MFU (selective config's batch; no remat effect in
+        # a pure forward).
+        from multiverso_tpu.models import transformer_forward
+        toks = np.random.RandomState(0).randint(
+            base["vocab_size"], size=(sel_batch, seq)).astype(np.int32)
+        from multiverso_tpu.models import init_params
+        params = jax.tree_util.tree_map(
+            jnp.asarray, init_params(cfg_sel, seed=0),
+            is_leaf=lambda x: isinstance(x, np.ndarray))
+        fwd = jax.jit(lambda p, t: jnp.sum(
+            transformer_forward(p, t, cfg_sel).astype(jnp.float32)))
+        tok_dev = jnp.asarray(toks)
+        fwd_sec = _time_pipelined(lambda: fwd(params, tok_dev),
+                                  steps=10, warmup=2, reps=3)
+        fwd_flops = _transformer_train_flops(cfg_sel, sel_batch, seq) / 3
+        out["roofline_fwd_mfu_pct"] = 100.0 * fwd_flops / fwd_sec / peak
+        del params
+
+        # Flash forward kernel alone at the config's attention shape.
+        from multiverso_tpu.ops import flash_attention
+        H, D = base["n_heads"], base["dim"] // base["n_heads"]
+        rng = np.random.RandomState(1)
+        qkv = [jnp.asarray(rng.randn(sel_batch, H, seq, D), jnp.bfloat16)
+               for _ in range(3)]
+        fa = jax.jit(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32)))
+        fa_sec = _time_pipelined(lambda: fa(*qkv), steps=10, warmup=2,
+                                 reps=3)
+        # Causal QK^T + PV: 2 matmuls × 2·B·H·T²·D flops, halved by mask.
+        fa_flops = 2 * (2 * sel_batch * H * seq * seq * D) / 2
+        out["roofline_flash_fwd_pct_of_peak"] = (100.0 * fa_flops
+                                                 / fa_sec / peak)
+
+        # Remat tax at equal tokens/step.
+        sel_sec = sel_batch * seq / out["transformer_large_tokens_per_sec"]
+        full_sec_eq = (sel_batch * seq
+                       / full["transformer_large_fullremat_tokens_per_sec"])
+        out["roofline_remat_tax_pct"] = (100.0 * (full_sec_eq - sel_sec)
+                                         / full_sec_eq)
+    except Exception:
+        traceback.print_exc()
+    return out
 
 
 def bench_moe(batch: int = 8, seq: int = 1024):
@@ -512,18 +632,22 @@ def bench_lightlda_mh(num_docs: int = 2048, vocab: int = 10000,
                                       num_topics=min(K, 64),
                                       doc_len=doc_len, seed=0)
         lda = LightLDA(vocab, K, alpha=0.5, beta=0.1, name=f"lda_mh_k{K}")
-        dt = lda.initialize_counts(docs)
-        dt = lda.run_mh_pass(docs, dt)         # compile + warm
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            dt = lda.run_mh_pass(docs, dt)
-            times.append(time.perf_counter() - t0)
-        sec = float(np.median(times))
-        out[f"lda_mh_k{K}_tokens_per_sec"] = docs.size / sec
-        # The context registry pins tables; close() actually frees the
-        # [V, K] HBM before the long-context section allocates.
-        lda.close()
+        try:
+            dt = lda.initialize_counts(docs)
+            dt = lda.run_mh_pass(docs, dt)     # compile + warm
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                dt = lda.run_mh_pass(docs, dt)
+                times.append(time.perf_counter() - t0)
+            sec = float(np.median(times))
+            out[f"lda_mh_k{K}_tokens_per_sec"] = docs.size / sec
+        finally:
+            # The context registry pins tables; close() actually frees
+            # the [V, K] HBM before the long-context section allocates —
+            # including when the large-K pass OOMs (main() swallows the
+            # section error; the leak must not degrade later sections).
+            lda.close()
     return out
 
 
@@ -543,7 +667,12 @@ def main() -> None:
     import multiverso_tpu as mv
 
     mv.init(args=["-log_level=error"], updater_type="sgd")
-    results = {}
+    # Schema history: 1-2 = add_gbps meant the host parity path;
+    # 3 = add_gbps redefined to the device tier; 4 = explicit
+    # add_dev_gbps/get_dev_gbps keys (legacy names kept as aliases),
+    # transformer_large_mfu_pct = selective-remat headline with
+    # _fullremat_ keys and the roofline_* decomposition alongside.
+    results = {"bench_schema": 4}
     errors = []
     for section in _SECTIONS:
         try:

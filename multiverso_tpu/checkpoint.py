@@ -73,6 +73,11 @@ def restore_pytree(uri: str, like: Any = None) -> Any:
     ``jax.Array`` leaves), each loaded leaf is ``device_put`` with the
     matching leaf's sharding — restoring a trainer onto any mesh.
 
+    Multi-host: ``save_pytree`` writes on rank 0 only, but EVERY rank
+    reads ``uri`` here — the path must resolve on all hosts (shared
+    filesystem, or pre-distributed copies), the same broadcast seam
+    :func:`restore` documents.
+
     Trust boundary: pickle body — restore only checkpoints you control
     (same caveat as :func:`restore`).
     """

@@ -59,6 +59,17 @@ class TransformerConfig:
     # prunes no backward recompute — grads w.r.t. wq/wk/wv still need the
     # attention internals — so it only added residual memory.)
     remat: bool = False
+    # remat_policy (with remat=True):
+    # - "full": save only layer boundaries; the backward re-runs the whole
+    #   layer forward (~2P extra matmul FLOPs — bills MFU at ~6/8 of the
+    #   hardware's actual utilization).  Minimal memory.
+    # - "dots": jax.checkpoint_policies selective remat — save every
+    #   matmul output (q/k/v/wo/w1/w3/w2 projections), recompute only the
+    #   cheap tensor ops (norms, rope) and the flash-attention kernel
+    #   (its custom_vjp output is not a dot, so it replays from the saved
+    #   q/k/v).  Recompute tax drops from ~2P to roughly the attention
+    #   FLOPs; memory grows to O(layers·B·T·(5·dim+2·hidden)).
+    remat_policy: str = "full"
     # scan_layers: stack the per-layer params into [L, ...] arrays and run
     # ``lax.scan`` over them — O(1) trace/compile time in depth and the
     # natural pairing with remat (XLA sees one layer body once).
@@ -129,12 +140,10 @@ def stack_layer_params(layers):
                      else jnp.stack(xs)), *layers)
 
 
-def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
-    """TP layout: attention io dims, MLP hidden, and vocab shard over ``tp``;
-    everything else replicated (dp/sp shard activations, not weights).
-
-    Scan-format params get the same per-layer specs with an unsharded
-    leading layer dim."""
+def _layer_pspecs(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
+    """Per-layer weight PartitionSpecs for the Megatron-style tp layout:
+    attention io dims and MLP hidden shard over ``tp`` (column-parallel
+    wq/wk/wv/w1/w3, row-parallel wo/w2); norms replicated."""
     tp = "tp" if "tp" in mesh.shape else None
 
     layer = {
@@ -149,6 +158,17 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
     else:
         layer.update({"w1": P(None, tp), "w3": P(None, tp),
                       "w2": P(tp, None)})
+    return layer
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
+    """TP layout: attention io dims, MLP hidden, and vocab shard over ``tp``;
+    everything else replicated (dp/sp shard activations, not weights).
+
+    Scan-format params get the same per-layer specs with an unsharded
+    leading layer dim."""
+    tp = "tp" if "tp" in mesh.shape else None
+    layer = _layer_pspecs(cfg, mesh)
 
     is_spec = lambda x: isinstance(x, P)
     if cfg.scan_layers:
@@ -212,49 +232,77 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
     scale = cfg.head_dim ** -0.5
     use_ring = mesh is not None and int(mesh.shape.get("sp", 1)) > 1
 
-    def block(x, lyr):
-        """One decoder layer: attn + residual, MLP/MoE + residual.
+    def make_block(local_heads: int, reduce=None):
+        """Build one decoder-layer fn (with the remat wrapper applied).
 
-        Shapes derive from ``x`` itself — under pipeline parallelism the
-        block sees microbatches, not the full batch."""
-        Bb, Tb, _ = x.shape
-        h = _rms_norm(x, lyr["attn_norm"].astype(dt), cfg.norm_eps)
-        q = (h @ lyr["wq"].astype(dt)).reshape(Bb, Tb, cfg.n_heads,
-                                               cfg.head_dim)
-        k = (h @ lyr["wk"].astype(dt)).reshape(Bb, Tb, cfg.n_heads,
-                                               cfg.head_dim)
-        v = (h @ lyr["wv"].astype(dt)).reshape(Bb, Tb, cfg.n_heads,
-                                               cfg.head_dim)
-        q = _rope(q.transpose(0, 2, 1, 3), cfg.rope_theta)
-        k = _rope(k.transpose(0, 2, 1, 3), cfg.rope_theta)
-        v = v.transpose(0, 2, 1, 3)
-        if use_ring:
-            o = ring_attention(q, k, v, mesh, axis_name="sp", causal=True,
-                               scale=scale)
-        else:
-            o = blockwise_attention_local(q, k, v, scale, causal=True)
-        o = o.transpose(0, 2, 1, 3).reshape(Bb, Tb, cfg.dim)
-        x = x + o @ lyr["wo"].astype(dt)
+        ``local_heads``/``reduce`` specialize it for manual tensor
+        parallelism inside a pipeline stage: the block then sees
+        tp-local column shards of wq/wk/wv/w1/w3 (so ``local_heads =
+        n_heads/tp`` and the io width is ``dim/tp``) and ``reduce`` —
+        a ``psum`` over the tp axis — completes the row-parallel
+        wo/w2 matmuls (the Megatron two-all-reduce-per-layer pattern).
+        Default (GSPMD paths): full heads, no explicit collective.
+        """
+        red = reduce if reduce is not None else (lambda t: t)
 
-        h = _rms_norm(x, lyr["mlp_norm"].astype(dt), cfg.norm_eps)
-        if cfg.num_experts:
-            from .moe import moe_ffn
+        def block(x, lyr):
+            """One decoder layer: attn + residual, MLP/MoE + residual.
 
-            out, aux = moe_ffn(lyr["moe"], h, top_k=cfg.top_k,
-                               compute_dtype=dt,
-                               dispatch=cfg.moe_dispatch,
-                               capacity_factor=cfg.capacity_factor)
-            return x + out, aux
-        gated = (jax.nn.silu(h @ lyr["w1"].astype(dt))
-                 * (h @ lyr["w3"].astype(dt)))
-        return x + gated @ lyr["w2"].astype(dt), jnp.float32(0)
+            Shapes derive from ``x`` itself — under pipeline parallelism
+            the block sees microbatches, not the full batch."""
+            Bb, Tb, _ = x.shape
+            h = _rms_norm(x, lyr["attn_norm"].astype(dt), cfg.norm_eps)
+            q = (h @ lyr["wq"].astype(dt)).reshape(Bb, Tb, local_heads,
+                                                   cfg.head_dim)
+            k = (h @ lyr["wk"].astype(dt)).reshape(Bb, Tb, local_heads,
+                                                   cfg.head_dim)
+            v = (h @ lyr["wv"].astype(dt)).reshape(Bb, Tb, local_heads,
+                                                   cfg.head_dim)
+            q = _rope(q.transpose(0, 2, 1, 3), cfg.rope_theta)
+            k = _rope(k.transpose(0, 2, 1, 3), cfg.rope_theta)
+            v = v.transpose(0, 2, 1, 3)
+            if use_ring:
+                o = ring_attention(q, k, v, mesh, axis_name="sp",
+                                   causal=True, scale=scale)
+            else:
+                o = blockwise_attention_local(q, k, v, scale, causal=True)
+            o = o.transpose(0, 2, 1, 3).reshape(Bb, Tb,
+                                                local_heads * cfg.head_dim)
+            x = x + red(o @ lyr["wo"].astype(dt))
 
-    if cfg.remat:
-        # Save only the layer boundary; the backward pass re-runs the
-        # layer forward (flash kernel included — its custom_vjp composes
-        # with checkpoint).  Under scan the body already blocks CSE, so
-        # the anti-CSE barriers are pure overhead there.
-        block = jax.checkpoint(block, prevent_cse=not cfg.scan_layers)
+            h = _rms_norm(x, lyr["mlp_norm"].astype(dt), cfg.norm_eps)
+            if cfg.num_experts:
+                from .moe import moe_ffn
+
+                out, aux = moe_ffn(lyr["moe"], h, top_k=cfg.top_k,
+                                   compute_dtype=dt,
+                                   dispatch=cfg.moe_dispatch,
+                                   capacity_factor=cfg.capacity_factor)
+                return x + out, aux
+            gated = (jax.nn.silu(h @ lyr["w1"].astype(dt))
+                     * (h @ lyr["w3"].astype(dt)))
+            return x + red(gated @ lyr["w2"].astype(dt)), jnp.float32(0)
+
+        if cfg.remat:
+            # Under scan the body already blocks CSE, so the anti-CSE
+            # barriers are pure overhead there.  The flash kernel's
+            # custom_vjp composes with checkpoint under both policies.
+            if cfg.remat_policy == "dots":
+                block = jax.checkpoint(
+                    block,
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable,
+                    prevent_cse=not cfg.scan_layers)
+            elif cfg.remat_policy == "full":
+                block = jax.checkpoint(block,
+                                       prevent_cse=not cfg.scan_layers)
+            else:
+                raise ValueError(
+                    f"unknown remat_policy '{cfg.remat_policy}' "
+                    "(expected 'full' or 'dots')")
+        return block
+
+    block = make_block(cfg.n_heads)
 
     use_pp = (mesh is not None and cfg.pipeline_microbatches > 0
               and int(mesh.shape.get("pp", 1)) > 1)
@@ -268,29 +316,44 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
             raise ValueError(
                 "pipeline_microbatches requires scan_layers=True and a "
                 "dense MLP (num_experts=0)")
-        if use_ring or int(mesh.shape.get("tp", 1)) > 1:
-            # Inside gpipe's shard_map the stage weights are manual SPMD:
-            # tp-sharded matmuls would need hand-written psums in the
-            # stage body.  pp composes with dp; tp/sp stay at 1.
+        if use_ring:
+            # Ring attention's own shard_map cannot nest inside gpipe's.
             raise ValueError(
-                "pipeline parallelism composes with dp only (tp/sp must "
-                "be 1 — tensor parallel inside pipeline stages needs "
-                "manual collectives)")
+                "pipeline parallelism composes with dp and tp, not sp "
+                "(ring attention inside pipeline stages is unsupported)")
         pp = int(mesh.shape["pp"])
         dp = int(mesh.shape.get("dp", 1))
+        tp = int(mesh.shape.get("tp", 1))
         M = cfg.pipeline_microbatches
         if cfg.n_layers % pp or B % (M * dp):
             raise ValueError(
                 f"n_layers ({cfg.n_layers}) must divide into pp ({pp}) "
                 f"stages and batch ({B}) into {M} microbatches x dp "
                 f"({dp}) shards")
+        if cfg.n_heads % tp or cfg.hidden % tp or cfg.dim % tp:
+            raise ValueError(
+                f"pp x tp needs n_heads ({cfg.n_heads}), hidden "
+                f"({cfg.hidden}) and dim ({cfg.dim}) divisible by tp "
+                f"({tp}) — the stage body shards them manually")
         stages = jax.tree_util.tree_map(
             lambda l: l.reshape(pp, cfg.n_layers // pp, *l.shape[1:]),
             params["layers"])
 
+        if tp > 1:
+            # Manual tensor parallelism inside the stage: gpipe's
+            # shard_map makes every named axis manual, so the tp layout
+            # becomes explicit — column-parallel wq/wk/wv/w1/w3 shards
+            # arrive via param_specs, and the block psums the
+            # row-parallel wo/w2 outputs over "tp".
+            stage_block = make_block(
+                cfg.n_heads // tp,
+                reduce=lambda t: jax.lax.psum(t, "tp"))
+        else:
+            stage_block = block
+
         def stage_fn(stage_params, h):
             def body(h, lyr):
-                h, _ = block(h, lyr)
+                h, _ = stage_block(h, lyr)
                 return h, None
 
             h, _ = jax.lax.scan(body, h, stage_params)
@@ -302,7 +365,9 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
         # contiguous split would all-to-all the whole activation tensor.
         xm = x.reshape(B // M, M, T, cfg.dim).swapaxes(0, 1)
         xm = gpipe(stage_fn, stages, xm, mesh, axis_name="pp",
-                   batch_axis="dp")
+                   batch_axis="dp",
+                   param_specs=(_layer_pspecs(cfg, mesh) if tp > 1
+                                else None))
         x = xm.swapaxes(0, 1).reshape(B, T, cfg.dim)
         aux_total = jnp.float32(0)
     elif cfg.scan_layers:

@@ -7,6 +7,11 @@
 // `-rpc_timeout_ms`/`-barrier_timeout_ms` deadline expired (fail-fast
 // instead of hanging on a dead rank), -4 shard (de)serialization
 // failed, -5 local stream open failed (an IO problem, NOT peer death).
+// A -3 from a DEADLINE is indeterminate, not at-most-once: a slow
+// server may still apply the Add after the caller gave up (a blind
+// retry can double-apply), and a timed-out Get's output buffer may be
+// partially filled.  Treat -3 as "state unknown": re-Get before
+// deciding whether to re-Add.
 #pragma once
 
 #include <stdint.h>

@@ -82,8 +82,12 @@ class Zoo {
   UpdaterType updater_type() const { return updater_type_; }
 
   // ---- barrier plumbing (internal) ------------------------------------
-  void OnBarrierArrive(int src_rank);   // rank-0 controller counting
-  void OnBarrierRelease();              // local waiter release
+  // Arrive/release messages carry a per-rank ROUND number (msg_id):
+  // after a timed-out round k, a late round-k release must not free the
+  // retry's round-k+1 waiter.  round = -1 forces the release (local
+  // failure paths that already latched barrier_failed_).
+  void OnBarrierArrive(int src_rank, int64_t round);
+  void OnBarrierRelease(int64_t round = -1);
   void OnFlushReply(int64_t msg_id);    // per-server flush ack
 
  private:
@@ -127,10 +131,15 @@ class Zoo {
   // arrivals PER RANK (a retry after an abandoned round must not double
   // count toward the quorum).  barrier_failed_ latches transport
   // failures so Barrier() reports them instead of a false release.
+  // barrier_round_ is this rank's current round; barrier_rounds_ is the
+  // rank-0 authority's record of each rank's latest announced round
+  // (echoed in the release so stale releases are droppable).
   std::mutex barrier_mu_;
   Waiter* barrier_waiter_ = nullptr;
   std::vector<bool> barrier_arrived_;
   bool barrier_failed_ = false;
+  int64_t barrier_round_ = 0;
+  std::vector<int64_t> barrier_rounds_;
 
   // Outstanding pipeline flushes (msg_id → waiter); acks notify under
   // flush_mu_ so a timed-out flush cannot race its stack waiter.

@@ -267,6 +267,16 @@ bool TcpNet::RegisterWithController(const std::string& ctrl_endpoint,
     *endpoints = UnpackEndpoints(reply.data[2]);
     ok = endpoints->size() == n && *my_rank > 0 &&
          *my_rank < static_cast<int>(n);
+    // The assigned slot must be OUR endpoint: a controller bug or a
+    // crossed reply would otherwise make this node answer for another
+    // rank's address and misroute every message sent to it.
+    if (ok && (*endpoints)[*my_rank] != my_endpoint) {
+      Log::Error("RegisterWithController: assigned rank %d maps to "
+                 "endpoint %s, but this node registered %s",
+                 *my_rank, (*endpoints)[*my_rank].c_str(),
+                 my_endpoint.c_str());
+      ok = false;
+    }
   }
   ::close(fd);
   return ok;

@@ -227,6 +227,14 @@ bool WorkerTable::RoundTrip(std::vector<MessagePtr> reqs,
   }
   // Deadline passed: withdraw the pending entry so late replies are
   // dropped at the door instead of touching dead stack frames.
+  //
+  // CONTRACT: a timed-out result (rc -3 at the C API) is INDETERMINATE,
+  // not at-most-once.  The server may still apply an Add whose ack was
+  // merely slow — a caller that blindly retries can double-apply the
+  // delta — and a timed-out Get leaves the caller's buffer partially
+  // filled (some shards landed, some did not).  Callers must treat -3
+  // as "state unknown": re-Get before deciding to re-Add.  (Documented
+  // at MV_* in c_api.h as well.)
   std::lock_guard<std::mutex> lk(mu_);
   auto it = pending_.find(msg_id);
   if (it == pending_.end()) return !failed;  // raced: replies completed

@@ -104,11 +104,10 @@ class ControllerActor : public Actor {
  public:
   ControllerActor() : Actor(actor::kController) {
     RegisterHandler(MsgType::ControlBarrier, [](MessagePtr& m) {
-      Zoo::Get()->OnBarrierArrive(m->src);
+      Zoo::Get()->OnBarrierArrive(m->src, m->msg_id);
     });
     RegisterHandler(MsgType::ControlBarrierReply, [](MessagePtr& m) {
-      (void)m;
-      Zoo::Get()->OnBarrierRelease();
+      Zoo::Get()->OnBarrierRelease(m->msg_id);
     });
   }
 };
@@ -305,16 +304,18 @@ bool Zoo::Barrier() {
   // release lands (observed at n=4).
   bool flushed = FlushPipelines();
   Waiter waiter(1);
+  int64_t round;
   {
     std::lock_guard<std::mutex> lk(barrier_mu_);
     barrier_waiter_ = &waiter;
     // OR, don't assign: a dead shard latched barrier_failed_ during the
     // flush (Deliver's RequestFlush case) and that must survive.
     barrier_failed_ = barrier_failed_ || !flushed;
+    round = ++barrier_round_;
   }
   auto msg = std::make_unique<Message>();
   msg->type = MsgType::ControlBarrier;
-  msg->msg_id = NextMsgId();
+  msg->msg_id = round;  // round tag: lets stale releases be dropped
   msg->src = rank_;
   msg->dst = 0;
   SendTo(actor::kWorker, std::move(msg));
@@ -330,13 +331,19 @@ bool Zoo::Barrier() {
   return ok && !barrier_failed_;
 }
 
-void Zoo::OnBarrierArrive(int src_rank) {
-  std::vector<int> release;
+void Zoo::OnBarrierArrive(int src_rank, int64_t round) {
+  std::vector<std::pair<int, int64_t>> release;  // (rank, its round)
   {
     std::lock_guard<std::mutex> lk(barrier_mu_);
     if (barrier_arrived_.size() != static_cast<size_t>(size_))
       barrier_arrived_.assign(size_, false);
+    if (barrier_rounds_.size() != static_cast<size_t>(size_))
+      barrier_rounds_.assign(size_, 0);
     if (src_rank < 0 || src_rank >= size_) return;
+    // Track the rank's LATEST round even on a duplicate arrive: a retry
+    // after an abandoned round re-announces with round k+1, and the
+    // eventual release must echo that so the retry's waiter accepts it.
+    if (round > barrier_rounds_[src_rank]) barrier_rounds_[src_rank] = round;
     // Per-rank, not per-message: a retry after an abandoned (timed-out)
     // round must not double-count toward the quorum.
     if (barrier_arrived_[src_rank]) return;
@@ -344,14 +351,16 @@ void Zoo::OnBarrierArrive(int src_rank) {
     for (bool a : barrier_arrived_)
       if (!a) return;
     barrier_arrived_.assign(size_, false);
-    for (int r = 0; r < size_; ++r) release.push_back(r);
+    for (int r = 0; r < size_; ++r)
+      release.emplace_back(r, barrier_rounds_[r]);
   }
-  for (int r : release) {
+  for (auto& [r, r_round] : release) {
     if (r == rank_) {
-      OnBarrierRelease();
+      OnBarrierRelease(r_round);
     } else {
       Message reply;
       reply.type = MsgType::ControlBarrierReply;
+      reply.msg_id = r_round;  // echo the receiver's announced round
       reply.src = rank_;
       reply.dst = r;
       net_->Send(r, reply);
@@ -359,8 +368,19 @@ void Zoo::OnBarrierArrive(int src_rank) {
   }
 }
 
-void Zoo::OnBarrierRelease() {
+void Zoo::OnBarrierRelease(int64_t round) {
   std::lock_guard<std::mutex> lk(barrier_mu_);
+  // round >= 0: a wire release — drop it unless it matches the waiter's
+  // current round (a late round-k release after a timeout must not free
+  // the round-k+1 rendezvous).  round < 0: local failure path, always
+  // releases (barrier_failed_ is already latched).
+  if (round >= 0 && round != barrier_round_) {
+    Log::Debug("Zoo::OnBarrierRelease: dropping stale release "
+               "(round %lld, current %lld)",
+               static_cast<long long>(round),
+               static_cast<long long>(barrier_round_));
+    return;
+  }
   if (barrier_waiter_) barrier_waiter_->Notify();
 }
 

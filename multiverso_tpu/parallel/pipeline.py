@@ -39,7 +39,8 @@ def stage_pspec(ndim: int, axis_name: str = "pp"):
 def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
           stage_params: Any, x: jax.Array, mesh: Mesh,
           axis_name: str = "pp",
-          batch_axis: str | None = "dp") -> jax.Array:
+          batch_axis: str | None = "dp",
+          param_specs: Any = None) -> jax.Array:
     """Run ``x`` through ``pp`` pipeline stages, microbatched.
 
     - ``stage_fn(params_slice, h) -> h``: one stage's compute (e.g. a
@@ -50,17 +51,29 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
       outputs — microbatch m's activations after ALL pp stages.
     - ``batch_axis``: mesh axis the microbatch dim Bm is sharded over
       (data parallel inside each stage), or None.
-
-    Non-stage weight dims are REPLICATED inside the pipeline (the stage
-    body is manual SPMD — tensor-parallel weights would need explicit
-    psums in ``stage_fn``); pp composes with data parallelism.
+    - ``param_specs``: optional pytree of per-leaf ``PartitionSpec``s for
+      the *trailing* weight dims (e.g. tensor-parallel layouts like
+      ``P(None, "tp")`` per layer); ``gpipe`` prepends the stage axis
+      and pads unnamed middle dims.  With tp-sharded weights the stage
+      body is manual SPMD over that axis too — ``stage_fn`` must psum
+      its row-parallel matmul outputs (see
+      ``models/transformer.py`` pp×tp).  Default: weights replicated on
+      every non-stage axis; pp then composes with dp only.
     """
     pp = int(mesh.shape[axis_name])
     M = int(x.shape[0])
     b_ax = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
     x_spec = P(None, b_ax, *([None] * (x.ndim - 2)))
-    p_spec = jax.tree_util.tree_map(
-        lambda l: stage_pspec(l.ndim, axis_name), stage_params)
+    if param_specs is None:
+        p_spec = jax.tree_util.tree_map(
+            lambda l: stage_pspec(l.ndim, axis_name), stage_params)
+    else:
+        p_spec = jax.tree_util.tree_map(
+            lambda l, spec: P(axis_name,
+                              *([None] * (l.ndim - 1 - len(spec))),
+                              *spec),
+            stage_params, param_specs,
+            is_leaf=lambda t: isinstance(t, P))
     ring = [(s, (s + 1) % pp) for s in range(pp)]
 
     def local(params_s, x_all):

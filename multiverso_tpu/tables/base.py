@@ -202,8 +202,14 @@ class Table:
             fn = jax.jit(_apply, donate_argnums=(0, 1))
             self._dense_cache[opt] = fn
         padded_shape = self._data.shape
-        padded = np.zeros(padded_shape, dtype=self.dtype)
-        padded[tuple(slice(0, s) for s in delta.shape)] = delta
+        if tuple(delta.shape) == tuple(padded_shape):
+            # Already padded-size (e.g. the table divides the mesh
+            # evenly): skip the zero-fill + copy — at tens of MiB that
+            # alloc+memcpy costs a measurable slice of the wire budget.
+            padded = np.ascontiguousarray(delta, dtype=self.dtype)
+        else:
+            padded = np.zeros(padded_shape, dtype=self.dtype)
+            padded[tuple(slice(0, s) for s in delta.shape)] = delta
         if not presummed:
             padded = multihost_sum(padded)
         d = host_put(padded, self._sharding)

@@ -161,12 +161,57 @@ def test_transformer_pipeline_rejects_bad_configs(mv):
     with pytest.raises(ValueError, match="scan_layers"):
         transformer_forward(params_loop, toks, cfg_loop, mesh=mesh2)
 
-    mesh_tp = Mesh(np.asarray(jax.devices()).reshape(2, 2, 2),
-                   ("dp", "tp", "pp"))
-    with pytest.raises(ValueError, match="tp/sp"):
-        transformer_forward(params, toks, cfg, mesh=mesh_tp)
-
     # batch 4 with M=2 microbatches over dp=4: Bm=2 not divisible
     mesh_dp4 = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("dp", "pp"))
     with pytest.raises(ValueError, match="microbatches"):
         transformer_forward(params, toks, cfg, mesh=mesh_dp4)
+
+    # pp x tp needs head/hidden/dim divisibility by tp
+    from dataclasses import replace as _replace
+    mesh_tp = Mesh(np.asarray(jax.devices()).reshape(2, 2, 2),
+                   ("dp", "tp", "pp"))
+    cfg_odd = _replace(cfg, n_heads=3, dim=48, hidden=66)
+    params_odd = jax.tree_util.tree_map(
+        jnp.asarray, init_params(cfg_odd, seed=2))
+    with pytest.raises(ValueError, match="divisible by tp"):
+        transformer_forward(params_odd, jnp.zeros((4, 16), jnp.int32),
+                            cfg_odd, mesh=mesh_tp)
+
+
+def test_transformer_pipeline_tp_matches_local(mv):
+    """pp x tp composition (VERDICT r3 item 4): the manual-collective
+    stage body (psum after row-parallel wo/w2) on a (dp, tp, pp) mesh
+    reproduces the single-device forward, and the trainer's loss falls
+    with stage weights sharded over BOTH pp and tp."""
+    from dataclasses import replace
+
+    from multiverso_tpu.models import (TransformerConfig,
+                                       TransformerTrainer, init_params)
+    from multiverso_tpu.models.transformer import transformer_forward
+
+    mv.init()
+    cfg = TransformerConfig(vocab_size=128, dim=32, n_layers=4, n_heads=4,
+                            hidden=64, max_seq=32,
+                            compute_dtype=jnp.float32, scan_layers=True,
+                            pipeline_microbatches=2)
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 2, 2),
+                ("dp", "tp", "pp"))
+    params = jax.tree_util.tree_map(jnp.asarray, init_params(cfg, seed=0))
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        128, size=(4, 16)).astype(np.int32))
+
+    local_cfg = replace(cfg, pipeline_microbatches=0)
+    want = transformer_forward(params, toks, local_cfg, mesh=None)
+    got = transformer_forward(params, toks, cfg, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4)
+
+    tr = TransformerTrainer(cfg, mesh, updater_type="sgd")
+    spec = tr.params["layers"]["wq"].sharding.spec
+    assert spec[0] == "pp" and spec[-1] == "tp", spec
+    toks_np = np.random.RandomState(1).randint(
+        128, size=(4, 16)).astype(np.int32)
+    first = tr.train_step(toks_np)
+    for _ in range(15):
+        last = tr.train_step(toks_np)
+    assert last < first * 0.8, (first, last)

@@ -174,6 +174,39 @@ def test_scan_remat_matches_loop():
             g_scan_l0[key], np.asarray(g_loop["layers"][0][key]), atol=1e-5)
 
 
+def test_selective_remat_matches_full():
+    """remat_policy='dots' (save matmul outputs, recompute attention) is a
+    pure re-scheduling too: logits and grads must match full remat."""
+    from dataclasses import replace
+
+    from multiverso_tpu.models.transformer import stack_layer_params
+
+    cfg_full = replace(_CFG, scan_layers=True, remat=True)
+    cfg_sel = replace(cfg_full, remat_policy="dots")
+    loop_params = jax.tree_util.tree_map(jnp.asarray,
+                                         init_params(_CFG, seed=7))
+    params = dict(loop_params,
+                  layers=stack_layer_params(loop_params["layers"]))
+    toks = jnp.asarray(np.random.RandomState(7).randint(
+        128, size=(2, 32)).astype(np.int32))
+
+    out_full = transformer_forward(params, toks, cfg_full, mesh=None)
+    out_sel = transformer_forward(params, toks, cfg_sel, mesh=None)
+    np.testing.assert_allclose(np.asarray(out_sel), np.asarray(out_full),
+                               atol=1e-5)
+    g_full = jax.grad(lm_loss)(params, toks, cfg_full)
+    g_sel = jax.grad(lm_loss)(params, toks, cfg_sel)
+    for key in ("wq", "w2", "attn_norm"):
+        np.testing.assert_allclose(
+            np.asarray(g_sel["layers"][key]),
+            np.asarray(g_full["layers"][key]), atol=1e-5)
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        transformer_forward(params, toks,
+                            replace(cfg_full, remat_policy="bogus"),
+                            mesh=None)
+
+
 def test_scan_remat_trainer_sharded():
     """Full trainer on a (dp, sp, tp) mesh with scan+remat params: the
     stacked layout shards, trains, and the loss falls."""
