@@ -23,6 +23,7 @@ ffi.cdef[[
 int MV_Init(int argc, const char* const* argv);
 int MV_ShutDown();
 int MV_Barrier();
+int MV_Clock();
 int MV_NumWorkers();
 int MV_WorkerId();
 int MV_ServerId();
@@ -43,6 +44,14 @@ int MV_AddMatrixTableByRows(int32_t handle, const float* delta,
 int MV_AddAsyncMatrixTableByRows(int32_t handle, const float* delta,
                                  const int32_t* row_ids, int64_t num_rows,
                                  int64_t cols);
+int MV_NewKVTable(int32_t* handle);
+int MV_GetKV(int32_t handle, const char* key, float* value);
+int MV_AddKV(int32_t handle, const char* key, float delta);
+int MV_AddAsyncKV(int32_t handle, const char* key, float delta);
+int MV_GetKVBatch(int32_t handle, const char* keys, const int32_t* key_lens,
+                  int64_t num_keys, float* values);
+int MV_AddKVBatch(int32_t handle, const char* keys, const int32_t* key_lens,
+                  int64_t num_keys, const float* deltas);
 int MV_SetAddOption(float learning_rate, float momentum, float rho, float eps);
 int MV_StoreTable(int32_t handle, const char* path);
 int MV_LoadTable(int32_t handle, const char* path);
@@ -91,6 +100,8 @@ end
 
 function mv.shutdown() check(C.MV_ShutDown(), "MV_ShutDown") end
 function mv.barrier() check(C.MV_Barrier(), "MV_Barrier") end
+--- SSP clock tick (see c_api.h MV_Clock / the -staleness flag).
+function mv.clock() check(C.MV_Clock(), "MV_Clock") end
 function mv.num_workers() return C.MV_NumWorkers() end
 function mv.worker_id() return C.MV_WorkerId() end
 function mv.server_id() return C.MV_ServerId() end
@@ -199,6 +210,66 @@ function mv.MatrixTableHandler:add_rows(row_ids, delta, opts, k)
     check(C.MV_AddMatrixTableByRows(self.handle, buf, ids, k, self.cols),
           "MV_AddMatrixTableByRows")
   end
+end
+
+-- ------------------------------------------------------------------- KV
+
+mv.KVTableHandler = {}
+mv.KVTableHandler.__index = mv.KVTableHandler
+
+function mv.KVTableHandler:new()
+  local h = ffi.new("int32_t[1]")
+  check(C.MV_NewKVTable(h), "MV_NewKVTable")
+  return setmetatable({ handle = h[0] }, self)
+end
+
+--- get("key") -> number; absent keys read 0.
+function mv.KVTableHandler:get(key)
+  local v = ffi.new("float[1]")
+  check(C.MV_GetKV(self.handle, key, v), "MV_GetKV")
+  return v[0]
+end
+
+--- add("key", delta [, {async=true}])
+function mv.KVTableHandler:add(key, delta, opts)
+  if opts and opts.async then
+    check(C.MV_AddAsyncKV(self.handle, key, delta), "MV_AddAsyncKV")
+  else
+    check(C.MV_AddKV(self.handle, key, delta), "MV_AddKV")
+  end
+end
+
+--- Pack a Lua array of strings into (concatenated bytes, int32 lens).
+local function pack_keys(keys)
+  local blob = table.concat(keys)
+  local lens = ffi.new("int32_t[?]", #keys)
+  for i = 1, #keys do lens[i - 1] = #keys[i] end
+  return blob, lens
+end
+
+--- get_batch({"k1", "k2", ...}) -> float[n] (absent keys read 0).
+function mv.KVTableHandler:get_batch(keys)
+  local blob, lens = pack_keys(keys)
+  local vals = ffi.new("float[?]", #keys)
+  check(C.MV_GetKVBatch(self.handle, blob, lens, #keys, vals),
+        "MV_GetKVBatch")
+  return vals
+end
+
+--- add_batch({"k1", ...}, deltas): deltas is a Lua array or float[n].
+function mv.KVTableHandler:add_batch(keys, deltas)
+  local blob, lens = pack_keys(keys)
+  local buf = to_floats(deltas, #keys)
+  check(C.MV_AddKVBatch(self.handle, blob, lens, #keys, buf),
+        "MV_AddKVBatch")
+end
+
+function mv.KVTableHandler:store(path)
+  check(C.MV_StoreTable(self.handle, path), "MV_StoreTable")
+end
+
+function mv.KVTableHandler:load(path)
+  check(C.MV_LoadTable(self.handle, path), "MV_LoadTable")
 end
 
 return mv

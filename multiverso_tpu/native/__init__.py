@@ -62,8 +62,8 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_Init.argtypes = [ctypes.c_int,
                             ctypes.POINTER(ctypes.c_char_p)]
     lib.MV_Init.restype = ctypes.c_int
-    for name in ("MV_ShutDown", "MV_Barrier", "MV_NumWorkers", "MV_WorkerId",
-                 "MV_ServerId"):
+    for name in ("MV_ShutDown", "MV_Barrier", "MV_Clock", "MV_NumWorkers",
+                 "MV_WorkerId", "MV_ServerId"):
         getattr(lib, name).argtypes = []
         getattr(lib, name).restype = ctypes.c_int
     lib.MV_SetFlag.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
@@ -92,6 +92,22 @@ def load(build: bool = True) -> ctypes.CDLL:
             ctypes.c_int32, c_float_p, c_int32_p, ctypes.c_int64,
             ctypes.c_int64]
         getattr(lib, name).restype = ctypes.c_int
+    lib.MV_NewKVTable.argtypes = [ctypes.POINTER(ctypes.c_int32)]
+    lib.MV_NewKVTable.restype = ctypes.c_int
+    lib.MV_GetKV.argtypes = [ctypes.c_int32, ctypes.c_char_p, c_float_p]
+    lib.MV_GetKV.restype = ctypes.c_int
+    for name in ("MV_AddKV", "MV_AddAsyncKV"):
+        getattr(lib, name).argtypes = [ctypes.c_int32, ctypes.c_char_p,
+                                       ctypes.c_float]
+        getattr(lib, name).restype = ctypes.c_int
+    lib.MV_GetKVBatch.argtypes = [ctypes.c_int32, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_int32),
+                                  ctypes.c_int64, c_float_p]
+    lib.MV_GetKVBatch.restype = ctypes.c_int
+    lib.MV_AddKVBatch.argtypes = [ctypes.c_int32, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_int32),
+                                  ctypes.c_int64, c_float_p]
+    lib.MV_AddKVBatch.restype = ctypes.c_int
     lib.MV_SetAddOption.argtypes = [ctypes.c_float] * 4
     lib.MV_SetAddOption.restype = ctypes.c_int
     lib.MV_StoreTable.argtypes = [ctypes.c_int32, ctypes.c_char_p]
@@ -134,6 +150,10 @@ class NativeRuntime:
 
     def barrier(self) -> None:
         self._check(self.lib.MV_Barrier(), "MV_Barrier")
+
+    def clock(self) -> None:
+        """SSP tick (see MV_Clock / the -staleness flag)."""
+        self._check(self.lib.MV_Clock(), "MV_Clock")
 
     def workers_num(self) -> int:
         return self.lib.MV_NumWorkers()
@@ -207,6 +227,44 @@ class NativeRuntime:
         self._check(fn(handle, _fp(d.ravel()), _ip(ids), ids.size,
                        d.shape[1]),
                     "MV_AddMatrixTableByRows")
+
+    # ------------------------------------------------------------------ KV
+    def new_kv_table(self) -> int:
+        h = ctypes.c_int32(-1)
+        self._check(self.lib.MV_NewKVTable(ctypes.byref(h)),
+                    "MV_NewKVTable")
+        return h.value
+
+    def kv_get(self, handle: int, keys):
+        """str -> float, or list[str] -> np.ndarray (absent keys read 0)."""
+        if isinstance(keys, str):
+            v = ctypes.c_float(0.0)
+            self._check(self.lib.MV_GetKV(handle, keys.encode(),
+                                          ctypes.byref(v)), "MV_GetKV")
+            return v.value
+        enc = [k.encode() for k in keys]
+        lens = np.asarray([len(e) for e in enc], np.int32)
+        out = np.zeros(len(enc), np.float32)
+        self._check(self.lib.MV_GetKVBatch(handle, b"".join(enc),
+                                           _ip(lens), len(enc), _fp(out)),
+                    "MV_GetKVBatch")
+        return out
+
+    def kv_add(self, handle: int, keys, deltas, sync: bool = True) -> None:
+        """str+float, or list[str]+array (batch adds are blocking)."""
+        if isinstance(keys, str):
+            fn = self.lib.MV_AddKV if sync else self.lib.MV_AddAsyncKV
+            self._check(fn(handle, keys.encode(), float(deltas)),
+                        "MV_AddKV")
+            return
+        enc = [k.encode() for k in keys]
+        lens = np.asarray([len(e) for e in enc], np.int32)
+        d = _f32(deltas)
+        if d.size != len(enc):
+            raise ValueError("keys/deltas length mismatch")
+        self._check(self.lib.MV_AddKVBatch(handle, b"".join(enc),
+                                           _ip(lens), len(enc), _fp(d)),
+                    "MV_AddKVBatch")
 
     # ----------------------------------------------------------- checkpoint
     def store_table(self, handle: int, path: str) -> None:

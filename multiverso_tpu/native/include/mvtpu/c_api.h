@@ -23,6 +23,12 @@ extern "C" {
 int MV_Init(int argc, const char* const* argv);
 int MV_ShutDown();
 int MV_Barrier();
+// SSP (bounded staleness): advance this worker's clock.  With
+// `-staleness=s`, a server holds this worker's Gets while it is more
+// than s clocks ahead of the slowest worker (released as peers Clock;
+// the rpc deadline still bounds the wait).  s=0 = read-side per-clock
+// rendezvous (BSP reads without a barrier).
+int MV_Clock();
 int MV_NumWorkers();
 int MV_WorkerId();
 int MV_ServerId();
@@ -48,6 +54,17 @@ int MV_AddMatrixTableByRows(int32_t handle, const float* delta,
 int MV_AddAsyncMatrixTableByRows(int32_t handle, const float* delta,
                                  const int32_t* row_ids, int64_t num_rows,
                                  int64_t cols);
+
+// KV table (string key -> float value; SURVEY.md §2.14).  Batch calls
+// take keys as concatenated NUL-FREE bytes with per-key lengths.
+int MV_NewKVTable(int32_t* handle);
+int MV_GetKV(int32_t handle, const char* key, float* value);
+int MV_AddKV(int32_t handle, const char* key, float delta);
+int MV_AddAsyncKV(int32_t handle, const char* key, float delta);
+int MV_GetKVBatch(int32_t handle, const char* keys, const int32_t* key_lens,
+                  int64_t num_keys, float* values);
+int MV_AddKVBatch(int32_t handle, const char* keys, const int32_t* key_lens,
+                  int64_t num_keys, const float* deltas);
 
 // Per-call hyper-parameters for subsequent Add* on this thread
 // (reference AddOption-in-message).

@@ -30,6 +30,11 @@ enum class MsgType : int32_t {
   ControlReply = 17,
   ControlBarrier = 18,
   ControlBarrierReply = 19,
+  // SSP clock announcement (msg_id = the worker's new clock).  Rides
+  // each worker->server connection BEHIND that clock's adds (FIFO), so
+  // "min worker clock >= c" implies every rank's adds through clock c
+  // landed — the bounded-staleness guarantee MV_Clock documents.
+  ClockTick = 20,
   Exit = 64,
 };
 

@@ -14,6 +14,7 @@
 #include <cstdint>
 #include <memory>
 #include <mutex>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -163,6 +164,63 @@ class MatrixWorkerTable : public WorkerTable {
  private:
   int64_t rows_, cols_;
   int servers_;
+};
+
+// ------------------------------------------------------------------- KV
+// Hash-map table, string key -> float value (SURVEY.md §2.14,
+// table/kv_table.h: KVWorkerTable::{Get,Add,raw} / KVServerTable).
+// Keys shard by a FIXED hash (FNV-1a — std::hash is implementation-
+// defined and the partition contract must agree across processes).
+// Wire: keys blob = concatenated (u32 len, bytes) entries;
+//   Get  req: [keys]                 reply: [float vals, request order,
+//                                            missing keys read 0]
+//   Add  req: [AddOption][keys][float vals]
+inline uint64_t KVHash(const char* s, size_t n) {
+  uint64_t h = 1469598103934665603ull;          // FNV-1a 64
+  for (size_t i = 0; i < n; ++i) {
+    h ^= static_cast<unsigned char>(s[i]);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+Blob PackKeys(const std::vector<std::string>& keys);
+std::vector<std::string> UnpackKeys(const Blob& b);
+
+class KVServerTable : public ServerTable {
+ public:
+  explicit KVServerTable(UpdaterType updater) : updater_(updater) {}
+  void ProcessGet(const Message& req, Message* reply) override;
+  void ProcessAdd(const Message& req) override;
+  bool Store(Stream* out) const override;
+  bool Load(Stream* in) override;
+  size_t size() const;
+
+ private:
+  std::unordered_map<std::string, float> data_;
+  std::unordered_map<std::string, float> slot0_;  // stateful updaters
+  UpdaterType updater_;
+  mutable std::mutex mu_;
+};
+
+class KVWorkerTable : public WorkerTable {
+ public:
+  KVWorkerTable(int32_t table_id, int num_servers)
+      : WorkerTable(table_id), servers_(num_servers) {}
+  // vals[i] receives the value of keys[i] (0 when absent); refreshes
+  // the local cache — the reference worker's `raw` dict.
+  bool Get(const std::vector<std::string>& keys, float* vals);
+  bool Add(const std::vector<std::string>& keys, const float* deltas,
+           const AddOption& opt, bool blocking);
+  // Worker-side cache of the last Get'd values (reference `raw()`).
+  const std::unordered_map<std::string, float>& raw() const {
+    return cache_;
+  }
+
+ private:
+  int servers_;
+  std::unordered_map<std::string, float> cache_;
+  std::mutex cache_mu_;
 };
 
 }  // namespace mvtpu

@@ -61,6 +61,19 @@ class Zoo {
   // (default: infinite) expired or the barrier authority is unreachable.
   bool Barrier();
 
+  // SSP (bounded staleness, SURVEY.md §2.9-bis): advance this worker's
+  // clock and announce it to every server shard (async, FIFO behind this
+  // clock's adds).  With `-staleness=s`, a server holds a worker's Get
+  // while that worker is more than s ticks ahead of the slowest worker —
+  // s=0 degenerates to per-clock rendezvous on read (BSP reads without
+  // a full barrier); jobs that never Clock() are unaffected.
+  void Clock();
+  int64_t clock() const { return clock_; }
+  // Server side: true = the get was parked until the SSP bound allows it
+  // (the caller's handler must return without serving).
+  bool MaybeHoldGet(MessagePtr& msg);
+  void OnClockTick(int src_rank, int64_t clock);
+
   // Deliver to a LOCAL actor's mailbox.
   void SendTo(const std::string& actor_name, MessagePtr msg);
 
@@ -74,10 +87,12 @@ class Zoo {
   // ---- table registry -------------------------------------------------
   int32_t RegisterArrayTable(int64_t size);
   int32_t RegisterMatrixTable(int64_t rows, int64_t cols);
+  int32_t RegisterKVTable();
   ServerTable* server_table(int32_t id);
   WorkerTable* worker_table(int32_t id);
   ArrayWorkerTable* array_worker(int32_t id);
   MatrixWorkerTable* matrix_worker(int32_t id);
+  KVWorkerTable* kv_worker(int32_t id);
 
   UpdaterType updater_type() const { return updater_type_; }
 
@@ -140,6 +155,20 @@ class Zoo {
   bool barrier_failed_ = false;
   int64_t barrier_round_ = 0;
   std::vector<int64_t> barrier_rounds_;
+
+  // SSP state: this rank's worker clock; server-side per-rank clock
+  // vector + the gets parked until the staleness bound admits them.
+  // Parks carry a deadline (rpc_timeout_ms at park time): a dead
+  // straggler whose clock never advances must not grow held_gets_
+  // without bound, so every park/tick event purges expired entries and
+  // fails them fast with ReplyError (the caller sees rc=-3).
+  std::atomic<int64_t> clock_{0};
+  std::mutex ssp_mu_;
+  std::vector<int64_t> worker_clocks_;
+  std::vector<std::pair<int64_t, MessagePtr>> held_gets_;  // (deadline_ms,…)
+  // Under ssp_mu_: moves expired parks out for fail-fast replies.
+  void PurgeExpiredHeldLocked(std::vector<MessagePtr>* expired);
+  void FailHeldGets(std::vector<MessagePtr> expired);
 
   // Outstanding pipeline flushes (msg_id → waiter); acks notify under
   // flush_mu_ so a timed-out flush cannot race its stack waiter.

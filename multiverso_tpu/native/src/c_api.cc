@@ -34,6 +34,12 @@ int MV_Barrier() {
   return Zoo::Get()->Barrier() ? 0 : -3;  // -3: timeout / peer death
 }
 
+int MV_Clock() {
+  if (RequireStarted()) return -1;
+  Zoo::Get()->Clock();
+  return 0;
+}
+
 int MV_NumWorkers() { return Zoo::Get()->num_workers(); }
 int MV_WorkerId() { return Zoo::Get()->worker_id(); }
 int MV_ServerId() { return Zoo::Get()->server_id(); }
@@ -130,6 +136,71 @@ int MV_AddMatrixTableByRows(int32_t h, const float* d, const int32_t* ids,
 int MV_AddAsyncMatrixTableByRows(int32_t h, const float* d, const int32_t* ids,
                                  int64_t k, int64_t) {
   return AddMatrixRows(h, d, ids, k, false);
+}
+
+int MV_NewKVTable(int32_t* handle) {
+  if (RequireStarted() || !handle) return -1;
+  *handle = Zoo::Get()->RegisterKVTable();
+  return 0;
+}
+
+namespace {
+
+std::vector<std::string> SplitKeys(const char* keys, const int32_t* lens,
+                                   int64_t k) {
+  std::vector<std::string> out;
+  out.reserve(static_cast<size_t>(k));
+  const char* p = keys;
+  for (int64_t i = 0; i < k; ++i) {
+    out.emplace_back(p, static_cast<size_t>(lens[i]));
+    p += lens[i];
+  }
+  return out;
+}
+
+}  // namespace
+
+int MV_GetKV(int32_t handle, const char* key, float* value) {
+  if (RequireStarted() || !key || !value) return -1;
+  auto* t = Zoo::Get()->kv_worker(handle);
+  if (!t) return -2;
+  return t->Get({std::string(key)}, value) ? 0 : -3;
+}
+
+static int AddKV(int32_t handle, const char* key, float delta,
+                 bool blocking) {
+  if (RequireStarted() || !key) return -1;
+  auto* t = Zoo::Get()->kv_worker(handle);
+  if (!t) return -2;
+  return t->Add({std::string(key)}, &delta, g_add_option, blocking) ? 0 : -3;
+}
+
+int MV_AddKV(int32_t h, const char* key, float delta) {
+  return AddKV(h, key, delta, true);
+}
+int MV_AddAsyncKV(int32_t h, const char* key, float delta) {
+  return AddKV(h, key, delta, false);
+}
+
+int MV_GetKVBatch(int32_t handle, const char* keys, const int32_t* key_lens,
+                  int64_t num_keys, float* values) {
+  if (RequireStarted() || !keys || !key_lens || !values || num_keys < 0)
+    return -1;
+  auto* t = Zoo::Get()->kv_worker(handle);
+  if (!t) return -2;
+  return t->Get(SplitKeys(keys, key_lens, num_keys), values) ? 0 : -3;
+}
+
+int MV_AddKVBatch(int32_t handle, const char* keys, const int32_t* key_lens,
+                  int64_t num_keys, const float* deltas) {
+  if (RequireStarted() || !keys || !key_lens || !deltas || num_keys < 0)
+    return -1;
+  auto* t = Zoo::Get()->kv_worker(handle);
+  if (!t) return -2;
+  return t->Add(SplitKeys(keys, key_lens, num_keys), deltas, g_add_option,
+                true)
+             ? 0
+             : -3;
 }
 
 int MV_SetAddOption(float learning_rate, float momentum, float rho,

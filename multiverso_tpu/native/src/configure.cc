@@ -148,6 +148,11 @@ void RegisterDefaults() {
                  "dynamic registration: address peers reach this node at");
     DefineInt("port", 55555, "base port (transport parity flag)");
     DefineDouble("backup_worker_ratio", 0.0, "straggler slack (parity flag)");
+    DefineInt("staleness", 0,
+              "SSP bound: a worker's Get is held while it runs more than "
+              "this many MV_Clock() ticks ahead of the slowest worker "
+              "(0 = per-clock rendezvous on read; clocks start equal so "
+              "jobs that never call MV_Clock are unaffected)");
     DefineInt("rpc_timeout_ms", 30000,
               "blocking Get/Add deadline; <=0 waits forever");
     DefineInt("connect_retry_ms", 15000,

@@ -181,6 +181,134 @@ bool MatrixServerTable::Load(Stream* in) {
   return true;
 }
 
+// -------------------------------------------------------------------- KV
+
+Blob PackKeys(const std::vector<std::string>& keys) {
+  size_t bytes = 0;
+  for (const auto& k : keys) bytes += sizeof(uint32_t) + k.size();
+  Blob out(bytes);
+  char* p = out.As<char>();
+  for (const auto& k : keys) {
+    uint32_t n = static_cast<uint32_t>(k.size());
+    std::memcpy(p, &n, sizeof(n));
+    p += sizeof(n);
+    std::memcpy(p, k.data(), k.size());
+    p += k.size();
+  }
+  return out;
+}
+
+std::vector<std::string> UnpackKeys(const Blob& b) {
+  std::vector<std::string> keys;
+  const char* p = b.As<char>();
+  size_t left = b.size();
+  while (left >= sizeof(uint32_t)) {
+    uint32_t n;
+    std::memcpy(&n, p, sizeof(n));
+    p += sizeof(n);
+    left -= sizeof(n);
+    if (n > left) break;  // truncated frame: stop, don't overread
+    keys.emplace_back(p, n);
+    p += n;
+    left -= n;
+  }
+  return keys;
+}
+
+void KVServerTable::ProcessGet(const Message& req, Message* reply) {
+  Monitor mon("KVServer::ProcessGet");
+  if (req.data.empty()) return;
+  auto keys = UnpackKeys(req.data[0]);
+  Blob out(keys.size() * sizeof(float));
+  float* vals = out.As<float>();
+  std::lock_guard<std::mutex> lk(mu_);
+  for (size_t i = 0; i < keys.size(); ++i) {
+    auto it = data_.find(keys[i]);
+    vals[i] = it == data_.end() ? 0.0f : it->second;
+  }
+  reply->data.push_back(std::move(out));
+}
+
+void KVServerTable::ProcessAdd(const Message& req) {
+  Monitor mon("KVServer::ProcessAdd");
+  if (req.data.size() < 3) return;
+  const AddOption* opt = req.data[0].As<AddOption>();
+  auto keys = UnpackKeys(req.data[1]);
+  const float* deltas = req.data[2].As<float>();
+  if (req.data[2].count<float>() < keys.size()) {
+    Log::Error("KVServerTable: %zu keys but %zu deltas", keys.size(),
+               req.data[2].count<float>());
+    return;
+  }
+  bool stateful = NumSlots(updater_) > 0;
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!stateful) {
+    for (size_t i = 0; i < keys.size(); ++i)
+      ApplyUpdate(updater_, *opt, &data_[keys[i]], nullptr, deltas + i, 1);
+    return;
+  }
+  // Pre-aggregate duplicate keys so stateful updaters see one delta per
+  // key (the same contract as the matrix row path / the JAX plane).
+  std::unordered_map<std::string, float> agg;
+  for (size_t i = 0; i < keys.size(); ++i) agg[keys[i]] += deltas[i];
+  for (auto& kv : agg)
+    ApplyUpdate(updater_, *opt, &data_[kv.first], &slot0_[kv.first],
+                &kv.second, 1);
+}
+
+size_t KVServerTable::size() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return data_.size();
+}
+
+bool KVServerTable::Store(Stream* out) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t n = static_cast<int64_t>(data_.size());
+  int8_t has_slots = slot0_.empty() ? 0 : 1;
+  if (out->Write(&n, sizeof(n)) != sizeof(n) ||
+      out->Write(&has_slots, 1) != 1)
+    return false;
+  for (const auto& kv : data_) {
+    uint32_t len = static_cast<uint32_t>(kv.first.size());
+    float slot = 0.0f;
+    if (has_slots) {
+      auto it = slot0_.find(kv.first);
+      if (it != slot0_.end()) slot = it->second;
+    }
+    if (out->Write(&len, sizeof(len)) != sizeof(len) ||
+        out->Write(kv.first.data(), len) != len ||
+        out->Write(&kv.second, sizeof(float)) != sizeof(float) ||
+        (has_slots &&
+         out->Write(&slot, sizeof(float)) != sizeof(float)))
+      return false;
+  }
+  return true;
+}
+
+bool KVServerTable::Load(Stream* in) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t n = 0;
+  int8_t has_slots = 0;
+  if (in->Read(&n, sizeof(n)) != sizeof(n) ||
+      in->Read(&has_slots, 1) != 1 || n < 0)
+    return false;
+  data_.clear();
+  slot0_.clear();
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t len = 0;
+    if (in->Read(&len, sizeof(len)) != sizeof(len)) return false;
+    std::string key(len, '\0');
+    float val = 0.0f, slot = 0.0f;
+    if (in->Read(&key[0], len) != len ||
+        in->Read(&val, sizeof(float)) != sizeof(float) ||
+        (has_slots && in->Read(&slot, sizeof(float)) != sizeof(float)))
+      return false;
+    data_[key] = val;
+    if (has_slots) slot0_[key] = slot;
+  }
+  return true;
+}
+
 // ---------------------------------------------------------------- worker
 
 void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
@@ -424,6 +552,93 @@ bool MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
                            per_rank_ids[r].size() * sizeof(int32_t));
     req->data.emplace_back(per_rank_delta[r].data(),
                            per_rank_delta[r].size() * sizeof(float));
+    reqs.push_back(std::move(req));
+  }
+  if (reqs.empty()) return true;
+  if (blocking)
+    return RoundTrip(std::move(reqs), DiscardReply, nullptr);
+  for (auto& req : reqs)
+    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+  return true;
+}
+
+// -------------------------------------------------------------- KV worker
+
+namespace {
+
+// Scatter KV get replies: positions[shard] lists the caller-order slots
+// that shard's reply values fill (request order within the shard).
+struct KVDest {
+  float* vals;
+  const std::vector<std::vector<int64_t>>* positions;
+};
+
+void ScatterKVReply(void* arg, const Message& reply) {
+  auto* d = static_cast<KVDest*>(arg);
+  if (reply.data.empty()) return;
+  int shard = Zoo::Get()->server_index(reply.src);
+  if (shard < 0) return;
+  const auto& pos = (*d->positions)[static_cast<size_t>(shard)];
+  const float* src = reply.data[0].As<float>();
+  size_t have = reply.data[0].count<float>();
+  for (size_t i = 0; i < pos.size() && i < have; ++i)
+    d->vals[pos[i]] = src[i];
+}
+
+}  // namespace
+
+bool KVWorkerTable::Get(const std::vector<std::string>& keys, float* vals) {
+  Monitor mon("KVWorker::Get");
+  std::vector<std::vector<std::string>> per_rank(servers_);
+  std::vector<std::vector<int64_t>> positions(servers_);
+  for (size_t i = 0; i < keys.size(); ++i) {
+    int owner = static_cast<int>(
+        KVHash(keys[i].data(), keys[i].size()) %
+        static_cast<uint64_t>(servers_));
+    per_rank[owner].push_back(keys[i]);
+    positions[owner].push_back(static_cast<int64_t>(i));
+  }
+  std::memset(vals, 0, keys.size() * sizeof(float));
+  int64_t msg_id = Zoo::Get()->NextMsgId();
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r) {
+    if (per_rank[r].empty()) continue;
+    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r);
+    req->data.push_back(PackKeys(per_rank[r]));
+    reqs.push_back(std::move(req));
+  }
+  KVDest d{vals, &positions};
+  bool ok = reqs.empty() || RoundTrip(std::move(reqs), ScatterKVReply, &d);
+  if (ok) {
+    // Refresh the worker-side dict (the reference KVWorkerTable `raw`).
+    std::lock_guard<std::mutex> lk(cache_mu_);
+    for (size_t i = 0; i < keys.size(); ++i) cache_[keys[i]] = vals[i];
+  }
+  return ok;
+}
+
+bool KVWorkerTable::Add(const std::vector<std::string>& keys,
+                        const float* deltas, const AddOption& opt,
+                        bool blocking) {
+  Monitor mon("KVWorker::Add");
+  std::vector<std::vector<std::string>> per_rank(servers_);
+  std::vector<std::vector<float>> per_vals(servers_);
+  for (size_t i = 0; i < keys.size(); ++i) {
+    int owner = static_cast<int>(
+        KVHash(keys[i].data(), keys[i].size()) %
+        static_cast<uint64_t>(servers_));
+    per_rank[owner].push_back(keys[i]);
+    per_vals[owner].push_back(deltas[i]);
+  }
+  int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r) {
+    if (per_rank[r].empty()) continue;
+    auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
+    req->data.emplace_back(&opt, sizeof(opt));
+    req->data.push_back(PackKeys(per_rank[r]));
+    req->data.emplace_back(per_vals[r].data(),
+                           per_vals[r].size() * sizeof(float));
     reqs.push_back(std::move(req));
   }
   if (reqs.empty()) return true;

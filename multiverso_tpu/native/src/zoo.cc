@@ -1,5 +1,7 @@
 #include "mvtpu/zoo.h"
 
+#include <chrono>
+
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/log.h"
@@ -23,6 +25,11 @@ class WorkerActor : public Actor {
       Zoo::Get()->Deliver(actor::kServer, std::move(m));
     });
     RegisterHandler(MsgType::RequestFlush, [](MessagePtr& m) {
+      Zoo::Get()->Deliver(actor::kServer, std::move(m));
+    });
+    RegisterHandler(MsgType::ClockTick, [](MessagePtr& m) {
+      // Outbound SSP tick: same worker->server leg as Get/Add, so the
+      // per-connection FIFO keeps it behind this clock's adds.
       Zoo::Get()->Deliver(actor::kServer, std::move(m));
     });
     RegisterHandler(MsgType::ReplyFlush, [](MessagePtr& m) {
@@ -56,6 +63,9 @@ class ServerActor : public Actor {
                    m->table_id);
         return;
       }
+      // SSP: park the get while its sender runs too far ahead of the
+      // slowest worker; OnClockTick re-delivers it here when admitted.
+      if (Zoo::Get()->MaybeHoldGet(m)) return;
       auto reply = std::make_unique<Message>();
       reply->type = MsgType::ReplyGet;
       reply->table_id = m->table_id;
@@ -64,6 +74,9 @@ class ServerActor : public Actor {
       reply->dst = m->src;
       table->ProcessGet(*m, reply.get());
       Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
+    });
+    RegisterHandler(MsgType::ClockTick, [](MessagePtr& m) {
+      Zoo::Get()->OnClockTick(m->src, m->msg_id);
     });
     RegisterHandler(MsgType::RequestAdd, [](MessagePtr& m) {
       auto* table = Zoo::Get()->server_table(m->table_id);
@@ -384,6 +397,103 @@ void Zoo::OnBarrierRelease(int64_t round) {
   if (barrier_waiter_) barrier_waiter_->Notify();
 }
 
+void Zoo::Clock() {
+  int64_t c = ++clock_;
+  // Announce to every server shard, async.  Per-connection FIFO puts the
+  // tick BEHIND this clock's adds on the same connection, which is what
+  // makes "min worker clock >= c" mean those adds are applied.
+  for (int s = 0; s < num_servers(); ++s) {
+    auto msg = std::make_unique<Message>();
+    msg->type = MsgType::ClockTick;
+    msg->msg_id = c;
+    msg->src = rank_;
+    msg->dst = server_rank(s);
+    SendTo(actor::kWorker, std::move(msg));
+  }
+}
+
+static int64_t NowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void Zoo::PurgeExpiredHeldLocked(std::vector<MessagePtr>* expired) {
+  int64_t now = NowMs();
+  auto keep = held_gets_.begin();
+  for (auto& [deadline, m] : held_gets_) {
+    if (deadline > 0 && now >= deadline)
+      expired->push_back(std::move(m));
+    else
+      *keep++ = {deadline, std::move(m)};
+  }
+  held_gets_.erase(keep, held_gets_.end());
+}
+
+void Zoo::FailHeldGets(std::vector<MessagePtr> expired) {
+  // A dead straggler's clock may never advance: fail the parked get
+  // fast (the caller's RoundTrip sees ReplyError -> rc=-3) instead of
+  // leaking it — the SSP analog of Deliver's dead-peer synthesis.
+  for (auto& m : expired) {
+    Log::Error("SSP: held get from rank %d expired (straggler stuck?)",
+               m->src);
+    auto err = std::make_unique<Message>();
+    err->type = MsgType::ReplyError;
+    err->table_id = m->table_id;
+    err->msg_id = m->msg_id;
+    err->src = rank_;
+    err->dst = m->src;
+    Deliver(actor::kWorker, std::move(err));
+  }
+}
+
+bool Zoo::MaybeHoldGet(MessagePtr& msg) {
+  int64_t s = configure::GetInt("staleness");
+  std::vector<MessagePtr> expired;
+  bool held = false;
+  {
+    std::lock_guard<std::mutex> lk(ssp_mu_);
+    PurgeExpiredHeldLocked(&expired);
+    if (worker_clocks_.size() != static_cast<size_t>(size_))
+      worker_clocks_.assign(size_, 0);
+    if (msg->src >= 0 && msg->src < size_) {
+      int64_t mine = worker_clocks_[msg->src];
+      int64_t slowest = mine;
+      for (int r : worker_ranks_)
+        slowest = std::min(slowest, worker_clocks_[r]);
+      if (mine - slowest > s) {
+        int64_t t = configure::GetInt("rpc_timeout_ms");
+        held_gets_.emplace_back(t > 0 ? NowMs() + t : 0, std::move(msg));
+        held = true;
+      }
+    }
+  }
+  FailHeldGets(std::move(expired));
+  return held;
+}
+
+void Zoo::OnClockTick(int src_rank, int64_t clock) {
+  std::vector<MessagePtr> admit;
+  std::vector<MessagePtr> expired;
+  {
+    std::lock_guard<std::mutex> lk(ssp_mu_);
+    PurgeExpiredHeldLocked(&expired);
+    if (worker_clocks_.size() != static_cast<size_t>(size_))
+      worker_clocks_.assign(size_, 0);
+    if (src_rank >= 0 && src_rank < size_) {
+      worker_clocks_[src_rank] =
+          std::max(worker_clocks_[src_rank], clock);
+      // Release every parked get the new bound admits: re-deliver
+      // through the server mailbox so the normal handler (and
+      // MaybeHoldGet) rerun.
+      for (auto& [deadline, m] : held_gets_) admit.push_back(std::move(m));
+      held_gets_.clear();
+    }
+  }
+  FailHeldGets(std::move(expired));
+  for (auto& m : admit) SendTo(actor::kServer, std::move(m));
+}
+
 void Zoo::SetRoles(const std::vector<int>& roles) {
   worker_ranks_.clear();
   server_ranks_.clear();
@@ -465,6 +575,7 @@ void Zoo::RouteInbound(Message&& m) {
     case MsgType::RequestGet:
     case MsgType::RequestAdd:
     case MsgType::RequestFlush:
+    case MsgType::ClockTick:
       SendTo(actor::kServer, std::move(msg));
       break;
     case MsgType::ReplyGet:
@@ -510,6 +621,18 @@ int32_t Zoo::RegisterMatrixTable(int64_t rows, int64_t cols) {
   return id;
 }
 
+int32_t Zoo::RegisterKVTable() {
+  std::lock_guard<std::mutex> lk(tables_mu_);
+  int32_t id = static_cast<int32_t>(server_tables_.size());
+  int sid = server_id();
+  server_tables_.push_back(
+      sid < 0 ? nullptr
+              : std::make_unique<KVServerTable>(updater_type_));
+  worker_tables_.push_back(
+      std::make_unique<KVWorkerTable>(id, num_servers()));
+  return id;
+}
+
 ServerTable* Zoo::server_table(int32_t id) {
   std::lock_guard<std::mutex> lk(tables_mu_);
   return (id >= 0 && id < static_cast<int32_t>(server_tables_.size()))
@@ -530,6 +653,10 @@ ArrayWorkerTable* Zoo::array_worker(int32_t id) {
 
 MatrixWorkerTable* Zoo::matrix_worker(int32_t id) {
   return dynamic_cast<MatrixWorkerTable*>(worker_table(id));
+}
+
+KVWorkerTable* Zoo::kv_worker(int32_t id) {
+  return dynamic_cast<KVWorkerTable*>(worker_table(id));
 }
 
 }  // namespace mvtpu

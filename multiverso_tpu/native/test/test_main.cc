@@ -175,6 +175,39 @@ static int TestCheckpoint() {
   return 0;
 }
 
+static int TestKV() {
+  // Single-process KV round trips: singles, batch (with a duplicate key
+  // summing), absent-key zero reads, and a checkpoint round trip.
+  int32_t h;
+  CHECK(MV_NewKVTable(&h) == 0);
+  float v = -1.0f;
+  CHECK(MV_GetKV(h, "absent", &v) == 0);
+  CHECK(v == 0.0f);
+  CHECK(MV_AddKV(h, "alpha", 2.5f) == 0);
+  CHECK(MV_AddAsyncKV(h, "alpha", 0.5f) == 0);
+  CHECK(MV_Barrier() == 0);  // flush the async add
+  CHECK(MV_GetKV(h, "alpha", &v) == 0);
+  CHECK(v == 3.0f);
+  // Batch: "bee"+"bee" duplicate must compose to the sum, "sea" lands.
+  const char keys[] = "beebeesea";
+  int32_t lens[3] = {3, 3, 3};
+  float deltas[3] = {1.0f, 2.0f, 4.0f};
+  CHECK(MV_AddKVBatch(h, keys, lens, 3, deltas) == 0);
+  float vals[3] = {-1, -1, -1};
+  CHECK(MV_GetKVBatch(h, keys, lens, 3, vals) == 0);
+  CHECK(vals[0] == 3.0f && vals[1] == 3.0f && vals[2] == 4.0f);
+  // Checkpoint: mutate after store, load must restore the snapshot.
+  const char* path = "/tmp/mvtpu_native_kv_ck.bin";
+  CHECK(MV_StoreTable(h, path) == 0);
+  CHECK(MV_AddKV(h, "alpha", 10.0f) == 0);
+  CHECK(MV_LoadTable(h, path) == 0);
+  CHECK(MV_GetKV(h, "alpha", &v) == 0);
+  CHECK(v == 3.0f);
+  CHECK(MV_GetKV(h, "sea", &v) == 0);
+  CHECK(v == 4.0f);
+  return 0;
+}
+
 static int TestThreads() {
   // Concurrent blocking adds from many app threads — the actor pipeline
   // must serialize them without loss (reference MtQueue/actor guarantee).
@@ -248,6 +281,27 @@ static int NetChild(const char* machine_file, const char* rank) {
     std::vector<float> rout(8, -1.0f);
     CHECK(MV_GetMatrixTableByRows(hm, rout.data(), qrows, 2, 4) == 0);
     for (float v : rout) CHECK(v == (float)(r + 1));
+  }
+
+  // KV cross-rank: every rank adds (rank+1) under a SHARED key (entries
+  // hash-shard, so whichever rank owns it sees remote adds) plus its own
+  // key; after the barrier every rank reads the merged map.
+  int32_t hk;
+  CHECK(MV_NewKVTable(&hk) == 0);
+  CHECK(MV_Barrier() == 0);  // every rank registered the table
+  char own_key[16];
+  snprintf(own_key, sizeof(own_key), "rank_%d", me);
+  CHECK(MV_AddKV(hk, "shared", (float)(me + 1)) == 0);
+  CHECK(MV_AddAsyncKV(hk, own_key, 100.0f + me) == 0);
+  CHECK(MV_Barrier() == 0);  // async adds flushed, all ranks landed
+  float kv = -1.0f;
+  CHECK(MV_GetKV(hk, "shared", &kv) == 0);
+  CHECK(kv == total);
+  for (int r = 0; r < n; ++r) {
+    char qk[16];
+    snprintf(qk, sizeof(qk), "rank_%d", r);
+    CHECK(MV_GetKV(hk, qk, &kv) == 0);
+    CHECK(kv == 100.0f + r);
   }
 
   CHECK(MV_Barrier() == 0);
@@ -449,6 +503,98 @@ static int RegisterChild(const char* ctrl, const char* port,
   return 0;
 }
 
+static int SspChild(const char* machine_file, const char* rank,
+                    const char* staleness) {
+  // SSP scenario (SURVEY.md §2.9-bis, -staleness + MV_Clock): rank 0
+  // races ahead while rank 1 lags ~1.5 s.  With s=1 the first fast-rank
+  // Get OVERLAPS the straggler (admitted, no wait); one more clock and
+  // the bound binds (held until the straggler's tick).  With s=0 every
+  // ahead-Get is held — and the released read must include the
+  // straggler's clock adds (ticks ride the connection BEHIND the adds),
+  // which is exactly the BSP read guarantee.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  std::string st = std::string("-staleness=") + staleness;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), st.c_str(),
+                         "-updater_type=default", "-log_level=error",
+                         "-rpc_timeout_ms=20000",
+                         "-barrier_timeout_ms=20000"};
+  CHECK(MV_Init(7, argv2) == 0);
+  int me = MV_WorkerId();
+  int s = atoi(staleness);
+  int32_t h;
+  CHECK(MV_NewArrayTable(4, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+
+  if (me == 1) {
+    // The straggler: adds for its clock 1, then ticks, 1.5 s late.
+    std::this_thread::sleep_for(std::chrono::milliseconds(1500));
+    std::vector<float> twos(4, 2.0f);
+    CHECK(MV_AddAsyncArrayTable(h, twos.data(), 4) == 0);
+    CHECK(MV_Clock() == 0);
+  } else {
+    auto t0 = std::chrono::steady_clock::now();
+    std::vector<float> ones(4, 1.0f), out(4, -1.0f);
+    CHECK(MV_AddArrayTable(h, ones.data(), 4) == 0);
+    CHECK(MV_Clock() == 0);  // clock 1
+    CHECK(MV_GetArrayTable(h, out.data(), 4) == 0);
+    auto ms1 = std::chrono::duration_cast<std::chrono::milliseconds>(
+                   std::chrono::steady_clock::now() - t0)
+                   .count();
+    if (s >= 1) {
+      // Overlap: admitted while 1 - 0 <= s, no straggler wait.
+      CHECK(ms1 < 1000);
+      CHECK(MV_Clock() == 0);  // clock 2: now 2 - 0 > s — must hold
+      CHECK(MV_GetArrayTable(h, out.data(), 4) == 0);
+    }
+    // (s=0: the first Get itself was the held one.)
+    auto ms2 = std::chrono::duration_cast<std::chrono::milliseconds>(
+                   std::chrono::steady_clock::now() - t0)
+                   .count();
+    CHECK(ms2 >= 1200);  // held until the straggler's tick
+    // Released read includes the straggler's clock-1 adds (BSP read).
+    for (float v : out) CHECK(v == 3.0f);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("SSP_OK %d s=%d\n", me, s);
+  return 0;
+}
+
+static int SspDeadChild(const char* machine_file, const char* rank) {
+  // SSP + dead straggler: rank 1 rendezvouses then crashes without ever
+  // ticking.  Rank 0 races ahead; its held Gets must fail fast (rc=-3,
+  // bounded by -rpc_timeout_ms) and repeated attempts must keep failing
+  // fast — each park purges the previous expired one (no unbounded
+  // held_gets_ growth, no hang).
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-staleness=0",
+                         "-updater_type=default", "-log_level=error",
+                         "-connect_retry_ms=500", "-rpc_timeout_ms=2000",
+                         "-barrier_timeout_ms=2000"};
+  CHECK(MV_Init(8, argv2) == 0);
+  int me = MV_WorkerId();
+  int32_t h;
+  CHECK(MV_NewArrayTable(4, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+  if (me == 1) _exit(0);  // crash before any MV_Clock
+
+  std::this_thread::sleep_for(std::chrono::milliseconds(300));
+  CHECK(MV_Clock() == 0);  // now ahead of the dead rank 1 forever
+  auto t0 = std::chrono::steady_clock::now();
+  std::vector<float> out(4, 0.0f);
+  CHECK(MV_GetArrayTable(h, out.data(), 4) == -3);
+  CHECK(MV_GetArrayTable(h, out.data(), 4) == -3);  // retry also bounded
+  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
+  CHECK(ms < 15000);
+  CHECK(MV_ShutDown() == 0);
+  printf("SSP_DEAD_OK\n");
+  return 0;
+}
+
 // Scenario children: a CHECK failure returns without MV_ShutDown, and
 // live runtime threads then crash during normal process exit (rc=-11),
 // masking the CHECK diagnostic — _exit skips teardown and keeps rc=1.
@@ -467,6 +613,10 @@ int main(int argc, char** argv) {
   if (argc == 7 && std::string(argv[1]) == "register")
     return ScenarioExit(
         RegisterChild(argv[2], argv[3], argv[4], argv[5], argv[6]));
+  if (argc == 5 && std::string(argv[1]) == "ssp_child")
+    return ScenarioExit(SspChild(argv[2], argv[3], argv[4]));
+  if (argc == 4 && std::string(argv[1]) == "ssp_dead")
+    return ScenarioExit(SspDeadChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "dead_peer")
     return ScenarioExit(DeadPeerChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "dead_server")
@@ -481,7 +631,7 @@ int main(int argc, char** argv) {
       {"configure", TestConfigure}, {"message", TestMessage},
       {"updater", TestUpdater},   {"array", TestArray},
       {"matrix", TestMatrix},     {"checkpoint", TestCheckpoint},
-      {"threads", TestThreads},
+      {"kv", TestKV},             {"threads", TestThreads},
   };
   int failures = 0;
   std::string only = argc > 1 ? argv[1] : "";

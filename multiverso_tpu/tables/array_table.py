@@ -110,12 +110,17 @@ class ArrayTable(Table):
     def flush(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, {}
-        for option, delta in pending.items():
-            self._apply_now(delta, option)
+
+        def apply(pending=pending):
+            for option, delta in pending.items():
+                self._apply_now(delta, option)
+
+        self._ssp_defer(apply if pending else None)
 
     def discard_pending(self) -> None:
         with self._lock:
             self._pending = {}
+            self._stale_queue = []
 
     def _apply_now(self, delta: np.ndarray, option: Optional[AddOption]) -> None:
         self._apply_dense_padded(delta, option)

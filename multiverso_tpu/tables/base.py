@@ -153,7 +153,8 @@ class Table:
     def __init__(self, name: Optional[str] = None,
                  updater_type: Optional[str] = None,
                  sync: Optional[bool] = None,
-                 default_option: Optional[AddOption] = None):
+                 default_option: Optional[AddOption] = None,
+                 staleness: int = 0):
         ctx = core_context.get_context()
         self._ctx = ctx
         if updater_type is None:
@@ -161,6 +162,16 @@ class Table:
         self.updater = get_updater(updater_type)
         self.updater_type = updater_type
         self.sync = ctx.sync if sync is None else bool(sync)
+        self.staleness = int(staleness)
+        if self.staleness < 0:
+            raise ValueError(f"staleness must be >= 0, got {staleness}")
+        if self.staleness and not self.sync:
+            raise ValueError(
+                "staleness (SSP) requires a sync=True table — ASP has no "
+                "clock to be stale against")
+        # SSP deferral queue: (clock, apply_fn) flushes waiting out their
+        # staleness bound (see _ssp_defer).
+        self._stale_queue: list = []
         self.default_option = default_option or AddOption()
         self.table_id = ctx.register_table(self)
         self.name = name or f"{self.kind}_{self.table_id}"
@@ -446,6 +457,37 @@ class Table:
             self._dense_cache.clear()
 
     # -- BSP clock boundary --------------------------------------------------
+    def _ssp_defer(self, apply_fn=None) -> None:
+        """SSP clock-lag (SURVEY.md §2.9-bis, the SPMD semantic mapping).
+
+        BSP (``staleness=0``): ``apply_fn`` runs now — the flush applies
+        at its own barrier.  SSP (``staleness=s``): the apply waits out
+        ``s`` further barriers, so a Get at clock *t* is guaranteed all
+        adds from clocks ≤ t-1-s (the SSP reader bound) while the last
+        *s* clocks' adds may still be pending — the lockstep analog of
+        the native plane's per-rank clock vector (``-staleness`` +
+        ``MV_Clock``; there stragglers are real, here every rank defers
+        identically so the collective applies stay in lockstep).
+
+        Called by each table's ``flush()`` with the pending snapshot
+        closed over; the queue is clock-tagged with the barrier that
+        buffered it.
+        """
+        if not self.staleness:
+            if apply_fn is not None:
+                apply_fn()
+            return
+        if apply_fn is not None:
+            self._stale_queue.append((self._ctx.clock, apply_fn))
+        # Drain on EVERY flush (apply_fn=None = nothing new this clock) —
+        # an idle clock must still release the backlog it matured.
+        ready = [(c, f) for c, f in self._stale_queue
+                 if self._ctx.clock - c >= self.staleness]
+        self._stale_queue = [(c, f) for c, f in self._stale_queue
+                             if self._ctx.clock - c < self.staleness]
+        for _, f in sorted(ready, key=lambda cf: cf[0]):
+            f()
+
     def flush(self) -> None:
         """Apply buffered (sync-mode) adds; called by ``barrier()``."""
         raise NotImplementedError

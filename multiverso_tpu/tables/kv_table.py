@@ -54,10 +54,18 @@ class KVTable(Table):
     kind = "kv"
 
     def __init__(self, value_shape: Tuple[int, ...] = (), dtype=np.float32,
-                 **kw):
+                 coalesce: bool = False, **kw):
+        """``coalesce=True``: eager (ASP) adds buffer locally and merge
+        into ONE collective at the next ``barrier()`` instead of paying a
+        pickle-allgather per call — the knob for hot-loop KV use under
+        multi-host.  Trades read-your-own-writes (the store, and peers,
+        see the adds at the barrier).  No-op semantics change under a
+        single controller beyond the barrier-visible timing.
+        """
         super().__init__(**kw)
         self.value_shape = tuple(value_shape)
         self.dtype = np.dtype(dtype)
+        self.coalesce = bool(coalesce)
         self._store: Dict[Any, np.ndarray] = {}
         self._state: Dict[Any, List[np.ndarray]] = {}
         self._cache: Dict[Any, np.ndarray] = {}
@@ -87,15 +95,33 @@ class KVTable(Table):
         with self._monitor("Add"):
             ups = {k: np.asarray(v, dtype=self.dtype)
                    for k, v in updates.items()}
-            if self.sync:
+            if self.sync or self.coalesce:
+                # BSP buffering, or coalesce=True batching eager adds
+                # into the per-barrier collective.
                 with self._lock:
                     self._pending.append((ups, option))
                 return
             self._apply_now(ups, option)
 
+    def add_many(self, updates_list,
+                 option: Optional[AddOption] = None) -> None:
+        """Batch API: N update dicts, ONE apply (and under multi-host ONE
+        pickle-allgather instead of N) — the explicit alternative to
+        ``coalesce=True`` for callers that batch naturally."""
+        with self._monitor("AddMany"):
+            merged: Dict[Any, np.ndarray] = {}
+            for ups in updates_list:
+                for k, v in ups.items():
+                    v = np.asarray(v, dtype=self.dtype)
+                    merged[k] = merged[k] + v if k in merged else v.copy()
+            if not merged:
+                return
+            self.add(merged, option=option)
+
     def discard_pending(self) -> None:
         with self._lock:
             self._pending = []
+            self._stale_queue = []
 
     def flush(self) -> None:
         from .base import is_multiprocess
@@ -112,15 +138,26 @@ class KVTable(Table):
                     bucket[k] = bucket[k] + v
                 else:
                     bucket[k] = v.copy()
-        if is_multiprocess():
-            # ONE collective for the whole flush, entered by every rank
-            # even with nothing pending (a rank that early-returned while
-            # peers allgathered would deadlock the job), carrying the
-            # (option, ups) buckets so ranks whose clocks used different
-            # AddOptions still merge per matching option.
-            merged = self._multihost_merge_buckets(merged)
-        for option, ups in merged.items():
-            self._apply_local(ups, option)
+
+        def apply(merged=merged):
+            m = merged
+            if is_multiprocess():
+                # ONE collective for the whole flush, entered by every
+                # rank even with nothing pending (a rank that
+                # early-returned while peers allgathered would deadlock
+                # the job), carrying the (option, ups) buckets so ranks
+                # whose clocks used different AddOptions still merge per
+                # matching option.
+                m = self._multihost_merge_buckets(m)
+            for option, ups in m.items():
+                self._apply_local(ups, option)
+
+        # NOTE the multi-host lockstep contract: the merge collective runs
+        # inside the (possibly SSP-deferred) apply, and clocks advance in
+        # lockstep, so every rank defers and enters it at the same barrier.
+        # Unlike the dense tables, an empty flush must still apply (the
+        # allgather is unconditional), so no empty-skip here.
+        self._ssp_defer(apply)
 
     def _allgather_payload(self, payload: Any) -> List[Any]:
         """Pickle → byte-allgather → unpickle per rank (one collective).

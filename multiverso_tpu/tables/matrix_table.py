@@ -169,21 +169,26 @@ class MatrixTable(Table):
         with self._lock:
             dense, self._pending_dense = self._pending_dense, {}
             sparse, self._pending_sparse = self._pending_sparse, []
-        by_opt: Dict[Optional[AddOption],
-                     List[Tuple[np.ndarray, np.ndarray]]] = {}
-        for rows, deltas, option in sparse:
-            by_opt.setdefault(option, []).append((rows, deltas))
-        for option, batches in by_opt.items():
-            rows = np.concatenate([r for r, _ in batches])
-            deltas = np.concatenate([d for _, d in batches])
-            self._apply_rows_now(rows, deltas, option)
-        for option, delta in dense.items():
-            self._apply_dense_now(delta, option)
+
+        def apply(dense=dense, sparse=sparse):
+            by_opt: Dict[Optional[AddOption],
+                         List[Tuple[np.ndarray, np.ndarray]]] = {}
+            for rows, deltas, option in sparse:
+                by_opt.setdefault(option, []).append((rows, deltas))
+            for option, batches in by_opt.items():
+                rows = np.concatenate([r for r, _ in batches])
+                deltas = np.concatenate([d for _, d in batches])
+                self._apply_rows_now(rows, deltas, option)
+            for option, delta in dense.items():
+                self._apply_dense_now(delta, option)
+
+        self._ssp_defer(apply if (dense or sparse) else None)
 
     def discard_pending(self) -> None:
         with self._lock:
             self._pending_dense = {}
             self._pending_sparse = []
+            self._stale_queue = []
 
     # ----------------------------------------------------------- internals
     def _multihost_union(self, uniq: np.ndarray, agg: np.ndarray):

@@ -114,6 +114,44 @@ np.testing.assert_allclose(ts.get(), 0.0)             # invisible pre-barrier
 mv.barrier()
 np.testing.assert_allclose(ts.get(), float(total))
 
+# --- SSP: staleness=1 defers the merged apply one clock, in lockstep -------
+# (the SPMD mapping of bounded staleness: every rank defers identically,
+# so the flush collective still runs at the same barrier on all ranks)
+tssp = mv.ArrayTable(4, name="mp_ssp", sync=True, staleness=1)
+tssp.add(np.ones(4, np.float32) * (pid + 1))
+mv.barrier()                                   # s=1 overlap: still stale
+np.testing.assert_allclose(tssp.get(), 0.0)
+mv.barrier()                                   # matured: all ranks' adds
+np.testing.assert_allclose(tssp.get(), float(total))
+# s=0 table on the same clocks behaves exactly like BSP
+tssp0 = mv.ArrayTable(4, name="mp_ssp0", sync=True, staleness=0)
+tssp0.add(np.ones(4, np.float32) * (pid + 1))
+mv.barrier()
+np.testing.assert_allclose(tssp0.get(), float(total))
+
+# --- KV coalesce: N eager adds -> ONE allgather at the barrier -------------
+kvc = mv.KVTable(name="mp_kvc", coalesce=True)
+_collectives = {"n": 0}
+_orig_allgather = kvc._allgather_payload
+def _counting_allgather(payload):
+    _collectives["n"] += 1
+    return _orig_allgather(payload)
+kvc._allgather_payload = _counting_allgather
+for i in range(5):                        # 5 eager adds, zero collectives
+    kvc.add({f"c{pid}": 1.0, "tot": 1.0})
+assert _collectives["n"] == 0, _collectives
+mv.barrier()                              # ONE merged collective
+assert _collectives["n"] == 1, _collectives
+gc = kvc.get(["tot"] + [f"c{r}" for r in range(nprocs)])
+np.testing.assert_allclose(gc["tot"], 5.0 * nprocs)
+for r in range(nprocs):
+    np.testing.assert_allclose(gc[f"c{r}"], 5.0)
+# Scratch tables out of the registry (also keeps the checkpoint below
+# restorable by the parent test, which re-creates only the core tables).
+tssp.close()
+tssp0.close()
+kvc.close()
+
 # --- checkpoint: collective store, rank-0 write, collective restore --------
 path = os.path.join(scratch, "mp.ckpt")
 checkpoint.save(path, extra={"step": 7})

@@ -76,9 +76,30 @@ def test_native_checkpoint(native, tmp_path):
     np.testing.assert_allclose(native.array_get(h, 8), 7.0)
 
 
+def test_native_kv_roundtrip(native, tmp_path):
+    """KV table through ctypes: singles, async, batch with duplicate keys,
+    absent-key zeros, checkpoint (SURVEY.md §2.14)."""
+    h = native.new_kv_table()
+    assert native.kv_get(h, "nope") == 0.0
+    native.kv_add(h, "alpha", 2.0)
+    native.kv_add(h, "alpha", 0.5, sync=False)
+    native.barrier()  # flush the async add
+    assert native.kv_get(h, "alpha") == 2.5
+    native.kv_add(h, ["b", "c", "b"], [1.0, 4.0, 2.0])
+    np.testing.assert_allclose(native.kv_get(h, ["b", "c", "alpha"]),
+                               [3.0, 4.0, 2.5])
+    p = str(tmp_path / "kv.bin")
+    native.store_table(h, p)
+    native.kv_add(h, "alpha", 100.0)
+    native.load_table(h, p)
+    assert native.kv_get(h, "alpha") == 2.5
+
+
 def test_native_bad_handle(native):
     with pytest.raises(RuntimeError, match="rc=-2"):
         native.array_get(999, 4)
+    with pytest.raises(RuntimeError, match="rc=-2"):
+        native.kv_get(999, "k")
 
 
 def test_native_dashboard(native):
@@ -191,6 +212,37 @@ def test_native_stateful_updater_cross_rank(native, tmp_path, updater):
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
         assert f"NET_UPDATER_OK {r}" in out, out[-2000:]
+
+
+@pytest.mark.parametrize("staleness", ["0", "1"])
+def test_native_ssp_bounded_staleness(native, tmp_path, staleness):
+    """SSP (SURVEY.md §2.9-bis): with -staleness=1 the fast rank's first
+    ahead-Get overlaps the straggler (no wait) and the NEXT clock's Get
+    is held; with -staleness=0 every ahead-Get is held.  Released reads
+    include the straggler's clock adds — the s=0 case is exactly the BSP
+    read guarantee without a barrier."""
+    mf = _machine_file(tmp_path, 2)
+    b = _binary()
+    outs, procs = _run_ranks(b, "ssp_child", mf, 2, extra=(staleness,))
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"SSP_OK {r}" in out, out[-2000:]
+
+
+def test_native_ssp_dead_straggler_fails_fast(native, tmp_path):
+    """A straggler that crashes without ticking must not hang or leak the
+    fast rank's held Gets: each attempt errors within -rpc_timeout_ms
+    and purges the previously parked message (ReplyError fail-fast)."""
+    mf = _machine_file(tmp_path, 2)
+    b = _binary()
+    procs = [subprocess.Popen([b, "ssp_dead", mf, str(r)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert procs[0].returncode == 0, outs[0][-3000:]
+    assert "SSP_DEAD_OK" in outs[0]
+    assert procs[1].returncode == 0, outs[1][-3000:]  # _exit(0) crash sim
 
 
 @pytest.mark.parametrize("live_rank", ["0", "1"])

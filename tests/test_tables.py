@@ -394,3 +394,101 @@ def test_array_device_add_shape_error(mv):
     t = mv.ArrayTable(8)
     with pytest.raises(ValueError, match="delta shape"):
         t.add(jnp.ones(9, dtype=jnp.float32))
+
+
+# ------------------------------------------------------- SSP (staleness)
+
+def test_ssp_staleness_defers_one_clock(mv):
+    """staleness=1: a clock's adds stay invisible through ONE barrier and
+    land at the next — the SSP reader bound t-1-s (SURVEY.md §2.9-bis)."""
+    mv.init()
+    t = mv.ArrayTable(4, sync=True, staleness=1, name="ssp_a",
+                      updater_type="default")
+    t.add(np.ones(4, np.float32))
+    np.testing.assert_allclose(t.get(), 0.0)   # buffered (BSP-like)
+    mv.barrier()
+    np.testing.assert_allclose(t.get(), 0.0)   # deferred: still stale
+    mv.barrier()
+    np.testing.assert_allclose(t.get(), 1.0)   # matured after s+1 clocks
+
+
+def test_ssp_zero_equals_bsp(mv):
+    """staleness=0 must be bit-identical to plain BSP."""
+    mv.init()
+    t = mv.ArrayTable(4, sync=True, staleness=0, name="ssp_b",
+                      updater_type="default")
+    t.add(np.full(4, 2.0, np.float32))
+    mv.barrier()
+    np.testing.assert_allclose(t.get(), 2.0)
+
+
+def test_ssp_matrix_and_kv_defer(mv):
+    mv.init()
+    m = mv.MatrixTable(4, 2, sync=True, staleness=1, name="ssp_m",
+                       updater_type="default")
+    kv = mv.KVTable(sync=True, staleness=1, name="ssp_kv",
+                    updater_type="default")
+    m.add_rows(np.array([1]), np.ones((1, 2), np.float32))
+    kv.add({"k": 5.0})
+    mv.barrier()
+    np.testing.assert_allclose(m.get()[1], 0.0)
+    assert kv.get(["k"])["k"] == 0.0
+    mv.barrier()
+    np.testing.assert_allclose(m.get()[1], 1.0)
+    assert kv.get(["k"])["k"] == 5.0
+
+
+def test_ssp_idle_clock_releases_backlog(mv):
+    """A barrier with no new adds must still mature the queue."""
+    mv.init()
+    t = mv.ArrayTable(2, sync=True, staleness=2, name="ssp_idle",
+                      updater_type="default")
+    t.add(np.ones(2, np.float32))
+    mv.barrier()   # clock+1 (held)
+    mv.barrier()   # clock+2 (held)
+    np.testing.assert_allclose(t.get(), 0.0)
+    mv.barrier()   # idle clock: matures and applies
+    np.testing.assert_allclose(t.get(), 1.0)
+
+
+def test_ssp_requires_sync(mv):
+    mv.init()
+    with pytest.raises(ValueError, match="sync=True"):
+        mv.ArrayTable(4, sync=False, staleness=1, name="ssp_bad")
+    with pytest.raises(ValueError, match=">= 0"):
+        mv.ArrayTable(4, sync=True, staleness=-1, name="ssp_bad2")
+
+
+def test_ssp_discard_pending_drops_queue(mv):
+    """Checkpoint-restore discards BOTH pending buffers and the matured
+    SSP backlog (deltas of an abandoned timeline)."""
+    mv.init()
+    t = mv.ArrayTable(2, sync=True, staleness=1, name="ssp_disc",
+                      updater_type="default")
+    t.add(np.ones(2, np.float32))
+    mv.barrier()                    # now queued in _stale_queue
+    t.discard_pending()
+    mv.barrier()
+    np.testing.assert_allclose(t.get(), 0.0)
+
+
+# ------------------------------------------------------ KV coalesce/batch
+
+def test_kv_coalesce_buffers_until_barrier(mv):
+    mv.init()
+    kv = mv.KVTable(coalesce=True, name="kv_co", updater_type="default")
+    kv.add({"a": 1.0})
+    kv.add({"a": 2.0, "b": 1.0})
+    assert kv.get(["a"])["a"] == 0.0       # buffered, not applied
+    mv.barrier()
+    g = kv.get(["a", "b"])
+    assert g["a"] == 3.0 and g["b"] == 1.0
+
+
+def test_kv_add_many_single_apply(mv):
+    mv.init()
+    kv = mv.KVTable(name="kv_many", updater_type="default")
+    kv.add_many([{"x": 1.0}, {"x": 2.0, "y": 3.0}, {}])
+    g = kv.get(["x", "y"])
+    assert g["x"] == 3.0 and g["y"] == 3.0
+    kv.add_many([])                        # empty batch: no-op
