@@ -405,8 +405,39 @@ def _peak_flops() -> float:
     return _PEAK_CACHE["v"]
 
 
+def _timed_slope(timed, lo: int, hi: int) -> float:
+    """Per-unit seconds from a warmed two-point slope of ``timed(n)``
+    (cancels fixed per-call costs; falls back to the raw hi-point rate
+    when noise inverts the pair)."""
+    timed(lo)                      # compile + warm
+    t_lo, t_hi = timed(lo), timed(hi)
+    if t_hi <= t_lo:
+        return t_hi / hi
+    return (t_hi - t_lo) / (hi - lo)
+
+
+def _fused_step_seconds(tr, toks, lo: int = 1, hi: int = 5,
+                        reps: int = 2) -> float:
+    """Per-step seconds via the trainer's in-jit multi-step loop.
+
+    A single dispatch through the bench tunnel costs ~10 ms — at small
+    step times, per-call timing measures the tunnel, not the step
+    (round-3's toy-MFU mystery).  ``train_steps_fused`` runs n steps in
+    ONE program; the (hi−lo) slope cancels the remaining per-call cost.
+    """
+    def timed(n):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(tr.train_steps_fused(toks, n))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    return _timed_slope(timed, lo, hi)
+
+
 def _bench_transformer_cfg(cfg, batch, seq, prefix, *, steps=10,
-                           with_mfu=True):
+                           with_mfu=True, fused_timing=True):
     import jax
     import numpy as np
     from jax.sharding import Mesh
@@ -418,8 +449,14 @@ def _bench_transformer_cfg(cfg, batch, seq, prefix, *, steps=10,
     toks = np.random.RandomState(0).randint(
         cfg.vocab_size, size=(batch, seq)).astype(np.int32)
 
-    sec = _time_pipelined(lambda: tr.train_step_async(toks),
-                          steps=steps, warmup=2, reps=3)
+    if fused_timing:
+        sec = _fused_step_seconds(tr, toks, lo=1, hi=max(steps // 2, 2))
+    else:
+        # Billion-param configs: the fused-loop program costs minutes to
+        # compile and the ~10 ms/dispatch tunnel tax is <3% of a step —
+        # per-call pipelined timing is the better trade there.
+        sec = _time_pipelined(lambda: tr.train_step_async(toks),
+                              steps=steps, warmup=2, reps=3)
     out = {f"{prefix}_tokens_per_sec": batch * seq / sec}
     if not with_mfu:
         del tr
@@ -470,6 +507,13 @@ def bench_transformer_large(batch: int = 8, seq: int = 2048):
       kernel alone at this config's [B, H, T, D], its causal FLOPs vs
       the calibrated matmul peak: how much of the step's attention time
       is kernel inefficiency vs shape-inherent.
+    - ``roofline_exp_gelem_per_sec`` / ``roofline_flash_fwd_gexp_per_sec``
+      — the chip's streamed elementwise exp rate vs the kernel's achieved
+      exps/s (softmax needs one exp per attention score).  The kernel
+      running at/above the streamed exp rate while far below matmul peak
+      is the decomposition: attention cost on this chip is VPU-class
+      exp/elementwise work that the MXU-peak denominator cannot price —
+      kernel-at-roofline, not kernel deficiency.
     - ``roofline_remat_tax_pct`` — (full-remat step − selective step) /
       full-remat step at equal tokens: the wall-clock share full remat
       burns on recompute.
@@ -487,30 +531,62 @@ def bench_transformer_large(batch: int = 8, seq: int = 2048):
     sel_batch = max(batch // 2, 1)
     cfg_sel = TransformerConfig(**base, remat=True, remat_policy="dots")
     out.update(_bench_transformer_cfg(cfg_sel, sel_batch, seq,
-                                      "transformer_large", steps=5))
+                                      "transformer_large", steps=5,
+                                      fused_timing=False))
 
     cfg_full = TransformerConfig(**base, remat=True)
     full = _bench_transformer_cfg(cfg_full, batch, seq,
-                                  "transformer_large_fullremat", steps=5)
+                                  "transformer_large_fullremat", steps=5,
+                                  fused_timing=False)
     out.update(full)
 
     # ---- roofline decomposition ---------------------------------------
+    # Every probe here uses an IN-JIT fori_loop + two-point slope: one
+    # dispatch through the bench tunnel costs ~10 ms, which at
+    # millisecond kernel times would BE the measurement (the round-3
+    # numbers reported the tunnel: flash read as 2% of peak when the
+    # kernel actually runs at ~40%).
+    def _injit_seconds(make_loop, lo=4, hi=24):
+        def timed(steps):
+            ts = []
+            for _ in range(4):
+                t0 = time.perf_counter()
+                float(make_loop(steps))
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+        return _timed_slope(timed, lo, hi)
+
     try:
+        import functools
+
         peak = _peak_flops()
         # Forward-only MFU (selective config's batch; no remat effect in
         # a pure forward).
-        from multiverso_tpu.models import transformer_forward
+        from multiverso_tpu.models import init_params, transformer_forward
         toks = np.random.RandomState(0).randint(
             base["vocab_size"], size=(sel_batch, seq)).astype(np.int32)
-        from multiverso_tpu.models import init_params
         params = jax.tree_util.tree_map(
             jnp.asarray, init_params(cfg_sel, seed=0),
             is_leaf=lambda x: isinstance(x, np.ndarray))
-        fwd = jax.jit(lambda p, t: jnp.sum(
-            transformer_forward(p, t, cfg_sel).astype(jnp.float32)))
         tok_dev = jnp.asarray(toks)
-        fwd_sec = _time_pipelined(lambda: fwd(params, tok_dev),
-                                  steps=10, warmup=2, reps=3)
+
+        @functools.partial(jax.jit, static_argnums=2)
+        def fwd_many(p, t, steps):
+            def body(i, carry):
+                t_i, acc = carry
+                # Loop-carried token dependency: an invariant body would
+                # be hoisted (computed once) and the slope would read as
+                # a >100% MFU fantasy.
+                out = transformer_forward(p, t_i, cfg_sel)
+                nxt = jnp.roll(t_i, 1, axis=1)
+                return nxt, acc + jnp.sum(out[:, -1, :1]
+                                          .astype(jnp.float32))
+            _, acc = jax.lax.fori_loop(0, steps, body,
+                                       (t, jnp.float32(0)))
+            return acc
+
+        fwd_sec = _injit_seconds(
+            lambda n: fwd_many(params, tok_dev, n), lo=2, hi=8)
         fwd_flops = _transformer_train_flops(cfg_sel, sel_batch, seq) / 3
         out["roofline_fwd_mfu_pct"] = 100.0 * fwd_flops / fwd_sec / peak
         del params
@@ -519,16 +595,44 @@ def bench_transformer_large(batch: int = 8, seq: int = 2048):
         from multiverso_tpu.ops import flash_attention
         H, D = base["n_heads"], base["dim"] // base["n_heads"]
         rng = np.random.RandomState(1)
-        qkv = [jnp.asarray(rng.randn(sel_batch, H, seq, D), jnp.bfloat16)
-               for _ in range(3)]
-        fa = jax.jit(lambda q, k, v: jnp.sum(
-            flash_attention(q, k, v, causal=True).astype(jnp.float32)))
-        fa_sec = _time_pipelined(lambda: fa(*qkv), steps=10, warmup=2,
-                                 reps=3)
+        q0, k0, v0 = [jnp.asarray(rng.randn(sel_batch, H, seq, D),
+                                  jnp.bfloat16) for _ in range(3)]
+
+        @functools.partial(jax.jit, static_argnums=3)
+        def fa_many(q, k, v, steps):
+            def body(_, c):
+                return flash_attention(c, k, v, causal=True)
+            return jnp.sum(jax.lax.fori_loop(0, steps, body, q)
+                           .astype(jnp.float32))
+
+        fa_sec = _injit_seconds(lambda n: fa_many(q0, k0, v0, n))
         # Causal QK^T + PV: 2 matmuls × 2·B·H·T²·D flops, halved by mask.
         fa_flops = 2 * (2 * sel_batch * H * seq * seq * D) / 2
         out["roofline_flash_fwd_pct_of_peak"] = (100.0 * fa_flops
                                                  / fa_sec / peak)
+
+        # The BINDING constraint for attention on this chip is the VPU /
+        # transcendental class, not the MXU: softmax needs one exp per
+        # score.  Two rates for the comparison: the XLA elementwise exp
+        # chain (HBM-streamed) and the kernel's achieved exps/s (ideal
+        # causal count / time — a LOWER bound, block rounding computes
+        # more).  The kernel beating the streamed rate while sitting at
+        # single-digit %-of-matmul-peak is the decomposition: attention
+        # cost is exp/VPU-class work the MXU peak cannot price.
+        xe = jnp.asarray(np.random.RandomState(2)
+                         .randn(8, 2048, 2048).astype(np.float32))
+
+        @functools.partial(jax.jit, static_argnums=1)
+        def exp_many(x, steps):
+            def body(_, c):
+                return jnp.exp(c * 0.999)
+            return jnp.sum(jax.lax.fori_loop(0, steps, body, x))
+
+        exp_sec = _injit_seconds(lambda n: exp_many(xe, n))
+        out["roofline_exp_gelem_per_sec"] = xe.size / exp_sec / 1e9
+        causal_exps = sel_batch * H * seq * seq / 2
+        out["roofline_flash_fwd_gexp_per_sec"] = (causal_exps / fa_sec
+                                                  / 1e9)
 
         # Remat tax at equal tokens/step.
         sel_sec = sel_batch * seq / out["transformer_large_tokens_per_sec"]
@@ -563,8 +667,7 @@ def bench_moe(batch: int = 8, seq: int = 1024):
         tr = TransformerTrainer(cfg, mesh, updater_type="sgd")
         toks = np.random.RandomState(0).randint(
             cfg.vocab_size, size=(batch, seq)).astype(np.int32)
-        sec[disp] = _time_pipelined(lambda: tr.train_step_async(toks),
-                                    steps=5, warmup=2, reps=3)
+        sec[disp] = _fused_step_seconds(tr, toks, lo=1, hi=4)
         out[f"moe_{disp}_tokens_per_sec"] = batch * seq / sec[disp]
         del tr
     out["moe_capacity_vs_dense"] = sec["dense"] / sec["capacity"]

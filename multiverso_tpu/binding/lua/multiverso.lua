@@ -33,6 +33,7 @@ int MV_GetArrayTable(int32_t handle, float* data, int64_t size);
 int MV_AddArrayTable(int32_t handle, const float* delta, int64_t size);
 int MV_AddAsyncArrayTable(int32_t handle, const float* delta, int64_t size);
 int MV_NewMatrixTable(int64_t rows, int64_t cols, int32_t* handle);
+int MV_NewSparseMatrixTable(int64_t rows, int64_t cols, int32_t* handle);
 int MV_GetMatrixTableAll(int32_t handle, float* data, int64_t size);
 int MV_AddMatrixTableAll(int32_t handle, const float* delta, int64_t size);
 int MV_AddAsyncMatrixTableAll(int32_t handle, const float* delta, int64_t size);
@@ -179,6 +180,16 @@ function mv.MatrixTableHandler:add(delta, opts)
     check(C.MV_AddMatrixTableAll(self.handle, buf, n),
           "MV_AddMatrixTableAll")
   end
+end
+
+-- Sparse variant: worker-side row cache, same handler methods.
+mv.SparseMatrixTableHandler = {}
+
+function mv.SparseMatrixTableHandler:new(rows, cols)
+  local h = ffi.new("int32_t[1]")
+  check(C.MV_NewSparseMatrixTable(rows, cols, h), "MV_NewSparseMatrixTable")
+  return setmetatable({ handle = h[0], rows = rows, cols = cols },
+                      mv.MatrixTableHandler)
 end
 
 --- #x raises on cdata, so FFI-array callers must pass the count.

@@ -437,12 +437,11 @@ class TransformerTrainer:
         self._step = None
         self._eval = None
 
-    def _build_step(self):
-        cfg, mesh, updater, opt = self.cfg, self.mesh, self.updater, self.option
-        from ..parallel.sharding import batch_placer
-        _, place_tokens = batch_placer(mesh, "dp", dtype=jnp.int32)
+    def _raw_step(self):
+        """Un-jitted (params, state, tokens) -> (params, state, loss)."""
+        cfg, mesh, updater, opt = (self.cfg, self.mesh, self.updater,
+                                   self.option)
 
-        @partial(jax.jit, donate_argnums=(0, 1))
         def step(params, state, tokens):
             loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg,
                                                       mesh)
@@ -458,7 +457,46 @@ class TransformerTrainer:
             state = jax.tree_util.tree_unflatten(tree, [s for _, s in out])
             return params, state, loss
 
+        return step
+
+    def _build_step(self):
+        from ..parallel.sharding import batch_placer
+        _, place_tokens = batch_placer(self.mesh, "dp", dtype=jnp.int32)
+        step = jax.jit(self._raw_step(), donate_argnums=(0, 1))
         return step, place_tokens
+
+    def train_steps_fused(self, tokens, n: int) -> jax.Array:
+        """Run ``n`` train steps on one batch inside ONE compiled program
+        (``fori_loop`` over the step body); returns the last device loss.
+
+        The honest way to measure step time on remote-tunneled devices —
+        a per-step dispatch costs ~10 ms through the tunnel, which at
+        small step times IS the measurement; one fused program amortizes
+        it to nothing.  Also useful for burn-in loops where the batch is
+        fixed.
+        """
+        from ..parallel.sharding import batch_placer
+        fn = getattr(self, "_multi_step", None)
+        if fn is None:
+            raw = self._raw_step()
+
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def multi(params, state, tokens, n):
+                def body(_, carry):
+                    p, s, _loss = carry
+                    return raw(p, s, tokens)
+
+                zero = jnp.float32(0)
+                # Dynamic bound: one compile serves every n.
+                return jax.lax.fori_loop(0, n, body,
+                                         (params, state, zero))
+
+            self._multi_step = fn = multi
+        _, place = batch_placer(self.mesh, "dp", dtype=jnp.int32)
+        self.params, self.state, loss = fn(self.params, self.state,
+                                           place(tokens),
+                                           jnp.int32(n))
+        return loss
 
     def train_step_async(self, tokens) -> jax.Array:
         """Enqueue one step; returns the device loss scalar (no host

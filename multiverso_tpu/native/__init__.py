@@ -76,6 +76,9 @@ def load(build: bool = True) -> ctypes.CDLL:
         getattr(lib, name).argtypes = [ctypes.c_int32, c_float_p,
                                        ctypes.c_int64]
         getattr(lib, name).restype = ctypes.c_int
+    lib.MV_NewSparseMatrixTable.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                            c_int32_p]
+    lib.MV_NewSparseMatrixTable.restype = ctypes.c_int
     lib.MV_NewMatrixTable.argtypes = [ctypes.c_int64, ctypes.c_int64,
                                       ctypes.POINTER(ctypes.c_int32)]
     lib.MV_NewMatrixTable.restype = ctypes.c_int
@@ -192,6 +195,15 @@ class NativeRuntime:
         h = ctypes.c_int32(-1)
         self._check(self.lib.MV_NewMatrixTable(rows, cols, ctypes.byref(h)),
                     "MV_NewMatrixTable")
+        return h.value
+
+    def new_sparse_matrix_table(self, rows: int, cols: int) -> int:
+        """Worker-side row cache variant (MV_NewSparseMatrixTable); same
+        get/add calls as the plain matrix table."""
+        h = ctypes.c_int32(-1)
+        self._check(
+            self.lib.MV_NewSparseMatrixTable(rows, cols, ctypes.byref(h)),
+            "MV_NewSparseMatrixTable")
         return h.value
 
     def matrix_get_all(self, handle: int, rows: int, cols: int) -> np.ndarray:

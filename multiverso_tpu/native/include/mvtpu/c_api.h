@@ -43,6 +43,10 @@ int MV_AddArrayTable(int32_t handle, const float* delta, int64_t size);
 int MV_AddAsyncArrayTable(int32_t handle, const float* delta, int64_t size);
 
 int MV_NewMatrixTable(int64_t rows, int64_t cols, int32_t* handle);
+// Sparse variant: worker-side row cache (hits skip the wire until this
+// worker Adds the row or a barrier closes the clock).  Same Get/Add
+// functions as the plain matrix table.
+int MV_NewSparseMatrixTable(int64_t rows, int64_t cols, int32_t* handle);
 int MV_GetMatrixTableAll(int32_t handle, float* data, int64_t size);
 int MV_AddMatrixTableAll(int32_t handle, const float* delta, int64_t size);
 int MV_AddAsyncMatrixTableAll(int32_t handle, const float* delta, int64_t size);

@@ -112,6 +112,10 @@ class WorkerTable {
   // Called by the Worker actor when a reply for msg_id arrives.
   void Notify(int64_t msg_id, const Message& reply);
 
+  // Clock boundary hook (Zoo::Barrier success): worker-side caches drop
+  // entries here — peers' adds from the closed clock are now visible.
+  virtual void OnClockInvalidate() {}
+
  protected:
   // Send all reqs (same msg_id) via the Zoo, block until each got its
   // reply; `consume` runs once per reply (serialized — one worker-actor
@@ -155,15 +159,40 @@ class MatrixWorkerTable : public WorkerTable {
                     int num_servers = 1)
       : WorkerTable(table_id), rows_(rows), cols_(cols),
         servers_(num_servers) {}
-  bool GetAll(float* data);                       // [rows*cols]
-  bool GetRows(const int32_t* row_ids, int64_t k, float* data);  // [k*cols]
-  bool AddAll(const float* delta, const AddOption& opt, bool blocking);
-  bool AddRows(const int32_t* row_ids, int64_t k, const float* delta,
-               const AddOption& opt, bool blocking);
+  virtual bool GetAll(float* data);               // [rows*cols]
+  virtual bool GetRows(const int32_t* row_ids, int64_t k,
+                       float* data);              // [k*cols]
+  virtual bool AddAll(const float* delta, const AddOption& opt,
+                      bool blocking);
+  virtual bool AddRows(const int32_t* row_ids, int64_t k,
+                       const float* delta, const AddOption& opt,
+                       bool blocking);
 
- private:
+ protected:
   int64_t rows_, cols_;
   int servers_;
+};
+
+// Sparse variant (SURVEY.md §2.13, table/sparse_matrix_table.h): the
+// worker keeps a row cache — repeated GetRows of hot rows (LightLDA's
+// access pattern) skip the wire until the row is invalidated by this
+// worker's own Add or by a clock boundary (Zoo::Barrier), when peers'
+// adds become visible.  Mirrors tables/sparse_matrix_table.py: a dense
+// [rows, cols] mirror + validity bitmap, lazily allocated.
+class SparseMatrixWorkerTable : public MatrixWorkerTable {
+ public:
+  using MatrixWorkerTable::MatrixWorkerTable;
+  bool GetRows(const int32_t* row_ids, int64_t k, float* data) override;
+  bool AddAll(const float* delta, const AddOption& opt,
+              bool blocking) override;
+  bool AddRows(const int32_t* row_ids, int64_t k, const float* delta,
+               const AddOption& opt, bool blocking) override;
+  void OnClockInvalidate() override;
+
+ private:
+  std::mutex cache_mu_;
+  std::vector<uint8_t> valid_;   // lazily rows_ entries
+  std::vector<float> mirror_;    // lazily rows_*cols_ floats
 };
 
 // ------------------------------------------------------------------- KV

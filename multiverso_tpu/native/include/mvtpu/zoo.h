@@ -87,6 +87,7 @@ class Zoo {
   // ---- table registry -------------------------------------------------
   int32_t RegisterArrayTable(int64_t size);
   int32_t RegisterMatrixTable(int64_t rows, int64_t cols);
+  int32_t RegisterSparseMatrixTable(int64_t rows, int64_t cols);
   int32_t RegisterKVTable();
   ServerTable* server_table(int32_t id);
   WorkerTable* worker_table(int32_t id);
@@ -169,6 +170,7 @@ class Zoo {
   // Under ssp_mu_: moves expired parks out for fail-fast replies.
   void PurgeExpiredHeldLocked(std::vector<MessagePtr>* expired);
   void FailHeldGets(std::vector<MessagePtr> expired);
+  bool HeldBySspLocked(int src);  // admission predicate (ssp_mu_ held)
 
   // Outstanding pipeline flushes (msg_id → waiter); acks notify under
   // flush_mu_ so a timed-out flush cannot race its stack waiter.

@@ -88,6 +88,12 @@ int MV_NewMatrixTable(int64_t rows, int64_t cols, int32_t* handle) {
   return 0;
 }
 
+int MV_NewSparseMatrixTable(int64_t rows, int64_t cols, int32_t* handle) {
+  if (RequireStarted() || rows <= 0 || cols <= 0 || !handle) return -1;
+  *handle = Zoo::Get()->RegisterSparseMatrixTable(rows, cols);
+  return 0;
+}
+
 int MV_GetMatrixTableAll(int32_t handle, float* data, int64_t size) {
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->matrix_worker(handle);

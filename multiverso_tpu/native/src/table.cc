@@ -562,6 +562,79 @@ bool MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
   return true;
 }
 
+// ------------------------------------------------- sparse matrix worker
+
+bool SparseMatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
+                                      float* data) {
+  Monitor mon("SparseMatrixWorker::GetRows");
+  std::lock_guard<std::mutex> lk(cache_mu_);
+  if (valid_.empty()) {
+    valid_.assign(static_cast<size_t>(rows_), 0);
+    mirror_.assign(static_cast<size_t>(rows_ * cols_), 0.0f);
+  }
+  // Fetch only the missing in-range rows (deduped), then serve all from
+  // the mirror; out-of-range ids read zeros (the wire contract).
+  std::vector<int32_t> missing;
+  for (int64_t i = 0; i < k; ++i) {
+    int32_t r = row_ids[i];
+    if (r >= 0 && r < rows_ && !valid_[r]) {
+      valid_[r] = 2;  // mark "fetch scheduled" so duplicates dedupe
+      missing.push_back(r);
+    }
+  }
+  if (!missing.empty()) {
+    std::vector<float> fetched(missing.size() * cols_);
+    if (!MatrixWorkerTable::GetRows(missing.data(),
+                                    static_cast<int64_t>(missing.size()),
+                                    fetched.data())) {
+      for (int32_t r : missing) valid_[r] = 0;  // fetch failed: stay cold
+      return false;
+    }
+    for (size_t i = 0; i < missing.size(); ++i) {
+      std::memcpy(mirror_.data() + missing[i] * cols_,
+                  fetched.data() + i * cols_, cols_ * sizeof(float));
+      valid_[missing[i]] = 1;
+    }
+  }
+  for (int64_t i = 0; i < k; ++i) {
+    int32_t r = row_ids[i];
+    if (r >= 0 && r < rows_)
+      std::memcpy(data + i * cols_, mirror_.data() + r * cols_,
+                  cols_ * sizeof(float));
+    else
+      std::memset(data + i * cols_, 0, cols_ * sizeof(float));
+  }
+  return true;
+}
+
+bool SparseMatrixWorkerTable::AddAll(const float* delta,
+                                     const AddOption& opt, bool blocking) {
+  {
+    std::lock_guard<std::mutex> lk(cache_mu_);
+    if (!valid_.empty()) std::fill(valid_.begin(), valid_.end(), 0);
+  }
+  return MatrixWorkerTable::AddAll(delta, opt, blocking);
+}
+
+bool SparseMatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
+                                      const float* delta,
+                                      const AddOption& opt, bool blocking) {
+  {
+    std::lock_guard<std::mutex> lk(cache_mu_);
+    if (!valid_.empty())
+      for (int64_t i = 0; i < k; ++i)
+        if (row_ids[i] >= 0 && row_ids[i] < rows_) valid_[row_ids[i]] = 0;
+  }
+  return MatrixWorkerTable::AddRows(row_ids, k, delta, opt, blocking);
+}
+
+void SparseMatrixWorkerTable::OnClockInvalidate() {
+  // Clock closed: peers' adds are now applied server-side — every
+  // cached row may be stale.
+  std::lock_guard<std::mutex> lk(cache_mu_);
+  if (!valid_.empty()) std::fill(valid_.begin(), valid_.end(), 0);
+}
+
 // -------------------------------------------------------------- KV worker
 
 namespace {

@@ -339,9 +339,29 @@ bool Zoo::Barrier() {
   if (!ok)
     Log::Error("Zoo::Barrier: timed out waiting for release (rank %d)",
                rank_);
-  std::lock_guard<std::mutex> lk(barrier_mu_);
-  barrier_waiter_ = nullptr;
-  return ok && !barrier_failed_;
+  bool failed;
+  {
+    std::lock_guard<std::mutex> lk(barrier_mu_);
+    barrier_waiter_ = nullptr;
+    failed = barrier_failed_;
+  }
+  if (ok && !failed) {
+    // Clock boundary: peers' adds are applied — drop worker-side row
+    // caches (SparseMatrixWorkerTable) so post-barrier Gets see them.
+    // Pointers copied OUT of tables_mu_ before the hooks run: a hook
+    // takes its cache lock, which another thread may hold across a
+    // blocking fetch whose service path needs tables_mu_ — invoking
+    // under the lock would close that cycle into a deadlock.  (Tables
+    // are never unregistered, so the copied pointers stay valid.)
+    std::vector<WorkerTable*> snapshot;
+    {
+      std::lock_guard<std::mutex> lk(tables_mu_);
+      for (auto& t : worker_tables_)
+        if (t) snapshot.push_back(t.get());
+    }
+    for (auto* t : snapshot) t->OnClockInvalidate();
+  }
+  return ok && !failed;
 }
 
 void Zoo::OnBarrierArrive(int src_rank, int64_t round) {
@@ -447,25 +467,30 @@ void Zoo::FailHeldGets(std::vector<MessagePtr> expired) {
   }
 }
 
-bool Zoo::MaybeHoldGet(MessagePtr& msg) {
+bool Zoo::HeldBySspLocked(int src) {
+  // Admission predicate (ssp_mu_ held): src runs more than `staleness`
+  // ticks ahead of the slowest worker.
   int64_t s = configure::GetInt("staleness");
+  if (worker_clocks_.size() != static_cast<size_t>(size_))
+    worker_clocks_.assign(size_, 0);
+  if (src < 0 || src >= size_) return false;
+  int64_t mine = worker_clocks_[src];
+  int64_t slowest = mine;
+  for (int r : worker_ranks_)
+    slowest = std::min(slowest, worker_clocks_[r]);
+  return mine - slowest > s;
+}
+
+bool Zoo::MaybeHoldGet(MessagePtr& msg) {
   std::vector<MessagePtr> expired;
   bool held = false;
   {
     std::lock_guard<std::mutex> lk(ssp_mu_);
     PurgeExpiredHeldLocked(&expired);
-    if (worker_clocks_.size() != static_cast<size_t>(size_))
-      worker_clocks_.assign(size_, 0);
-    if (msg->src >= 0 && msg->src < size_) {
-      int64_t mine = worker_clocks_[msg->src];
-      int64_t slowest = mine;
-      for (int r : worker_ranks_)
-        slowest = std::min(slowest, worker_clocks_[r]);
-      if (mine - slowest > s) {
-        int64_t t = configure::GetInt("rpc_timeout_ms");
-        held_gets_.emplace_back(t > 0 ? NowMs() + t : 0, std::move(msg));
-        held = true;
-      }
+    if (HeldBySspLocked(msg->src)) {
+      int64_t t = configure::GetInt("rpc_timeout_ms");
+      held_gets_.emplace_back(t > 0 ? NowMs() + t : 0, std::move(msg));
+      held = true;
     }
   }
   FailHeldGets(std::move(expired));
@@ -483,11 +508,20 @@ void Zoo::OnClockTick(int src_rank, int64_t clock) {
     if (src_rank >= 0 && src_rank < size_) {
       worker_clocks_[src_rank] =
           std::max(worker_clocks_[src_rank], clock);
-      // Release every parked get the new bound admits: re-deliver
-      // through the server mailbox so the normal handler (and
-      // MaybeHoldGet) rerun.
-      for (auto& [deadline, m] : held_gets_) admit.push_back(std::move(m));
-      held_gets_.clear();
+      // Admission decided IN PLACE: only now-admitted gets re-deliver
+      // (through the server mailbox, so the normal handler reruns).
+      // Still-held gets KEEP their original park deadline — a blanket
+      // release-and-repark would refresh deadlines on every tick and a
+      // dead straggler's parks would never expire while live workers
+      // keep ticking.
+      auto keep = held_gets_.begin();
+      for (auto& [deadline, m] : held_gets_) {
+        if (!HeldBySspLocked(m->src))
+          admit.push_back(std::move(m));
+        else
+          *keep++ = {deadline, std::move(m)};
+      }
+      held_gets_.erase(keep, held_gets_.end());
     }
   }
   FailHeldGets(std::move(expired));
@@ -618,6 +652,22 @@ int32_t Zoo::RegisterMatrixTable(int64_t rows, int64_t cols) {
                     rows, cols, updater_type_, sid, num_servers()));
   worker_tables_.push_back(
       std::make_unique<MatrixWorkerTable>(id, rows, cols, num_servers()));
+  return id;
+}
+
+int32_t Zoo::RegisterSparseMatrixTable(int64_t rows, int64_t cols) {
+  std::lock_guard<std::mutex> lk(tables_mu_);
+  int32_t id = static_cast<int32_t>(server_tables_.size());
+  int sid = server_id();
+  // Server side reuses the matrix shard (only requested rows ever ride
+  // the wire); the sparse value-add is the WORKER-side row cache.
+  server_tables_.push_back(
+      sid < 0 ? nullptr
+              : std::make_unique<MatrixServerTable>(
+                    rows, cols, updater_type_, sid, num_servers()));
+  worker_tables_.push_back(
+      std::make_unique<SparseMatrixWorkerTable>(id, rows, cols,
+                                                num_servers()));
   return id;
 }
 

@@ -175,6 +175,31 @@ static int TestCheckpoint() {
   return 0;
 }
 
+static int TestSparseMatrix() {
+  // Worker row cache: own adds invalidate their rows; a barrier (clock)
+  // invalidates everything; reads serve correct values throughout.
+  int32_t h;
+  CHECK(MV_NewSparseMatrixTable(6, 4, &h) == 0);
+  int32_t rows[2] = {1, 4};
+  std::vector<float> d(8, 2.0f), out(8, -1.0f);
+  CHECK(MV_AddMatrixTableByRows(h, d.data(), rows, 2, 4) == 0);
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), rows, 2, 4) == 0);
+  for (float v : out) CHECK(v == 2.0f);          // cache filled
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), rows, 2, 4) == 0);
+  for (float v : out) CHECK(v == 2.0f);          // cache hit, same value
+  CHECK(MV_AddMatrixTableByRows(h, d.data(), rows, 2, 4) == 0);
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), rows, 2, 4) == 0);
+  for (float v : out) CHECK(v == 4.0f);          // own add invalidated
+  CHECK(MV_Barrier() == 0);                      // clock invalidate
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), rows, 2, 4) == 0);
+  for (float v : out) CHECK(v == 4.0f);
+  int32_t oob[1] = {99};
+  std::vector<float> zout(4, -1.0f);
+  CHECK(MV_GetMatrixTableByRows(h, zout.data(), oob, 1, 4) == 0);
+  for (float v : zout) CHECK(v == 0.0f);         // out-of-range zeros
+  return 0;
+}
+
 static int TestKV() {
   // Single-process KV round trips: singles, batch (with a duplicate key
   // summing), absent-key zero reads, and a checkpoint round trip.
@@ -282,6 +307,61 @@ static int NetChild(const char* machine_file, const char* rank) {
     CHECK(MV_GetMatrixTableByRows(hm, rout.data(), qrows, 2, 4) == 0);
     for (float v : rout) CHECK(v == (float)(r + 1));
   }
+
+  // Sparse matrix cross-rank: the worker row cache serves CACHED values
+  // while peers add (AD-LDA staleness), and a barrier makes peers' adds
+  // visible.  A KV counter synchronizes "all +10 adds applied" without
+  // touching the sparse cache, so the staleness assert is deterministic.
+  int32_t hs;
+  CHECK(MV_NewSparseMatrixTable(4, 4, &hs) == 0);
+  int32_t hsync;
+  CHECK(MV_NewKVTable(&hsync) == 0);
+  CHECK(MV_Barrier() == 0);
+  int32_t my_row[1] = {me};
+  std::vector<float> mine(4, (float)(me + 1));
+  CHECK(MV_AddMatrixTableByRows(hs, mine.data(), my_row, 1, 4) == 0);
+  CHECK(MV_Barrier() == 0);
+  // Fill the cache with every rank's row, then RENDEZVOUS THROUGH KV
+  // (not a barrier — that would invalidate the cache) before anyone
+  // bumps: a fast rank's bump must not land before a slow rank's
+  // snapshot read, or the snapshot values race.
+  std::vector<int32_t> all_rows(n);
+  for (int r = 0; r < n; ++r) all_rows[r] = r;
+  std::vector<float> snap(n * 4, -1.0f);
+  CHECK(MV_GetMatrixTableByRows(hs, snap.data(), all_rows.data(), n, 4) == 0);
+  for (int r = 0; r < n; ++r)
+    for (int c = 0; c < 4; ++c) CHECK(snap[r * 4 + c] == (float)(r + 1));
+  CHECK(MV_AddKV(hsync, "cached", 1.0f) == 0);
+  float cached = 0.0f;
+  for (int tries = 0; tries < 500 && cached < (float)n; ++tries) {
+    CHECK(MV_GetKV(hsync, "cached", &cached) == 0);
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  CHECK(cached == (float)n);
+  // Everyone bumps their own row by 10 (blocking), then announces via KV.
+  std::vector<float> bump(4, 10.0f);
+  CHECK(MV_AddMatrixTableByRows(hs, bump.data(), my_row, 1, 4) == 0);
+  CHECK(MV_AddKV(hsync, "adds_done", 1.0f) == 0);
+  float done = 0.0f;
+  for (int tries = 0; tries < 500 && done < (float)n; ++tries) {
+    CHECK(MV_GetKV(hsync, "adds_done", &done) == 0);
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  CHECK(done == (float)n);
+  // Peer rows: served from the cache — the PRE-bump snapshot — even
+  // though every +10 is provably applied server-side by now.  Own row:
+  // our add invalidated it, so it re-fetches fresh.
+  int peer = (me + 1) % n;
+  int32_t prow[1] = {(int32_t)peer};
+  std::vector<float> pv(4, -1.0f);
+  CHECK(MV_GetMatrixTableByRows(hs, pv.data(), prow, 1, 4) == 0);
+  for (float v : pv) CHECK(v == (float)(peer + 1));       // stale (cached)
+  std::vector<float> ov(4, -1.0f);
+  CHECK(MV_GetMatrixTableByRows(hs, ov.data(), my_row, 1, 4) == 0);
+  for (float v : ov) CHECK(v == (float)(me + 11));        // fresh (own add)
+  CHECK(MV_Barrier() == 0);                               // clock closes
+  CHECK(MV_GetMatrixTableByRows(hs, pv.data(), prow, 1, 4) == 0);
+  for (float v : pv) CHECK(v == (float)(peer + 11));      // now visible
 
   // KV cross-rank: every rank adds (rank+1) under a SHARED key (entries
   // hash-shard, so whichever rank owns it sees remote adds) plus its own
@@ -630,7 +710,8 @@ int main(int argc, char** argv) {
       {"blob", TestBlob},         {"queue", TestQueue},
       {"configure", TestConfigure}, {"message", TestMessage},
       {"updater", TestUpdater},   {"array", TestArray},
-      {"matrix", TestMatrix},     {"checkpoint", TestCheckpoint},
+      {"matrix", TestMatrix},     {"sparse", TestSparseMatrix},
+      {"checkpoint", TestCheckpoint},
       {"kv", TestKV},             {"threads", TestThreads},
   };
   int failures = 0;

@@ -58,10 +58,13 @@ def _online_block(q, k_blk, v_blk, o, m, l, q_pos, k_pos, scale, causal):
     return o, new_m, l
 
 
-def _flash_block(t: int) -> int:
-    """Largest power-of-two block ≤512 dividing t (0 if none ≥64)."""
-    for b in (512, 256, 128, 64):
-        if t % b == 0:
+def _flash_block(t: int, cap: int = 512) -> int:
+    """Largest power-of-two block ≤cap dividing t (0 if none ≥64).
+
+    Caps are the measured v5e sweet spot at D=128: q blocks 512, k
+    blocks 1024 (``ops/flash_attention.py`` docstring)."""
+    for b in (1024, 512, 256, 128, 64):
+        if b <= cap and t % b == 0:
             return b
     return 0
 
@@ -81,17 +84,18 @@ def blockwise_attention_local(q, k, v, scale: float, causal: bool = True,
     import os
 
     B, H, T, D = q.shape
-    block = _flash_block(T)
+    bq = _flash_block(T, cap=512)
+    bk = _flash_block(T, cap=1024)
     on_tpu = jax.default_backend() == "tpu"
     force = os.environ.get("MVTPU_FORCE_FLASH", "")
     use_flash = (q_offset == 0 and k_offset == 0 and T == k.shape[2]
-                 and block and not os.environ.get("MVTPU_NO_FLASH")
+                 and bq and bk and not os.environ.get("MVTPU_NO_FLASH")
                  and (on_tpu or force))
     if use_flash:
         from ..ops import flash_attention
 
         return flash_attention(q, k, v, scale=scale, causal=causal,
-                               block_q=block, block_k=block,
+                               block_q=bq, block_k=bk,
                                interpret=not on_tpu)
     o = jnp.zeros(q.shape, jnp.float32)
     m = jnp.full((B, H, T, 1), _NEG, jnp.float32)
@@ -118,7 +122,7 @@ def _attn_piece(q, k, v, scale, causal: bool):
 
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    bq, bk = _flash_block(Tq), _flash_block(Tk)
+    bq, bk = _flash_block(Tq, cap=512), _flash_block(Tk, cap=1024)
     on_tpu = jax.default_backend() == "tpu"
     force = os.environ.get("MVTPU_FORCE_FLASH", "")
     if (bq and bk and not os.environ.get("MVTPU_NO_FLASH")

@@ -31,9 +31,24 @@ def test_flash_matches_dense(B, H, T, D, bq, bk, causal):
 
 
 def test_flash_rejects_misaligned():
+    # No power-of-two block >= 8 divides 100: unusable, so it raises.
     q = jnp.zeros((1, 1, 100, 32))
-    with pytest.raises(ValueError, match="must divide"):
+    with pytest.raises(ValueError, match="no usable block"):
         flash_attention(q, q, q, block_q=64, block_k=64, interpret=True)
+
+
+def test_flash_block_fallback_fits_odd_lengths():
+    """Requested blocks shrink to the largest dividing power of two —
+    T=192 runs under the 512/1024 defaults (as 64-blocks) instead of
+    raising like rounds 1-3 did."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 2, 192, 32).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(1, 2, 192, 32).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(1, 2, 192, 32).astype(np.float32)) * 0.3
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    want = dense_attention_ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
 
 
 def test_local_attention_cpu_fallback_is_jnp():
