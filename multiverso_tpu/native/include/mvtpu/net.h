@@ -19,11 +19,29 @@
 
 namespace mvtpu {
 
-class TcpNet {
+// Wire-transport interface — what the Zoo needs from a transport.  The
+// reference selects its transport (MPI vs ZMQ) behind one NetInterface
+// (include/multiverso/net.h, SURVEY.md §2.17-2.18); this is that seam:
+// TcpNet is the machine-file/registration transport, MpiNet (mpi_net.h)
+// the literal MPI wire, chosen by `-net_type`.
+class Net {
  public:
   using InboundFn = std::function<void(Message&&)>;
 
-  ~TcpNet() { Stop(); }
+  virtual ~Net() = default;
+
+  // Serialize + ship to the peer; false on a dead/unreachable rank.
+  virtual bool Send(int dst_rank, const Message& msg) = 0;
+  virtual void Stop() = 0;
+  virtual int rank() const = 0;
+  virtual int size() const = 0;
+};
+
+class TcpNet : public Net {
+ public:
+  using InboundFn = Net::InboundFn;
+
+  ~TcpNet() override { Stop(); }
 
   // Parse a machine file into "host:port" endpoints; empty on error.
   static std::vector<std::string> ParseMachineFile(const std::string& path);
@@ -68,12 +86,12 @@ class TcpNet {
 
   // Serialize + frame + write to the peer (lazy connect with retries —
   // peers start in any order).  Returns false on a dead peer.
-  bool Send(int dst_rank, const Message& msg);
+  bool Send(int dst_rank, const Message& msg) override;
 
-  void Stop();
+  void Stop() override;
 
-  int rank() const { return rank_; }
-  int size() const { return static_cast<int>(endpoints_.size()); }
+  int rank() const override { return rank_; }
+  int size() const override { return static_cast<int>(endpoints_.size()); }
 
  private:
   void AcceptLoop();

@@ -193,6 +193,11 @@ class SparseMatrixWorkerTable : public MatrixWorkerTable {
   std::mutex cache_mu_;
   std::vector<uint8_t> valid_;   // lazily rows_ entries
   std::vector<float> mirror_;    // lazily rows_*cols_ floats
+  // Bumped by every invalidation (own add, clock).  GetRows releases
+  // cache_mu_ for the wire fetch and installs the result only if the
+  // epoch is unchanged — a fetch that raced an invalidation must not
+  // resurrect pre-add values into the cache.
+  uint64_t cache_epoch_ = 0;
 };
 
 // ------------------------------------------------------------------- KV

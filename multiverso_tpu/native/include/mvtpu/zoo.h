@@ -88,6 +88,12 @@ class Zoo {
   int32_t RegisterArrayTable(int64_t size);
   int32_t RegisterMatrixTable(int64_t rows, int64_t cols);
   int32_t RegisterSparseMatrixTable(int64_t rows, int64_t cols);
+
+ private:
+  template <typename WorkerT>
+  int32_t RegisterMatrixTableImpl(int64_t rows, int64_t cols);
+
+ public:
   int32_t RegisterKVTable();
   ServerTable* server_table(int32_t id);
   WorkerTable* worker_table(int32_t id);
@@ -134,7 +140,7 @@ class Zoo {
   int size_ = 1;
   std::vector<int> worker_ranks_{0};   // ranks holding the worker role
   std::vector<int> server_ranks_{0};   // ranks holding the server role
-  std::unique_ptr<TcpNet> net_;
+  std::unique_ptr<Net> net_;  // TcpNet or MpiNet, per -net_type
 
   std::unique_ptr<Actor> worker_actor_;
   std::unique_ptr<Actor> server_actor_;

@@ -136,6 +136,11 @@ void RegisterDefaults() {
                  "default|sgd|adagrad|momentum|smooth_gradient");
     DefineString("machine_file", "",
                  "host:port per line; >1 line enables the TCP transport");
+    DefineString("net_type", "tcp",
+                 "tcp|mpi — wire transport (reference net.h NetLib). mpi "
+                 "dlopen's libmpi: rank/size come from MPI (mpirun for "
+                 ">1 node; isolated singleton otherwise), no machine "
+                 "file needed");
     DefineInt("rank", 0, "this process's line index in machine_file");
     DefineString("controller_endpoint", "",
                  "dynamic registration: rank 0's host:port (no machine "

@@ -567,29 +567,40 @@ bool MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
 bool SparseMatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
                                       float* data) {
   Monitor mon("SparseMatrixWorker::GetRows");
-  std::lock_guard<std::mutex> lk(cache_mu_);
-  if (valid_.empty()) {
-    valid_.assign(static_cast<size_t>(rows_), 0);
-    mirror_.assign(static_cast<size_t>(rows_ * cols_), 0.0f);
-  }
-  // Fetch only the missing in-range rows (deduped), then serve all from
-  // the mirror; out-of-range ids read zeros (the wire contract).
+  // Plan under the lock, fetch OUTSIDE it: a wire round-trip (up to
+  // rpc_timeout_ms when SSP parks the get) must not serialize other
+  // readers or stall a barrier's OnClockInvalidate.
   std::vector<int32_t> missing;
-  for (int64_t i = 0; i < k; ++i) {
-    int32_t r = row_ids[i];
-    if (r >= 0 && r < rows_ && !valid_[r]) {
-      valid_[r] = 2;  // mark "fetch scheduled" so duplicates dedupe
-      missing.push_back(r);
+  std::unordered_map<int32_t, size_t> fetch_slot;
+  uint64_t epoch;
+  {
+    std::lock_guard<std::mutex> lk(cache_mu_);
+    if (valid_.empty()) {
+      valid_.assign(static_cast<size_t>(rows_), 0);
+      mirror_.assign(static_cast<size_t>(rows_ * cols_), 0.0f);
+    }
+    epoch = cache_epoch_;
+    for (int64_t i = 0; i < k; ++i) {
+      int32_t r = row_ids[i];
+      if (r >= 0 && r < rows_ && !valid_[r] && !fetch_slot.count(r)) {
+        fetch_slot[r] = missing.size();
+        missing.push_back(r);
+      }
     }
   }
-  if (!missing.empty()) {
-    std::vector<float> fetched(missing.size() * cols_);
-    if (!MatrixWorkerTable::GetRows(missing.data(),
-                                    static_cast<int64_t>(missing.size()),
-                                    fetched.data())) {
-      for (int32_t r : missing) valid_[r] = 0;  // fetch failed: stay cold
-      return false;
-    }
+  std::vector<float> fetched(missing.size() * cols_);
+  if (!missing.empty() &&
+      !MatrixWorkerTable::GetRows(missing.data(),
+                                  static_cast<int64_t>(missing.size()),
+                                  fetched.data()))
+    return false;
+
+  std::lock_guard<std::mutex> lk(cache_mu_);
+  // Install only if no invalidation ran while the wire was in flight —
+  // caching a pre-add value after the add's invalidation would serve
+  // stale reads forever.  The fetched values themselves are still fine
+  // to RETURN: a get that races a concurrent add may see either side.
+  if (!missing.empty() && cache_epoch_ == epoch) {
     for (size_t i = 0; i < missing.size(); ++i) {
       std::memcpy(mirror_.data() + missing[i] * cols_,
                   fetched.data() + i * cols_, cols_ * sizeof(float));
@@ -598,7 +609,11 @@ bool SparseMatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
   }
   for (int64_t i = 0; i < k; ++i) {
     int32_t r = row_ids[i];
-    if (r >= 0 && r < rows_)
+    auto it = fetch_slot.find(r);
+    if (it != fetch_slot.end())
+      std::memcpy(data + i * cols_, fetched.data() + it->second * cols_,
+                  cols_ * sizeof(float));
+    else if (r >= 0 && r < rows_)
       std::memcpy(data + i * cols_, mirror_.data() + r * cols_,
                   cols_ * sizeof(float));
     else
@@ -609,29 +624,34 @@ bool SparseMatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
 
 bool SparseMatrixWorkerTable::AddAll(const float* delta,
                                      const AddOption& opt, bool blocking) {
-  {
-    std::lock_guard<std::mutex> lk(cache_mu_);
-    if (!valid_.empty()) std::fill(valid_.begin(), valid_.end(), 0);
-  }
-  return MatrixWorkerTable::AddAll(delta, opt, blocking);
+  // Invalidate AFTER the base add: doing it first opens a window where
+  // a concurrent GetRows re-caches the pre-add value and a blocking
+  // adder's own next read is stale.  Invalidate even on failure — a
+  // deadline rc is indeterminate (the server may still apply it).
+  bool ok = MatrixWorkerTable::AddAll(delta, opt, blocking);
+  std::lock_guard<std::mutex> lk(cache_mu_);
+  ++cache_epoch_;
+  if (!valid_.empty()) std::fill(valid_.begin(), valid_.end(), 0);
+  return ok;
 }
 
 bool SparseMatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
                                       const float* delta,
                                       const AddOption& opt, bool blocking) {
-  {
-    std::lock_guard<std::mutex> lk(cache_mu_);
-    if (!valid_.empty())
-      for (int64_t i = 0; i < k; ++i)
-        if (row_ids[i] >= 0 && row_ids[i] < rows_) valid_[row_ids[i]] = 0;
-  }
-  return MatrixWorkerTable::AddRows(row_ids, k, delta, opt, blocking);
+  bool ok = MatrixWorkerTable::AddRows(row_ids, k, delta, opt, blocking);
+  std::lock_guard<std::mutex> lk(cache_mu_);
+  ++cache_epoch_;
+  if (!valid_.empty())
+    for (int64_t i = 0; i < k; ++i)
+      if (row_ids[i] >= 0 && row_ids[i] < rows_) valid_[row_ids[i]] = 0;
+  return ok;
 }
 
 void SparseMatrixWorkerTable::OnClockInvalidate() {
   // Clock closed: peers' adds are now applied server-side — every
   // cached row may be stale.
   std::lock_guard<std::mutex> lk(cache_mu_);
+  ++cache_epoch_;
   if (!valid_.empty()) std::fill(valid_.begin(), valid_.end(), 0);
 }
 

@@ -5,6 +5,7 @@
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/log.h"
+#include "mvtpu/mpi_net.h"
 #include "mvtpu/waiter.h"
 
 namespace mvtpu {
@@ -156,7 +157,25 @@ bool Zoo::Start(int argc, const char* const* argv) {
   server_ranks_ = {0};
   std::string machine_file = configure::GetString("machine_file");
   std::string ctrl = configure::GetString("controller_endpoint");
-  if (!ctrl.empty()) {
+  std::string net_type = configure::GetString("net_type");
+  if (net_type != "tcp" && net_type != "mpi") {
+    Log::Error("unknown -net_type '%s' (expected tcp|mpi)",
+               net_type.c_str());
+    return false;
+  }
+  if (net_type == "mpi") {
+    // Literal MPI wire (reference net/mpi_net.h, SURVEY §2.17): rank and
+    // size come from MPI itself — machine_file / -rank / registration
+    // are TCP-mode concepts and are ignored.  Every rank is
+    // worker + server (the reference's MPI static mode, Role::All).
+    auto mpi = std::make_unique<MpiNet>();
+    if (!mpi->Init([this](Message&& m) { RouteInbound(std::move(m)); }))
+      return false;
+    rank_ = mpi->rank();
+    size_ = mpi->size();
+    SetRoles(std::vector<int>(size_, kRoleWorker | kRoleServer));
+    net_ = std::move(mpi);
+  } else if (!ctrl.empty()) {
     // Dynamic registration (reference Control_Register, SURVEY §2.7):
     // no machine file, no -rank — the controller assigns ranks and
     // broadcasts the node table; roles can differ per process.
@@ -194,13 +213,12 @@ bool Zoo::Start(int argc, const char* const* argv) {
     size_ = static_cast<int>(endpoints.size());
     SetRoles(roles);
     if (size_ > 1) {
-      net_ = std::make_unique<TcpNet>();
-      if (!net_->Init(endpoints, rank_,
-                      [this](Message&& m) { RouteInbound(std::move(m)); },
-                      configure::GetInt("connect_retry_ms"))) {
-        net_.reset();
+      auto tcp = std::make_unique<TcpNet>();
+      if (!tcp->Init(endpoints, rank_,
+                     [this](Message&& m) { RouteInbound(std::move(m)); },
+                     configure::GetInt("connect_retry_ms")))
         return false;
-      }
+      net_ = std::move(tcp);
     }
   } else if (!machine_file.empty()) {
     auto endpoints = TcpNet::ParseMachineFile(machine_file);
@@ -209,13 +227,12 @@ bool Zoo::Start(int argc, const char* const* argv) {
       size_ = static_cast<int>(endpoints.size());
       // Static mode: every rank is worker + server (reference Role::All).
       SetRoles(std::vector<int>(size_, kRoleWorker | kRoleServer));
-      net_ = std::make_unique<TcpNet>();
-      if (!net_->Init(endpoints, rank_,
-                      [this](Message&& m) { RouteInbound(std::move(m)); },
-                      configure::GetInt("connect_retry_ms"))) {
-        net_.reset();
+      auto tcp = std::make_unique<TcpNet>();
+      if (!tcp->Init(endpoints, rank_,
+                     [this](Message&& m) { RouteInbound(std::move(m)); },
+                     configure::GetInt("connect_retry_ms")))
         return false;
-      }
+      net_ = std::move(tcp);
     }
   }
 
@@ -419,6 +436,20 @@ void Zoo::OnBarrierRelease(int64_t round) {
 
 void Zoo::Clock() {
   int64_t c = ++clock_;
+  // A tick is the SSP read boundary: cached rows fetched before it
+  // would be served as hits FOREVER — never reaching the server where
+  // MaybeHoldGet enforces `-staleness` — so the bound would silently
+  // not hold.  Invalidate like Barrier does (snapshot under tables_mu_,
+  // call outside — OnClockInvalidate takes the table's own lock).
+  {
+    std::vector<WorkerTable*> snapshot;
+    {
+      std::lock_guard<std::mutex> lk(tables_mu_);
+      for (auto& t : worker_tables_)
+        if (t) snapshot.push_back(t.get());
+    }
+    for (auto* t : snapshot) t->OnClockInvalidate();
+  }
   // Announce to every server shard, async.  Per-connection FIFO puts the
   // tick BEHIND this clock's adds on the same connection, which is what
   // makes "min worker clock >= c" mean those adds are applied.
@@ -642,7 +673,12 @@ int32_t Zoo::RegisterArrayTable(int64_t size) {
   return id;
 }
 
-int32_t Zoo::RegisterMatrixTable(int64_t rows, int64_t cols) {
+// Both matrix kinds share the server shard (only requested rows ever
+// ride the wire); the sparse table's value-add is purely the
+// WORKER-side row cache, so registration differs only in the
+// worker-table type.
+template <typename WorkerT>
+int32_t Zoo::RegisterMatrixTableImpl(int64_t rows, int64_t cols) {
   std::lock_guard<std::mutex> lk(tables_mu_);
   int32_t id = static_cast<int32_t>(server_tables_.size());
   int sid = server_id();
@@ -651,24 +687,16 @@ int32_t Zoo::RegisterMatrixTable(int64_t rows, int64_t cols) {
               : std::make_unique<MatrixServerTable>(
                     rows, cols, updater_type_, sid, num_servers()));
   worker_tables_.push_back(
-      std::make_unique<MatrixWorkerTable>(id, rows, cols, num_servers()));
+      std::make_unique<WorkerT>(id, rows, cols, num_servers()));
   return id;
 }
 
+int32_t Zoo::RegisterMatrixTable(int64_t rows, int64_t cols) {
+  return RegisterMatrixTableImpl<MatrixWorkerTable>(rows, cols);
+}
+
 int32_t Zoo::RegisterSparseMatrixTable(int64_t rows, int64_t cols) {
-  std::lock_guard<std::mutex> lk(tables_mu_);
-  int32_t id = static_cast<int32_t>(server_tables_.size());
-  int sid = server_id();
-  // Server side reuses the matrix shard (only requested rows ever ride
-  // the wire); the sparse value-add is the WORKER-side row cache.
-  server_tables_.push_back(
-      sid < 0 ? nullptr
-              : std::make_unique<MatrixServerTable>(
-                    rows, cols, updater_type_, sid, num_servers()));
-  worker_tables_.push_back(
-      std::make_unique<SparseMatrixWorkerTable>(id, rows, cols,
-                                                num_servers()));
-  return id;
+  return RegisterMatrixTableImpl<SparseMatrixWorkerTable>(rows, cols);
 }
 
 int32_t Zoo::RegisterKVTable() {

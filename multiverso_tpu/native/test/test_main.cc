@@ -16,7 +16,9 @@
 #include "mvtpu/blob.h"
 #include "mvtpu/c_api.h"
 #include "mvtpu/configure.h"
+#include "mvtpu/dashboard.h"
 #include "mvtpu/message.h"
+#include "mvtpu/mpi_net.h"
 #include "mvtpu/mt_queue.h"
 #include "mvtpu/updater.h"
 #include "mvtpu/waiter.h"
@@ -192,6 +194,21 @@ static int TestSparseMatrix() {
   for (float v : out) CHECK(v == 4.0f);          // own add invalidated
   CHECK(MV_Barrier() == 0);                      // clock invalidate
   CHECK(MV_GetMatrixTableByRows(h, out.data(), rows, 2, 4) == 0);
+  for (float v : out) CHECK(v == 4.0f);
+  // An SSP tick (MV_Clock) must invalidate the cache like a barrier —
+  // a cache hit would bypass the server's -staleness enforcement.
+  // Observable via the base table's wire-fetch monitor: warm reads
+  // don't touch it, the post-tick read must.
+  long long wire0 = 0, wire1 = 0, wire2 = 0;
+  double tot = 0.0;
+  mvtpu::Dashboard::Query("MatrixWorker::GetRows", &wire0, &tot);
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), rows, 2, 4) == 0);
+  mvtpu::Dashboard::Query("MatrixWorker::GetRows", &wire1, &tot);
+  CHECK(wire1 == wire0);                         // warm: pure cache hit
+  CHECK(MV_Clock() == 0);
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), rows, 2, 4) == 0);
+  mvtpu::Dashboard::Query("MatrixWorker::GetRows", &wire2, &tot);
+  CHECK(wire2 == wire1 + 1);                     // tick forced a re-fetch
   for (float v : out) CHECK(v == 4.0f);
   int32_t oob[1] = {99};
   std::vector<float> zout(4, -1.0f);
@@ -677,6 +694,76 @@ static int SspDeadChild(const char* machine_file, const char* rank) {
 
 // Scenario children: a CHECK failure returns without MV_ShutDown, and
 // live runtime threads then crash during normal process exit (rc=-11),
+// MPI scenarios (SURVEY §2.17, reference net/mpi_net.h).  MPI allows one
+// init/finalize cycle per process, so each scenario is its own argv[1]
+// dispatch (own subprocess from pytest).  When no usable libmpi resolves
+// they print MPI_UNAVAILABLE and exit 0 — the pytest side skips.
+
+// Direct wire exercise: a Message with real float payload rides MPI to
+// this rank (self-send traverses the actual transport — MpiNet::Send →
+// MPI_Send → probe thread → inbound callback; the Zoo's local-dst
+// shortcut is deliberately not in the path).
+static int MpiSelfScenario() {
+  if (!mvtpu::MpiNet::Available()) {
+    printf("MPI_UNAVAILABLE\n");
+    return 0;
+  }
+  mvtpu::MpiNet net;
+  mvtpu::MtQueue<mvtpu::Message> inbox;
+  CHECK(net.Init([&](mvtpu::Message&& m) { inbox.Push(std::move(m)); }));
+  CHECK(net.size() >= 1);
+
+  mvtpu::Message msg;
+  msg.src = net.rank();
+  msg.dst = net.rank();
+  msg.type = mvtpu::MsgType::RequestAdd;
+  msg.table_id = 7;
+  msg.msg_id = 1234;
+  mvtpu::Blob payload(4 * sizeof(float));
+  for (int i = 0; i < 4; ++i) payload.As<float>()[i] = 0.5f * i;
+  msg.data.push_back(payload);
+  CHECK(net.Send(net.rank(), msg));
+
+  mvtpu::Message got;
+  CHECK(inbox.Pop(&got));
+  CHECK(got.src == net.rank() && got.dst == net.rank());
+  CHECK(got.type == mvtpu::MsgType::RequestAdd);
+  CHECK(got.table_id == 7 && got.msg_id == 1234);
+  CHECK(got.data.size() == 1 && got.data[0].count<float>() == 4);
+  for (int i = 0; i < 4; ++i)
+    CHECK(std::fabs(got.data[0].As<float>()[i] - 0.5f * i) < 1e-6f);
+
+  // Unknown rank → clean false, not an MPI abort.
+  CHECK(!net.Send(net.size() + 3, msg));
+  net.Stop();
+  printf("MPI_SELF_OK rank=%d size=%d\n", net.rank(), net.size());
+  return 0;
+}
+
+// Full runtime lifecycle over the MPI transport: MV_Init with
+// -net_type=mpi (isolated singleton under a plain launch; the same path
+// serves mpirun-launched jobs), table round trips, clean shutdown.
+static int MpiZooScenario() {
+  if (!mvtpu::MpiNet::Available()) {
+    printf("MPI_UNAVAILABLE\n");
+    return 0;
+  }
+  const char* argv[] = {"-net_type=mpi", "-updater_type=default",
+                        "-log_level=error"};
+  CHECK(MV_Init(3, argv) == 0);
+  CHECK(MV_NumWorkers() >= 1);
+  int32_t h = -1;
+  CHECK(MV_NewArrayTable(16, &h) == 0);
+  std::vector<float> delta(16, 2.0f), out(16, 0.0f);
+  CHECK(MV_AddArrayTable(h, delta.data(), 16) == 0);
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 16) == 0);
+  for (float v : out) CHECK(std::fabs(v - 2.0f) < 1e-6f);
+  CHECK(MV_ShutDown() == 0);
+  printf("MPI_ZOO_OK\n");
+  return 0;
+}
+
 // masking the CHECK diagnostic — _exit skips teardown and keeps rc=1.
 static int ScenarioExit(int rc) {
   fflush(stdout);
@@ -701,6 +788,10 @@ int main(int argc, char** argv) {
     return ScenarioExit(DeadPeerChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "dead_server")
     return ScenarioExit(DeadServerChild(argv[2], argv[3]));
+  if (argc == 2 && std::string(argv[1]) == "mpi_self")
+    return ScenarioExit(MpiSelfScenario());
+  if (argc == 2 && std::string(argv[1]) == "mpi_zoo")
+    return ScenarioExit(MpiZooScenario());
   struct Case {
     const char* name;
     int (*fn)();

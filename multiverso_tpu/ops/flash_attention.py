@@ -41,7 +41,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fit_block"]
+
+
+def fit_block(block: int, t: int) -> int:
+    """Largest power-of-two ≤ ``block`` dividing ``t`` (or ``t`` itself
+    when ``t <= block``).  Blocks are a perf knob, not an API contract —
+    requested sizes shrink to fit.  The one block-fitting policy for
+    every flash dispatch site (the ring-attention dispatcher wraps this
+    with its own floor)."""
+    b = min(block, t)
+    while b >= 8 and t % b:
+        b //= 2
+    return b
 
 _NEG = -1e30
 _LANES = 128
@@ -355,16 +367,8 @@ def flash_attention(q, k, v, scale: Optional[float] = None,
     if scale is None:
         scale = D ** -0.5
 
-    def fit(block, t):
-        """Largest power-of-two ≤ block dividing t (blocks are a perf
-        knob, not an API contract — requested sizes shrink to fit)."""
-        b = min(block, t)
-        while b >= 8 and t % b:
-            b //= 2
-        return b
-
-    block_q = fit(block_q, Tq)
-    block_k = fit(block_k, Tk)
+    block_q = fit_block(block_q, Tq)
+    block_k = fit_block(block_k, Tk)
     if block_q < 8 or block_k < 8:
         raise ValueError(f"no usable block size (>=8) divides "
                          f"Tq={Tq}, Tk={Tk}")

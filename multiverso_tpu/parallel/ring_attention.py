@@ -58,15 +58,22 @@ def _online_block(q, k_blk, v_blk, o, m, l, q_pos, k_pos, scale, causal):
     return o, new_m, l
 
 
-def _flash_block(t: int, cap: int = 512) -> int:
-    """Largest power-of-two block ≤cap dividing t (0 if none ≥64).
+def _flash_block(t: int, cap: int, head_dim: int) -> int:
+    """Block for the flash dispatch: the kernel's own fit policy
+    (``ops.flash_attention.fit_block``) gated at ≥64 — below that the
+    non-pallas scan path wins (0 = don't dispatch flash).
 
     Caps are the measured v5e sweet spot at D=128: q blocks 512, k
-    blocks 1024 (``ops/flash_attention.py`` docstring)."""
-    for b in (1024, 512, 256, 128, 64):
-        if b <= cap and t % b == 0:
-            return b
-    return 0
+    blocks 1024 (``ops/flash_attention.py`` docstring).  The kernel's
+    VMEM footprint scales with block·head_dim (k/v tiles) — larger head
+    dims shrink the cap proportionally so D=256 keeps the D=128 budget
+    instead of risking Mosaic VMEM exhaustion."""
+    from ..ops.flash_attention import fit_block
+
+    if head_dim > 128:
+        cap = max(64, cap * 128 // head_dim)
+    b = fit_block(cap, t)
+    return b if b >= 64 else 0
 
 
 def blockwise_attention_local(q, k, v, scale: float, causal: bool = True,
@@ -84,8 +91,8 @@ def blockwise_attention_local(q, k, v, scale: float, causal: bool = True,
     import os
 
     B, H, T, D = q.shape
-    bq = _flash_block(T, cap=512)
-    bk = _flash_block(T, cap=1024)
+    bq = _flash_block(T, cap=512, head_dim=D)
+    bk = _flash_block(T, cap=1024, head_dim=D)
     on_tpu = jax.default_backend() == "tpu"
     force = os.environ.get("MVTPU_FORCE_FLASH", "")
     use_flash = (q_offset == 0 and k_offset == 0 and T == k.shape[2]
@@ -122,7 +129,8 @@ def _attn_piece(q, k, v, scale, causal: bool):
 
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    bq, bk = _flash_block(Tq, cap=512), _flash_block(Tk, cap=1024)
+    bq = _flash_block(Tq, cap=512, head_dim=D)
+    bk = _flash_block(Tk, cap=1024, head_dim=D)
     on_tpu = jax.default_backend() == "tpu"
     force = os.environ.get("MVTPU_FORCE_FLASH", "")
     if (bq and bk and not os.environ.get("MVTPU_NO_FLASH")

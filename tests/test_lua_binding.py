@@ -66,6 +66,92 @@ def test_lua_module_wraps_every_cdef_function():
         assert api in body, f"missing reference API surface: {api}"
 
 
+def test_lua_cdef_executes_via_cffi(tmp_path):
+    """Execute the binding's EXACT FFI contract — no luajit required.
+
+    LuaJIT's ``ffi.cdef``/``ffi.load`` and Python's ``cffi`` are design
+    twins: both parse real C declarations and bind them to a dlopen'd
+    library.  This test feeds the verbatim cdef block from
+    ``multiverso.lua`` through cffi's C parser (strict — a bad type or
+    missing typedef fails here where the regex contract test cannot see
+    it), dlopens the same ``libmvtpu.so`` the Lua module loads, and
+    replays ``test_lua_smoke``'s round trips (array add/get, matrix
+    rows sync+async, KV single+batch) through those declarations.  What
+    it cannot cover is the Lua wrapper code itself (the handler classes
+    in ``multiverso.lua``) — that remains gated on a luajit appearing on
+    PATH (see ``test_lua_smoke``).
+    """
+    pytest.importorskip("cffi")
+    from multiverso_tpu import native as nat
+
+    lib = nat.ensure_built()
+    lua = open(_LUA).read()
+    cdef = re.search(r"ffi\.cdef\[\[(.*?)\]\]", lua, re.DOTALL).group(1)
+    cdef_file = tmp_path / "cdef.txt"
+    cdef_file.write_text(cdef)
+
+    script = tmp_path / "cffi_smoke.py"
+    script.write_text(f"""
+import cffi
+
+ffi = cffi.FFI()
+ffi.cdef(open({str(cdef_file)!r}).read())   # the verbatim Lua cdef block
+C = ffi.dlopen({lib!r})
+
+argv = [ffi.new("char[]", s) for s in (b"-updater_type=default",
+                                       b"-log_level=error")]
+assert C.MV_Init(len(argv), ffi.new("const char*[]", argv)) == 0
+
+h = ffi.new("int32_t[1]")
+assert C.MV_NewArrayTable(8, h) == 0
+ones = ffi.new("float[]", [1.0] * 8)
+assert C.MV_AddArrayTable(h[0], ones, 8) == 0
+out = ffi.new("float[8]")
+assert C.MV_GetArrayTable(h[0], out, 8) == 0
+assert abs(out[0] - 1.0) < 1e-6 and abs(out[7] - 1.0) < 1e-6
+
+m = ffi.new("int32_t[1]")
+assert C.MV_NewMatrixTable(6, 3, m) == 0
+ids = ffi.new("int32_t[]", [1, 4])
+delta = ffi.new("float[]", [1, 2, 3, 4, 5, 6])
+assert C.MV_AddMatrixTableByRows(m[0], delta, ids, 2, 3) == 0
+rows = ffi.new("float[6]")
+back = ffi.new("int32_t[]", [4, 1])
+assert C.MV_GetMatrixTableByRows(m[0], rows, back, 2, 3) == 0
+assert abs(rows[0] - 4.0) < 1e-6 and abs(rows[3] - 1.0) < 1e-6
+one = ffi.new("int32_t[]", [1])
+ten = ffi.new("float[]", [10.0, 10.0, 10.0])
+assert C.MV_AddAsyncMatrixTableByRows(m[0], ten, one, 1, 3) == 0
+assert C.MV_Barrier() == 0
+assert C.MV_GetMatrixTableByRows(m[0], rows, one, 1, 3) == 0
+assert abs(rows[0] - 11.0) < 1e-6
+
+kv = ffi.new("int32_t[1]")
+assert C.MV_NewKVTable(kv) == 0
+assert C.MV_AddKV(kv[0], b"alpha", 2.5) == 0
+v = ffi.new("float[1]")
+assert C.MV_GetKV(kv[0], b"alpha", v) == 0
+assert abs(v[0] - 2.5) < 1e-6
+lens = ffi.new("int32_t[]", [1, 2])
+assert C.MV_AddKVBatch(kv[0], b"bcc", lens, 2,
+                       ffi.new("float[]", [1.0, 2.0])) == 0
+qlens = ffi.new("int32_t[]", [2, 1, 6])
+vals = ffi.new("float[3]")
+assert C.MV_GetKVBatch(kv[0], b"ccbabsent", qlens, 3, vals) == 0
+assert abs(vals[0] - 2.0) < 1e-6 and abs(vals[1] - 1.0) < 1e-6
+assert vals[2] == 0.0
+
+assert C.MV_Barrier() == 0
+assert C.MV_ShutDown() == 0
+print("CFFI_SMOKE_OK")
+""")
+    out = subprocess.run(
+        [__import__("sys").executable, str(script)], capture_output=True,
+        text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CFFI_SMOKE_OK" in out.stdout
+
+
 @pytest.mark.skipif(shutil.which("luajit") is None, reason="no luajit")
 def test_lua_smoke(tmp_path):
     """Live execution of the Lua module: array, matrix-rows, and KV round

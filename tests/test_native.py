@@ -314,3 +314,30 @@ def test_native_dynamic_registration(native, tmp_path):
     for (port, role, _), p, out in zip(spec, procs, outs):
         assert p.returncode == 0, f"{role}:\n{out[-3000:]}"
         assert f"REGISTER_OK {role}" in out, out[-2000:]
+
+
+@pytest.mark.parametrize("scenario,marker", [
+    ("mpi_self", "MPI_SELF_OK"),
+    ("mpi_zoo", "MPI_ZOO_OK"),
+])
+def test_native_mpi_transport(native, scenario, marker):
+    """Literal MPI wire backend (reference net/mpi_net.h, SURVEY.md
+    §2.17), selected with ``-net_type=mpi``: libmpi is dlopen'd (no
+    mpi.h in the image) and rank/size come from MPI itself.
+
+    ``mpi_self`` drives MpiNet directly — a Message with float payload
+    traverses MPI_Send → Iprobe/Recv → inbound callback (the Zoo's
+    local-dst shortcut is not in the path).  ``mpi_zoo`` boots the full
+    runtime over the MPI transport and round-trips a table.  Each runs
+    in its own subprocess because MPI_Finalize is terminal per process.
+    Without mpirun in the image both run as OpenMPI isolated singletons
+    (rank 0 / size 1); the same code path serves ``mpirun -n N``
+    launches, where rank/size arrive from the launcher environment.
+    Skips only when no usable libmpi resolves at all.
+    """
+    out = subprocess.run([_binary(), scenario], capture_output=True,
+                         text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    if "MPI_UNAVAILABLE" in out.stdout:
+        pytest.skip("no dlopen-able libmpi in this image")
+    assert marker in out.stdout, out.stdout + out.stderr
