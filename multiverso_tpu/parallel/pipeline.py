@@ -40,7 +40,8 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
           stage_params: Any, x: jax.Array, mesh: Mesh,
           axis_name: str = "pp",
           batch_axis: str | None = "dp",
-          param_specs: Any = None) -> jax.Array:
+          param_specs: Any = None,
+          remat_stages: bool = False) -> jax.Array:
     """Run ``x`` through ``pp`` pipeline stages, microbatched.
 
     - ``stage_fn(params_slice, h) -> h``: one stage's compute (e.g. a
@@ -59,6 +60,22 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
       its row-parallel matmul outputs (see
       ``models/transformer.py`` pp×tp).  Default: weights replicated on
       every non-stage axis; pp then composes with dp only.
+    - ``remat_stages``: wrap each stage tick in ``jax.checkpoint``.
+      Under ``jax.grad`` this gives the 1F1B *memory* profile without
+      1F1B's manual fwd/bwd interleaving: plain GPipe-as-scan saves
+      every stage's internal activations for all M microbatches
+      (O(M·layers_per_stage) per device); with remat only each tick's
+      stage INPUT survives to the backward sweep — and that is the
+      rotation buffer the scan carries anyway — so live memory drops to
+      the microbatched input [M, Bm, d] plus one in-flight activation,
+      the same O(pp)-in-flight bound 1F1B schedules target.  The cost is
+      one extra forward per stage in the backward sweep, which is the
+      standard remat trade everywhere else in this framework.  (1F1B's
+      remaining advantage, bubble shape under interleaved virtual
+      stages, needs per-tick fwd/bwd mixing that fights ``jax.grad``'s
+      reverse-of-forward schedule — documented as out of scope.)  Must
+      run under ``jax.jit`` (``jax.checkpoint`` inside ``shard_map`` has
+      no eager path).
     """
     pp = int(mesh.shape[axis_name])
     M = int(x.shape[0])
@@ -75,6 +92,7 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
             stage_params, param_specs,
             is_leaf=lambda t: isinstance(t, P))
     ring = [(s, (s + 1) % pp) for s in range(pp)]
+    tick_fn = jax.checkpoint(stage_fn) if remat_stages else stage_fn
 
     def local(params_s, x_all):
         # params_s leaves: [1, ...] (this stage's slice); drop the dim.
@@ -89,7 +107,7 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
             # work on what the previous tick's rotation handed them.
             inject = x_all[jnp.minimum(t, M - 1)]
             h = jnp.where(idx == 0, inject, buf)
-            h = stage_fn(params_s, h)
+            h = tick_fn(params_s, h)
             m = t - idx                         # microbatch this stage did
             bank = (idx == pp - 1) & (m >= 0) & (m < M)
             # Mask the ROW, not the whole bank — a full-buffer where()
