@@ -4,6 +4,7 @@
 //                        updater|checkpoint|threads
 #include <unistd.h>
 
+#include <atomic>
 #include <cassert>
 #include <chrono>
 #include <cmath>
@@ -735,6 +736,24 @@ static int MpiSelfScenario() {
 
   // Unknown rank → clean false, not an MPI abort.
   CHECK(!net.Send(net.size() + 3, msg));
+
+  // Concurrent senders: 4 threads x 50 sends through the serial-mode
+  // lock (Isend + Test polling) while the probe thread drains — the
+  // exact interleaving a worker/server pair generates under load.
+  std::atomic<int> sent{0};
+  std::vector<std::thread> senders;
+  for (int s = 0; s < 4; ++s)
+    senders.emplace_back([&net, &sent, &msg] {
+      for (int i = 0; i < 50; ++i)
+        if (net.Send(net.rank(), msg)) ++sent;
+    });
+  for (auto& t : senders) t.join();
+  CHECK(sent.load() == 200);
+  for (int i = 0; i < 200; ++i) {
+    mvtpu::Message m;
+    CHECK(inbox.Pop(&m));
+    CHECK(m.table_id == 7 && m.data.size() == 1);
+  }
   net.Stop();
   printf("MPI_SELF_OK rank=%d size=%d\n", net.rank(), net.size());
   return 0;
