@@ -341,3 +341,14 @@ def test_native_mpi_transport(native, scenario, marker):
     if "MPI_UNAVAILABLE" in out.stdout:
         pytest.skip("no dlopen-able libmpi in this image")
     assert marker in out.stdout, out.stdout + out.stderr
+
+
+def test_native8_lr_baseline_section(native):
+    """bench_lr_native8's machinery at CI scale (2 procs, 5 steps): the
+    north-star denominator (BASELINE.md action 2) must produce a finite
+    aggregate rate from real cross-process wire traffic."""
+    import bench
+
+    r = bench.bench_lr_native8(procs=2, steps=5, batch=64)
+    assert r["lr_native8_samples_per_sec"] > 0
+    assert r["lr_native8_procs"] == 2.0
