@@ -15,13 +15,15 @@ table name.  Multi-host: only process 0 writes; everyone barriers after.
 from __future__ import annotations
 
 import pickle
+import threading
 from typing import Any, Dict, Optional
 
 from .core import context as core_context
 from .io import StreamFactory
 from .log import Log
 
-__all__ = ["save", "restore", "save_pytree", "restore_pytree"]
+__all__ = ["save", "restore", "save_pytree", "restore_pytree",
+           "save_pytree_async", "AsyncSave"]
 
 _MAGIC = b"MVTPUCKPT1"
 _MAGIC_TREE = b"MVTPUTREE1"
@@ -115,6 +117,73 @@ def restore_pytree(uri: str, like: Any = None) -> Any:
         raise ValueError(
             f"{uri}: snapshot tree structure does not match the live "
             f"tree (different model config or updater?): {exc}") from exc
+
+
+class AsyncSave:
+    """Handle for an in-flight :func:`save_pytree_async` write.
+
+    ``result()`` joins the writer thread, re-raises any IO error, and
+    host-syncs every rank — after it returns on all ranks the file is
+    durable and safe to restore.  Dropping the handle without calling
+    ``result()`` leaves a daemon thread that may still be writing at
+    interpreter exit (the atomic temp+rename means a killed write never
+    leaves a truncated file at the final path, just no file)."""
+
+    def __init__(self, uri: str, thread: Optional[threading.Thread]):
+        self._uri = uri
+        self._thread = thread
+        self._err: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._thread is None or not self._thread.is_alive()
+
+    def result(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(
+                    f"checkpoint write still in flight: {self._uri}")
+        if self._err is not None:
+            raise self._err
+        # Same durability contract as the sync save: every rank agrees
+        # the file exists before anyone restores it.
+        core_context.get_context().host_sync("mvtpu_pytree_async_save")
+
+
+def save_pytree_async(uri: str, tree: Any) -> AsyncSave:
+    """:func:`save_pytree` with the slow half off the critical path.
+
+    The device→host fetch runs synchronously at the call point — it is
+    the collective, consistency-critical part (the snapshot is of the
+    params AS OF this call, and multi-host gathers need every rank) —
+    then rank 0's pickle + stream write happens on a background thread
+    while training continues.  For the ~seconds a multi-GB write takes,
+    the train loop only pays the D2H copy.  Call ``result()`` on the
+    returned handle (every rank) before restoring or shutting down.
+    """
+    import jax
+
+    from .tables.base import host_fetch
+
+    ctx = core_context.get_context()
+    host_tree = jax.tree_util.tree_map(
+        lambda a: host_fetch(a) if isinstance(a, jax.Array) else a, tree)
+    if ctx.node.rank != 0:
+        return AsyncSave(uri, None)
+
+    handle = AsyncSave(uri, None)
+
+    def write():
+        try:
+            _write_snapshot(uri, _MAGIC_TREE, host_tree)
+            Log.info("pytree checkpoint saved (async): %s", uri)
+        except BaseException as exc:  # surfaced by result()
+            handle._err = exc
+
+    t = threading.Thread(target=write, name="mvtpu-ckpt-write", daemon=True)
+    handle._thread = t
+    t.start()
+    return handle
 
 
 def save(uri: str, extra: Optional[Dict[str, Any]] = None) -> None:
