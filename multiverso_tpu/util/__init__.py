@@ -2,7 +2,8 @@
 
 from .async_buffer import AsyncBuffer
 from .net_util import get_host_name, get_local_ips, match_machine_file
+from .prefetch import prefetch_to_device
 from .timer import Timer
 
 __all__ = ["AsyncBuffer", "Timer", "get_local_ips", "get_host_name",
-           "match_machine_file"]
+           "match_machine_file", "prefetch_to_device"]

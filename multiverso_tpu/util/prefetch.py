@@ -1,0 +1,61 @@
+"""Device prefetch — keep H2D transfers behind compute.
+
+The reference's ``AsyncBuffer`` (SURVEY.md §2.24) hides parameter-pull
+latency behind the training step; on TPU the analogous host-side
+bottleneck is the input pipeline: a ``device_put`` issued only when the
+step needs its batch serializes transfer and compute.  ``jax``'s
+transfers are asynchronous — ``device_put`` returns immediately with
+the copy in flight — so keeping a small window of batches pre-issued
+overlaps every transfer with the previous step's compute, no thread
+needed (the standard flax-style prefetch pattern, re-homed here next to
+its host-thread sibling :class:`~multiverso_tpu.util.AsyncBuffer`).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Any, Iterable, Iterator, Optional
+
+__all__ = ["prefetch_to_device"]
+
+
+def prefetch_to_device(iterator: Iterable[Any], size: int = 2,
+                       sharding: Optional[Any] = None) -> Iterator[Any]:
+    """Yield elements of ``iterator`` with their arrays already on device.
+
+    Each element (a pytree of host arrays) is ``jax.device_put`` up to
+    ``size`` elements ahead of the consumer; with ``sharding`` (e.g. a
+    ``NamedSharding`` over the data mesh axis) batches land pre-sharded,
+    so the train step never reshards its input.  Non-array leaves
+    (step counters, ids, strings) ride along untouched — a batch
+    sharding makes no sense for them.
+
+    ``size=2`` is the sweet spot for steady-state training (one batch
+    computing, one in flight); larger only helps jittery producers.
+    """
+    import jax
+
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    it = iter(iterator)
+    queue: collections.deque = collections.deque()
+
+    import numpy as np
+
+    def put_leaf(x):
+        if not isinstance(x, (np.ndarray, jax.Array)):
+            return x
+        return jax.device_put(x, sharding)
+
+    def put(batch):
+        return jax.tree_util.tree_map(put_leaf, batch)
+
+    def enqueue(n: int) -> None:
+        for batch in itertools.islice(it, n):
+            queue.append(put(batch))
+
+    enqueue(size)
+    while queue:
+        yield queue.popleft()
+        enqueue(1)

@@ -148,6 +148,37 @@ def test_async_buffer_order_and_overlap(mv):
         assert buf.get() == 2
 
 
+def test_prefetch_to_device(mv):
+    """prefetch_to_device: order preserved, values intact, arrays land
+    as committed jax.Arrays (optionally pre-sharded), exhaustion clean."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from multiverso_tpu.util import prefetch_to_device
+
+    batches = [{"x": np.full((4, 2), i, np.float32), "i": i}
+               for i in range(5)]
+    got = list(prefetch_to_device(iter(batches), size=2))
+    assert [b["i"] for b in got] == list(range(5))
+    for i, b in enumerate(got):
+        assert isinstance(b["x"], jax.Array)
+        np.testing.assert_allclose(np.asarray(b["x"]), i)
+
+    # Pre-sharded landing: the batch dim arrives split over the mesh.
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+    sh = NamedSharding(mesh, P("dp"))
+    out = list(prefetch_to_device(iter(batches[:2]), size=2, sharding=sh))
+    assert out[0]["x"].sharding == sh
+
+    with pytest.raises(ValueError):
+        next(prefetch_to_device(iter(batches), size=0))
+
+    # size > stream length: everything still arrives exactly once.
+    assert [b["i"] for b in
+            prefetch_to_device(iter(batches), size=10)] == list(range(5))
+
+
 def test_timer():
     from multiverso_tpu.util import Timer
 
