@@ -28,28 +28,49 @@ def prefetch_to_device(iterator: Iterable[Any], size: int = 2,
     ``size`` elements ahead of the consumer; with ``sharding`` (e.g. a
     ``NamedSharding`` over the data mesh axis) batches land pre-sharded,
     so the train step never reshards its input.  Non-array leaves
-    (step counters, ids, strings) ride along untouched — a batch
-    sharding makes no sense for them.
+    (step counters, ids, strings) ride along untouched, and a leaf the
+    sharding cannot apply to — a scalar array, or a final partial batch
+    whose leading dim doesn't divide the axis — is replicated instead
+    of raising mid-epoch (the same fallback as
+    ``parallel.sharding.batch_placer``, which serves the fused apps;
+    this serves arbitrary host iterators).
 
     ``size=2`` is the sweet spot for steady-state training (one batch
     computing, one in flight); larger only helps jittery producers.
     """
-    import jax
-
-    if size < 1:
+    if size < 1:  # validate HERE, not at first next() inside the loop
         raise ValueError(f"prefetch size must be >= 1, got {size}")
-    it = iter(iterator)
-    queue: collections.deque = collections.deque()
+    return _prefetch_gen(iter(iterator), size, sharding)
 
+
+def _prefetch_gen(it: Iterator[Any], size: int,
+                  sharding: Optional[Any]) -> Iterator[Any]:
+    import jax
     import numpy as np
+
+    replicated = None
+    if sharding is not None and hasattr(sharding, "mesh"):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicated = NamedSharding(sharding.mesh, PartitionSpec())
 
     def put_leaf(x):
         if not isinstance(x, (np.ndarray, jax.Array)):
             return x
-        return jax.device_put(x, sharding)
+        if sharding is None:
+            return jax.device_put(x)
+        try:
+            return jax.device_put(x, sharding)
+        except ValueError:
+            # Spec rank > leaf rank, or non-divisible dims: replicated
+            # is correct, just unsharded.
+            return jax.device_put(x, replicated) if replicated is not None \
+                else jax.device_put(x)
 
     def put(batch):
         return jax.tree_util.tree_map(put_leaf, batch)
+
+    queue: collections.deque = collections.deque()
 
     def enqueue(n: int) -> None:
         for batch in itertools.islice(it, n):

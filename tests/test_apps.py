@@ -171,8 +171,18 @@ def test_prefetch_to_device(mv):
     out = list(prefetch_to_device(iter(batches[:2]), size=2, sharding=sh))
     assert out[0]["x"].sharding == sh
 
+    # A scalar-array leaf and a non-divisible partial batch replicate
+    # instead of raising mid-epoch.
+    ragged = [{"x": np.ones((3, 2), np.float32), "n": np.asarray(7)}]
+    (rb,) = prefetch_to_device(iter(ragged), sharding=sh)
+    assert np.asarray(rb["n"]) == 7
+    np.testing.assert_allclose(np.asarray(rb["x"]), 1.0)
+    assert rb["x"].sharding.is_fully_replicated
+    assert rb["n"].sharding.is_fully_replicated
+
+    # size validated at the call site, not at first next().
     with pytest.raises(ValueError):
-        next(prefetch_to_device(iter(batches), size=0))
+        prefetch_to_device(iter(batches), size=0)
 
     # size > stream length: everything still arrives exactly once.
     assert [b["i"] for b in
