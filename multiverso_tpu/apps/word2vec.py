@@ -190,14 +190,21 @@ class SkipGram:
 
     def train_epoch_fused(self, corpus: np.ndarray, batch_size: int,
                           seed: int = 0) -> Tuple[int, float]:
+        from ..util import prefetch_to_device
+
         step, place = self.make_fused_step()
         din, sin = self.table_in.raw_value()
         dout, sout = self.table_out.raw_value()
         loss = jnp.zeros(())
         steps = 0
-        for c, o, neg in self.batches(corpus, batch_size, seed=seed):
+        # Index batches go device-side one step ahead of the compiled
+        # step (H2D rides behind the previous step's compute), placed by
+        # the same batch_placer closure the step's shardings expect.
+        for c, o, neg in prefetch_to_device(
+                self.batches(corpus, batch_size, seed=seed), size=2,
+                sharding=place):
             din, sin, dout, sout, loss = step(
-                din, sin, dout, sout, place(c), place(o), place(neg))
+                din, sin, dout, sout, c, o, neg)
             steps += 1
         if steps == 0:
             raise ValueError(

@@ -35,6 +35,11 @@ def prefetch_to_device(iterator: Iterable[Any], size: int = 2,
     ``parallel.sharding.batch_placer``, which serves the fused apps;
     this serves arbitrary host iterators).
 
+    ``sharding`` may also be a *callable* ``array -> placed array`` —
+    e.g. the closure ``batch_placer`` returns — applied to every array
+    leaf, for placement policies richer than one sharding (dtype casts,
+    per-leaf divisibility fallback).
+
     ``size=2`` is the sweet spot for steady-state training (one batch
     computing, one in flight); larger only helps jittery producers.
     """
@@ -57,6 +62,8 @@ def _prefetch_gen(it: Iterator[Any], size: int,
     def put_leaf(x):
         if not isinstance(x, (np.ndarray, jax.Array)):
             return x
+        if callable(sharding):
+            return sharding(x)
         if sharding is None:
             return jax.device_put(x)
         try:
