@@ -173,6 +173,11 @@ bool Zoo::Start(int argc, const char* const* argv) {
       return false;
     rank_ = mpi->rank();
     size_ = mpi->size();
+    std::string role_str = configure::GetString("role");
+    if (role_str != "all")
+      Log::Info("-net_type=mpi ignores -role=%s: MPI static mode runs "
+                "every rank as worker+server (use the registration "
+                "transport for split roles)", role_str.c_str());
     SetRoles(std::vector<int>(size_, kRoleWorker | kRoleServer));
     net_ = std::move(mpi);
   } else if (!ctrl.empty()) {
