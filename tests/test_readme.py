@@ -35,13 +35,17 @@ def test_readme_python_blocks_execute(mv):
     ns["x"], ns["y"] = synthetic_classification(64, 784, 10, seed=0)
     import multiverso_tpu as _mv
 
-    for i, block in enumerate(_python_blocks()):
+    import shutil
+
+    for i, block in enumerate(blocks):
         code = compile(block, f"README.md#python-block-{i}", "exec")
         if "TransformerTrainer" in block:
             # Flagship fragments build dim-2048 models — minutes of CPU
             # compile for a doc test.  Syntax-checked above; execution
             # parity lives in tests/test_transformer.py.
             continue
+        if "NativeRuntime" in block and shutil.which("g++") is None:
+            continue  # same toolchain gate as tests/test_native.py
         # Blocks after the quickstart are session fragments (the reader
         # is mid-session); give them a live session and a live table.
         if "mv.init" not in block:
@@ -54,6 +58,12 @@ def test_readme_python_blocks_execute(mv):
             raise AssertionError(
                 f"README python block {i} failed: {exc}\n---\n{block}"
             ) from exc
+        if "NativeRuntime" in block and "rt" in ns:
+            # The C runtime is process-global state: left started with
+            # this block's flags, a later NativeRuntime(args=...) would
+            # silently reuse it (Zoo::Start no-ops when started) and
+            # other tests' updater expectations would break.
+            ns["rt"].shutdown()
     # The quickstart's shutdown ran; re-init so later blocks that touch
     # tables keep working is handled inside the loop order — final state
     # sanity: the fused LR step produced a finite loss.
