@@ -24,13 +24,15 @@ Measures the BASELINE.json headline configs on whatever devices JAX sees
 Each section runs under its own try/except — a single regression can cost
 that section's numbers but never the whole JSON line (round-1 lesson).
 
-``vs_baseline`` compares the fused TPU path against the reference-shaped
-push-pull loop measured in the same run on the same hardware (the
-per-batch Get -> local grad -> Add round-trip the reference's workers do).
-The reference's own 8-node MPI numbers are unmeasurable here (empty mount,
-no egress — see BASELINE.md), so this self-measured ratio is the honest
-stand-in: it is exactly the speedup a Multiverso user gets from moving
-their loop onto the fused path on this chip.
+``vs_baseline`` (schema 5) compares the fused TPU path against a real
+distributed parameter-server run measured in the same invocation: 8
+worker+server PROCESSES over the native TcpNet wire doing the
+per-batch Get -> local grad -> Add loop the reference's ``mpirun -n 8``
+job does (``bench_lr_native8``; workers in
+``apps/lr_native_worker.py``).  The reference's own binary stays
+unmeasurable (empty mount, no egress — see BASELINE.md's caveats), so
+this measured-mechanism ratio is the honest stand-in; the older
+same-chip loop ratio still rides along as ``lr_fused_vs_pushpull``.
 
 Primary metric: LR samples/sec (headline config #1). Extras ride along in
 the same JSON object.
