@@ -862,7 +862,8 @@ def main() -> None:
         except Exception as exc:  # keep every other section's numbers
             traceback.print_exc()
             errors.append(f"{section.__name__}: {type(exc).__name__}: {exc}")
-    if "lr_native8_samples_per_sec" in results:
+    if {"lr_native8_samples_per_sec",
+            "lr_fused_samples_per_sec"} <= results.keys():
         results["lr_fused_vs_native8"] = (
             results["lr_fused_samples_per_sec"]
             / results["lr_native8_samples_per_sec"])

@@ -119,6 +119,23 @@ def restore_pytree(uri: str, like: Any = None) -> Any:
             f"tree (different model config or updater?): {exc}") from exc
 
 
+_STATUS_OK, _STATUS_ERR, _STATUS_PENDING = 0, 1, 2
+
+
+def _exchange_status(status: int) -> int:
+    """All-ranks agreement on the async writer's status — a collective
+    (every rank's ``AsyncSave.result()`` calls it).  Rank 0 is the only
+    writer, so its status is the one broadcast."""
+    import jax
+
+    if jax.process_count() == 1:
+        return status
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return int(multihost_utils.broadcast_one_to_all(np.asarray(status)))
+
+
 class AsyncSave:
     """Handle for an in-flight :func:`save_pytree_async` write.
 
@@ -138,13 +155,33 @@ class AsyncSave:
         return self._thread is None or not self._thread.is_alive()
 
     def result(self, timeout: Optional[float] = None) -> None:
+        # Exchange the writer status across hosts BEFORE raising: if
+        # rank 0 raised its IO error (or join timeout) here while the
+        # other ranks went straight into the rendezvous below, they
+        # would block in the barrier forever.  The broadcast is itself
+        # a collective, so after it every rank takes the SAME exit:
+        # return (file durable), raise the IO error, or raise
+        # TimeoutError (write still in flight on rank 0 — the thread
+        # keeps running; call result() again to re-join it).  Non-zero
+        # ranks have no writer thread; they learn all three outcomes
+        # from the broadcast.
+        status = _STATUS_OK
         if self._thread is not None:
             self._thread.join(timeout)
             if self._thread.is_alive():
-                raise TimeoutError(
-                    f"checkpoint write still in flight: {self._uri}")
-        if self._err is not None:
-            raise self._err
+                status = _STATUS_PENDING
+            elif self._err is not None:
+                status = _STATUS_ERR
+        status = _exchange_status(status)
+        if status == _STATUS_PENDING:
+            raise TimeoutError(
+                f"checkpoint write still in flight: {self._uri}")
+        if status == _STATUS_ERR:
+            if self._err is not None:
+                raise self._err
+            raise IOError(
+                "checkpoint write failed on rank 0 (see its log): "
+                f"{self._uri}")
         # Same durability contract as the sync save: every rank agrees
         # the file exists before anyone restores it.
         core_context.get_context().host_sync("mvtpu_pytree_async_save")

@@ -6,7 +6,9 @@
 #include <chrono>
 #include <climits>
 #include <mutex>
+#include <vector>
 
+#include "mvtpu/configure.h"
 #include "mvtpu/log.h"
 
 namespace mvtpu {
@@ -42,6 +44,8 @@ struct MpiApi {
   int (*recv)(void*, int, void*, int, int, void*, MpiStatus*) = nullptr;
   int (*iprobe)(int, int, void*, int*, MpiStatus*) = nullptr;
   int (*get_count)(const MpiStatus*, void*, int*) = nullptr;
+  int (*cancel)(void**) = nullptr;
+  int (*request_free)(void**) = nullptr;
   void* comm_world = nullptr;
   void* byte = nullptr;
   bool ok = false;
@@ -77,6 +81,9 @@ MpiApi LoadMpi() {
       sym("MPI_Iprobe"));
   api.get_count = reinterpret_cast<int (*)(const MpiStatus*, void*, int*)>(
       sym("MPI_Get_count"));
+  api.cancel = reinterpret_cast<int (*)(void**)>(sym("MPI_Cancel"));
+  api.request_free =
+      reinterpret_cast<int (*)(void**)>(sym("MPI_Request_free"));
   // Predefined handles are data symbols in the OpenMPI ABI; their
   // absence means some other MPI (e.g. MPICH's integer handles), whose
   // ABI these declarations would corrupt — treat as unavailable.
@@ -85,7 +92,7 @@ MpiApi LoadMpi() {
   api.ok = api.init_thread && api.initialized && api.finalized &&
            api.finalize && api.comm_rank && api.comm_size && api.isend &&
            api.test && api.recv && api.iprobe && api.get_count &&
-           api.comm_world && api.byte;
+           api.cancel && api.request_free && api.comm_world && api.byte;
   return api;
 }
 
@@ -98,6 +105,16 @@ MpiApi& Api() {
 std::mutex& MpiMu() {
   static std::mutex mu;
   return mu;
+}
+
+// Payloads of timed-out sends.  MPI_Request_free drops our handle but
+// the library may still read the user buffer until the (cancelled or
+// completed) send drains, so the blob is parked for the life of the
+// process — bounded by the number of timeouts, each of which already
+// logged an error.  Guarded by MpiMu().
+std::vector<Blob>& OrphanedSendBufs() {
+  static auto* v = new std::vector<Blob>();
+  return *v;
 }
 
 // MPI_Finalize is terminal for the process; latch it so a second
@@ -179,6 +196,20 @@ bool MpiNet::Send(int dst_rank, const Message& msg) {
                   dst_rank, kTag, api.comm_world, &req) != 0)
       return false;
   }
+  // The poll is bounded by -rpc_timeout_ms: a dead or wedged peer that
+  // never posts the matching Recv must not wedge this rank forever —
+  // the same fail-fast contract TcpNet implements.  On expiry the
+  // request is cancelled best-effort (MPI may ignore cancel on sends)
+  // and freed; the payload blob is parked in OrphanedSendBufs() because
+  // the library can keep reading it until the send actually drains.
+  // Has() guard: MpiNet can be driven standalone (tests, embedders)
+  // before Zoo registered the flag defaults.  <=0 keeps the flag's
+  // documented wait-forever contract (configure.cc).
+  const int64_t timeout_ms = configure::Has("rpc_timeout_ms")
+                                 ? configure::GetInt("rpc_timeout_ms")
+                                 : 30000;
+  const auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::milliseconds(timeout_ms);
   while (true) {
     {
       std::lock_guard<std::mutex> lk(MpiMu());
@@ -186,6 +217,15 @@ bool MpiNet::Send(int dst_rank, const Message& msg) {
       MpiStatus st{};
       if (api.test(&req, &done, &st) != 0) return false;
       if (done) return true;
+      if (timeout_ms > 0 && std::chrono::steady_clock::now() >= deadline) {
+        api.cancel(&req);
+        api.request_free(&req);
+        OrphanedSendBufs().push_back(std::move(wire));
+        Log::Error("MpiNet::Send to rank %d timed out after %lld ms "
+                   "(peer dead or never posted the matching Recv)",
+                   dst_rank, static_cast<long long>(timeout_ms));
+        return false;
+      }
     }
     std::this_thread::sleep_for(std::chrono::microseconds(50));
   }

@@ -71,7 +71,11 @@ def _flash_block(t: int, cap: int, head_dim: int) -> int:
     from ..ops.flash_attention import fit_block
 
     if head_dim > 128:
+        # Round the scaled cap down to a power of two: fit_block halves
+        # to find a divisor, so a non-pow2 cap (D=192 → 341) would walk
+        # 341→170→85→… and never hit one ≥64, silently disabling flash.
         cap = max(64, cap * 128 // head_dim)
+        cap = 1 << (cap.bit_length() - 1)
     b = fit_block(cap, t)
     return b if b >= 64 else 0
 
