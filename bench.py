@@ -125,16 +125,12 @@ def bench_lr(batch: int = 8192, features: int = 784, classes: int = 10):
     }
 
 
-def bench_lr_native8(procs: int = 8, steps: int = 60, batch: int = 1024):
-    """The BASELINE.json north-star denominator, measured as honestly as
-    the empty reference mount allows: LR through the native C++ runtime
-    over the TcpNet wire, 8 worker+server processes on this host —
-    mechanically the reference's ``mpirun -n 8`` LR job (push/pull per
-    batch through a wire into C++ updaters), minus the reference binary
-    itself (unbuildable, mount empty rounds 1-4).  Aggregate samples/s
-    over the max per-rank barrier-to-barrier window; ``main`` derives
-    ``lr_fused_vs_native8`` = TPU-fused / this — a distributed-wire
-    denominator instead of the same-chip push-pull loop."""
+def _run_native_workers(script_name: str, procs: int, marker: str,
+                        extra_args=()):
+    """Spawn ``procs`` copies of a native-wire worker script over a fresh
+    loopback machine file and return the max per-rank barrier-to-barrier
+    ``dt=`` window (the job's wall-clock).  Shared by the LR and word2vec
+    north-star denominators."""
     import re
     import socket
     import subprocess
@@ -155,14 +151,14 @@ def bench_lr_native8(procs: int = 8, steps: int = 60, batch: int = 1024):
         f.write("\n".join(eps) + "\n")
 
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "multiverso_tpu", "apps", "lr_native_worker.py")
+                          "multiverso_tpu", "apps", script_name)
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)      # workers force cpu themselves
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = os.path.dirname(worker).rsplit("multiverso_tpu", 1)[0]
     children = [
         subprocess.Popen(
-            [sys.executable, worker, mf, str(r), str(steps), str(batch)],
+            [sys.executable, worker, mf, str(r), *map(str, extra_args)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for r in range(procs)
@@ -177,13 +173,56 @@ def bench_lr_native8(procs: int = 8, steps: int = 60, batch: int = 1024):
                 p.kill()
     dts = []
     for p, out in zip(children, outs):
-        if p.returncode != 0 or "NATIVE_LR_OK" not in out:
-            raise RuntimeError(f"native LR worker failed:\n{out[-2000:]}")
+        if p.returncode != 0 or marker not in out:
+            raise RuntimeError(
+                f"{script_name} worker failed:\n{out[-2000:]}")
         dts.append(float(re.search(r"dt=([0-9.]+)", out).group(1)))
-    wall = max(dts)
+    return max(dts)
+
+
+def bench_lr_native8(procs: int = 8, steps: int = 60, batch: int = 1024):
+    """The BASELINE.json north-star denominator (LR half), measured as
+    honestly as the empty reference mount allows: LR through the native
+    C++ runtime over the TcpNet wire, 8 worker+server processes on this
+    host — mechanically the reference's ``mpirun -n 8`` LR job
+    (push/pull per batch through a wire into C++ updaters), minus the
+    reference binary itself (unbuildable, mount empty rounds 1-4).
+    Aggregate samples/s over the max per-rank barrier-to-barrier window;
+    ``main`` derives ``lr_fused_vs_native8`` = TPU-fused / this — a
+    distributed-wire denominator instead of the same-chip push-pull
+    loop."""
+    wall = _run_native_workers("lr_native_worker.py", procs,
+                               "NATIVE_LR_OK", (steps, batch))
     return {
         "lr_native8_samples_per_sec": procs * steps * batch / wall,
         "lr_native8_procs": float(procs),
+    }
+
+
+def bench_w2v_native8(procs: int = 8, steps: int = 20, batch: int = 512):
+    """The word2vec half of the north-star ledger (VERDICT r4 action 1):
+    skip-gram negative sampling over row-sharded 100k×128 MatrixTables
+    through the native wire — workers pull only the touched rows
+    (``MV_GetAsyncMatrixTableByRows``, double-buffered so the next
+    batch's pull overlaps this batch's gradient), push row deltas back
+    through non-blocking adds, the reference's
+    distributed-word-embedding mechanism (SURVEY.md §2.36).  ``main``
+    derives ``w2v_fused_vs_native8`` = TPU-fused pairs/s / this.
+
+    ``w2v_native8_prefetch_speedup`` compares the same job with the
+    double-buffer off (blocking gets).  Caveat: on a single-core host
+    (this sandbox: nproc=1) the loopback wire IS cpu work, so there is
+    no idle to hide the pull in and the ratio sits near 1.0; the
+    mechanism itself is proven by the ``async_overlap`` native scenario
+    (wire progress during caller idle, tests/test_native.py)."""
+    wall = _run_native_workers("w2v_native_worker.py", procs,
+                               "NATIVE_W2V_OK", (steps, batch, 1))
+    wall_sync = _run_native_workers("w2v_native_worker.py", procs,
+                                    "NATIVE_W2V_OK", (steps, batch, 0))
+    return {
+        "w2v_native8_pairs_per_sec": procs * steps * batch / wall,
+        "w2v_native8_procs": float(procs),
+        "w2v_native8_prefetch_speedup": wall_sync / wall,
     }
 
 
@@ -830,7 +869,8 @@ def bench_lightlda_mh(num_docs: int = 2048, vocab: int = 10000,
     return out
 
 
-_SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_add_get,
+_SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_w2v_native8,
+             bench_add_get,
              bench_transformer, bench_transformer_large, bench_moe,
              bench_lightlda, bench_lightlda_mh, bench_long_context]
 
@@ -853,8 +893,10 @@ def main() -> None:
     # _fullremat_ keys and the roofline_* decomposition alongside;
     # 5 = lr vs_baseline is lr_fused_vs_native8 (the 8-process
     # native-wire denominator, BASELINE.md action 2) — the old same-chip
-    # loop ratio stays as lr_fused_vs_pushpull.
-    results = {"bench_schema": 5}
+    # loop ratio stays as lr_fused_vs_pushpull;
+    # 6 = w2v_native8_* + w2v_fused_vs_native8 close the word2vec half
+    # of the north-star ledger the same way (VERDICT r4 action 1).
+    results = {"bench_schema": 6}
     errors = []
     for section in _SECTIONS:
         try:
@@ -867,6 +909,11 @@ def main() -> None:
         results["lr_fused_vs_native8"] = (
             results["lr_fused_samples_per_sec"]
             / results["lr_native8_samples_per_sec"])
+    if {"w2v_native8_pairs_per_sec",
+            "w2v_fused_pairs_per_sec"} <= results.keys():
+        results["w2v_fused_vs_native8"] = (
+            results["w2v_fused_pairs_per_sec"]
+            / results["w2v_native8_pairs_per_sec"])
     try:
         mv.shutdown()
     except Exception:

@@ -95,6 +95,17 @@ def load(build: bool = True) -> ctypes.CDLL:
             ctypes.c_int32, c_float_p, c_int32_p, ctypes.c_int64,
             ctypes.c_int64]
         getattr(lib, name).restype = ctypes.c_int
+    lib.MV_GetAsyncArrayTable.argtypes = [ctypes.c_int32, c_float_p,
+                                          ctypes.c_int64, c_int32_p]
+    lib.MV_GetAsyncArrayTable.restype = ctypes.c_int
+    lib.MV_GetAsyncMatrixTableByRows.argtypes = [
+        ctypes.c_int32, c_float_p, c_int32_p, ctypes.c_int64,
+        ctypes.c_int64, c_int32_p]
+    lib.MV_GetAsyncMatrixTableByRows.restype = ctypes.c_int
+    lib.MV_WaitGet.argtypes = [ctypes.c_int32]
+    lib.MV_WaitGet.restype = ctypes.c_int
+    lib.MV_CancelGet.argtypes = [ctypes.c_int32]
+    lib.MV_CancelGet.restype = ctypes.c_int
     lib.MV_NewKVTable.argtypes = [ctypes.POINTER(ctypes.c_int32)]
     lib.MV_NewKVTable.restype = ctypes.c_int
     lib.MV_GetKV.argtypes = [ctypes.c_int32, ctypes.c_char_p, c_float_p]
@@ -135,6 +146,50 @@ def _fp(a: np.ndarray):
 
 def _ip(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class AsyncGet:
+    """In-flight ``MV_GetAsync*`` pull (reference ``GetAsync``+``Wait``,
+    SURVEY.md §2.10): the request is already on the wire; ``wait()``
+    blocks until every contacted shard replied and returns the filled
+    array, raising on dead shard / ``-rpc_timeout_ms`` expiry (the C
+    API's indeterminate ``-3``).  The handle keeps the output buffer
+    alive for ctypes; ``wait()`` is idempotent (a failure replays on
+    retry).  Dropping the handle un-waited cancels the ticket
+    (``MV_CancelGet``) so a late reply cannot write freed memory."""
+
+    def __init__(self, rt: "NativeRuntime", ticket: int, out: np.ndarray,
+                 shape: tuple):
+        self._rt = rt
+        self._ticket = ticket
+        self._out = out
+        self._shape = shape
+        self._done = False
+        self._err: "Exception | None" = None
+
+    def wait(self) -> np.ndarray:
+        if not self._done:
+            self._done = True   # MV_WaitGet consumes the ticket either way
+            try:
+                self._rt._check(self._rt.lib.MV_WaitGet(self._ticket),
+                                "MV_WaitGet")
+            except Exception as exc:
+                self._err = exc  # replayed on retry, not a bogus rc=-2
+                raise
+        if self._err is not None:
+            raise self._err
+        return self._out.reshape(self._shape)
+
+    def __del__(self):
+        # This object holds the ONLY reference to the output buffer a
+        # late shard reply would scatter into — an un-waited drop must
+        # withdraw the in-flight request before numpy frees it.
+        if getattr(self, "_done", True):
+            return
+        try:
+            self._rt.lib.MV_CancelGet(self._ticket)
+        except Exception:
+            pass  # interpreter teardown / already reclaimed at shutdown
 
 
 class NativeRuntime:
@@ -184,6 +239,16 @@ class NativeRuntime:
                     "MV_GetArrayTable")
         return out
 
+    def array_get_async(self, handle: int, size: int) -> AsyncGet:
+        """Start a non-blocking Get; overlap compute, then ``wait()``."""
+        out = np.zeros(size, np.float32)
+        t = ctypes.c_int32(-1)
+        self._check(
+            self.lib.MV_GetAsyncArrayTable(handle, _fp(out), size,
+                                           ctypes.byref(t)),
+            "MV_GetAsyncArrayTable")
+        return AsyncGet(self, t.value, out, (size,))
+
     def array_add(self, handle: int, delta, sync: bool = True) -> None:
         d = _f32(delta)
         fn = (self.lib.MV_AddArrayTable if sync
@@ -227,6 +292,21 @@ class NativeRuntime:
                                              ids.size, cols),
             "MV_GetMatrixTableByRows")
         return out.reshape(ids.size, cols)
+
+    def matrix_get_rows_async(self, handle: int, row_ids,
+                              cols: int) -> AsyncGet:
+        """Start a non-blocking row pull (``MV_GetAsyncMatrixTableByRows``);
+        the ids are consumed before this returns.  On a sparse table the
+        async path bypasses the worker row cache entirely."""
+        ids = np.ascontiguousarray(row_ids, dtype=np.int32)
+        out = np.zeros(ids.size * cols, np.float32)
+        t = ctypes.c_int32(-1)
+        self._check(
+            self.lib.MV_GetAsyncMatrixTableByRows(
+                handle, _fp(out), _ip(ids), ids.size, cols,
+                ctypes.byref(t)),
+            "MV_GetAsyncMatrixTableByRows")
+        return AsyncGet(self, t.value, out, (ids.size, cols))
 
     def matrix_add_rows(self, handle: int, row_ids, delta,
                         sync: bool = True) -> None:

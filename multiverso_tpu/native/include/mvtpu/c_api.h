@@ -59,6 +59,28 @@ int MV_AddAsyncMatrixTableByRows(int32_t handle, const float* delta,
                                  const int32_t* row_ids, int64_t num_rows,
                                  int64_t cols);
 
+// Async Gets (reference WorkerTable::GetAsync + Wait, SURVEY.md §2.10):
+// the pull is on the wire when the call returns; *wait_handle receives
+// a ticket for MV_WaitGet, which blocks until every contacted shard
+// replied (0), or returns -3 on dead shard / deadline — indeterminate
+// like every -3 above (the buffer may be partially filled).  The output
+// buffer must stay alive and untouched until MV_WaitGet returns, which
+// also frees the ticket (a second wait on it returns -2).  A ticket the
+// caller will never wait on MUST be released with MV_CancelGet before
+// its output buffer dies — cancelling withdraws the in-flight request
+// so a late shard reply cannot scatter into freed memory (the ctypes
+// binding does this from the handle's destructor).  Tickets neither
+// waited nor cancelled are reclaimed at MV_ShutDown.  On a sparse
+// matrix table the async path goes straight to the wire (no row-cache
+// read or install).
+int MV_GetAsyncArrayTable(int32_t handle, float* data, int64_t size,
+                          int32_t* wait_handle);
+int MV_GetAsyncMatrixTableByRows(int32_t handle, float* data,
+                                 const int32_t* row_ids, int64_t num_rows,
+                                 int64_t cols, int32_t* wait_handle);
+int MV_WaitGet(int32_t wait_handle);
+int MV_CancelGet(int32_t wait_handle);  // 0, or -2 unknown/consumed
+
 // KV table (string key -> float value; SURVEY.md §2.14).  Batch calls
 // take keys as concatenated NUL-FREE bytes with per-key lengths.
 int MV_NewKVTable(int32_t* handle);

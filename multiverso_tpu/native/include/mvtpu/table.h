@@ -102,6 +102,41 @@ class MatrixServerTable : public ServerTable {
 };
 
 // ---------------------------------------------------------------- worker
+class WorkerTable;
+
+// Handle for an in-flight async Get (reference WorkerTable::GetAsync +
+// Waiter-handle Wait, SURVEY.md §2.10): the request is on the wire when
+// the starting call returns, so the caller overlaps the round trip with
+// compute — the AsyncBuffer double-buffer idiom (§2.24) expressed over
+// the wire.  The caller's output buffer must stay alive and untouched
+// until Wait() returns.  Wait() is RoundTrip's back half: true when
+// every contacted shard replied, false on dead-shard ReplyError or
+// `-rpc_timeout_ms` expiry — with the same INDETERMINATE contract (the
+// buffer may be partially filled).  Idempotent.  Destroying an
+// un-Wait()ed handle withdraws the request safely: late replies are
+// dropped at the door, never touching the dead waiter or the buffer.
+// The owning table must outlive the handle.
+class AsyncGetHandle {
+ public:
+  ~AsyncGetHandle();
+  bool Wait();
+
+ private:
+  friend class WorkerTable;
+  AsyncGetHandle(WorkerTable* t, int64_t msg_id, int nreq,
+                 std::shared_ptr<void> state)
+      : table_(t), msg_id_(msg_id), waiter_(nreq),
+        state_(std::move(state)) {}
+  WorkerTable* table_;
+  int64_t msg_id_;          // -1: empty request, trivially complete
+  Waiter waiter_;
+  bool failed_ = false;     // written by Notify under the table's mu_
+  bool waited_ = false;
+  bool ok_ = false;
+  std::shared_ptr<void> state_;  // owns the consume plan (scatter map)
+};
+using AsyncGetPtr = std::unique_ptr<AsyncGetHandle>;
+
 // Blocking stub; one instance per table per process.
 class WorkerTable {
  public:
@@ -125,9 +160,18 @@ class WorkerTable {
   bool RoundTrip(std::vector<MessagePtr> reqs,
                  void (*consume)(void*, const Message&), void* arg);
 
+  // RoundTrip's front half: register the pending entry, put every req
+  // on the wire, return the handle whose Wait() is the back half.
+  // `state` keeps `arg` (the consume destination plan) alive for the
+  // handle's lifetime.
+  AsyncGetPtr StartRoundTrip(std::vector<MessagePtr> reqs,
+                             void (*consume)(void*, const Message&),
+                             void* arg, std::shared_ptr<void> state);
+
   int32_t table_id_;
 
  private:
+  friend class AsyncGetHandle;
   std::mutex mu_;
   struct Pending {
     Waiter* waiter;
@@ -145,6 +189,8 @@ class ArrayWorkerTable : public WorkerTable {
       : WorkerTable(table_id), global_(global_size),
         servers_(num_servers) {}
   bool Get(float* data, int64_t size);
+  // Non-blocking Get: data fills in the background; see AsyncGetHandle.
+  AsyncGetPtr GetAsync(float* data, int64_t size);
   bool Add(const float* delta, int64_t size, const AddOption& opt,
            bool blocking);
 
@@ -162,6 +208,12 @@ class MatrixWorkerTable : public WorkerTable {
   virtual bool GetAll(float* data);               // [rows*cols]
   virtual bool GetRows(const int32_t* row_ids, int64_t k,
                        float* data);              // [k*cols]
+  // Non-blocking GetRows (see AsyncGetHandle).  row_ids are consumed
+  // before this returns; `data` must live until Wait().  Deliberately
+  // non-virtual: on a SparseMatrixWorkerTable this goes straight to the
+  // wire — it neither reads nor installs into the row cache (an async
+  // fill racing a clock invalidation could resurrect stale rows).
+  AsyncGetPtr GetRowsAsync(const int32_t* row_ids, int64_t k, float* data);
   virtual bool AddAll(const float* delta, const AddOption& opt,
                       bool blocking);
   virtual bool AddRows(const int32_t* row_ids, int64_t k,

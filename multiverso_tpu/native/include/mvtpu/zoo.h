@@ -29,6 +29,10 @@ namespace mvtpu {
 
 class Waiter;
 
+// Defined in c_api.cc: drops un-waited MV_GetAsync* tickets.  Zoo::Stop
+// calls it before clearing the table registry the tickets point into.
+void CApiReclaimAsyncGets();
+
 class Zoo {
  public:
   static Zoo* Get();

@@ -2,7 +2,9 @@
 
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <stdexcept>
+#include <unordered_map>
 
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
@@ -16,7 +18,32 @@ namespace {
 thread_local AddOption g_add_option;
 
 int RequireStarted() { return Zoo::Get()->started() ? 0 : -1; }
+
+// Outstanding MV_GetAsync* tickets.  Tickets index AsyncGetHandles so
+// the FFI surface stays integer-only; MV_WaitGet consumes the entry.
+std::mutex g_gets_mu;
+std::unordered_map<int32_t, mvtpu::AsyncGetPtr>& Gets() {
+  static auto* m = new std::unordered_map<int32_t, mvtpu::AsyncGetPtr>();
+  return *m;
+}
+int32_t g_next_get_ticket = 1;
+
+int32_t StashGet(mvtpu::AsyncGetPtr h) {
+  std::lock_guard<std::mutex> lk(g_gets_mu);
+  int32_t t = g_next_get_ticket++;
+  Gets()[t] = std::move(h);
+  return t;
+}
 }  // namespace
+
+namespace mvtpu {
+// Called by Zoo::Stop(): un-waited tickets must not outlive the tables
+// their handles point into (~AsyncGetHandle dereferences the table).
+void CApiReclaimAsyncGets() {
+  std::lock_guard<std::mutex> lk(g_gets_mu);
+  Gets().clear();
+}
+}  // namespace mvtpu
 
 extern "C" {
 
@@ -94,7 +121,7 @@ int MV_NewSparseMatrixTable(int64_t rows, int64_t cols, int32_t* handle) {
   return 0;
 }
 
-int MV_GetMatrixTableAll(int32_t handle, float* data, int64_t size) {
+int MV_GetMatrixTableAll(int32_t handle, float* data, int64_t /*size*/) {
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->matrix_worker(handle);
   if (!t) return -2;
@@ -142,6 +169,54 @@ int MV_AddMatrixTableByRows(int32_t h, const float* d, const int32_t* ids,
 int MV_AddAsyncMatrixTableByRows(int32_t h, const float* d, const int32_t* ids,
                                  int64_t k, int64_t) {
   return AddMatrixRows(h, d, ids, k, false);
+}
+
+int MV_GetAsyncArrayTable(int32_t handle, float* data, int64_t size,
+                          int32_t* wait_handle) {
+  if (RequireStarted() || !data || !wait_handle || size < 0) return -1;
+  auto* t = Zoo::Get()->array_worker(handle);
+  if (!t) return -2;
+  *wait_handle = StashGet(t->GetAsync(data, size));
+  return 0;
+}
+
+int MV_GetAsyncMatrixTableByRows(int32_t handle, float* data,
+                                 const int32_t* row_ids, int64_t num_rows,
+                                 int64_t /*cols*/, int32_t* wait_handle) {
+  if (RequireStarted() || !data || !row_ids || !wait_handle ||
+      num_rows < 0)
+    return -1;
+  auto* t = Zoo::Get()->matrix_worker(handle);
+  if (!t) return -2;
+  *wait_handle = StashGet(t->GetRowsAsync(row_ids, num_rows, data));
+  return 0;
+}
+
+int MV_WaitGet(int32_t wait_handle) {
+  mvtpu::AsyncGetPtr h;
+  {
+    std::lock_guard<std::mutex> lk(g_gets_mu);
+    auto it = Gets().find(wait_handle);
+    if (it == Gets().end()) return -2;
+    h = std::move(it->second);
+    Gets().erase(it);
+  }
+  return h->Wait() ? 0 : -3;  // Wait outside the registry lock
+}
+
+int MV_CancelGet(int32_t wait_handle) {
+  mvtpu::AsyncGetPtr h;
+  {
+    std::lock_guard<std::mutex> lk(g_gets_mu);
+    auto it = Gets().find(wait_handle);
+    if (it == Gets().end()) return -2;
+    h = std::move(it->second);
+    Gets().erase(it);
+  }
+  // ~AsyncGetHandle withdraws the pending entry (under the table's
+  // lock), so a late reply is dropped at the door instead of scattering
+  // into an output buffer the caller is about to free.
+  return 0;
 }
 
 int MV_NewKVTable(int32_t* handle) {

@@ -373,6 +373,66 @@ bool WorkerTable::RoundTrip(std::vector<MessagePtr> reqs,
   return false;
 }
 
+AsyncGetPtr WorkerTable::StartRoundTrip(std::vector<MessagePtr> reqs,
+                                        void (*consume)(void*,
+                                                        const Message&),
+                                        void* arg,
+                                        std::shared_ptr<void> state) {
+  int64_t msg_id = reqs.empty() ? -1 : reqs[0]->msg_id;
+  AsyncGetPtr h(new AsyncGetHandle(this, msg_id,
+                                   static_cast<int>(reqs.size()),
+                                   std::move(state)));
+  if (reqs.empty()) return h;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_[msg_id] = Pending{&h->waiter_, consume, arg,
+                               static_cast<int>(reqs.size()), &h->failed_};
+  }
+  for (auto& req : reqs)
+    Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+  return h;
+}
+
+bool AsyncGetHandle::Wait() {
+  if (waited_) return ok_;
+  waited_ = true;
+  if (msg_id_ < 0) {      // empty request: nothing was on the wire
+    ok_ = true;
+    return ok_;
+  }
+  // Identical deadline + withdrawal discipline as the blocking
+  // RoundTrip, including the INDETERMINATE -3 contract on timeout.
+  int64_t timeout_ms = configure::GetInt("rpc_timeout_ms");
+  if (waiter_.WaitFor(timeout_ms)) {
+    std::lock_guard<std::mutex> lk(table_->mu_);
+    ok_ = !failed_;
+    return ok_;
+  }
+  std::lock_guard<std::mutex> lk(table_->mu_);
+  auto it = table_->pending_.find(msg_id_);
+  if (it == table_->pending_.end()) {  // raced: replies completed
+    ok_ = !failed_;
+    return ok_;
+  }
+  table_->pending_.erase(it);
+  Log::Error("WorkerTable %d: async get %lld timed out after %lld ms",
+             table_->table_id_, static_cast<long long>(msg_id_),
+             static_cast<long long>(timeout_ms));
+  ok_ = false;
+  return false;
+}
+
+AsyncGetHandle::~AsyncGetHandle() {
+  if (waited_ || msg_id_ < 0) return;
+  // Un-awaited handle: withdraw the pending entry so late replies are
+  // dropped at the door instead of touching the dying waiter or the
+  // caller's (possibly gone) output buffer.  Notify holds the same
+  // lock for its whole lookup-consume-notify sequence, so after this
+  // erase no reply can be mid-flight into our state.
+  std::lock_guard<std::mutex> lk(table_->mu_);
+  table_->pending_.erase(msg_id_);
+}
+
 namespace {
 
 MessagePtr MakeReq(MsgType type, int32_t table_id, int64_t msg_id,
@@ -447,6 +507,18 @@ bool ArrayWorkerTable::Get(float* data, int64_t size) {
   return RoundTrip(std::move(reqs), GatherReply, &d);
 }
 
+AsyncGetPtr ArrayWorkerTable::GetAsync(float* data, int64_t size) {
+  Monitor mon("ArrayWorker::GetAsync");
+  int64_t msg_id = Zoo::Get()->NextMsgId();
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r)
+    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r));
+  auto d = std::make_shared<GatherDest>();
+  *d = GatherDest{data, static_cast<size_t>(size), global_, servers_, 1};
+  GatherDest* raw = d.get();
+  return StartRoundTrip(std::move(reqs), GatherReply, raw, std::move(d));
+}
+
 bool ArrayWorkerTable::Add(const float* delta, int64_t size,
                            const AddOption& opt, bool blocking) {
   Monitor mon("ArrayWorker::Add");
@@ -505,6 +577,44 @@ bool MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
   }
   RowsDest d{data, cols_, &positions};
   return RoundTrip(std::move(reqs), ScatterRowsReply, &d);
+}
+
+namespace {
+// The async GetRows' scatter plan must outlive the starting call (the
+// blocking path keeps it on the stack); the handle owns one of these.
+struct RowsGetState {
+  RowsDest d;
+  std::vector<std::vector<int64_t>> positions;
+};
+}  // namespace
+
+AsyncGetPtr MatrixWorkerTable::GetRowsAsync(const int32_t* row_ids,
+                                            int64_t k, float* data) {
+  Monitor mon("MatrixWorker::GetRowsAsync");
+  auto state = std::make_shared<RowsGetState>();
+  state->positions.resize(static_cast<size_t>(servers_));
+  std::vector<std::vector<int32_t>> per_rank_ids(servers_);
+  for (int64_t i = 0; i < k; ++i) {
+    int owner = (row_ids[i] >= 0 && row_ids[i] < rows_)
+                    ? OwnerOf(row_ids[i], rows_, servers_)
+                    : 0;  // out-of-range: any shard answers zeros
+    per_rank_ids[owner].push_back(row_ids[i]);
+    state->positions[owner].push_back(i);
+  }
+  std::memset(data, 0, static_cast<size_t>(k * cols_) * sizeof(float));
+  int64_t msg_id = Zoo::Get()->NextMsgId();
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r) {
+    if (per_rank_ids[r].empty()) continue;
+    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r);
+    req->data.emplace_back(per_rank_ids[r].data(),
+                           per_rank_ids[r].size() * sizeof(int32_t));
+    reqs.push_back(std::move(req));
+  }
+  state->d = RowsDest{data, cols_, &state->positions};
+  RowsGetState* raw = state.get();
+  return StartRoundTrip(std::move(reqs), ScatterRowsReply, &raw->d,
+                        std::move(state));
 }
 
 bool MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,

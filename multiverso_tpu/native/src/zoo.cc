@@ -265,6 +265,9 @@ void Zoo::Stop() {
     std::lock_guard<std::mutex> lk(mu_);
     started_ = false;
   }
+  // Un-waited async-get tickets hold pointers into the worker tables —
+  // reclaim them before the registry dies (c_api.cc).
+  CApiReclaimAsyncGets();
   // Join OUTSIDE mu_: a draining handler may query the table registry.
   // Pipeline order so queued async adds apply before teardown.
   worker_actor_->Stop();

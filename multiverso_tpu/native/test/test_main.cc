@@ -783,6 +783,73 @@ static int MpiZooScenario() {
   return 0;
 }
 
+static int AsyncOverlapChild(const char* machine_file, const char* rank) {
+  // Async Get overlap scenario (reference WorkerTable::GetAsync + Wait,
+  // SURVEY.md §2.10 / the AsyncBuffer idiom §2.24): the pull must make
+  // wire progress WHILE the caller computes.  Protocol on rank 0: time
+  // a blocking GetRows of a wire-heavy row set; start the identical
+  // pull async; spend ~3x the blocking time "computing" (sleep); then
+  // Wait() — which must return in well under the blocking time, since
+  // the shards answered during the compute.  Bounds are generous (half
+  // the blocking time plus 50 ms absolute slack) so a loaded CI host
+  // cannot flake the assertion; the w2v native bench carries the
+  // quantitative overlap claim.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+                         "-log_level=error", "-rpc_timeout_ms=60000",
+                         "-barrier_timeout_ms=60000"};
+  CHECK(MV_Init(6, argv2) == 0);
+  int me = MV_WorkerId();
+  const int64_t R = 20000, C = 128, K = 16000;   // pull ~8 MB of rows
+  int32_t hm;
+  CHECK(MV_NewMatrixTable(R, C, &hm) == 0);
+  CHECK(MV_Barrier() == 0);
+  if (me == 0) {
+    std::vector<float> ones(R * C, 1.0f);
+    CHECK(MV_AddMatrixTableAll(hm, ones.data(), R * C) == 0);
+  }
+  CHECK(MV_Barrier() == 0);  // the add is visible everywhere
+
+  if (me == 0) {
+    std::vector<int32_t> ids(K);
+    for (int64_t i = 0; i < K; ++i)
+      ids[i] = static_cast<int32_t>((i * 2654435761ull) % R);
+    std::vector<float> out1(K * C, -1.0f), out2(K * C, -1.0f);
+    auto now = [] { return std::chrono::steady_clock::now(); };
+    auto secs = [](auto d) {
+      return std::chrono::duration<double>(d).count();
+    };
+
+    auto t0 = now();
+    CHECK(MV_GetMatrixTableByRows(hm, out1.data(), ids.data(), K, C) == 0);
+    double t_sync = secs(now() - t0);
+
+    int32_t ticket = -1;
+    t0 = now();
+    CHECK(MV_GetAsyncMatrixTableByRows(hm, out2.data(), ids.data(), K, C,
+                                       &ticket) == 0);
+    double t_start = secs(now() - t0);
+    // The start call must not secretly block for the round trip.
+    CHECK(t_start < t_sync * 0.5 + 0.05);
+    std::this_thread::sleep_for(std::chrono::duration<double>(
+        t_sync * 3.0 + 0.05));                     // the "compute"
+    t0 = now();
+    CHECK(MV_WaitGet(ticket) == 0);
+    double t_wait = secs(now() - t0);
+    CHECK(t_wait < t_sync * 0.5 + 0.05);           // overlapped, not serial
+    CHECK(MV_WaitGet(ticket) == -2);               // ticket consumed
+    for (int64_t i = 0; i < K * C; i += 997)
+      CHECK(out2[i] == 1.0f);
+    printf("overlap: sync=%.3fs start=%.4fs wait=%.4fs\n", t_sync,
+           t_start, t_wait);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("ASYNC_OVERLAP_OK %d\n", me);
+  return 0;
+}
+
 // masking the CHECK diagnostic — _exit skips teardown and keeps rc=1.
 static int ScenarioExit(int rc) {
   fflush(stdout);
@@ -803,6 +870,8 @@ int main(int argc, char** argv) {
     return ScenarioExit(SspChild(argv[2], argv[3], argv[4]));
   if (argc == 4 && std::string(argv[1]) == "ssp_dead")
     return ScenarioExit(SspDeadChild(argv[2], argv[3]));
+  if (argc == 4 && std::string(argv[1]) == "async_overlap")
+    return ScenarioExit(AsyncOverlapChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "dead_peer")
     return ScenarioExit(DeadPeerChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "dead_server")
