@@ -180,6 +180,113 @@ def _run_native_workers(script_name: str, procs: int, marker: str,
     return max(dts)
 
 
+def _run_test_ranks(scenario: str, procs: int, extra=()):
+    """Spawn ``procs`` ranks of the native test binary on a fresh
+    loopback machine file and return their stdouts.  One home for the
+    endpoint-probe/spawn/kill-in-finally plumbing the wire and SSP
+    sections share (``_run_native_workers`` is its Python-worker
+    sibling); raises naming the rank that actually failed."""
+    import socket
+    import subprocess
+    import tempfile
+
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    native_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "multiverso_tpu", "native")
+    binary = os.path.join(native_dir, "build", "mvtpu_test")
+    subprocess.run(["make", "-C", native_dir, "-j4", "build/mvtpu_test"],
+                   check=True, capture_output=True)
+    socks = [socket.socket() for _ in range(procs)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = os.path.join(tempfile.mkdtemp(prefix="mvtpu_bench_"), "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+    children = [subprocess.Popen(
+        [binary, scenario, mf, str(r), *map(str, extra)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(procs)]
+    outs = []
+    try:
+        for p in children:
+            outs.append(p.communicate(timeout=300)[0])
+    finally:
+        # A dead sibling must not leave the others polling forever and
+        # skewing every later section's numbers.
+        for p in children:
+            if p.poll() is None:
+                p.kill()
+    for r, p in enumerate(children):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"{scenario} rank {r} failed:\n{outs[r][-1500:]}")
+    return outs
+
+
+def bench_wire_micro():
+    """Direct transport microbench (VERDICT r4 action 6): message-size
+    sweep (4 KiB → 16 MiB) at the Net layer itself — the `wire_bench`
+    scenario of the native test binary, two ranks on loopback, no
+    tables/updaters in the path — so a transport regression shows up
+    here even when the LR/w2v aggregates still look healthy.  Keys:
+    ``wire_tcp_{put,get}_gbps_{4k,64k,1m,16m}`` + ``wire_tcp_rtt_ms``;
+    the MPI sweep (``wire_mpi_*``) runs only under mpirun (without a
+    launcher two processes cannot form an MPI world — OpenMPI
+    singletons each get size 1, and the scenario reports itself
+    skipped)."""
+    import shutil
+    import subprocess
+
+    suffix = {4096: "4k", 65536: "64k", 1048576: "1m", 16777216: "16m"}
+
+    def parse(out, prefix, res):
+        for line in out.splitlines():
+            if line.startswith("WIRE "):
+                _, size, put, get, rtt = line.split()
+                sfx = suffix[int(size)]
+                res[f"{prefix}_put_gbps_{sfx}"] = float(put)
+                res[f"{prefix}_get_gbps_{sfx}"] = float(get)
+                res[f"{prefix}_rtt_ms"] = float(rtt)
+
+    res = {}
+    outs = _run_test_ranks("wire_bench", 2, ("tcp",))
+    parse(outs[0], "wire_tcp", res)
+
+    # MPI sweep: only meaningful under a launcher.
+    if shutil.which("mpirun"):
+        native_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "multiverso_tpu", "native")
+        binary = os.path.join(native_dir, "build", "mvtpu_test")
+        out = subprocess.run(
+            ["mpirun", "-n", "2", binary, "wire_bench", "none", "0", "mpi"],
+            capture_output=True, text=True, timeout=300)
+        if out.returncode == 0:
+            parse(out.stdout, "wire_mpi", res)
+    return res
+
+
+def bench_ssp():
+    """SSP vs BSP throughput under a jittery straggler (VERDICT r4
+    action 7), via the native ``ssp_tput`` scenario: a steady 40 ms/clock
+    worker paired with an alternating 0/160 ms straggler.  ``staleness=3``
+    absorbs the jitter that ``staleness=0`` pays worst-case every clock;
+    locally ~1.9×.  Key: ``ssp_vs_bsp_speedup``."""
+    import re
+
+    def run(staleness):
+        outs = _run_test_ranks("ssp_tput", 2, (staleness,))
+        return int(re.search(r"SSP_TPUT ms=(\d+)", outs[0]).group(1))
+
+    bsp_ms, ssp_ms = run("0"), run("3")
+    return {"ssp_vs_bsp_speedup": bsp_ms / ssp_ms}
+
+
 def bench_lr_native8(procs: int = 8, steps: int = 60, batch: int = 1024):
     """The BASELINE.json north-star denominator (LR half), measured as
     honestly as the empty reference mount allows: LR through the native
@@ -870,7 +977,7 @@ def bench_lightlda_mh(num_docs: int = 2048, vocab: int = 10000,
 
 
 _SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_w2v_native8,
-             bench_add_get,
+             bench_wire_micro, bench_ssp, bench_add_get,
              bench_transformer, bench_transformer_large, bench_moe,
              bench_lightlda, bench_lightlda_mh, bench_long_context]
 

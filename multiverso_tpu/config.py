@@ -9,9 +9,13 @@ Flags keep the reference's names (``sync``, ``updater_type``, ``machine_file``,
 same ``-name=value`` argv syntax is accepted (plus ``--name=value``).
 
 Instead of C macros registering globals, flags live in a single registry that
-both the Python runtime and the native C layer read.  ``machine_file`` and
-``backup_worker_ratio`` are accepted for CLI compatibility but are
-no-ops under single-controller SPMD (documented in SURVEY.md §2.9-bis).
+both the Python runtime and the native C layer read.  ``machine_file`` is
+accepted for CLI compatibility but is a no-op under single-controller SPMD
+(documented in SURVEY.md §2.9-bis).  ``backup_worker_ratio`` is likewise a
+no-op on the SPMD plane (collectives are lockstep — there is no straggler to
+slack), but on the NATIVE wire plane it is real: the sync server releases
+clock t once ceil((1-ratio)·workers) ticks arrive (``native/src/zoo.cc``
+``HeldBySspLocked``; late adds fold into the open clock).
 """
 
 from __future__ import annotations
@@ -145,7 +149,8 @@ define_string("updater_type", "default",
 define_string("machine_file", "", "accepted for CLI parity; unused on TPU mesh")
 define_int("port", 55555, "accepted for CLI parity; unused on TPU mesh")
 define_double("backup_worker_ratio", 0.0,
-              "straggler slack; N/A under SPMD lockstep, kept for parity")
+              "straggler slack; N/A under SPMD lockstep — real on the "
+              "native wire plane (quorum clock release, zoo.cc)")
 define_string("log_level", os.environ.get("MVTPU_LOG_LEVEL", "info"),
               "debug|info|error|fatal")
 define_string("log_file", "", "optional log file sink")

@@ -288,10 +288,19 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
             # barriers are pure overhead there.  The flash kernel's
             # custom_vjp composes with checkpoint under both policies.
             if cfg.remat_policy == "dots":
+                # Dot outputs PLUS the flash kernel's named (o, lse)
+                # residuals (ops/flash_attention.py `_flash_fwd`): with
+                # them saved, the backward calls the dq/dkv kernels
+                # directly instead of replaying the forward kernel —
+                # the recompute tax drops to the cheap tensor ops
+                # (norms, rope) for ~one extra o-sized buffer per layer.
                 block = jax.checkpoint(
                     block,
-                    policy=jax.checkpoint_policies
-                    .dots_with_no_batch_dims_saveable,
+                    policy=jax.checkpoint_policies.save_from_both_policies(
+                        jax.checkpoint_policies
+                        .dots_with_no_batch_dims_saveable,
+                        jax.checkpoint_policies.save_only_these_names(
+                            "flash_out", "flash_lse")),
                     prevent_cse=not cfg.scan_layers)
             elif cfg.remat_policy == "full":
                 block = jax.checkpoint(block,

@@ -7,6 +7,7 @@
 // dependency.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <functional>
 #include <memory>
@@ -103,7 +104,11 @@ class TcpNet : public Net {
   InboundFn inbound_;
   int64_t connect_retry_ms_ = 15000;
 
-  int listen_fd_ = -1;
+  // listen_fd_/running_ are atomics, not mutex-guarded: AcceptLoop
+  // blocks inside ::accept() holding no lock while Stop() shuts the fd
+  // down from another thread to unblock it — the flags must be readable
+  // concurrently with that teardown (TSan-verified, round 5).
+  std::atomic<int> listen_fd_{-1};
   std::thread accept_thread_;
   std::vector<std::thread> readers_;
   std::vector<int> accepted_fds_;
@@ -112,7 +117,7 @@ class TcpNet : public Net {
   std::vector<int> send_fds_;
   std::vector<std::unique_ptr<std::mutex>> send_mus_;
 
-  bool running_ = false;
+  std::atomic<bool> running_{false};
   std::mutex mu_;
 };
 

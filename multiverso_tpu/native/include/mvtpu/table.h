@@ -214,6 +214,7 @@ class MatrixWorkerTable : public WorkerTable {
   // wire — it neither reads nor installs into the row cache (an async
   // fill racing a clock invalidation could resurrect stale rows).
   AsyncGetPtr GetRowsAsync(const int32_t* row_ids, int64_t k, float* data);
+
   virtual bool AddAll(const float* delta, const AddOption& opt,
                       bool blocking);
   virtual bool AddRows(const int32_t* row_ids, int64_t k,
@@ -223,6 +224,16 @@ class MatrixWorkerTable : public WorkerTable {
  protected:
   int64_t rows_, cols_;
   int servers_;
+
+ private:
+  // THE one owner-partitioning plan for GetRows/GetRowsAsync: fills
+  // `positions` (caller slots per shard), zero-fills the output (the
+  // out-of-range-id contract), returns the per-shard requests.  Both
+  // paths must stay in lockstep — a divergence here silently breaks
+  // one of them.
+  std::vector<MessagePtr> PlanRowsGet(
+      const int32_t* row_ids, int64_t k, float* data,
+      std::vector<std::vector<int64_t>>* positions);
 };
 
 // Sparse variant (SURVEY.md §2.13, table/sparse_matrix_table.h): the

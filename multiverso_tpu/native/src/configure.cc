@@ -152,7 +152,11 @@ void RegisterDefaults() {
     DefineString("node_host", "127.0.0.1",
                  "dynamic registration: address peers reach this node at");
     DefineInt("port", 55555, "base port (transport parity flag)");
-    DefineDouble("backup_worker_ratio", 0.0, "straggler slack (parity flag)");
+    DefineDouble("backup_worker_ratio", 0.0,
+                 "sync-plane straggler slack: clock t counts as reached "
+                 "once ceil((1-ratio)*workers) ticked it; the slowest "
+                 "floor(ratio*workers) cannot park reads (their late "
+                 "adds fold into the open clock)");
     DefineInt("staleness", 0,
               "SSP bound: a worker's Get is held while it runs more than "
               "this many MV_Clock() ticks ahead of the slowest worker "

@@ -552,18 +552,18 @@ bool MatrixWorkerTable::GetAll(float* data) {
   return RoundTrip(std::move(reqs), GatherReply, &d);
 }
 
-bool MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
-                                float* data) {
-  Monitor mon("MatrixWorker::GetRows");
+std::vector<MessagePtr> MatrixWorkerTable::PlanRowsGet(
+    const int32_t* row_ids, int64_t k, float* data,
+    std::vector<std::vector<int64_t>>* positions) {
   // Partition ids by owner; remember which caller slots each owner fills.
+  positions->assign(static_cast<size_t>(servers_), {});
   std::vector<std::vector<int32_t>> per_rank_ids(servers_);
-  std::vector<std::vector<int64_t>> positions(servers_);
   for (int64_t i = 0; i < k; ++i) {
     int owner = (row_ids[i] >= 0 && row_ids[i] < rows_)
                     ? OwnerOf(row_ids[i], rows_, servers_)
                     : 0;  // out-of-range: any shard answers zeros
     per_rank_ids[owner].push_back(row_ids[i]);
-    positions[owner].push_back(i);
+    (*positions)[owner].push_back(i);
   }
   std::memset(data, 0, static_cast<size_t>(k * cols_) * sizeof(float));
   int64_t msg_id = Zoo::Get()->NextMsgId();
@@ -575,6 +575,14 @@ bool MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
                            per_rank_ids[r].size() * sizeof(int32_t));
     reqs.push_back(std::move(req));
   }
+  return reqs;
+}
+
+bool MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
+                                float* data) {
+  Monitor mon("MatrixWorker::GetRows");
+  std::vector<std::vector<int64_t>> positions;
+  auto reqs = PlanRowsGet(row_ids, k, data, &positions);
   RowsDest d{data, cols_, &positions};
   return RoundTrip(std::move(reqs), ScatterRowsReply, &d);
 }
@@ -592,25 +600,7 @@ AsyncGetPtr MatrixWorkerTable::GetRowsAsync(const int32_t* row_ids,
                                             int64_t k, float* data) {
   Monitor mon("MatrixWorker::GetRowsAsync");
   auto state = std::make_shared<RowsGetState>();
-  state->positions.resize(static_cast<size_t>(servers_));
-  std::vector<std::vector<int32_t>> per_rank_ids(servers_);
-  for (int64_t i = 0; i < k; ++i) {
-    int owner = (row_ids[i] >= 0 && row_ids[i] < rows_)
-                    ? OwnerOf(row_ids[i], rows_, servers_)
-                    : 0;  // out-of-range: any shard answers zeros
-    per_rank_ids[owner].push_back(row_ids[i]);
-    state->positions[owner].push_back(i);
-  }
-  std::memset(data, 0, static_cast<size_t>(k * cols_) * sizeof(float));
-  int64_t msg_id = Zoo::Get()->NextMsgId();
-  std::vector<MessagePtr> reqs;
-  for (int r = 0; r < servers_; ++r) {
-    if (per_rank_ids[r].empty()) continue;
-    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r);
-    req->data.emplace_back(per_rank_ids[r].data(),
-                           per_rank_ids[r].size() * sizeof(int32_t));
-    reqs.push_back(std::move(req));
-  }
+  auto reqs = PlanRowsGet(row_ids, k, data, &state->positions);
   state->d = RowsDest{data, cols_, &state->positions};
   RowsGetState* raw = state.get();
   return StartRoundTrip(std::move(reqs), ScatterRowsReply, &raw->d,

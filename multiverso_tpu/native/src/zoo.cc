@@ -1,6 +1,9 @@
 #include "mvtpu/zoo.h"
 
+#include <algorithm>
 #include <chrono>
+#include <cmath>
+#include <functional>
 
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
@@ -508,16 +511,42 @@ void Zoo::FailHeldGets(std::vector<MessagePtr> expired) {
 
 bool Zoo::HeldBySspLocked(int src) {
   // Admission predicate (ssp_mu_ held): src runs more than `staleness`
-  // ticks ahead of the slowest worker.
+  // ticks ahead of the QUORUM clock.  With -backup_worker_ratio=0 (the
+  // default) the quorum is every worker, so the quorum clock is the
+  // slowest worker's — plain sync semantics.  With ratio r > 0
+  // (reference include/multiverso/server.h sync variant, SURVEY §2.9)
+  // the slowest floor(r·N) workers are backup slack: clock t counts as
+  // reached once ceil((1-r)·N) workers ticked it, so a straggler
+  // beyond the allowance cannot park the fleet's reads.  Its late adds
+  // are NOT dropped — they apply on arrival, i.e. fold into whichever
+  // clock is then open (the reference's fold-into-next-clock).
   int64_t s = configure::GetInt("staleness");
   if (worker_clocks_.size() != static_cast<size_t>(size_))
     worker_clocks_.assign(size_, 0);
   if (src < 0 || src >= size_) return false;
   int64_t mine = worker_clocks_[src];
-  int64_t slowest = mine;
-  for (int r : worker_ranks_)
-    slowest = std::min(slowest, worker_clocks_[r]);
-  return mine - slowest > s;
+  double ratio = configure::GetDouble("backup_worker_ratio");
+  if (ratio <= 0.0) {
+    // Default path, run per admission check on the server hot path:
+    // allocation-free single-pass min (quorum == all workers).
+    int64_t slowest = mine;
+    for (int r : worker_ranks_)
+      slowest = std::min(slowest, worker_clocks_[r]);
+    return mine - slowest > s;
+  }
+  std::vector<int64_t> clocks;
+  clocks.reserve(worker_ranks_.size());
+  for (int r : worker_ranks_) clocks.push_back(worker_clocks_[r]);
+  if (clocks.empty()) return false;
+  int n = static_cast<int>(clocks.size());
+  int quorum = std::min(
+      n, std::max(1, static_cast<int>(std::ceil((1.0 - ratio) * n))));
+  // The quorum-th FASTEST worker's clock = the highest clock at least
+  // `quorum` workers have reached.
+  std::nth_element(clocks.begin(), clocks.begin() + (quorum - 1),
+                   clocks.end(), std::greater<int64_t>());
+  int64_t quorum_clock = clocks[quorum - 1];
+  return mine - quorum_clock > s;
 }
 
 bool Zoo::MaybeHoldGet(MessagePtr& msg) {

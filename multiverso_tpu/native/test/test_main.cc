@@ -659,6 +659,116 @@ static int SspChild(const char* machine_file, const char* rank,
   return 0;
 }
 
+static int BackupChild(const char* machine_file, const char* rank,
+                       const char* ratio) {
+  // backup_worker_ratio scenario (reference server.h sync variant,
+  // SURVEY §2.9; VERDICT r4 action 3): 3 workers, staleness 0.  Ranks
+  // 0/1 add + tick clock 1 immediately; rank 2 is a deliberate ~1.5 s
+  // straggler.  With -backup_worker_ratio=0.34 the quorum is
+  // ceil(0.66*3)=2, so the fast ranks' clock-1 reads admit as soon as
+  // BOTH fast ranks ticked — no straggler wait (asserted < 1000 ms).
+  // With ratio=0 (control) the same reads park until the straggler's
+  // tick (asserted >= 1200 ms) — the quorum releases only because of
+  // the ratio.  Either way the straggler's adds are never dropped:
+  // after the final barrier every rank reads the full sum.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  std::string rt = std::string("-backup_worker_ratio=") + ratio;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), rt.c_str(),
+                         "-staleness=0",  "-updater_type=default",
+                         "-log_level=error", "-rpc_timeout_ms=20000",
+                         "-barrier_timeout_ms=20000"};
+  CHECK(MV_Init(8, argv2) == 0);
+  int me = MV_WorkerId();
+  bool slack = atof(ratio) > 0.0;
+  int32_t h;
+  CHECK(MV_NewArrayTable(4, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+
+  if (me == 2) {
+    // The straggler: its clock-1 work lands ~1.5 s late.
+    std::this_thread::sleep_for(std::chrono::milliseconds(1500));
+    std::vector<float> twos(4, 2.0f);
+    CHECK(MV_AddAsyncArrayTable(h, twos.data(), 4) == 0);
+    CHECK(MV_Clock() == 0);
+  } else {
+    auto t0 = std::chrono::steady_clock::now();
+    std::vector<float> ones(4, 1.0f), out(4, -1.0f);
+    CHECK(MV_AddArrayTable(h, ones.data(), 4) == 0);
+    CHECK(MV_Clock() == 0);  // clock 1
+    CHECK(MV_GetArrayTable(h, out.data(), 4) == 0);
+    auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                  std::chrono::steady_clock::now() - t0)
+                  .count();
+    if (slack) {
+      CHECK(ms < 1000);    // quorum of 2 released without the straggler
+      // Quorum-released read carries at least both fast ranks' adds
+      // (the straggler's may or may not have landed — ASP fold).
+      for (float v : out) CHECK(v >= 2.0f);
+    } else {
+      CHECK(ms >= 1200);   // control: parked until the straggler's tick
+      for (float v : out) CHECK(v == 4.0f);  // BSP read: all adds
+    }
+  }
+  // Straggler catch-up fence, then the consistency check: no add was
+  // dropped by the quorum release.
+  CHECK(MV_Barrier() == 0);
+  std::vector<float> fin(4, -1.0f);
+  CHECK(MV_GetArrayTable(h, fin.data(), 4) == 0);
+  for (float v : fin) CHECK(v == 4.0f);
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("BACKUP_OK %d ratio=%s\n", me, ratio);
+  return 0;
+}
+
+static int SspThroughputChild(const char* machine_file, const char* rank,
+                              const char* staleness) {
+  // SSP-earns-its-keep scenario (VERDICT r4 action 7): 2 workers, 10
+  // clocks.  Rank 0 computes a steady 40 ms per clock; rank 1 is a
+  // JITTERY straggler — alternating 0 / 160 ms (same 80 ms average).
+  // With -staleness=0 every rank-0 read rendezvouses with the
+  // straggler's CURRENT clock, so rank 0 pays the straggler's
+  // worst-case path.  With -staleness=3 the window absorbs the
+  // alternation — rank 0 only ever waits for clock c-3, which the
+  // straggler's average pace has long passed.  Rank 0 prints its timed
+  // window; the pytest side runs both modes and asserts the SSP run is
+  // meaningfully faster on the SAME straggler profile.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  std::string st = std::string("-staleness=") + staleness;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), st.c_str(),
+                         "-updater_type=default", "-log_level=error",
+                         "-rpc_timeout_ms=30000",
+                         "-barrier_timeout_ms=30000"};
+  CHECK(MV_Init(7, argv2) == 0);
+  int me = MV_WorkerId();
+  const int kClocks = 10;
+  int32_t h;
+  CHECK(MV_NewArrayTable(8, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+
+  std::vector<float> delta(8, 1.0f), out(8, 0.0f);
+  auto t0 = std::chrono::steady_clock::now();
+  for (int c = 0; c < kClocks; ++c) {
+    int ms = (me == 0) ? 40 : ((c % 2) ? 160 : 0);   // the "compute"
+    std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+    CHECK(MV_AddAsyncArrayTable(h, delta.data(), 8) == 0);
+    CHECK(MV_Clock() == 0);
+    CHECK(MV_GetArrayTable(h, out.data(), 8) == 0);  // SSP-gated read
+  }
+  auto dt_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                   std::chrono::steady_clock::now() - t0)
+                   .count();
+  if (me == 0)
+    printf("SSP_TPUT ms=%lld staleness=%s\n",
+           static_cast<long long>(dt_ms), staleness);
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("SSP_TPUT_OK %d\n", me);
+  return 0;
+}
+
 static int SspDeadChild(const char* machine_file, const char* rank) {
   // SSP + dead straggler: rank 1 rendezvouses then crashes without ever
   // ticking.  Rank 0 races ahead; its held Gets must fail fast (rc=-3,
@@ -783,6 +893,161 @@ static int MpiZooScenario() {
   return 0;
 }
 
+static int WireBenchChild(const char* machine_file, const char* rank,
+                          const char* net_type) {
+  // Direct transport microbench (VERDICT r4 action 6): message-size
+  // sweep at the Net layer itself — no tables, no updaters — so a
+  // transport regression is visible independent of the LR/w2v
+  // aggregates.  Protocol per size S in 4 KiB → 16 MiB:
+  //   put: rank 0 fires K S-byte messages at rank 1; rank 1 acks once
+  //        after the K-th (time ≈ K·S / one-way bandwidth).
+  //   get: rank 0 sends one tiny request; rank 1 answers K S-byte
+  //        messages (the reply-payload direction).
+  //   rtt: median of 64 empty round trips.
+  // Output: one "WIRE <size> <put_gbps> <get_gbps> <rtt_ms>" line per
+  // size on rank 0, parsed by bench.py into wire_{tcp,mpi}_* keys.
+  using mvtpu::Blob;
+  using mvtpu::Message;
+  using mvtpu::MsgType;
+  const bool mpi = std::string(net_type) == "mpi";
+  int me = atoi(rank);
+
+  // Payload sizes; K scaled so each probe moves ~32 MiB.
+  const size_t kSizes[] = {4 << 10, 64 << 10, 1 << 20, 16 << 20};
+  const int kNumSizes = 4, kPings = 64;
+  auto burst_len = [](size_t s) {
+    return std::max(2, (int)((32u << 20) / s));
+  };
+
+  // Directional protocol (each counter only ever counts the peer's
+  // sends): rank 0 receives ReplyFlush (ping echo), ReplyAdd (burst
+  // ack), RequestAdd (get payloads); rank 1 receives RequestFlush
+  // (ping), RequestAdd (put payloads), RequestGet (serve request),
+  // ControlRegister (done sentinel).
+  std::atomic<int> pings{0}, payloads{0}, get_reqs{0}, echoes{0},
+      burst_acks{0}, done{0};
+
+  mvtpu::TcpNet tcp;
+  mvtpu::MpiNet mpin;
+  mvtpu::Net* net = nullptr;
+  auto inbound = [&](Message&& m) {
+    switch (m.type) {
+      case MsgType::RequestFlush: pings.fetch_add(1); break;
+      case MsgType::ReplyFlush: echoes.fetch_add(1); break;
+      case MsgType::RequestAdd: payloads.fetch_add(1); break;
+      case MsgType::ReplyAdd: burst_acks.fetch_add(1); break;
+      case MsgType::RequestGet: get_reqs.fetch_add(1); break;
+      case MsgType::ControlRegister: done.store(1); break;
+      default: break;
+    }
+  };
+  if (mpi) {
+    if (!mvtpu::MpiNet::Available()) {
+      printf("MPI_UNAVAILABLE\n");
+      return 0;
+    }
+    CHECK(mpin.Init(inbound));
+    if (mpin.size() < 2) {
+      // No mpirun in the image: singleton mode gives size 1 — report
+      // and succeed so the bench can skip the MPI sweep cleanly.
+      printf("WIRE_MPI_SINGLETON\n");
+      mpin.Stop();
+      return 0;
+    }
+    net = &mpin;
+    me = mpin.rank();
+  } else {
+    auto eps = mvtpu::TcpNet::ParseMachineFile(machine_file);
+    CHECK(eps.size() == 2);
+    CHECK(tcp.Init(eps, me, inbound, 15000));
+    net = &tcp;
+  }
+
+  auto mk = [&](MsgType t, size_t bytes) {
+    Message m;
+    m.type = t;
+    m.src = me;
+    m.dst = 1 - me;
+    m.msg_id = 0;
+    m.table_id = 0;
+    if (bytes) {
+      Blob b(bytes);
+      memset(b.data(), 7, bytes);
+      m.data.push_back(std::move(b));
+    }
+    return m;
+  };
+  auto wait_until = [&](std::atomic<int>& ctr, int target) {
+    while (ctr.load() < target)
+      std::this_thread::sleep_for(std::chrono::microseconds(50));
+  };
+  auto now = [] { return std::chrono::steady_clock::now(); };
+  auto secs = [](auto d) {
+    return std::chrono::duration<double>(d).count();
+  };
+
+  if (me == 0) {
+    // Ping 0 is the startup rendezvous; 1..kPings time the RTT.
+    std::vector<double> rtts;
+    for (int i = 0; i <= kPings; ++i) {
+      auto t0 = now();
+      CHECK(net->Send(1, mk(MsgType::RequestFlush, 0)));
+      wait_until(echoes, i + 1);
+      if (i > 0) rtts.push_back(secs(now() - t0));
+    }
+    std::sort(rtts.begin(), rtts.end());
+    double rtt_ms = rtts[rtts.size() / 2] * 1e3;
+
+    int acks_seen = 0, payloads_seen = 0;
+    for (size_t S : kSizes) {
+      int K = burst_len(S);
+      // put: K payloads, then the peer's counted ack.
+      auto t0 = now();
+      for (int i = 0; i < K; ++i)
+        CHECK(net->Send(1, mk(MsgType::RequestAdd, S)));
+      wait_until(burst_acks, ++acks_seen);
+      double put_gbps = (double)K * S / secs(now() - t0) / 1e9;
+      // get: one request, K payloads back.
+      t0 = now();
+      CHECK(net->Send(1, mk(MsgType::RequestGet, 0)));
+      payloads_seen += K;
+      wait_until(payloads, payloads_seen);
+      double get_gbps = (double)K * S / secs(now() - t0) / 1e9;
+      printf("WIRE %zu %.4f %.4f %.4f\n", S, put_gbps, get_gbps, rtt_ms);
+    }
+    CHECK(net->Send(1, mk(MsgType::ControlRegister, 0)));  // done
+  } else {
+    // Peer state machine: echo pings, ack completed put bursts (sizes
+    // arrive in order), serve get requests, exit on the sentinel.
+    int echoed = 0, served = 0, acked = 0, burst_base = 0;
+    while (!done.load()) {
+      while (echoed < pings.load()) {
+        ++echoed;
+        CHECK(net->Send(0, mk(MsgType::ReplyFlush, 0)));
+      }
+      if (acked < kNumSizes) {
+        int K = burst_len(kSizes[acked]);
+        if (payloads.load() - burst_base >= K) {
+          burst_base += K;
+          ++acked;
+          CHECK(net->Send(0, mk(MsgType::ReplyAdd, 0)));
+        }
+      }
+      if (served < get_reqs.load() && served < kNumSizes) {
+        size_t S = kSizes[served];
+        int K = burst_len(S);
+        for (int i = 0; i < K; ++i)
+          CHECK(net->Send(0, mk(MsgType::RequestAdd, S)));
+        ++served;
+      }
+      std::this_thread::sleep_for(std::chrono::microseconds(50));
+    }
+  }
+  net->Stop();
+  printf("WIRE_BENCH_OK %d\n", me);
+  return 0;
+}
+
 static int AsyncOverlapChild(const char* machine_file, const char* rank) {
   // Async Get overlap scenario (reference WorkerTable::GetAsync + Wait,
   // SURVEY.md §2.10 / the AsyncBuffer idiom §2.24): the pull must make
@@ -868,8 +1133,14 @@ int main(int argc, char** argv) {
         RegisterChild(argv[2], argv[3], argv[4], argv[5], argv[6]));
   if (argc == 5 && std::string(argv[1]) == "ssp_child")
     return ScenarioExit(SspChild(argv[2], argv[3], argv[4]));
+  if (argc == 5 && std::string(argv[1]) == "ssp_tput")
+    return ScenarioExit(SspThroughputChild(argv[2], argv[3], argv[4]));
+  if (argc == 5 && std::string(argv[1]) == "backup_child")
+    return ScenarioExit(BackupChild(argv[2], argv[3], argv[4]));
   if (argc == 4 && std::string(argv[1]) == "ssp_dead")
     return ScenarioExit(SspDeadChild(argv[2], argv[3]));
+  if (argc == 5 && std::string(argv[1]) == "wire_bench")
+    return ScenarioExit(WireBenchChild(argv[2], argv[3], argv[4]));
   if (argc == 4 && std::string(argv[1]) == "async_overlap")
     return ScenarioExit(AsyncOverlapChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "dead_peer")

@@ -38,6 +38,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -265,6 +266,15 @@ def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret)
+    # Remat seam: under jax.checkpoint the partial-eval inlines this fwd
+    # rule, so naming the kernel outputs lets a policy SAVE them — the
+    # backward then feeds the dq/dkv kernels directly instead of
+    # replaying the forward kernel to regenerate its residuals (the
+    # ~12% remat tax measured in BENCH_r04).  models/transformer.py's
+    # "dots" policy saves both names; costs one o-sized buffer per
+    # layer (lse is ~D× smaller).
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return (o, lse), (q, k, v, o, lse)
 
 
