@@ -45,6 +45,13 @@ int MV_AddMatrixTableByRows(int32_t handle, const float* delta,
 int MV_AddAsyncMatrixTableByRows(int32_t handle, const float* delta,
                                  const int32_t* row_ids, int64_t num_rows,
                                  int64_t cols);
+int MV_GetAsyncArrayTable(int32_t handle, float* data, int64_t size,
+                          int32_t* wait_handle);
+int MV_GetAsyncMatrixTableByRows(int32_t handle, float* data,
+                                 const int32_t* row_ids, int64_t num_rows,
+                                 int64_t cols, int32_t* wait_handle);
+int MV_WaitGet(int32_t wait_handle);
+int MV_CancelGet(int32_t wait_handle);
 int MV_NewKVTable(int32_t* handle);
 int MV_GetKV(int32_t handle, const char* key, float* value);
 int MV_AddKV(int32_t handle, const char* key, float delta);
@@ -116,6 +123,39 @@ function mv.set_add_option(lr, momentum, rho, eps)
                           eps or 1e-8), "MV_SetAddOption")
 end
 
+-- Shared async-get handle (MV_GetAsync* wait tickets): wait() joins the
+-- pull and returns the filled buffer; a FAILED wait replays its error
+-- on retry (MV_WaitGet consumes the ticket either way, so re-calling
+-- it would report a bogus rc=-2).  cancel() withdraws an un-waited
+-- pull; wait() after cancel() raises instead of returning the unfilled
+-- buffer.  The buffer carries an ffi.gc finalizer so a handle dropped
+-- without wait()/cancel() withdraws its ticket BEFORE LuaJIT frees the
+-- buffer a late shard reply would scatter into (the c_api.h buffer-
+-- lifetime contract; mirrors the ctypes binding's __del__).
+local function make_async_get(ticket, buf)
+  local h = { _ticket = ticket, _done = false, _cancelled = false }
+  h._buf = ffi.gc(buf, function()
+    if not h._done and not h._cancelled then C.MV_CancelGet(ticket) end
+  end)
+  function h.wait()
+    if h._cancelled then error("async get was cancelled", 2) end
+    if not h._done then
+      h._done = true
+      local ok, err = pcall(check, C.MV_WaitGet(h._ticket), "MV_WaitGet")
+      if not ok then h._err = err end
+    end
+    if h._err then error(h._err, 0) end
+    return h._buf
+  end
+  function h.cancel()
+    if not h._done and not h._cancelled then
+      h._cancelled = true
+      C.MV_CancelGet(h._ticket)
+    end
+  end
+  return h
+end
+
 -- ---------------------------------------------------------------- Array
 
 mv.ArrayTableHandler = {}
@@ -142,6 +182,18 @@ function mv.ArrayTableHandler:add(delta, opts)
     check(C.MV_AddArrayTable(self.handle, buf, self.size),
           "MV_AddArrayTable")
   end
+end
+
+--- Non-blocking get: returns a handle whose wait() blocks for the
+--- replies and returns the buffer (async pull in flight meanwhile —
+--- see c_api.h MV_GetAsync*).  The buffer is owned by the handle; call
+--- cancel() instead of dropping an un-waited handle.
+function mv.ArrayTableHandler:get_async()
+  local buf = ffi.new("float[?]", self.size)
+  local w = ffi.new("int32_t[1]")
+  check(C.MV_GetAsyncArrayTable(self.handle, buf, self.size, w),
+        "MV_GetAsyncArrayTable")
+  return make_async_get(w[0], buf)
 end
 
 function mv.ArrayTableHandler:store(path)
@@ -207,6 +259,18 @@ function mv.MatrixTableHandler:get_rows(row_ids, k)
   check(C.MV_GetMatrixTableByRows(self.handle, buf, ids, k, self.cols),
         "MV_GetMatrixTableByRows")
   return buf
+end
+
+--- Non-blocking row pull; see ArrayTableHandler:get_async.
+function mv.MatrixTableHandler:get_rows_async(row_ids, k)
+  k = row_count(row_ids, k)
+  local ids = to_ints(row_ids, k)
+  local buf = ffi.new("float[?]", k * self.cols)
+  local w = ffi.new("int32_t[1]")
+  check(C.MV_GetAsyncMatrixTableByRows(self.handle, buf, ids, k,
+                                       self.cols, w),
+        "MV_GetAsyncMatrixTableByRows")
+  return make_async_get(w[0], buf)
 end
 
 function mv.MatrixTableHandler:add_rows(row_ids, delta, opts, k)

@@ -42,7 +42,7 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "fit_block"]
+__all__ = ["flash_attention", "fit_block", "scale_cap_for_head_dim"]
 
 
 def fit_block(block: int, t: int) -> int:
@@ -55,6 +55,18 @@ def fit_block(block: int, t: int) -> int:
     while b >= 8 and t % b:
         b //= 2
     return b
+
+
+def scale_cap_for_head_dim(cap: int, head_dim: int) -> int:
+    """VMEM guard shared by every dispatch site: block caps are measured
+    at D=128, and the kernels' k/v tiles scale with block·head_dim — so
+    larger head dims shrink the cap proportionally, rounded down to a
+    power of two (``fit_block`` halves to find a divisor, so a non-pow2
+    cap like D=192 → 341 would never land on one ≥64)."""
+    if head_dim > 128:
+        cap = max(64, cap * 128 // head_dim)
+        cap = 1 << (cap.bit_length() - 1)
+    return cap
 
 _NEG = -1e30
 _LANES = 128
@@ -259,12 +271,14 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
     return o, lse[:, :, 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, scale, causal, block_q, block_k, block_q_bwd,
+           block_k_bwd, interpret):
     return _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, block_q_bwd,
+               block_k_bwd, interpret):
     o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret)
     # Remat seam: under jax.checkpoint the partial-eval inlines this fwd
     # rule, so naming the kernel outputs lets a policy SAVE them — the
@@ -278,7 +292,13 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, cts):
+def _flash_bwd(scale, causal, block_q, block_k, block_q_bwd, block_k_bwd,
+               interpret, res, cts):
+    # The dq/dkv kernels run their own (larger) blocks: each revisits
+    # the [Bq, Bk] tile space with heavier per-tile state than the
+    # forward, and the measured v5e sweet spot is 1024×1024 (~12% over
+    # the forward's 512×1024 — fewer tile passes beats smaller tiles).
+    block_q, block_k = block_q_bwd, block_k_bwd
     q, k, v, o, lse = res
     do, dlse = cts
     bh, Tq, D = q.shape
@@ -352,6 +372,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, scale: Optional[float] = None,
                     causal: bool = True,
                     block_q: int = 512, block_k: int = 1024,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
                     interpret: bool = False,
                     return_lse: bool = False):
     """q [B,H,Tq,D], k/v [B,H,Tk,D] → [B,H,Tq,D] (and lse [B,H,Tq] f32).
@@ -382,10 +404,28 @@ def flash_attention(q, k, v, scale: Optional[float] = None,
     if block_q < 8 or block_k < 8:
         raise ValueError(f"no usable block size (>=8) divides "
                          f"Tq={Tq}, Tk={Tk}")
+    # Backward blocks default to the measured 1024x1024 sweet spot,
+    # VMEM-scaled for large head dims like the forward caps.  When the
+    # pow2 default cannot divide an odd T, fall back to the (validated)
+    # forward blocks rather than failing a call that may never be
+    # differentiated; only EXPLICIT bad bwd blocks raise.
+    explicit_bwd = block_q_bwd is not None or block_k_bwd is not None
+    if block_q_bwd is None:
+        block_q_bwd = scale_cap_for_head_dim(1024, D)
+    if block_k_bwd is None:
+        block_k_bwd = scale_cap_for_head_dim(1024, D)
+    block_q_bwd = fit_block(block_q_bwd, Tq)
+    block_k_bwd = fit_block(block_k_bwd, Tk)
+    if block_q_bwd < 8 or block_k_bwd < 8:
+        if explicit_bwd:
+            raise ValueError(f"no usable bwd block size (>=8) divides "
+                             f"Tq={Tq}, Tk={Tk}")
+        block_q_bwd, block_k_bwd = block_q, block_k
     bh = B * H
     o, lse = _flash(q.reshape(bh, Tq, D), k.reshape(bh, Tk, D),
                     v.reshape(bh, Tk, D), float(scale), bool(causal),
-                    int(block_q), int(block_k), bool(interpret))
+                    int(block_q), int(block_k), int(block_q_bwd),
+                    int(block_k_bwd), bool(interpret))
     o = o.reshape(B, H, Tq, D)
     if return_lse:
         return o, lse.reshape(B, H, Tq)

@@ -68,15 +68,9 @@ def _flash_block(t: int, cap: int, head_dim: int) -> int:
     VMEM footprint scales with block·head_dim (k/v tiles) — larger head
     dims shrink the cap proportionally so D=256 keeps the D=128 budget
     instead of risking Mosaic VMEM exhaustion."""
-    from ..ops.flash_attention import fit_block
+    from ..ops.flash_attention import fit_block, scale_cap_for_head_dim
 
-    if head_dim > 128:
-        # Round the scaled cap down to a power of two: fit_block halves
-        # to find a divisor, so a non-pow2 cap (D=192 → 341) would walk
-        # 341→170→85→… and never hit one ≥64, silently disabling flash.
-        cap = max(64, cap * 128 // head_dim)
-        cap = 1 << (cap.bit_length() - 1)
-    b = fit_block(cap, t)
+    b = fit_block(scale_cap_for_head_dim(cap, head_dim), t)
     return b if b >= 64 else 0
 
 
