@@ -371,16 +371,17 @@ def bench_w2v(batch: int = 8192, vocab: int = 100_000, dim: int = 128,
     }
 
 
-def _slope_seconds(timed, lo: int, hi: int, reduce=min) -> float:
+def _slope_seconds(timed, lo: int, hi: int, reduce=min,
+                   nslopes: int = 3) -> float:
     """Per-unit seconds via two-point slope — cancels any fixed cost
     (the bench tunnel's ~120 ms host round-trip) from ``timed(n)``.
 
-    3 independent slopes, reduced with ``reduce``: every noise source
-    here (dispatch overhead, tunnel jitter, host scheduling) ADDS time,
-    so for device-rate estimates ``min`` is the least-contaminated
+    ``nslopes`` independent slopes, reduced with ``reduce``: every noise
+    source here (dispatch overhead, tunnel jitter, host scheduling) ADDS
+    time, so for device-rate estimates ``min`` is the least-contaminated
     sample; pass ``np.median`` where the payload itself dominates."""
     slopes = []
-    for _ in range(3):
+    for _ in range(nslopes):
         t_lo, t_hi = timed(lo), timed(hi)
         if t_hi <= t_lo:
             slopes.append(t_hi / hi)
@@ -582,10 +583,13 @@ def _measured_matmul_peak_flops(dtype_name: str = "bfloat16") -> float:
         return float(np.median(ts))
 
     # Two-point slope cancels the tunnel's fixed ~120 ms round-trip.
-    # Median of 3 slopes: a single noisy pair can swing the implied peak
-    # ±80% through tunnel jitter, and an inflated peak silently deflates
-    # every reported MFU.
-    return 2 * n ** 3 / _slope_seconds(timed, lo, hi, reduce=np.median)
+    # Median of 7 slopes: a single noisy pair can swing the implied peak
+    # ±80% through tunnel jitter, and single-sample runs were observed
+    # drifting 190→198 TF/s run-to-run — an inflated peak silently
+    # deflates every reported MFU, so the denominator gets the most
+    # samples of any number in the bench.
+    return 2 * n ** 3 / _slope_seconds(timed, lo, hi, reduce=np.median,
+                                       nslopes=7)
 
 
 def _transformer_train_flops(cfg, batch: int, seq: int) -> float:
@@ -905,32 +909,47 @@ def bench_long_context(batch: int = 1, seq: int = 16384):
                                  with_mfu=False)
     out["longctx_seq"] = float(seq)   # the rate is meaningless without it
     if jax.default_backend() == "tpu" and seq == 16384:
-        # 4x the headline seq: the flash kernel's O(T) memory is what
-        # makes this fit at all; tokens/s drops with attention's O(T^2)
-        # FLOPs, which is the honest scaling story.
-        cfg64 = TransformerConfig(vocab_size=8192, dim=1024, n_layers=4,
-                                  n_heads=8, hidden=2816, max_seq=65536,
-                                  scan_layers=True, remat=True)
-        out64 = _bench_transformer_cfg(cfg64, batch, 65536, "longctx64k",
-                                       steps=3, with_mfu=False)
-        out["longctx64k_tokens_per_sec"] = out64["longctx64k_tokens_per_sec"]
-        out["longctx64k_seq"] = 65536.0
-        # 16x the headline seq (VERDICT r4 action 9): a 256k-token causal
-        # train step fits on ONE chip only because the flash kernel's
-        # memory is O(T) — the [T, T] score matrix alone would be 128 GiB
-        # in bf16.  Model slimmed (2 layers, dim 512, vocab 2048: the
-        # f32 CE logits at T=262144 are the actual memory governor) and
-        # per-call pipelined timing — at ~10 s/step the fused-loop
-        # program would pay minutes of compile for nothing.
-        cfg256 = TransformerConfig(vocab_size=2048, dim=512, n_layers=2,
-                                   n_heads=4, hidden=1408, max_seq=262144,
-                                   scan_layers=True, remat=True)
-        out256 = _bench_transformer_cfg(cfg256, 1, 262144, "longctx256k",
-                                        steps=2, with_mfu=False,
-                                        fused_timing=False)
-        out["longctx256k_tokens_per_sec"] = (
-            out256["longctx256k_tokens_per_sec"])
-        out["longctx256k_seq"] = 262144.0
+        # The longer-seq probes sit near the chip's memory limit, so
+        # each guards itself: a 64k/256k failure must not discard the
+        # measurements already banked above.
+        try:
+            # 4x the headline seq: the flash kernel's O(T) memory is
+            # what makes this fit at all; tokens/s drops with
+            # attention's O(T^2) FLOPs — the honest scaling story.
+            cfg64 = TransformerConfig(vocab_size=8192, dim=1024,
+                                      n_layers=4, n_heads=8, hidden=2816,
+                                      max_seq=65536, scan_layers=True,
+                                      remat=True)
+            out64 = _bench_transformer_cfg(cfg64, batch, 65536,
+                                           "longctx64k", steps=3,
+                                           with_mfu=False)
+            out["longctx64k_tokens_per_sec"] = (
+                out64["longctx64k_tokens_per_sec"])
+            out["longctx64k_seq"] = 65536.0
+        except Exception:
+            traceback.print_exc()
+        try:
+            # 16x the headline seq (VERDICT r4 action 9): a 256k-token
+            # causal train step fits on ONE chip only because the flash
+            # kernel's memory is O(T) — the [T, T] score matrix alone
+            # would be 128 GiB in bf16.  Model slimmed (2 layers, dim
+            # 512, vocab 2048: the f32 CE logits at T=262144 are the
+            # actual memory governor) and per-call pipelined timing —
+            # at ~10 s/step the fused-loop program would pay minutes of
+            # compile for nothing.
+            cfg256 = TransformerConfig(vocab_size=2048, dim=512,
+                                       n_layers=2, n_heads=4, hidden=1408,
+                                       max_seq=262144, scan_layers=True,
+                                       remat=True)
+            out256 = _bench_transformer_cfg(cfg256, 1, 262144,
+                                            "longctx256k", steps=2,
+                                            with_mfu=False,
+                                            fused_timing=False)
+            out["longctx256k_tokens_per_sec"] = (
+                out256["longctx256k_tokens_per_sec"])
+            out["longctx256k_seq"] = 262144.0
+        except Exception:
+            traceback.print_exc()
     return out
 
 

@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..updaters import AddOption, get_updater
@@ -251,13 +252,22 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
             Shapes derive from ``x`` itself — under pipeline parallelism
             the block sees microbatches, not the full batch."""
             Bb, Tb, _ = x.shape
+
+            def wc(w):
+                # Named so the "dots" policy SAVES the bf16 weight cast:
+                # the cast is not a dot, so without the name the
+                # backward re-reads the f32 masters and recasts every
+                # big weight per layer — avoidable HBM traffic for one
+                # bf16 copy of the layer weights of residency.
+                return checkpoint_name(w.astype(dt), "wcast")
+
             h = _rms_norm(x, lyr["attn_norm"].astype(dt), cfg.norm_eps)
-            q = (h @ lyr["wq"].astype(dt)).reshape(Bb, Tb, local_heads,
-                                                   cfg.head_dim)
-            k = (h @ lyr["wk"].astype(dt)).reshape(Bb, Tb, local_heads,
-                                                   cfg.head_dim)
-            v = (h @ lyr["wv"].astype(dt)).reshape(Bb, Tb, local_heads,
-                                                   cfg.head_dim)
+            q = (h @ wc(lyr["wq"])).reshape(Bb, Tb, local_heads,
+                                            cfg.head_dim)
+            k = (h @ wc(lyr["wk"])).reshape(Bb, Tb, local_heads,
+                                            cfg.head_dim)
+            v = (h @ wc(lyr["wv"])).reshape(Bb, Tb, local_heads,
+                                            cfg.head_dim)
             q = _rope(q.transpose(0, 2, 1, 3), cfg.rope_theta)
             k = _rope(k.transpose(0, 2, 1, 3), cfg.rope_theta)
             v = v.transpose(0, 2, 1, 3)
@@ -268,7 +278,7 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
                 o = blockwise_attention_local(q, k, v, scale, causal=True)
             o = o.transpose(0, 2, 1, 3).reshape(Bb, Tb,
                                                 local_heads * cfg.head_dim)
-            x = x + red(o @ lyr["wo"].astype(dt))
+            x = x + red(o @ wc(lyr["wo"]))
 
             h = _rms_norm(x, lyr["mlp_norm"].astype(dt), cfg.norm_eps)
             if cfg.num_experts:
@@ -279,9 +289,9 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
                                    dispatch=cfg.moe_dispatch,
                                    capacity_factor=cfg.capacity_factor)
                 return x + out, aux
-            gated = (jax.nn.silu(h @ lyr["w1"].astype(dt))
-                     * (h @ lyr["w3"].astype(dt)))
-            return x + red(gated @ lyr["w2"].astype(dt)), jnp.float32(0)
+            gated = (jax.nn.silu(h @ wc(lyr["w1"]))
+                     * (h @ wc(lyr["w3"])))
+            return x + red(gated @ wc(lyr["w2"])), jnp.float32(0)
 
         if cfg.remat:
             # Under scan the body already blocks CSE, so the anti-CSE
@@ -300,7 +310,7 @@ def transformer_forward(params, tokens, cfg: TransformerConfig,
                         jax.checkpoint_policies
                         .dots_with_no_batch_dims_saveable,
                         jax.checkpoint_policies.save_only_these_names(
-                            "flash_out", "flash_lse")),
+                            "flash_out", "flash_lse", "wcast")),
                     prevent_cse=not cfg.scan_layers)
             elif cfg.remat_policy == "full":
                 block = jax.checkpoint(block,

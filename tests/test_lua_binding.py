@@ -76,7 +76,8 @@ def test_lua_cdef_executes_via_cffi(tmp_path):
     missing typedef fails here where the regex contract test cannot see
     it), dlopens the same ``libmvtpu.so`` the Lua module loads, and
     replays ``test_lua_smoke``'s round trips (array add/get, matrix
-    rows sync+async, KV single+batch) through those declarations.  What
+    rows sync+async, async gets with wait/cancel ticket semantics, KV
+    single+batch) through those declarations.  What
     it cannot cover is the Lua wrapper code itself (the handler classes
     in ``multiverso.lua``) — that remains gated on a luajit appearing on
     PATH (see ``test_lua_smoke``).
@@ -125,6 +126,23 @@ assert C.MV_AddAsyncMatrixTableByRows(m[0], ten, one, 1, 3) == 0
 assert C.MV_Barrier() == 0
 assert C.MV_GetMatrixTableByRows(m[0], rows, one, 1, 3) == 0
 assert abs(rows[0] - 11.0) < 1e-6
+
+# Async gets through the exact cdef: GetAsync -> WaitGet fills the
+# buffer (ticket consumed: a second wait is -2), and CancelGet
+# releases an un-waited ticket (waiting it afterwards is -2 too).
+w = ffi.new("int32_t[1]")
+arows = ffi.new("float[3]")
+assert C.MV_GetAsyncMatrixTableByRows(m[0], arows, one, 1, 3, w) == 0
+assert C.MV_WaitGet(w[0]) == 0
+assert abs(arows[0] - 11.0) < 1e-6
+assert C.MV_WaitGet(w[0]) == -2
+aout = ffi.new("float[8]")
+assert C.MV_GetAsyncArrayTable(h[0], aout, 8, w) == 0
+assert C.MV_WaitGet(w[0]) == 0
+assert abs(aout[0] - 1.0) < 1e-6
+assert C.MV_GetAsyncArrayTable(h[0], aout, 8, w) == 0
+assert C.MV_CancelGet(w[0]) == 0
+assert C.MV_WaitGet(w[0]) == -2
 
 kv = ffi.new("int32_t[1]")
 assert C.MV_NewKVTable(kv) == 0
