@@ -451,20 +451,18 @@ def lm_loss(params, tokens, cfg: TransformerConfig,
 
     MoE configs add ``aux_loss_coef`` × the summed load-balancing loss.
 
-    Two CE lowerings, picked by head size (both v5e-measured): at vocab
-    ≥32k the ``_ce`` custom_vjp wins (+0.9 MFU points on the ~1B
+    Two CE lowerings, picked by head size (all v5e-measured): the
+    ``_ce`` custom_vjp wins at vocab 32k (+0.9 MFU points on the ~1B
     config — its bf16 dlogits keep the model's two largest matmuls on
-    the MXU fast path), but on small heads it LOSES 40% end-to-end
-    (dim 512 / vocab 8k toy: 525k → 313k tok/s) because the vjp
-    boundary blocks XLA from fusing the CE backward, and those extra
-    HBM passes dwarf the cheap matmul's dtype win."""
+    the MXU fast path) and by ~2% at vocab 16k (MoE bench config), but
+    LOSES 40% end-to-end on a small head (dim 512 / vocab 8k toy:
+    525k → 313k tok/s) because the vjp boundary blocks XLA from fusing
+    the CE backward, and those extra HBM passes dwarf the cheap
+    matmul's dtype win."""
     logits, aux = transformer_forward(params, tokens, cfg, mesh,
                                       return_aux=True)
-    # >= 32000 keeps the class default (the Llama-style 32000 vocab —
-    # the same head scale as the measured-win 32768 config) on the fast
-    # path; the untested middle (MoE bench's 16384) stays on the fused
-    # autodiff lowering until measured.
-    ce_fn = _ce if cfg.vocab_size >= 32000 else _ce_value
+    # Crossover measured between 8192 (big loss) and 16384 (small win).
+    ce_fn = _ce if cfg.vocab_size >= 16384 else _ce_value
     ce = ce_fn(logits[:, :-1], tokens[:, 1:])
     if cfg.num_experts:
         return ce + cfg.aux_loss_coef * aux
