@@ -497,33 +497,76 @@ class TransformerTrainer:
         self._step = None
         self._eval = None
 
-    def _raw_step(self):
-        """Un-jitted (params, state, tokens) -> (params, state, loss)."""
-        cfg, mesh, updater, opt = (self.cfg, self.mesh, self.updater,
-                                   self.option)
+    def _apply_updates(self, params, state, grads):
+        """One updater application over the whole param pytree."""
+        updater, opt = self.updater, self.option
+        flat_p, tree = jax.tree_util.tree_flatten(params)
+        flat_s = tree.flatten_up_to(state)
+        flat_g = tree.flatten_up_to(grads)
+        out = [updater.apply_dense(p, s, g, opt)
+               for p, s, g in zip(flat_p, flat_s, flat_g)]
+        params = jax.tree_util.tree_unflatten(tree, [p for p, _ in out])
+        state = jax.tree_util.tree_unflatten(tree, [s for _, s in out])
+        return params, state
+
+    def _raw_step(self, accum: int = 1):
+        """Un-jitted (params, state, tokens) -> (params, state, loss).
+
+        ``accum > 1`` splits the batch into that many microbatches,
+        accumulates their gradients in float32 (a ``lax.scan`` so the
+        activation memory is ONE microbatch's), and applies a single
+        update — mathematically the full-batch step (the CE is a mean
+        over equal-size chunks), with the activation footprint of
+        ``batch/accum``.  The trade is an extra f32 grad accumulator of
+        one full parameter set riding the scan carry, so the knob pays
+        off on ACTIVATION-dominated configs (long context, few params);
+        on the ~0.96B bench config the carry (~3.9 GB) was measured to
+        eat the whole 16 GB headroom the smaller microbatch freed.  The
+        microbatch must still be divisible by the mesh's dp axis.
+        MoE configs are rejected: their load-balancing aux loss is a
+        product of batch MEANS (nonlinear in the batch) and capacity
+        buckets size from N=B·T, so microbatching would silently change
+        the training objective, not just its memory profile."""
+        cfg, mesh = self.cfg, self.mesh
+        if accum > 1 and cfg.num_experts:
+            raise ValueError(
+                "grad accumulation is not equivalence-preserving for MoE "
+                "configs (batch-nonlinear aux loss, capacity buckets "
+                "sized from the microbatch); run MoE at full batch")
 
         def step(params, state, tokens):
-            loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg,
-                                                      mesh)
-            def apply(p, s, g):
-                new_p, new_s = updater.apply_dense(p, s, g, opt)
-                return new_p, new_s
+            if accum == 1:
+                loss, grads = jax.value_and_grad(lm_loss)(params, tokens,
+                                                          cfg, mesh)
+            else:
+                B, T = tokens.shape
+                if B % accum:
+                    raise ValueError(
+                        f"batch {B} not divisible by accum {accum}")
+                dp = int(mesh.shape.get("dp", 1)) if mesh is not None else 1
+                if (B // accum) % dp:
+                    raise ValueError(
+                        f"microbatch {B // accum} (batch {B} / accum "
+                        f"{accum}) not divisible by the dp axis ({dp})")
+                chunks = tokens.reshape(accum, B // accum, T)
 
-            flat_p, tree = jax.tree_util.tree_flatten(params)
-            flat_s = tree.flatten_up_to(state)
-            flat_g = tree.flatten_up_to(grads)
-            out = [apply(p, s, g) for p, s, g in zip(flat_p, flat_s, flat_g)]
-            params = jax.tree_util.tree_unflatten(tree, [p for p, _ in out])
-            state = jax.tree_util.tree_unflatten(tree, [s for _, s in out])
+                def body(g_acc, chunk):
+                    li, gi = jax.value_and_grad(lm_loss)(params, chunk,
+                                                         cfg, mesh)
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32), g_acc, gi)
+                    return g_acc, li
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                g_sum, losses = jax.lax.scan(body, zeros, chunks)
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g / accum), g_sum)
+                loss = jnp.mean(losses)
+            params, state = self._apply_updates(params, state, grads)
             return params, state, loss
 
         return step
-
-    def _build_step(self):
-        from ..parallel.sharding import batch_placer
-        _, place_tokens = batch_placer(self.mesh, "dp", dtype=jnp.int32)
-        step = jax.jit(self._raw_step(), donate_argnums=(0, 1))
-        return step, place_tokens
 
     def train_steps_fused(self, tokens, n: int) -> jax.Array:
         """Run ``n`` train steps on one batch inside ONE compiled program
@@ -558,14 +601,26 @@ class TransformerTrainer:
                                            jnp.int32(n))
         return loss
 
-    def train_step_async(self, tokens) -> jax.Array:
+    def train_step_async(self, tokens, accum: int = 1) -> jax.Array:
         """Enqueue one step; returns the device loss scalar (no host
         sync).  Back-to-back callers (the bench loop) pipeline dispatches
         and fetch once at the end — on remote-tunneled devices a per-step
-        host sync costs more than the step itself."""
+        host sync costs more than the step itself.
+
+        ``accum`` > 1 runs the gradient-accumulation step (see
+        ``_raw_step``): one update from ``accum`` microbatches with a
+        single microbatch's activation memory.  Compiled steps are
+        cached PER accum value, so interleaving regimes does not
+        recompile."""
         if self._step is None:
-            self._step = self._build_step()
-        step, place = self._step
+            self._step = {}
+        if accum not in self._step:
+            from ..parallel.sharding import batch_placer
+
+            _, place = batch_placer(self.mesh, "dp", dtype=jnp.int32)
+            step = jax.jit(self._raw_step(accum), donate_argnums=(0, 1))
+            self._step[accum] = (step, place)
+        step, place = self._step[accum]
         self.params, self.state, loss = step(self.params, self.state,
                                              place(tokens))
         return loss

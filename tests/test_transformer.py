@@ -319,3 +319,41 @@ def test_ce_custom_vjp_matches_autodiff():
         err = float(jnp.max(jnp.abs(g1.astype(jnp.float32)
                                     - g2.astype(jnp.float32))))
         assert err < tol, (dt, err)
+
+
+def test_grad_accumulation_matches_full_batch(mv):
+    """accum=2 (two microbatches, one update) must produce the same
+    post-step params as the plain full-batch step in f32 — the CE is a
+    mean over equal chunks, so summed-then-halved microbatch grads ARE
+    the full-batch grads."""
+    from jax.sharding import Mesh
+
+    cfg = TransformerConfig(vocab_size=64, dim=32, n_layers=2, n_heads=2,
+                            hidden=64, max_seq=16,
+                            compute_dtype=jnp.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+    toks = np.random.RandomState(3).randint(64, size=(8, 16)).astype(np.int32)
+
+    tr_a = TransformerTrainer(cfg, mesh, updater_type="sgd")
+    tr_b = TransformerTrainer(cfg, mesh, updater_type="sgd")
+    loss_a = float(tr_a.train_step_async(toks))
+    loss_b = float(tr_b.train_step_async(toks, accum=2))
+    assert abs(loss_a - loss_b) < 1e-5, (loss_a, loss_b)
+    for a, b in zip(jax.tree_util.tree_leaves(tr_a.params),
+                    jax.tree_util.tree_leaves(tr_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+    # bad split and dp-indivisible microbatch both fail loudly; MoE is
+    # rejected (its aux loss is batch-nonlinear, accumulation would
+    # silently change the objective)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="divisible"):
+        tr_b.train_step_async(toks[:6], accum=4)
+    with _pytest.raises(ValueError, match="dp axis"):
+        tr_b.train_step_async(toks, accum=8)   # microbatch 1 vs dp=2
+    cfg_moe = TransformerConfig(vocab_size=64, dim=32, n_layers=2,
+                                n_heads=2, hidden=64, max_seq=16,
+                                num_experts=4, top_k=2)
+    tr_moe = TransformerTrainer(cfg_moe, mesh, updater_type="sgd")
+    with _pytest.raises(ValueError, match="MoE"):
+        tr_moe.train_step_async(toks, accum=2)
