@@ -282,6 +282,21 @@ def test_native_wire_bench_scenario(native, tmp_path):
         assert float(put) > 0 and float(get) > 0 and float(rtt) > 0, line
 
 
+def test_native_wire_bench_mpi_singleton(native):
+    """The MPI wire-bench path without a launcher: a single process gets
+    OpenMPI's isolated singleton (size 1) and must report itself skipped
+    (WIRE_MPI_SINGLETON) — or MPI_UNAVAILABLE without libmpi — with
+    rc 0 either way, so bench.py's mpirun-gated sweep degrades cleanly.
+    (MPI mode ignores the machine-file argument.)"""
+    b = _binary()
+    out = subprocess.run([b, "wire_bench", "unused", "0", "mpi"],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert ("WIRE_MPI_SINGLETON" in out.stdout
+            or "MPI_UNAVAILABLE" in out.stdout), \
+        (out.stdout + out.stderr)[-1500:]
+
+
 def test_native_tsan_scenarios(native, tmp_path):
     """ThreadSanitizer sweep over the native runtime (VERDICT r4 action
     5): the whole runtime rebuilt -fsanitize=thread, then the unit
@@ -303,6 +318,7 @@ def test_native_tsan_scenarios(native, tmp_path):
 
     for scenario, nprocs, extra in [("net_child", 2, ()),
                                     ("backup_child", 3, ("0.34",)),
+                                    ("ssp_tput", 2, ("3",)),
                                     ("async_overlap", 2, ())]:
         mf = _machine_file(tmp_path, nprocs)  # rewritten per scenario
         procs = [subprocess.Popen([tsan_bin, scenario, mf, str(r), *extra],
