@@ -1042,7 +1042,10 @@ def main() -> None:
     # native-wire denominator, BASELINE.md action 2) — the old same-chip
     # loop ratio stays as lr_fused_vs_pushpull;
     # 6 = w2v_native8_* + w2v_fused_vs_native8 close the word2vec half
-    # of the north-star ledger the same way (VERDICT r4 action 1).
+    # of the north-star ledger the same way (VERDICT r4 action 1); also
+    # adds wire_tcp_*/wire_mpi_* (direct transport sweep),
+    # ssp_vs_bsp_speedup, longctx256k_*, and the w2v primary's
+    # vs_baseline becomes w2v_fused_vs_native8.
     results = {"bench_schema": 6}
     errors = []
     for section in _SECTIONS:
