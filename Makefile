@@ -1,0 +1,45 @@
+# Repo-level entry points.  The native runtime's build lives in
+# multiverso_tpu/native/Makefile; these targets fan out to it plus the
+# Python-layer lint (tools/mvlint.py).  docs/static_analysis.md explains
+# the analysis layers (analyze / asan / tsan / mvlint).
+NATIVE := multiverso_tpu/native
+PYTHON ?= python
+
+all:
+	$(MAKE) -C $(NATIVE) all
+
+test:
+	$(MAKE) -C $(NATIVE) test
+
+# Dynamic sanitizers (unit suite; the multi-process sweeps live in
+# tests/test_native.py as test_native_{tsan,asan}_scenarios).
+tsan:
+	$(MAKE) -C $(NATIVE) tsan
+
+asan:
+	$(MAKE) -C $(NATIVE) asan
+
+# Static thread-safety analysis (clang -Werror=thread-safety).
+analyze:
+	$(MAKE) -C $(NATIVE) analyze
+
+# Repo-specific Python AST lint (ctypes buffer lifetimes, dangling
+# async gets, host syncs inside jit, unbounded bench subprocesses).
+mvlint:
+	$(PYTHON) tools/mvlint.py
+
+# Umbrella: every static layer.  `make lint` green == what
+# tests/test_static_analysis.py enforces in tier-1 (mvlint always;
+# analyze when clang is present).
+lint: mvlint
+	@if command -v clang++ >/dev/null 2>&1; then \
+	  $(MAKE) -C $(NATIVE) analyze; \
+	else \
+	  echo "lint: clang++ not found — skipping make analyze" \
+	       "(mvlint ran; install clang for the thread-safety layer)"; \
+	fi
+
+clean:
+	$(MAKE) -C $(NATIVE) clean
+
+.PHONY: all test tsan asan analyze mvlint lint clean

@@ -197,7 +197,7 @@ def _run_test_ranks(scenario: str, procs: int, extra=()):
                               "multiverso_tpu", "native")
     binary = os.path.join(native_dir, "build", "mvtpu_test")
     subprocess.run(["make", "-C", native_dir, "-j4", "build/mvtpu_test"],
-                   check=True, capture_output=True)
+                   check=True, capture_output=True, timeout=600)
     socks = [socket.socket() for _ in range(procs)]
     for s in socks:
         s.bind(("127.0.0.1", 0))
@@ -263,11 +263,19 @@ def bench_wire_micro():
             os.path.dirname(os.path.abspath(__file__)),
             "multiverso_tpu", "native")
         binary = os.path.join(native_dir, "build", "mvtpu_test")
-        out = subprocess.run(
-            ["mpirun", "-n", "2", binary, "wire_bench", "none", "0", "mpi"],
-            capture_output=True, text=True, timeout=300)
-        if out.returncode == 0:
-            parse(out.stdout, "wire_mpi", res)
+        # A hung MPI job must cost only the wire_mpi_* keys, not the
+        # already-measured TCP sweep above.
+        try:
+            out = subprocess.run(
+                ["mpirun", "-n", "2", binary, "wire_bench", "none", "0",
+                 "mpi"],
+                capture_output=True, text=True, timeout=300)
+        except subprocess.TimeoutExpired:
+            print("bench_wire_micro: mpirun wire sweep timed out; "
+                  "keeping TCP keys", file=sys.stderr)
+        else:
+            if out.returncode == 0:
+                parse(out.stdout, "wire_mpi", res)
     return res
 
 
@@ -310,8 +318,10 @@ def bench_w2v_native8(procs: int = 8, steps: int = 20, batch: int = 512):
     """The word2vec half of the north-star ledger (VERDICT r4 action 1):
     skip-gram negative sampling over row-sharded 100k×128 MatrixTables
     through the native wire — workers pull only the touched rows
-    (``MV_GetAsyncMatrixTableByRows``, double-buffered so the next
-    batch's pull overlaps this batch's gradient), push row deltas back
+    (``MV_GetAsyncMatrixTableByRows``, double-buffered: the next batch's
+    pull is issued right after this batch's delta pushes, so the ordered
+    connection serves it post-add and the prefetch A/B runs under the
+    same staleness regime as the blocking path), push row deltas back
     through non-blocking adds, the reference's
     distributed-word-embedding mechanism (SURVEY.md §2.36).  ``main``
     derives ``w2v_fused_vs_native8`` = TPU-fused pairs/s / this.

@@ -24,9 +24,13 @@ Deltas go back through NON-blocking adds (``MV_AddAsyncMatrixTableByRows``
 — the reference app's ASP push mode; the trailing barrier flushes the
 pipeline so every delta lands inside the timed window), and with
 ``prefetch=True`` the next batch's rows are pulled through the async
-Get handles (``MV_GetAsyncMatrixTableByRows``) while the current
-batch's gradient computes — the reference's AsyncBuffer double-buffer
-idiom (SURVEY.md §2.24) expressed over the wire.
+Get handles (``MV_GetAsyncMatrixTableByRows``) issued right after this
+batch's delta pushes — the reference's AsyncBuffer double-buffer idiom
+(SURVEY.md §2.24) expressed over the wire.  The pushes go first so the
+ordered connection applies them before the gets are served: prefetch-on
+and prefetch-off then read under the SAME staleness regime and the A/B
+isolates the overlap mechanism (both tables' gets pipelined behind the
+in-flight adds) rather than overlap plus extra staleness.
 
 Run: ``python w2v_native_worker.py <machine_file> <rank> <steps>
 <batch> [prefetch]`` (spawned by ``bench.py``; stands alone for
@@ -126,12 +130,18 @@ def main(argv) -> None:
     pending = fetch(0)
     for i in range(steps):
         w_in, w_out = resolve(pending)
-        if i + 1 < steps:
-            pending = fetch(i + 1)   # overlap next pull with this grad
         rows_in, rows_out, c_loc, o_loc, neg_loc = batches[i]
         d_in, d_out = sgns_row_grads(w_in, w_out, c_loc, o_loc, neg_loc)
+        # Push THIS batch's deltas before issuing the next pull: the
+        # async gets ride the same ordered connection as the async adds,
+        # so batch i+1 reads post-add rows — the same staleness regime
+        # the prefetch-off path sees — and the A/B isolates the overlap
+        # mechanism itself (gets for both tables pipelined behind the
+        # in-flight adds) rather than overlap + extra staleness.
         rt.matrix_add_rows(h_in, rows_in, d_in, sync=False)
         rt.matrix_add_rows(h_out, rows_out, d_out, sync=False)
+        if i + 1 < steps:
+            pending = fetch(i + 1)
     rt.barrier()              # every rank's adds applied
     dt = time.perf_counter() - t0
 

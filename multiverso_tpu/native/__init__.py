@@ -316,7 +316,11 @@ class NativeRuntime:
             raise ValueError("rows/delta shape mismatch")
         fn = (self.lib.MV_AddMatrixTableByRows if sync
               else self.lib.MV_AddAsyncMatrixTableByRows)
-        self._check(fn(handle, _fp(d.ravel()), _ip(ids), ids.size,
+        # Named reference (not `_fp(d.ravel())`): the async add returns
+        # before the native side is done with the buffer, so a Python
+        # name must keep it alive across the call (mvlint MV001).
+        flat = d.ravel()
+        self._check(fn(handle, _fp(flat), _ip(ids), ids.size,
                        d.shape[1]),
                     "MV_AddMatrixTableByRows")
 

@@ -39,6 +39,12 @@ class MpiNet : public Net {
   // True when a dlopen-able libmpi with the expected ABI is present.
   static bool Available();
 
+  // Number of send payloads parked for the life of the process after a
+  // timed-out or failed send (MPI may keep reading a buffer whose
+  // request we freed).  Diagnostic/test hook: healthy runs stay at 0;
+  // every increment already logged an error.
+  static size_t OrphanedSendBufCount();
+
   // Initialize MPI (MPI_THREAD_MULTIPLE requested; serial-mode locking
   // regardless), read rank/size, start the inbound probe thread.
   bool Init(InboundFn fn);

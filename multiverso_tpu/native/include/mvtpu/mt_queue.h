@@ -2,10 +2,10 @@
 // Capability parity with include/multiverso/util/mt_queue.h (SURVEY.md §2.22).
 #pragma once
 
-#include <condition_variable>
 #include <deque>
-#include <mutex>
 #include <utility>
+
+#include "mvtpu/mutex.h"
 
 namespace mvtpu {
 
@@ -14,17 +14,17 @@ class MtQueue {
  public:
   void Push(T item) {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       q_.push_back(std::move(item));
     }
-    cv_.notify_one();
+    cv_.NotifyOne();
   }
 
   // Blocks until an item arrives or Exit() is called.
   // Returns false iff exited and drained.
   bool Pop(T* out) {
-    std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait(lk, [&] { return !q_.empty() || exit_; });
+    MutexLock lk(mu_);
+    while (q_.empty() && !exit_) cv_.Wait(mu_);
     if (q_.empty()) return false;
     *out = std::move(q_.front());
     q_.pop_front();
@@ -32,7 +32,7 @@ class MtQueue {
   }
 
   bool TryPop(T* out) {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     if (q_.empty()) return false;
     *out = std::move(q_.front());
     q_.pop_front();
@@ -41,22 +41,22 @@ class MtQueue {
 
   void Exit() {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       exit_ = true;
     }
-    cv_.notify_all();
+    cv_.NotifyAll();
   }
 
   size_t Size() const {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     return q_.size();
   }
 
  private:
-  mutable std::mutex mu_;
-  std::condition_variable cv_;
-  std::deque<T> q_;
-  bool exit_ = false;
+  mutable Mutex mu_;
+  CondVar cv_;
+  std::deque<T> q_ GUARDED_BY(mu_);
+  bool exit_ GUARDED_BY(mu_) = false;
 };
 
 }  // namespace mvtpu

@@ -11,12 +11,12 @@
 #include <cstdint>
 #include <functional>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
 
 #include "mvtpu/message.h"
+#include "mvtpu/mutex.h"
 
 namespace mvtpu {
 
@@ -110,15 +110,19 @@ class TcpNet : public Net {
   // concurrently with that teardown (TSan-verified, round 5).
   std::atomic<int> listen_fd_{-1};
   std::thread accept_thread_;
-  std::vector<std::thread> readers_;
-  std::vector<int> accepted_fds_;
-  std::mutex readers_mu_;
+  Mutex readers_mu_;
+  std::vector<std::thread> readers_ GUARDED_BY(readers_mu_);
+  std::vector<int> accepted_fds_ GUARDED_BY(readers_mu_);
 
+  // Per-destination locks: send_mus_[i] guards send_fds_[i] (lazy
+  // connect install + framed write).  A per-ELEMENT capability is
+  // beyond the annotation language, so the pairing is enforced by
+  // review + TSan; the vectors themselves are sized once in Init.
   std::vector<int> send_fds_;
-  std::vector<std::unique_ptr<std::mutex>> send_mus_;
+  std::vector<std::unique_ptr<Mutex>> send_mus_;
 
   std::atomic<bool> running_{false};
-  std::mutex mu_;
+  Mutex mu_;  // serializes Stop vs ConnectTo's retry-abort check
 };
 
 }  // namespace mvtpu

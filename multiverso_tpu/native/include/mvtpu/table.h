@@ -13,12 +13,12 @@
 #include <algorithm>
 #include <cstdint>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
 
 #include "mvtpu/message.h"
+#include "mvtpu/mutex.h"
 #include "mvtpu/stream.h"
 #include "mvtpu/updater.h"
 #include "mvtpu/waiter.h"
@@ -71,14 +71,17 @@ class ArrayServerTable : public ServerTable {
   void ProcessAdd(const Message& req) override;
   bool Store(Stream* out) const override;
   bool Load(Stream* in) override;
-  int64_t size() const { return static_cast<int64_t>(data_.size()); }
+  int64_t size() const {
+    MutexLock lk(mu_);
+    return static_cast<int64_t>(data_.size());
+  }
 
  private:
   ShardRange range_;
-  std::vector<float> data_;    // the local shard
-  std::vector<float> slot0_;
+  mutable Mutex mu_;
+  std::vector<float> data_ GUARDED_BY(mu_);    // the local shard
+  std::vector<float> slot0_ GUARDED_BY(mu_);
   UpdaterType updater_;
-  mutable std::mutex mu_;
 };
 
 class MatrixServerTable : public ServerTable {
@@ -95,10 +98,10 @@ class MatrixServerTable : public ServerTable {
  private:
   int64_t global_rows_, cols_;
   ShardRange range_;           // the row block this rank owns
-  std::vector<float> data_;    // range_.len() * cols, row-major
-  std::vector<float> slot0_;
+  mutable Mutex mu_;
+  std::vector<float> data_ GUARDED_BY(mu_);  // range_.len()*cols, row-major
+  std::vector<float> slot0_ GUARDED_BY(mu_);
   UpdaterType updater_;
-  mutable std::mutex mu_;
 };
 
 // ---------------------------------------------------------------- worker
@@ -125,12 +128,14 @@ class AsyncGetHandle {
   friend class WorkerTable;
   AsyncGetHandle(WorkerTable* t, int64_t msg_id, int nreq,
                  std::shared_ptr<void> state)
-      : table_(t), msg_id_(msg_id), waiter_(nreq),
-        state_(std::move(state)) {}
+      : table_(t), msg_id_(msg_id),
+        waiter_(std::make_shared<Waiter>(nreq)), state_(std::move(state)) {}
   WorkerTable* table_;
   int64_t msg_id_;          // -1: empty request, trivially complete
-  Waiter waiter_;
-  bool failed_ = false;     // written by Notify under the table's mu_
+  std::shared_ptr<Waiter> waiter_;  // shared with pending_ (see Notify)
+  bool failed_ GUARDED_BY(table_->mu_) = false;  // written by Notify
+  // Owner-thread state (only the thread driving Wait()/~ touches these;
+  // no lock, so they carry no capability annotation).
   bool waited_ = false;
   bool ok_ = false;
   std::shared_ptr<void> state_;  // owns the consume plan (scatter map)
@@ -172,15 +177,18 @@ class WorkerTable {
 
  private:
   friend class AsyncGetHandle;
-  std::mutex mu_;
+  Mutex mu_;
   struct Pending {
-    Waiter* waiter;
+    // shared_ptr, not a raw pointer to the caller's frame: the waiter
+    // must stay a live heap object for as long as a reply could touch
+    // it (and TSan only tracks mutex death through free()).
+    std::shared_ptr<Waiter> waiter;
     void (*consume)(void*, const Message&);
     void* arg;
     int remaining;
     bool* failed;
   };
-  std::unordered_map<int64_t, Pending> pending_;
+  std::unordered_map<int64_t, Pending> pending_ GUARDED_BY(mu_);
 };
 
 class ArrayWorkerTable : public WorkerTable {
@@ -253,14 +261,14 @@ class SparseMatrixWorkerTable : public MatrixWorkerTable {
   void OnClockInvalidate() override;
 
  private:
-  std::mutex cache_mu_;
-  std::vector<uint8_t> valid_;   // lazily rows_ entries
-  std::vector<float> mirror_;    // lazily rows_*cols_ floats
+  Mutex cache_mu_;
+  std::vector<uint8_t> valid_ GUARDED_BY(cache_mu_);   // lazily rows_
+  std::vector<float> mirror_ GUARDED_BY(cache_mu_);    // lazily rows_*cols_
   // Bumped by every invalidation (own add, clock).  GetRows releases
   // cache_mu_ for the wire fetch and installs the result only if the
   // epoch is unchanged — a fetch that raced an invalidation must not
   // resurrect pre-add values into the cache.
-  uint64_t cache_epoch_ = 0;
+  uint64_t cache_epoch_ GUARDED_BY(cache_mu_) = 0;
 };
 
 // ------------------------------------------------------------------- KV
@@ -294,10 +302,10 @@ class KVServerTable : public ServerTable {
   size_t size() const;
 
  private:
-  std::unordered_map<std::string, float> data_;
-  std::unordered_map<std::string, float> slot0_;  // stateful updaters
+  mutable Mutex mu_;
+  std::unordered_map<std::string, float> data_ GUARDED_BY(mu_);
+  std::unordered_map<std::string, float> slot0_ GUARDED_BY(mu_);  // slots
   UpdaterType updater_;
-  mutable std::mutex mu_;
 };
 
 class KVWorkerTable : public WorkerTable {
@@ -310,14 +318,18 @@ class KVWorkerTable : public WorkerTable {
   bool Add(const std::vector<std::string>& keys, const float* deltas,
            const AddOption& opt, bool blocking);
   // Worker-side cache of the last Get'd values (reference `raw()`).
-  const std::unordered_map<std::string, float>& raw() const {
+  // By value, under the lock: the old by-reference accessor handed out
+  // an unsynchronized view a concurrent Get could rehash under the
+  // reader (the first hole `make analyze` flagged in this layer).
+  std::unordered_map<std::string, float> raw() const {
+    MutexLock lk(cache_mu_);
     return cache_;
   }
 
  private:
   int servers_;
-  std::unordered_map<std::string, float> cache_;
-  std::mutex cache_mu_;
+  mutable Mutex cache_mu_;
+  std::unordered_map<std::string, float> cache_ GUARDED_BY(cache_mu_);
 };
 
 }  // namespace mvtpu

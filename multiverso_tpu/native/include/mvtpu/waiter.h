@@ -3,8 +3,9 @@
 #pragma once
 
 #include <chrono>
-#include <condition_variable>
-#include <mutex>
+#include <cstdint>
+
+#include "mvtpu/mutex.h"
 
 namespace mvtpu {
 
@@ -13,8 +14,8 @@ class Waiter {
   explicit Waiter(int count = 1) : count_(count) {}
 
   void Wait() {
-    std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait(lk, [&] { return count_ <= 0; });
+    MutexLock lk(mu_);
+    while (count_ > 0) cv_.Wait(mu_);
   }
 
   // Deadline wait: true when the count reached zero, false on timeout.
@@ -24,30 +25,37 @@ class Waiter {
       Wait();
       return true;
     }
-    std::unique_lock<std::mutex> lk(mu_);
-    return cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                        [&] { return count_ <= 0; });
+    auto deadline = std::chrono::system_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    MutexLock lk(mu_);
+    while (count_ > 0) {
+      if (!cv_.WaitUntil(mu_, deadline)) return count_ <= 0;
+    }
+    return true;
   }
 
   void Notify() {
-    // notify_all runs WHILE holding mu_: Waiters are stack-allocated by
-    // their waiting caller (RoundTrip, Barrier), so notifying after the
-    // unlock would race the waiter observing count_<=0, returning, and
-    // destroying this object mid-notify (use-after-free).
-    std::lock_guard<std::mutex> lk(mu_);
+    // notify_all runs WHILE holding mu_: the waiting caller (RoundTrip,
+    // Barrier) may drop its reference right after observing count_<=0,
+    // so notifying after the unlock could run on a destroyed object.
+    // Waiters are heap-allocated (shared_ptr) by every caller: TSan's
+    // mutex shadow state is flushed on free, whereas a stack slot
+    // reused by the next call's waiter resurrects the old mutex
+    // identity in gcc-10's libtsan.
+    MutexLock lk(mu_);
     --count_;
-    cv_.notify_all();
+    cv_.NotifyAll();
   }
 
   void Reset(int count) {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     count_ = count;
   }
 
  private:
-  std::mutex mu_;
-  std::condition_variable cv_;
-  int count_;
+  Mutex mu_;
+  CondVar cv_;
+  int count_ GUARDED_BY(mu_);
 };
 
 }  // namespace mvtpu

@@ -16,12 +16,12 @@
 
 #include <atomic>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
 
 #include "mvtpu/actor.h"
+#include "mvtpu/mutex.h"
 #include "mvtpu/net.h"
 #include "mvtpu/table.h"
 
@@ -41,7 +41,7 @@ class Zoo {
   // machine file names more than one process); idempotent.
   bool Start(int argc, const char* const* argv);
   void Stop();
-  bool started() const { return started_; }
+  bool started() const { return started_.load(); }
 
   int rank() const { return rank_; }
   int size() const { return size_; }
@@ -133,25 +133,39 @@ class Zoo {
 
   void RouteInbound(Message&& m);       // transport reader threads
 
-  bool started_ = false;
-  std::mutex mu_;         // lifecycle (Start/Stop) + actor pointers
-  std::mutex tables_mu_;  // table registry — actors query it mid-Stop, so
+  // Atomic, not GUARDED_BY(mu_): started() is the C-API fast-path gate
+  // (RequireStarted) and must not contend with Start/Stop.  It doubles
+  // as the Stop latch — the first Stop flips it under mu_ and later
+  // Stops return without touching the half-torn-down actors.
+  std::atomic<bool> started_{false};
+  Mutex mu_;              // lifecycle (Start/Stop) + actor pointers
+  Mutex tables_mu_;       // table registry — actors query it mid-Stop, so
                           // it must never be held across a thread join
   std::atomic<int64_t> next_msg_id_{0};
   UpdaterType updater_type_ = UpdaterType::kDefault;
 
+  // Phase-stable state (rank_, size_, role rank lists, net_,
+  // updater_type_): written once during Start and cleared by the one
+  // Stop that wins the started_ latch, both under mu_; every other
+  // reader runs between Start and Stop where the values are immutable.
+  // Deliberately NOT GUARDED_BY(mu_) — the hot paths (Deliver, shard
+  // math, barrier fan-out) read them lock-free, and net_->Send must not
+  // run under mu_ anyway.  The analyze build checks the mutex-guarded
+  // state below; this block's discipline is the started_ protocol.
   int rank_ = 0;
   int size_ = 1;
   std::vector<int> worker_ranks_{0};   // ranks holding the worker role
   std::vector<int> server_ranks_{0};   // ranks holding the server role
   std::unique_ptr<Net> net_;  // TcpNet or MpiNet, per -net_type
 
-  std::unique_ptr<Actor> worker_actor_;
-  std::unique_ptr<Actor> server_actor_;
-  std::unique_ptr<Actor> controller_actor_;
+  std::unique_ptr<Actor> worker_actor_ GUARDED_BY(mu_);
+  std::unique_ptr<Actor> server_actor_ GUARDED_BY(mu_);
+  std::unique_ptr<Actor> controller_actor_ GUARDED_BY(mu_);
 
-  std::vector<std::unique_ptr<ServerTable>> server_tables_;
-  std::vector<std::unique_ptr<WorkerTable>> worker_tables_;
+  std::vector<std::unique_ptr<ServerTable>> server_tables_
+      GUARDED_BY(tables_mu_);
+  std::vector<std::unique_ptr<WorkerTable>> worker_tables_
+      GUARDED_BY(tables_mu_);
 
   // Barrier state: one outstanding barrier per rank; rank 0 tracks
   // arrivals PER RANK (a retry after an abandoned round must not double
@@ -160,12 +174,12 @@ class Zoo {
   // barrier_round_ is this rank's current round; barrier_rounds_ is the
   // rank-0 authority's record of each rank's latest announced round
   // (echoed in the release so stale releases are droppable).
-  std::mutex barrier_mu_;
-  Waiter* barrier_waiter_ = nullptr;
-  std::vector<bool> barrier_arrived_;
-  bool barrier_failed_ = false;
-  int64_t barrier_round_ = 0;
-  std::vector<int64_t> barrier_rounds_;
+  Mutex barrier_mu_;
+  std::shared_ptr<Waiter> barrier_waiter_ GUARDED_BY(barrier_mu_);
+  std::vector<bool> barrier_arrived_ GUARDED_BY(barrier_mu_);
+  bool barrier_failed_ GUARDED_BY(barrier_mu_) = false;
+  int64_t barrier_round_ GUARDED_BY(barrier_mu_) = 0;
+  std::vector<int64_t> barrier_rounds_ GUARDED_BY(barrier_mu_);
 
   // SSP state: this rank's worker clock; server-side per-rank clock
   // vector + the gets parked until the staleness bound admits them.
@@ -174,18 +188,21 @@ class Zoo {
   // without bound, so every park/tick event purges expired entries and
   // fails them fast with ReplyError (the caller sees rc=-3).
   std::atomic<int64_t> clock_{0};
-  std::mutex ssp_mu_;
-  std::vector<int64_t> worker_clocks_;
-  std::vector<std::pair<int64_t, MessagePtr>> held_gets_;  // (deadline_ms,…)
-  // Under ssp_mu_: moves expired parks out for fail-fast replies.
-  void PurgeExpiredHeldLocked(std::vector<MessagePtr>* expired);
+  Mutex ssp_mu_;
+  std::vector<int64_t> worker_clocks_ GUARDED_BY(ssp_mu_);
+  std::vector<std::pair<int64_t, MessagePtr>> held_gets_
+      GUARDED_BY(ssp_mu_);  // (deadline_ms, parked get)
+  // Moves expired parks out for fail-fast replies.
+  void PurgeExpiredHeldLocked(std::vector<MessagePtr>* expired)
+      REQUIRES(ssp_mu_);
   void FailHeldGets(std::vector<MessagePtr> expired);
-  bool HeldBySspLocked(int src);  // admission predicate (ssp_mu_ held)
+  bool HeldBySspLocked(int src) REQUIRES(ssp_mu_);  // admission predicate
 
   // Outstanding pipeline flushes (msg_id → waiter); acks notify under
-  // flush_mu_ so a timed-out flush cannot race its stack waiter.
-  std::mutex flush_mu_;
-  std::unordered_map<int64_t, Waiter*> flush_pending_;
+  // flush_mu_ so a timed-out flush cannot race its waiter's teardown.
+  Mutex flush_mu_;
+  std::unordered_map<int64_t, std::shared_ptr<Waiter>> flush_pending_
+      GUARDED_BY(flush_mu_);
 };
 
 }  // namespace mvtpu

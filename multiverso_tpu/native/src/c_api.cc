@@ -2,16 +2,18 @@
 
 #include <cstdlib>
 #include <cstring>
-#include <mutex>
 #include <stdexcept>
 #include <unordered_map>
 
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
+#include "mvtpu/mutex.h"
 #include "mvtpu/stream.h"
 #include "mvtpu/zoo.h"
 
 using mvtpu::AddOption;
+using mvtpu::Mutex;
+using mvtpu::MutexLock;
 using mvtpu::Zoo;
 
 namespace {
@@ -21,15 +23,16 @@ int RequireStarted() { return Zoo::Get()->started() ? 0 : -1; }
 
 // Outstanding MV_GetAsync* tickets.  Tickets index AsyncGetHandles so
 // the FFI surface stays integer-only; MV_WaitGet consumes the entry.
-std::mutex g_gets_mu;
-std::unordered_map<int32_t, mvtpu::AsyncGetPtr>& Gets() {
+Mutex g_gets_mu;
+std::unordered_map<int32_t, mvtpu::AsyncGetPtr>& Gets()
+    REQUIRES(g_gets_mu) {
   static auto* m = new std::unordered_map<int32_t, mvtpu::AsyncGetPtr>();
   return *m;
 }
-int32_t g_next_get_ticket = 1;
+int32_t g_next_get_ticket GUARDED_BY(g_gets_mu) = 1;
 
 int32_t StashGet(mvtpu::AsyncGetPtr h) {
-  std::lock_guard<std::mutex> lk(g_gets_mu);
+  MutexLock lk(g_gets_mu);
   int32_t t = g_next_get_ticket++;
   Gets()[t] = std::move(h);
   return t;
@@ -40,7 +43,7 @@ namespace mvtpu {
 // Called by Zoo::Stop(): un-waited tickets must not outlive the tables
 // their handles point into (~AsyncGetHandle dereferences the table).
 void CApiReclaimAsyncGets() {
-  std::lock_guard<std::mutex> lk(g_gets_mu);
+  MutexLock lk(g_gets_mu);
   Gets().clear();
 }
 }  // namespace mvtpu
@@ -195,7 +198,7 @@ int MV_GetAsyncMatrixTableByRows(int32_t handle, float* data,
 int MV_WaitGet(int32_t wait_handle) {
   mvtpu::AsyncGetPtr h;
   {
-    std::lock_guard<std::mutex> lk(g_gets_mu);
+    MutexLock lk(g_gets_mu);
     auto it = Gets().find(wait_handle);
     if (it == Gets().end()) return -2;
     h = std::move(it->second);
@@ -207,7 +210,7 @@ int MV_WaitGet(int32_t wait_handle) {
 int MV_CancelGet(int32_t wait_handle) {
   mvtpu::AsyncGetPtr h;
   {
-    std::lock_guard<std::mutex> lk(g_gets_mu);
+    MutexLock lk(g_gets_mu);
     auto it = Gets().find(wait_handle);
     if (it == Gets().end()) return -2;
     h = std::move(it->second);

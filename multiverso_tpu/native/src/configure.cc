@@ -4,6 +4,8 @@
 #include <mutex>
 #include <stdexcept>
 
+#include "mvtpu/mutex.h"
+
 namespace mvtpu {
 namespace configure {
 
@@ -18,19 +20,23 @@ struct Flag {
   std::string help;
 };
 
-std::map<std::string, Flag>& Registry() {
+Mutex g_mu;
+
+// The registry map lives behind a function-local static (first use may
+// precede any other global's ctor); REQUIRES is the enforcement point —
+// the map itself is only reachable through these two accessors.
+std::map<std::string, Flag>& Registry() REQUIRES(g_mu) {
   static std::map<std::string, Flag> r;
   return r;
 }
-std::mutex g_mu;
 
 void Define(const std::string& name, Kind kind, const std::string& dflt,
             const std::string& help) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   Registry()[name] = Flag{kind, dflt, dflt, help};
 }
 
-Flag& Find(const std::string& name) {
+Flag& Find(const std::string& name) REQUIRES(g_mu) {
   auto it = Registry().find(name);
   if (it == Registry().end())
     throw std::invalid_argument("unknown flag: " + name);
@@ -75,30 +81,30 @@ void DefineString(const std::string& n, const std::string& d,
 }
 
 bool GetBool(const std::string& n) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   const std::string& v = Find(n).value;
   return v == "true" || v == "1";
 }
 long long GetInt(const std::string& n) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   return std::stoll(Find(n).value);
 }
 double GetDouble(const std::string& n) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   return std::stod(Find(n).value);
 }
 std::string GetString(const std::string& n) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   return Find(n).value;
 }
 
 bool Has(const std::string& n) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   return Registry().count(n) > 0;
 }
 
 void Set(const std::string& n, const std::string& value) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   Flag& f = Find(n);
   Validate(f.kind, value);
   f.value = value;
@@ -124,7 +130,7 @@ int ParseCmdFlags(int argc, const char* const* argv) {
 }
 
 void Reset() {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   for (auto& kv : Registry()) kv.second.value = kv.second.dflt;
 }
 

@@ -2,8 +2,9 @@
 
 #include <algorithm>
 #include <map>
-#include <mutex>
 #include <sstream>
+
+#include "mvtpu/mutex.h"
 
 namespace mvtpu {
 
@@ -13,12 +14,12 @@ struct Stat {
   double total = 0.0;
   double max = 0.0;
 };
-std::map<std::string, Stat> g_stats;
-std::mutex g_mu;
+Mutex g_mu;
+std::map<std::string, Stat> g_stats GUARDED_BY(g_mu);
 }  // namespace
 
 void Dashboard::Record(const std::string& name, double seconds) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   Stat& s = g_stats[name];
   ++s.count;
   s.total += seconds;
@@ -26,13 +27,14 @@ void Dashboard::Record(const std::string& name, double seconds) {
 }
 
 std::string Dashboard::Report() {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   std::ostringstream os;
   os << "---------------- Dashboard ----------------\n";
   for (const auto& kv : g_stats) {
     const Stat& s = kv.second;
     os << "  " << kv.first << ": count=" << s.count
-       << " total=" << s.total << "s mean=" << (s.total / s.count) * 1e3
+       << " total=" << s.total << "s mean="
+       << (s.total / static_cast<double>(s.count)) * 1e3
        << "ms max=" << s.max * 1e3 << "ms\n";
   }
   os << "--------------------------------------------";
@@ -40,13 +42,13 @@ std::string Dashboard::Report() {
 }
 
 void Dashboard::Reset() {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   g_stats.clear();
 }
 
 bool Dashboard::Query(const std::string& name, long long* count,
                       double* total) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   auto it = g_stats.find(name);
   if (it == g_stats.end()) return false;
   if (count) *count = it->second.count;

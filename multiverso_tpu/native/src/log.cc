@@ -1,21 +1,25 @@
 #include "mvtpu/log.h"
 
+#include <atomic>
 #include <cstdarg>
 #include <cstdio>
 #include <cstdlib>
 #include <ctime>
-#include <mutex>
+
+#include "mvtpu/mutex.h"
 
 namespace mvtpu {
 
 namespace {
-std::mutex g_mu;
-LogLevel g_level = LogLevel::kInfo;
-FILE* g_file = nullptr;
+Mutex g_mu;
+// Atomic: the level gate runs before taking g_mu on every log call and
+// SetLevel may race an in-flight Emit.
+std::atomic<LogLevel> g_level{LogLevel::kInfo};
+FILE* g_file GUARDED_BY(g_mu) = nullptr;
 
 void Emit(LogLevel level, const char* tag, const char* fmt, va_list ap) {
-  if (level < g_level) return;
-  std::lock_guard<std::mutex> lk(g_mu);
+  if (level < g_level.load(std::memory_order_relaxed)) return;
+  MutexLock lk(g_mu);
   char ts[32];
   time_t now = time(nullptr);
   struct tm tmv;
@@ -36,10 +40,12 @@ void Emit(LogLevel level, const char* tag, const char* fmt, va_list ap) {
 }
 }  // namespace
 
-void Log::SetLevel(LogLevel level) { g_level = level; }
+void Log::SetLevel(LogLevel level) {
+  g_level.store(level, std::memory_order_relaxed);
+}
 
 void Log::ResetLogFile(const std::string& path) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   if (g_file) fclose(g_file);
   g_file = path.empty() ? nullptr : fopen(path.c_str(), "a");
 }

@@ -5,7 +5,6 @@
 
 #include <chrono>
 #include <climits>
-#include <mutex>
 #include <vector>
 
 #include "mvtpu/configure.h"
@@ -102,17 +101,17 @@ MpiApi& Api() {
 }
 
 // Serial-mode lock: MPI state is process-wide, so the lock is too.
-std::mutex& MpiMu() {
-  static std::mutex mu;
-  return mu;
-}
+// A namespace-scope Mutex (constant-initialized: std::mutex's ctor is
+// constexpr) rather than a function-local static, so GUARDED_BY /
+// REQUIRES below have a name to bind to.
+Mutex g_mpi_mu;
 
-// Payloads of timed-out sends.  MPI_Request_free drops our handle but
-// the library may still read the user buffer until the (cancelled or
-// completed) send drains, so the blob is parked for the life of the
-// process — bounded by the number of timeouts, each of which already
-// logged an error.  Guarded by MpiMu().
-std::vector<Blob>& OrphanedSendBufs() {
+// Payloads of timed-out/failed sends.  MPI_Request_free drops our
+// handle but the library may still read the user buffer until the
+// (cancelled or completed) send drains, so the blob is parked for the
+// life of the process — bounded by the number of failures, each of
+// which already logged an error.
+std::vector<Blob>& OrphanedSendBufs() REQUIRES(g_mpi_mu) {
   static auto* v = new std::vector<Blob>();
   return *v;
 }
@@ -143,7 +142,7 @@ bool MpiNet::Init(InboundFn fn) {
     return false;
   }
   {
-    std::lock_guard<std::mutex> lk(MpiMu());
+    MutexLock lk(g_mpi_mu);
     int inited = 0;
     api.initialized(&inited);
     if (!inited) {
@@ -175,6 +174,11 @@ bool MpiNet::Init(InboundFn fn) {
   return true;
 }
 
+size_t MpiNet::OrphanedSendBufCount() {
+  MutexLock lk(g_mpi_mu);
+  return OrphanedSendBufs().size();
+}
+
 bool MpiNet::Send(int dst_rank, const Message& msg) {
   MpiApi& api = Api();
   if (!running_.load() || dst_rank < 0 || dst_rank >= size_) return false;
@@ -186,12 +190,12 @@ bool MpiNet::Send(int dst_rank, const Message& msg) {
     return false;
   }
   // Isend + Test poll, RELEASING the lock between polls: a blocking
-  // MPI_Send under MpiMu() would starve this rank's own ProbeLoop of
+  // MPI_Send under g_mpi_mu would starve this rank's own ProbeLoop of
   // the lock, and two ranks exchanging rendezvous-size messages would
   // deadlock (neither probe thread could post the matching Recv).
   void* req = nullptr;
   {
-    std::lock_guard<std::mutex> lk(MpiMu());
+    MutexLock lk(g_mpi_mu);
     if (api.isend(wire.data(), static_cast<int>(wire.size()), api.byte,
                   dst_rank, kTag, api.comm_world, &req) != 0)
       return false;
@@ -212,10 +216,21 @@ bool MpiNet::Send(int dst_rank, const Message& msg) {
                         std::chrono::milliseconds(timeout_ms);
   while (true) {
     {
-      std::lock_guard<std::mutex> lk(MpiMu());
+      MutexLock lk(g_mpi_mu);
       int done = 0;
       MpiStatus st{};
-      if (api.test(&req, &done, &st) != 0) return false;
+      if (api.test(&req, &done, &st) != 0) {
+        // Error path mirrors the timeout branch below: MPI_Test failing
+        // does NOT mean the send drained — the library may still read
+        // the user buffer, so free our handle and park the payload
+        // instead of letting `wire` die on return.
+        api.cancel(&req);
+        api.request_free(&req);
+        OrphanedSendBufs().push_back(std::move(wire));
+        Log::Error("MpiNet::Send to rank %d: MPI_Test failed; request "
+                   "freed, payload parked", dst_rank);
+        return false;
+      }
       if (done) return true;
       if (timeout_ms > 0 && std::chrono::steady_clock::now() >= deadline) {
         api.cancel(&req);
@@ -237,7 +252,7 @@ void MpiNet::ProbeLoop() {
     Blob buf;
     bool got = false;
     {
-      std::lock_guard<std::mutex> lk(MpiMu());
+      MutexLock lk(g_mpi_mu);
       int flag = 0;
       MpiStatus st{};
       if (api.iprobe(kAnySource, kTag, api.comm_world, &flag, &st) != 0)
@@ -265,7 +280,7 @@ void MpiNet::Stop() {
   if (!running_.exchange(false)) return;
   if (probe_thread_.joinable()) probe_thread_.join();
   MpiApi& api = Api();
-  std::lock_guard<std::mutex> lk(MpiMu());
+  MutexLock lk(g_mpi_mu);
   int inited = 0, fin = 0;
   api.initialized(&inited);
   api.finalized(&fin);

@@ -291,7 +291,7 @@ bool TcpNet::Init(const std::vector<std::string>& endpoints, int rank,
   send_fds_.assign(endpoints_.size(), -1);
   send_mus_.clear();
   for (size_t i = 0; i < endpoints_.size(); ++i)
-    send_mus_.push_back(std::make_unique<std::mutex>());
+    send_mus_.push_back(std::make_unique<Mutex>());
 
   std::string host;
   int port = 0;
@@ -331,7 +331,7 @@ void TcpNet::AcceptLoop() {
     if (fd < 0) return;  // listen_fd_ closed by Stop
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    std::lock_guard<std::mutex> lk(readers_mu_);
+    MutexLock lk(readers_mu_);
     if (!running_) {
       ::close(fd);
       return;
@@ -380,7 +380,7 @@ int TcpNet::ConnectTo(int dst_rank) {
     fd = -1;
     std::this_thread::sleep_for(std::chrono::milliseconds(100));
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       if (!running_) break;
     }
   }
@@ -401,19 +401,19 @@ bool TcpNet::Send(int dst_rank, const Message& msg) {
   // to this rank behind the retries.
   bool need_connect;
   {
-    std::lock_guard<std::mutex> lk(*send_mus_[dst_rank]);
+    MutexLock lk(*send_mus_[dst_rank]);
     need_connect = send_fds_[dst_rank] < 0;
   }
   if (need_connect) {
     int nfd = ConnectTo(dst_rank);
-    std::lock_guard<std::mutex> lk(*send_mus_[dst_rank]);
+    MutexLock lk(*send_mus_[dst_rank]);
     if (send_fds_[dst_rank] < 0) {
       send_fds_[dst_rank] = nfd;       // install (may still be -1)
     } else if (nfd >= 0) {
       ::close(nfd);                    // raced: another sender connected
     }
   }
-  std::lock_guard<std::mutex> lk(*send_mus_[dst_rank]);
+  MutexLock lk(*send_mus_[dst_rank]);
   int fd = send_fds_[dst_rank];
   if (fd < 0) {
     Log::Error("TcpNet: cannot reach rank %d (%s)", dst_rank,
@@ -431,7 +431,7 @@ bool TcpNet::Send(int dst_rank, const Message& msg) {
 
 void TcpNet::Stop() {
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     if (!running_ && listen_fd_ < 0) return;
     running_ = false;
   }
@@ -442,7 +442,7 @@ void TcpNet::Stop() {
   }
   if (accept_thread_.joinable()) accept_thread_.join();
   for (size_t i = 0; i < send_fds_.size(); ++i) {
-    std::lock_guard<std::mutex> lk(*send_mus_[i]);
+    MutexLock lk(*send_mus_[i]);
     if (send_fds_[i] >= 0) {
       ::shutdown(send_fds_[i], SHUT_RDWR);
       ::close(send_fds_[i]);
@@ -451,7 +451,7 @@ void TcpNet::Stop() {
   }
   std::vector<std::thread> readers;
   {
-    std::lock_guard<std::mutex> lk(readers_mu_);
+    MutexLock lk(readers_mu_);
     // Unblock readers stuck in recv() even if the peer never closes.
     for (int fd : accepted_fds_) ::shutdown(fd, SHUT_RDWR);
     accepted_fds_.clear();

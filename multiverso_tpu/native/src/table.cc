@@ -32,7 +32,7 @@ ArrayServerTable::ArrayServerTable(int64_t global_size, UpdaterType updater,
 void ArrayServerTable::ProcessGet(const Message& req, Message* reply) {
   (void)req;
   Monitor mon("ArrayServer::ProcessGet");
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   reply->data.emplace_back(data_.data(), data_.size() * sizeof(float));
 }
 
@@ -41,7 +41,7 @@ void ArrayServerTable::ProcessAdd(const Message& req) {
   const AddOption* opt = req.data[0].As<AddOption>();
   const float* delta = req.data[1].As<float>();
   size_t n = req.data[1].count<float>();
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (n != data_.size()) {
     Log::Error("ArrayServerTable: delta size %zu != %zu", n, data_.size());
     return;
@@ -51,7 +51,7 @@ void ArrayServerTable::ProcessAdd(const Message& req) {
 }
 
 bool ArrayServerTable::Store(Stream* out) const {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   int64_t n = static_cast<int64_t>(data_.size());
   return out->Write(&n, sizeof(n)) == sizeof(n) &&
          out->Write(data_.data(), n * sizeof(float)) == n * sizeof(float) &&
@@ -60,7 +60,7 @@ bool ArrayServerTable::Store(Stream* out) const {
 }
 
 bool ArrayServerTable::Load(Stream* in) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   int64_t n = 0;
   if (in->Read(&n, sizeof(n)) != sizeof(n) ||
       n != static_cast<int64_t>(data_.size()))
@@ -85,7 +85,7 @@ MatrixServerTable::MatrixServerTable(int64_t rows, int64_t cols,
 
 void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
   Monitor mon("MatrixServer::ProcessGet");
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (req.data.empty()) {  // GetAll: reply with the local row block
     reply->data.emplace_back(data_.data(), data_.size() * sizeof(float));
     return;
@@ -110,7 +110,7 @@ void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
 void MatrixServerTable::ProcessAdd(const Message& req) {
   Monitor mon("MatrixServer::ProcessAdd");
   const AddOption* opt = req.data[0].As<AddOption>();
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   float* slots = slot0_.empty() ? nullptr : slot0_.data();
   if (req.data.size() == 2) {  // AddAll: the local row-block slice
     const float* delta = req.data[1].As<float>();
@@ -161,7 +161,7 @@ void MatrixServerTable::ProcessAdd(const Message& req) {
 }
 
 bool MatrixServerTable::Store(Stream* out) const {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   int64_t hdr[2] = {range_.len(), cols_};
   size_t bytes = data_.size() * sizeof(float);
   return out->Write(hdr, sizeof(hdr)) == sizeof(hdr) &&
@@ -170,7 +170,7 @@ bool MatrixServerTable::Store(Stream* out) const {
 }
 
 bool MatrixServerTable::Load(Stream* in) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   int64_t hdr[2];
   if (in->Read(hdr, sizeof(hdr)) != sizeof(hdr) || hdr[0] != range_.len() ||
       hdr[1] != cols_)
@@ -221,7 +221,7 @@ void KVServerTable::ProcessGet(const Message& req, Message* reply) {
   auto keys = UnpackKeys(req.data[0]);
   Blob out(keys.size() * sizeof(float));
   float* vals = out.As<float>();
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   for (size_t i = 0; i < keys.size(); ++i) {
     auto it = data_.find(keys[i]);
     vals[i] = it == data_.end() ? 0.0f : it->second;
@@ -241,7 +241,7 @@ void KVServerTable::ProcessAdd(const Message& req) {
     return;
   }
   bool stateful = NumSlots(updater_) > 0;
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (!stateful) {
     for (size_t i = 0; i < keys.size(); ++i)
       ApplyUpdate(updater_, *opt, &data_[keys[i]], nullptr, deltas + i, 1);
@@ -257,12 +257,12 @@ void KVServerTable::ProcessAdd(const Message& req) {
 }
 
 size_t KVServerTable::size() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   return data_.size();
 }
 
 bool KVServerTable::Store(Stream* out) const {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   int64_t n = static_cast<int64_t>(data_.size());
   int8_t has_slots = slot0_.empty() ? 0 : 1;
   if (out->Write(&n, sizeof(n)) != sizeof(n) ||
@@ -286,7 +286,7 @@ bool KVServerTable::Store(Stream* out) const {
 }
 
 bool KVServerTable::Load(Stream* in) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   int64_t n = 0;
   int8_t has_slots = 0;
   if (in->Read(&n, sizeof(n)) != sizeof(n) ||
@@ -316,7 +316,7 @@ void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
   // serializes with RoundTrip's timeout path: once the timeout erases
   // the entry, a late reply finds nothing and cannot touch the (gone)
   // stack waiter or the caller's output buffers.
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   auto it = pending_.find(msg_id);
   if (it == pending_.end()) {
     Log::Error("WorkerTable %d: reply for unknown/expired msg %lld",
@@ -329,7 +329,7 @@ void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
   } else if (p.consume) {
     p.consume(p.arg, reply);
   }
-  Waiter* waiter = p.waiter;
+  std::shared_ptr<Waiter> waiter = p.waiter;  // keep alive across erase
   if (--p.remaining == 0) pending_.erase(it);
   waiter->Notify();
 }
@@ -338,19 +338,19 @@ bool WorkerTable::RoundTrip(std::vector<MessagePtr> reqs,
                             void (*consume)(void*, const Message&),
                             void* arg) {
   if (reqs.empty()) return true;
-  Waiter waiter(static_cast<int>(reqs.size()));
+  auto waiter = std::make_shared<Waiter>(static_cast<int>(reqs.size()));
   bool failed = false;
   int64_t msg_id = reqs[0]->msg_id;
   {
-    std::lock_guard<std::mutex> lk(mu_);
-    pending_[msg_id] = Pending{&waiter, consume, arg,
+    MutexLock lk(mu_);
+    pending_[msg_id] = Pending{waiter, consume, arg,
                                static_cast<int>(reqs.size()), &failed};
   }
   for (auto& req : reqs)
     Zoo::Get()->SendTo(actor::kWorker, std::move(req));
   int64_t timeout_ms = configure::GetInt("rpc_timeout_ms");
-  if (waiter.WaitFor(timeout_ms)) {
-    std::lock_guard<std::mutex> lk(mu_);
+  if (waiter->WaitFor(timeout_ms)) {
+    MutexLock lk(mu_);
     return !failed;
   }
   // Deadline passed: withdraw the pending entry so late replies are
@@ -363,7 +363,7 @@ bool WorkerTable::RoundTrip(std::vector<MessagePtr> reqs,
   // filled (some shards landed, some did not).  Callers must treat -3
   // as "state unknown": re-Get before deciding to re-Add.  (Documented
   // at MV_* in c_api.h as well.)
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   auto it = pending_.find(msg_id);
   if (it == pending_.end()) return !failed;  // raced: replies completed
   pending_.erase(it);
@@ -384,8 +384,8 @@ AsyncGetPtr WorkerTable::StartRoundTrip(std::vector<MessagePtr> reqs,
                                    std::move(state)));
   if (reqs.empty()) return h;
   {
-    std::lock_guard<std::mutex> lk(mu_);
-    pending_[msg_id] = Pending{&h->waiter_, consume, arg,
+    MutexLock lk(mu_);
+    pending_[msg_id] = Pending{h->waiter_, consume, arg,
                                static_cast<int>(reqs.size()), &h->failed_};
   }
   for (auto& req : reqs)
@@ -403,12 +403,12 @@ bool AsyncGetHandle::Wait() {
   // Identical deadline + withdrawal discipline as the blocking
   // RoundTrip, including the INDETERMINATE -3 contract on timeout.
   int64_t timeout_ms = configure::GetInt("rpc_timeout_ms");
-  if (waiter_.WaitFor(timeout_ms)) {
-    std::lock_guard<std::mutex> lk(table_->mu_);
+  if (waiter_->WaitFor(timeout_ms)) {
+    MutexLock lk(table_->mu_);
     ok_ = !failed_;
     return ok_;
   }
-  std::lock_guard<std::mutex> lk(table_->mu_);
+  MutexLock lk(table_->mu_);
   auto it = table_->pending_.find(msg_id_);
   if (it == table_->pending_.end()) {  // raced: replies completed
     ok_ = !failed_;
@@ -429,7 +429,7 @@ AsyncGetHandle::~AsyncGetHandle() {
   // caller's (possibly gone) output buffer.  Notify holds the same
   // lock for its whole lookup-consume-notify sequence, so after this
   // erase no reply can be mid-flight into our state.
-  std::lock_guard<std::mutex> lk(table_->mu_);
+  MutexLock lk(table_->mu_);
   table_->pending_.erase(msg_id_);
 }
 
@@ -674,7 +674,7 @@ bool SparseMatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
   std::unordered_map<int32_t, size_t> fetch_slot;
   uint64_t epoch;
   {
-    std::lock_guard<std::mutex> lk(cache_mu_);
+    MutexLock lk(cache_mu_);
     if (valid_.empty()) {
       valid_.assign(static_cast<size_t>(rows_), 0);
       mirror_.assign(static_cast<size_t>(rows_ * cols_), 0.0f);
@@ -695,7 +695,7 @@ bool SparseMatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
                                   fetched.data()))
     return false;
 
-  std::lock_guard<std::mutex> lk(cache_mu_);
+  MutexLock lk(cache_mu_);
   // Install only if no invalidation ran while the wire was in flight —
   // caching a pre-add value after the add's invalidation would serve
   // stale reads forever.  The fetched values themselves are still fine
@@ -729,7 +729,7 @@ bool SparseMatrixWorkerTable::AddAll(const float* delta,
   // adder's own next read is stale.  Invalidate even on failure — a
   // deadline rc is indeterminate (the server may still apply it).
   bool ok = MatrixWorkerTable::AddAll(delta, opt, blocking);
-  std::lock_guard<std::mutex> lk(cache_mu_);
+  MutexLock lk(cache_mu_);
   ++cache_epoch_;
   if (!valid_.empty()) std::fill(valid_.begin(), valid_.end(), 0);
   return ok;
@@ -739,7 +739,7 @@ bool SparseMatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
                                       const float* delta,
                                       const AddOption& opt, bool blocking) {
   bool ok = MatrixWorkerTable::AddRows(row_ids, k, delta, opt, blocking);
-  std::lock_guard<std::mutex> lk(cache_mu_);
+  MutexLock lk(cache_mu_);
   ++cache_epoch_;
   if (!valid_.empty())
     for (int64_t i = 0; i < k; ++i)
@@ -750,7 +750,7 @@ bool SparseMatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
 void SparseMatrixWorkerTable::OnClockInvalidate() {
   // Clock closed: peers' adds are now applied server-side — every
   // cached row may be stale.
-  std::lock_guard<std::mutex> lk(cache_mu_);
+  MutexLock lk(cache_mu_);
   ++cache_epoch_;
   if (!valid_.empty()) std::fill(valid_.begin(), valid_.end(), 0);
 }
@@ -804,7 +804,7 @@ bool KVWorkerTable::Get(const std::vector<std::string>& keys, float* vals) {
   bool ok = reqs.empty() || RoundTrip(std::move(reqs), ScatterKVReply, &d);
   if (ok) {
     // Refresh the worker-side dict (the reference KVWorkerTable `raw`).
-    std::lock_guard<std::mutex> lk(cache_mu_);
+    MutexLock lk(cache_mu_);
     for (size_t i = 0; i < keys.size(); ++i) cache_[keys[i]] = vals[i];
   }
   return ok;

@@ -137,7 +137,7 @@ Zoo* Zoo::Get() {
 }
 
 bool Zoo::Start(int argc, const char* const* argv) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (started_) return true;
   configure::RegisterDefaults();
   if (configure::ParseCmdFlags(argc, argv) < 0) return false;
@@ -258,32 +258,45 @@ bool Zoo::Start(int argc, const char* const* argv) {
 
 void Zoo::Stop() {
   {
-    std::lock_guard<std::mutex> lk(mu_);
-    if (!started_) return;
+    // First Stop wins the latch; a concurrent second Stop returns here
+    // instead of re-joining/resetting actors mid-teardown (a UB hole
+    // the thread-safety annotations flagged: both callers used to pass
+    // the old started_ check before either cleared it).
+    MutexLock lk(mu_);
+    if (!started_.exchange(false)) return;
   }
   // Cross-process: no rank may tear down while peers still need its
   // server shard — rendezvous first (also flushes every pipeline).
   if (size_ > 1) Barrier();
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    started_ = false;
-  }
   // Un-waited async-get tickets hold pointers into the worker tables —
   // reclaim them before the registry dies (c_api.cc).
   CApiReclaimAsyncGets();
-  // Join OUTSIDE mu_: a draining handler may query the table registry.
+  // Join OUTSIDE mu_ (a draining handler may SendTo, which takes mu_):
+  // snapshot the pointers under the lock, stop through the snapshots —
+  // only the latch winner reaches here, so the pointees are stable.
   // Pipeline order so queued async adds apply before teardown.
-  worker_actor_->Stop();
-  server_actor_->Stop();
-  controller_actor_->Stop();
-  if (net_) net_->Stop();
-  std::lock_guard<std::mutex> lk(mu_);
+  Actor* worker;
+  Actor* server;
+  Actor* controller;
+  Net* net;
+  {
+    MutexLock lk(mu_);
+    worker = worker_actor_.get();
+    server = server_actor_.get();
+    controller = controller_actor_.get();
+    net = net_.get();
+  }
+  if (worker) worker->Stop();
+  if (server) server->Stop();
+  if (controller) controller->Stop();
+  if (net) net->Stop();
+  MutexLock lk(mu_);
   worker_actor_.reset();
   server_actor_.reset();
   controller_actor_.reset();
   net_.reset();
   {
-    std::lock_guard<std::mutex> tlk(tables_mu_);
+    MutexLock tlk(tables_mu_);
     server_tables_.clear();
     worker_tables_.clear();
   }
@@ -292,7 +305,7 @@ void Zoo::Stop() {
   worker_ranks_ = {0};
   server_ranks_ = {0};
   {
-    std::lock_guard<std::mutex> blk(barrier_mu_);
+    MutexLock blk(barrier_mu_);
     barrier_arrived_.clear();
     barrier_failed_ = false;
   }
@@ -306,10 +319,10 @@ bool Zoo::FlushPipelines() {
     if (s != rank_) targets.push_back(s);
   if (targets.empty()) return true;
   int64_t id = NextMsgId();
-  Waiter waiter(static_cast<int>(targets.size()));
+  auto waiter = std::make_shared<Waiter>(static_cast<int>(targets.size()));
   {
-    std::lock_guard<std::mutex> lk(flush_mu_);
-    flush_pending_[id] = &waiter;
+    MutexLock lk(flush_mu_);
+    flush_pending_[id] = waiter;
   }
   for (int s : targets) {
     auto msg = std::make_unique<Message>();
@@ -319,8 +332,8 @@ bool Zoo::FlushPipelines() {
     msg->dst = s;
     SendTo(actor::kWorker, std::move(msg));
   }
-  bool ok = waiter.WaitFor(configure::GetInt("rpc_timeout_ms"));
-  std::lock_guard<std::mutex> lk(flush_mu_);
+  bool ok = waiter->WaitFor(configure::GetInt("rpc_timeout_ms"));
+  MutexLock lk(flush_mu_);
   flush_pending_.erase(id);
   if (!ok)
     Log::Error("Zoo::FlushPipelines: timed out (rank %d)", rank_);
@@ -328,7 +341,7 @@ bool Zoo::FlushPipelines() {
 }
 
 void Zoo::OnFlushReply(int64_t msg_id) {
-  std::lock_guard<std::mutex> lk(flush_mu_);
+  MutexLock lk(flush_mu_);
   auto it = flush_pending_.find(msg_id);
   if (it != flush_pending_.end()) it->second->Notify();
 }
@@ -336,7 +349,7 @@ void Zoo::OnFlushReply(int64_t msg_id) {
 bool Zoo::Barrier() {
   Monitor mon("Zoo::Barrier");
   {
-    std::lock_guard<std::mutex> lk(barrier_mu_);
+    MutexLock lk(barrier_mu_);
     barrier_failed_ = false;  // fresh round; flush may re-latch it
   }
   // First drain this rank's async pipeline INTO EVERY REMOTE SHARD:
@@ -344,11 +357,11 @@ bool Zoo::Barrier() {
   // an async add to a third rank could still be in flight when the
   // release lands (observed at n=4).
   bool flushed = FlushPipelines();
-  Waiter waiter(1);
+  auto waiter = std::make_shared<Waiter>(1);
   int64_t round;
   {
-    std::lock_guard<std::mutex> lk(barrier_mu_);
-    barrier_waiter_ = &waiter;
+    MutexLock lk(barrier_mu_);
+    barrier_waiter_ = waiter;
     // OR, don't assign: a dead shard latched barrier_failed_ during the
     // flush (Deliver's RequestFlush case) and that must survive.
     barrier_failed_ = barrier_failed_ || !flushed;
@@ -363,14 +376,14 @@ bool Zoo::Barrier() {
   // Default (<=0) waits forever — BSP semantics; a deadline turns a dead
   // peer into an error return instead of a hang (the release message may
   // still arrive later: OnBarrierRelease tolerates a cleared waiter).
-  bool ok = waiter.WaitFor(configure::GetInt("barrier_timeout_ms"));
+  bool ok = waiter->WaitFor(configure::GetInt("barrier_timeout_ms"));
   if (!ok)
     Log::Error("Zoo::Barrier: timed out waiting for release (rank %d)",
                rank_);
   bool failed;
   {
-    std::lock_guard<std::mutex> lk(barrier_mu_);
-    barrier_waiter_ = nullptr;
+    MutexLock lk(barrier_mu_);
+    barrier_waiter_.reset();
     failed = barrier_failed_;
   }
   if (ok && !failed) {
@@ -383,7 +396,7 @@ bool Zoo::Barrier() {
     // are never unregistered, so the copied pointers stay valid.)
     std::vector<WorkerTable*> snapshot;
     {
-      std::lock_guard<std::mutex> lk(tables_mu_);
+      MutexLock lk(tables_mu_);
       for (auto& t : worker_tables_)
         if (t) snapshot.push_back(t.get());
     }
@@ -395,7 +408,7 @@ bool Zoo::Barrier() {
 void Zoo::OnBarrierArrive(int src_rank, int64_t round) {
   std::vector<std::pair<int, int64_t>> release;  // (rank, its round)
   {
-    std::lock_guard<std::mutex> lk(barrier_mu_);
+    MutexLock lk(barrier_mu_);
     if (barrier_arrived_.size() != static_cast<size_t>(size_))
       barrier_arrived_.assign(size_, false);
     if (barrier_rounds_.size() != static_cast<size_t>(size_))
@@ -430,7 +443,7 @@ void Zoo::OnBarrierArrive(int src_rank, int64_t round) {
 }
 
 void Zoo::OnBarrierRelease(int64_t round) {
-  std::lock_guard<std::mutex> lk(barrier_mu_);
+  MutexLock lk(barrier_mu_);
   // round >= 0: a wire release — drop it unless it matches the waiter's
   // current round (a late round-k release after a timeout must not free
   // the round-k+1 rendezvous).  round < 0: local failure path, always
@@ -455,7 +468,7 @@ void Zoo::Clock() {
   {
     std::vector<WorkerTable*> snapshot;
     {
-      std::lock_guard<std::mutex> lk(tables_mu_);
+      MutexLock lk(tables_mu_);
       for (auto& t : worker_tables_)
         if (t) snapshot.push_back(t.get());
     }
@@ -553,7 +566,7 @@ bool Zoo::MaybeHoldGet(MessagePtr& msg) {
   std::vector<MessagePtr> expired;
   bool held = false;
   {
-    std::lock_guard<std::mutex> lk(ssp_mu_);
+    MutexLock lk(ssp_mu_);
     PurgeExpiredHeldLocked(&expired);
     if (HeldBySspLocked(msg->src)) {
       int64_t t = configure::GetInt("rpc_timeout_ms");
@@ -569,7 +582,7 @@ void Zoo::OnClockTick(int src_rank, int64_t clock) {
   std::vector<MessagePtr> admit;
   std::vector<MessagePtr> expired;
   {
-    std::lock_guard<std::mutex> lk(ssp_mu_);
+    MutexLock lk(ssp_mu_);
     PurgeExpiredHeldLocked(&expired);
     if (worker_clocks_.size() != static_cast<size_t>(size_))
       worker_clocks_.assign(size_, 0);
@@ -610,7 +623,7 @@ void Zoo::SetRoles(const std::vector<int>& roles) {
 void Zoo::SendTo(const std::string& actor_name, MessagePtr msg) {
   // Snapshot the pointer AND push under mu_ so a concurrent Stop cannot
   // free the actor between the lookup and the mailbox push.
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   Actor* a = nullptr;
   if (actor_name == actor::kWorker) a = worker_actor_.get();
   else if (actor_name == actor::kServer) a = server_actor_.get();
@@ -646,7 +659,7 @@ void Zoo::Deliver(const std::string& actor_name, MessagePtr msg) {
       // Dead shard: nothing to drain there — ack so Barrier proceeds,
       // but latch the failure so it reports false.
       {
-        std::lock_guard<std::mutex> lk(barrier_mu_);
+        MutexLock lk(barrier_mu_);
         barrier_failed_ = true;
       }
       OnFlushReply(msg->msg_id);
@@ -658,7 +671,7 @@ void Zoo::Deliver(const std::string& actor_name, MessagePtr msg) {
       // hanging or (worse) reporting a successful rendezvous.
       Log::Error("Zoo::Deliver: barrier authority (rank 0) unreachable");
       {
-        std::lock_guard<std::mutex> lk(barrier_mu_);
+        MutexLock lk(barrier_mu_);
         barrier_failed_ = true;
       }
       OnBarrierRelease();
@@ -696,7 +709,7 @@ void Zoo::RouteInbound(Message&& m) {
 }
 
 int32_t Zoo::RegisterArrayTable(int64_t size) {
-  std::lock_guard<std::mutex> lk(tables_mu_);
+  MutexLock lk(tables_mu_);
   int32_t id = static_cast<int32_t>(server_tables_.size());
   // Shards live on server-role ranks only; a worker-only rank registers
   // a null server slot (ids must line up across every rank).
@@ -716,7 +729,7 @@ int32_t Zoo::RegisterArrayTable(int64_t size) {
 // worker-table type.
 template <typename WorkerT>
 int32_t Zoo::RegisterMatrixTableImpl(int64_t rows, int64_t cols) {
-  std::lock_guard<std::mutex> lk(tables_mu_);
+  MutexLock lk(tables_mu_);
   int32_t id = static_cast<int32_t>(server_tables_.size());
   int sid = server_id();
   server_tables_.push_back(
@@ -737,7 +750,7 @@ int32_t Zoo::RegisterSparseMatrixTable(int64_t rows, int64_t cols) {
 }
 
 int32_t Zoo::RegisterKVTable() {
-  std::lock_guard<std::mutex> lk(tables_mu_);
+  MutexLock lk(tables_mu_);
   int32_t id = static_cast<int32_t>(server_tables_.size());
   int sid = server_id();
   server_tables_.push_back(
@@ -749,14 +762,14 @@ int32_t Zoo::RegisterKVTable() {
 }
 
 ServerTable* Zoo::server_table(int32_t id) {
-  std::lock_guard<std::mutex> lk(tables_mu_);
+  MutexLock lk(tables_mu_);
   return (id >= 0 && id < static_cast<int32_t>(server_tables_.size()))
              ? server_tables_[id].get()
              : nullptr;
 }
 
 WorkerTable* Zoo::worker_table(int32_t id) {
-  std::lock_guard<std::mutex> lk(tables_mu_);
+  MutexLock lk(tables_mu_);
   return (id >= 0 && id < static_cast<int32_t>(worker_tables_.size()))
              ? worker_tables_[id].get()
              : nullptr;

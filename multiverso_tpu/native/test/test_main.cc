@@ -36,7 +36,7 @@
 static int TestBlob() {
   mvtpu::Blob b(16);
   CHECK(b.size() == 16);
-  for (int i = 0; i < 4; ++i) b.As<float>()[i] = i * 1.5f;
+  for (int i = 0; i < 4; ++i) b.As<float>()[i] = static_cast<float>(i) * 1.5f;
   mvtpu::Blob shared = b;  // shallow
   shared.As<float>()[0] = 42.0f;
   CHECK(b.As<float>()[0] == 42.0f);
@@ -390,7 +390,7 @@ static int NetChild(const char* machine_file, const char* rank) {
   char own_key[16];
   snprintf(own_key, sizeof(own_key), "rank_%d", me);
   CHECK(MV_AddKV(hk, "shared", (float)(me + 1)) == 0);
-  CHECK(MV_AddAsyncKV(hk, own_key, 100.0f + me) == 0);
+  CHECK(MV_AddAsyncKV(hk, own_key, 100.0f + static_cast<float>(me)) == 0);
   CHECK(MV_Barrier() == 0);  // async adds flushed, all ranks landed
   float kv = -1.0f;
   CHECK(MV_GetKV(hk, "shared", &kv) == 0);
@@ -399,7 +399,7 @@ static int NetChild(const char* machine_file, const char* rank) {
     char qk[16];
     snprintf(qk, sizeof(qk), "rank_%d", r);
     CHECK(MV_GetKV(hk, qk, &kv) == 0);
-    CHECK(kv == 100.0f + r);
+    CHECK(kv == 100.0f + static_cast<float>(r));
   }
 
   CHECK(MV_Barrier() == 0);
@@ -435,7 +435,7 @@ static int NetUpdaterChild(const char* machine_file, const char* rank,
 
   float want = 0.0f;
   if (std::string(updater) == "sgd") {
-    want = -0.1f * n;                       // linear: order-free
+    want = -0.1f * static_cast<float>(n);                       // linear: order-free
   } else if (std::string(updater) == "adagrad") {
     // n sequential g=1 applies: w -= lr * g / sqrt(h_i), h_i = i
     for (int i = 1; i <= n; ++i) want -= 0.1f / sqrtf((float)i);
@@ -831,7 +831,7 @@ static int MpiSelfScenario() {
   msg.table_id = 7;
   msg.msg_id = 1234;
   mvtpu::Blob payload(4 * sizeof(float));
-  for (int i = 0; i < 4; ++i) payload.As<float>()[i] = 0.5f * i;
+  for (int i = 0; i < 4; ++i) payload.As<float>()[i] = 0.5f * static_cast<float>(i);
   msg.data.push_back(payload);
   CHECK(net.Send(net.rank(), msg));
 
@@ -842,7 +842,7 @@ static int MpiSelfScenario() {
   CHECK(got.table_id == 7 && got.msg_id == 1234);
   CHECK(got.data.size() == 1 && got.data[0].count<float>() == 4);
   for (int i = 0; i < 4; ++i)
-    CHECK(std::fabs(got.data[0].As<float>()[i] - 0.5f * i) < 1e-6f);
+    CHECK(std::fabs(got.data[0].As<float>()[i] - 0.5f * static_cast<float>(i)) < 1e-6f);
 
   // Unknown rank → clean false, not an MPI abort.
   CHECK(!net.Send(net.size() + 3, msg));
@@ -864,6 +864,10 @@ static int MpiSelfScenario() {
     CHECK(inbox.Pop(&m));
     CHECK(m.table_id == 7 && m.data.size() == 1);
   }
+  // Every send above completed or failed before Isend (unknown rank):
+  // no payload may be parked in the orphan list — an increment here
+  // would mean the error/timeout path fired on a healthy transport.
+  CHECK(mvtpu::MpiNet::OrphanedSendBufCount() == 0);
   net.Stop();
   printf("MPI_SELF_OK rank=%d size=%d\n", net.rank(), net.size());
   return 0;
@@ -1006,13 +1010,13 @@ static int WireBenchChild(const char* machine_file, const char* rank,
       for (int i = 0; i < K; ++i)
         CHECK(net->Send(1, mk(MsgType::RequestAdd, S)));
       wait_until(burst_acks, ++acks_seen);
-      double put_gbps = (double)K * S / secs(now() - t0) / 1e9;
+      double put_gbps = (double)K * (double)S / secs(now() - t0) / 1e9;
       // get: one request, K payloads back.
       t0 = now();
       CHECK(net->Send(1, mk(MsgType::RequestGet, 0)));
       payloads_seen += K;
       wait_until(payloads, payloads_seen);
-      double get_gbps = (double)K * S / secs(now() - t0) / 1e9;
+      double get_gbps = (double)K * (double)S / secs(now() - t0) / 1e9;
       printf("WIRE %zu %.4f %.4f %.4f\n", S, put_gbps, get_gbps, rtt_ms);
     }
     CHECK(net->Send(1, mk(MsgType::ControlRegister, 0)));  // done

@@ -25,7 +25,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from multiverso_tpu.parallel._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["gpipe", "stage_pspec"]

@@ -24,7 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from multiverso_tpu.parallel._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["ring_attention", "blockwise_attention_local"]

@@ -22,6 +22,16 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'` (ROADMAP.md): heavyweight sanitizer
+    # sweeps opt out of the runtime budget with this marker, everything
+    # else (mvlint, make analyze gate, TSan unit run) stays tier-1.
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight sweep (e.g. the ASan/UBSan multi-process "
+        "scenario rebuild+run) excluded from tier-1 via -m 'not slow'")
+
+
 @pytest.fixture()
 def mv():
     """Fresh multiverso_tpu runtime per test."""

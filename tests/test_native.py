@@ -339,6 +339,53 @@ def test_native_tsan_scenarios(native, tmp_path):
                 f"{scenario} rank {r}:\n{o[-4000:]}"
 
 
+@pytest.mark.slow
+def test_native_asan_scenarios(native, tmp_path):
+    """ASan+UBSan sweep — the heap-lifetime half of the sanitizer
+    matrix (docs/static_analysis.md): TSan schedules races, ASan
+    catches what TSan structurally cannot — use-after-free on reply
+    and send buffers (the MpiNet orphan-park class), overflows in the
+    wire framing, UB in the arithmetic.  Unit suite plus the same
+    multi-process scenarios as the TSan sweep, with the hold/admission
+    SSP variant.  Marked slow: full-runtime rebuild + multi-process
+    runs pay seconds, so tier-1 (`-m 'not slow'`) skips it; `make asan`
+    covers the unit half interactively."""
+    subprocess.run(["make", "-C", NATIVE_DIR, "-j4", "asan-build"],
+                   check=True, capture_output=True, timeout=600)
+    asan_bin = os.path.join(NATIVE_DIR, "build", "asan", "mvtpu_test")
+    env = dict(os.environ, ASAN_OPTIONS="halt_on_error=1",
+               UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1")
+
+    out = subprocess.run([asan_bin], capture_output=True, text=True,
+                         env=env, timeout=600)
+    report = out.stdout + out.stderr
+    assert out.returncode == 0 and "AddressSanitizer" not in report \
+        and "runtime error" not in report, report[-4000:]
+
+    for scenario, nprocs, extra in [("net_child", 2, ()),
+                                    ("backup_child", 3, ("0.34",)),
+                                    ("ssp_child", 2, ("1",)),
+                                    ("async_overlap", 2, ())]:
+        mf = _machine_file(tmp_path, nprocs)  # rewritten per scenario
+        procs = [subprocess.Popen([asan_bin, scenario, mf, str(r), *extra],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True,
+                                  env=env)
+                 for r in range(nprocs)]
+        outs = []
+        try:
+            for p in procs:
+                outs.append(p.communicate(timeout=600)[0])
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for r, (p, o) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0 and "AddressSanitizer" not in o \
+                and "runtime error" not in o, \
+                f"{scenario} rank {r}:\n{o[-4000:]}"
+
+
 @pytest.mark.parametrize("ratio", ["0", "0.34"])
 def test_native_backup_worker_ratio(native, tmp_path, ratio):
     """-backup_worker_ratio straggler slack (reference sync server,

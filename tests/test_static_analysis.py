@@ -1,0 +1,146 @@
+"""Tier-1 gate for the static-analysis layer (docs/static_analysis.md).
+
+Three jobs:
+
+1. ``make analyze`` — clang thread-safety analysis (plus -Wshadow /
+   -Wconversion as errors) over every native TU.  Skips with a clear
+   reason when clang++ is absent (the analysis is clang-only); the
+   gcc path is still exercised because the annotations compile to
+   no-ops in every other native test's build.
+2. ``tools/mvlint.py`` over the whole repo must be clean — the lint IS
+   tier-1 (fast, pure-AST, no toolchain dependency).
+3. Each mvlint rule must demonstrably FIRE on a seeded violation (and
+   stay quiet on the compliant twin), so a refactor of the lint cannot
+   silently lobotomize a rule while the repo stays green.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "multiverso_tpu", "native")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import mvlint  # noqa: E402
+
+
+# ------------------------------------------------------------ make analyze
+
+def test_make_analyze_thread_safety():
+    """clang -Wthread-safety -Werror over every native TU: a Get/Add/
+    registry path touching a GUARDED_BY member without its mutex is a
+    build error.  Skip (not fail) without clang — the whole point of
+    the target is that it runs wherever clang exists."""
+    if shutil.which("clang++") is None:
+        pytest.skip("clang++ not installed — `make analyze` needs clang's "
+                    "thread-safety analysis (gcc compiles the annotations "
+                    "as no-ops)")
+    out = subprocess.run(["make", "-C", NATIVE_DIR, "analyze"],
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, \
+        f"make analyze failed:\n{(out.stdout + out.stderr)[-4000:]}"
+
+
+# ------------------------------------------------------------ repo lint
+
+def test_mvlint_repo_clean():
+    """The repo's own Python layer holds every mvlint invariant (same
+    run `make mvlint` / `make lint` wraps)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mvlint.py"), REPO],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, \
+        f"mvlint findings:\n{out.stdout}\n{out.stderr}"
+
+
+# ------------------------------------------------- per-rule seeded violations
+
+def _lint_src(tmp_path, src, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return [(f.rule, f.line) for f in mvlint.lint_file(str(p))]
+
+
+def test_mv001_fires_on_ctypes_temporary(tmp_path):
+    rules = _lint_src(tmp_path, """\
+        import numpy as np
+        out = np.zeros(4, np.float32)
+        lib.MV_Get(h, _fp(np.zeros(4, np.float32)), 4)   # temporary: BAD
+        lib.MV_Get(h, _fp(out), 4)                       # named: fine
+        lib.MV_Put(h, (a + b).ctypes.data_as(P))         # temporary: BAD
+        lib.MV_Put(h, out.ctypes.data_as(P))             # named: fine
+        """)
+    assert [r for r, _ in rules] == ["MV001", "MV001"], rules
+
+
+def test_mv002_fires_on_dangling_async(tmp_path):
+    rules = _lint_src(tmp_path, """\
+        rt.matrix_get_rows_async(h, ids, 8)          # discarded: BAD
+        handle = rt.matrix_get_rows_async(h, ids, 8) # bound: fine
+        handle.wait()
+        """)
+    assert [r for r, _ in rules] == ["MV002"], rules
+
+
+def test_mv002_exempts_pytest_raises(tmp_path):
+    """Inside `with pytest.raises(...)` the call is SUPPOSED to throw
+    before a handle exists — no finding."""
+    rules = _lint_src(tmp_path, """\
+        import pytest
+        with pytest.raises(ValueError):
+            rt.train_step_async(toks, accum=4)
+        """)
+    assert rules == [], rules
+
+
+def test_mv003_fires_on_host_sync_in_jit(tmp_path):
+    # MV003 only applies to the tables layer — build the path shape.
+    d = tmp_path / "tables"
+    d.mkdir()
+    rules = _lint_src(d, """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return np.asarray(x) + 1          # host sync in trace: BAD
+
+        def host_step(x):
+            return np.asarray(x) + 1          # untraced: fine
+
+        def inner(x):
+            return x.block_until_ready()      # BAD once jitted below
+
+        f = jax.jit(inner)
+        """)
+    assert [r for r, _ in rules] == ["MV003", "MV003"], rules
+
+
+def test_mv004_fires_on_unbounded_subprocess(tmp_path):
+    # MV004 only applies to bench* files — name the snippet accordingly.
+    rules = _lint_src(tmp_path, """\
+        import subprocess
+        subprocess.run(["sleep", "9"])                  # unbounded: BAD
+        subprocess.run(["sleep", "9"], timeout=60)      # bounded: fine
+        p = subprocess.Popen(["sleep", "9"])
+        p.communicate()                                 # unbounded: BAD
+        p.communicate(timeout=60)                       # bounded: fine
+        """, name="bench_snippet.py")
+    assert [r for r, _ in rules] == ["MV004", "MV004"], rules
+
+
+def test_suppression_comment(tmp_path):
+    rules = _lint_src(tmp_path, """\
+        rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush
+        """)
+    assert rules == [], rules
+
+
+def test_unparseable_file_is_reported(tmp_path):
+    rules = _lint_src(tmp_path, "def broken(:\n")
+    assert [r for r, _ in rules] == ["MV000"], rules

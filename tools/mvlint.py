@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""mvlint — repo-specific AST lint for the multiverso_tpu Python layer.
+
+Generic linters cannot see this repo's invariants; these rules encode
+the ones that have bitten (or nearly bitten) real code here.  Run as
+``python tools/mvlint.py [paths...]`` (default: the repo root); exits
+non-zero on any finding.  ``make mvlint`` / ``make lint`` wrap this, and
+``tests/test_static_analysis.py`` keeps it green in tier-1.
+
+Rules (docs/static_analysis.md has the full rationale):
+
+- **MV001 ctypes-temporary** — an argument built as ``_fp(expr)`` /
+  ``_ip(expr)`` / ``expr.ctypes.data_as(...)`` must take a *name*, not a
+  temporary: the pointer outlives the expression only if a Python
+  reference keeps the numpy buffer alive (async natives scatter into it
+  after the call returns; a temporary's buffer is freed memory by then).
+
+- **MV002 dangling-async** — a ``*_async(...)`` call whose handle is
+  discarded can never be waited or cancelled: the request stays
+  in-flight against a buffer nobody owns.  Bind the handle; ``wait()``
+  it or drop it explicitly (``del``) so ``__del__`` withdraws the
+  ticket.
+
+- **MV003 host-sync-in-jit** — ``np.asarray`` / ``.block_until_ready``
+  / ``jax.device_get`` / ``.item`` inside a jit-traced function in the
+  tables layer either breaks tracing or silently forces a host sync per
+  step; hoist it out of the traced body.
+
+- **MV004 unbounded-subprocess** — bench sections must bound every
+  subprocess (``timeout=`` on ``subprocess.run``-family calls and on
+  ``.communicate()``/``.wait()``): a hung child otherwise wedges the
+  whole bench run instead of costing one section.
+
+Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+SKIP_DIRS = {".git", "build", "__pycache__", ".claude", "node_modules"}
+
+# Helpers that wrap numpy buffers into ctypes pointers (native binding).
+PTR_HELPERS = {"_fp", "_ip"}
+
+# Host-sync markers for MV003.
+HOST_SYNC_ATTRS = {"block_until_ready", "device_get", "item"}
+HOST_SYNC_NP = {"asarray"}
+
+SUBPROCESS_FNS = {"run", "call", "check_call", "check_output"}
+
+
+class Finding:
+    def __init__(self, path, line, rule, msg):
+        self.path, self.line, self.rule, self.msg = path, line, rule, msg
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.rule}: {self.msg}"
+
+
+def _call_name(func):
+    """Trailing name of a call target: Name id or Attribute attr."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def check_ctypes_temporary(tree, path):
+    """MV001: _fp/_ip/ctypes.data_as over anything but a bare name."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # _fp(expr) / _ip(expr): expr must be a Name.
+        if (_call_name(node.func) in PTR_HELPERS and node.args
+                and not isinstance(node.args[0], ast.Name)):
+            out.append(Finding(
+                path, node.lineno, "MV001",
+                f"{_call_name(node.func)}() over a temporary "
+                f"expression — bind the array to a local first so a "
+                f"reference keeps the buffer alive across the native "
+                f"call"))
+        # expr.ctypes.data_as(...): expr must be a Name.
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "data_as"
+                and isinstance(f.value, ast.Attribute)
+                and f.value.attr == "ctypes"
+                and not isinstance(f.value.value, ast.Name)):
+            out.append(Finding(
+                path, node.lineno, "MV001",
+                "ctypes.data_as over a temporary expression — bind the "
+                "array to a local first"))
+    return out
+
+
+def check_dangling_async(tree, path):
+    """MV002: *_async(...) result discarded as a bare statement."""
+    # Exempt `with pytest.raises(...):` bodies — the call is *supposed*
+    # to throw before a handle ever exists, so there is nothing to bind.
+    exempt = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With) and any(
+                isinstance(item.context_expr, ast.Call)
+                and _call_name(item.context_expr.func) == "raises"
+                for item in node.items):
+            for sub in ast.walk(node):
+                exempt.add(id(sub))
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+                and id(node) not in exempt
+                and _call_name(node.value.func).endswith("_async")):
+            out.append(Finding(
+                path, node.lineno, "MV002",
+                f"result of {_call_name(node.value.func)}() discarded — "
+                f"bind the handle and wait() it (or del it to withdraw "
+                f"the in-flight request)"))
+    return out
+
+
+def _is_jit_call(call):
+    """True for jax.jit(...) / jit(...) / functools.partial(jax.jit, ...)."""
+    name = _call_name(call.func)
+    if name == "jit":
+        return True
+    if name == "partial" and call.args:
+        first = call.args[0]
+        return isinstance(first, (ast.Name, ast.Attribute)) and \
+            _call_name(first) == "jit"
+    return False
+
+
+def check_host_sync_in_jit(tree, path):
+    """MV003: host syncs inside jit-traced functions (tables layer)."""
+    # Collect jit-traced bodies: decorated defs, defs whose name is
+    # passed to a jit call, and lambdas passed to jit directly.
+    jitted_names = set()
+    jitted_bodies = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                is_jit = (_call_name(dec) == "jit"
+                          or (isinstance(dec, ast.Call) and _is_jit_call(dec)))
+                if is_jit:
+                    jitted_bodies.append(node)
+                    break
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            args = node.args[1:] if _call_name(node.func) == "partial" \
+                else node.args
+            for a in args:
+                if isinstance(a, ast.Name):
+                    jitted_names.add(a.id)
+                elif isinstance(a, ast.Lambda):
+                    jitted_bodies.append(a)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node.name in jitted_names:
+            jitted_bodies.append(node)
+
+    out = []
+    seen = set()
+    for fn in jitted_bodies:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            f = node.func
+            sync = None
+            if isinstance(f, ast.Attribute):
+                if (f.attr in HOST_SYNC_NP and isinstance(f.value, ast.Name)
+                        and f.value.id in ("np", "numpy")):
+                    sync = f"np.{f.attr}"
+                elif f.attr in HOST_SYNC_ATTRS:
+                    sync = f".{f.attr}()"
+            if sync:
+                seen.add(id(node))
+                out.append(Finding(
+                    path, node.lineno, "MV003",
+                    f"{sync} inside a jit-traced function — host sync "
+                    f"breaks tracing / forces a per-step device flush; "
+                    f"hoist it out of the traced body"))
+    return out
+
+
+def check_unbounded_subprocess(tree, path):
+    """MV004: bench subprocess calls without a timeout bound."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        kwargs = {k.arg for k in node.keywords}
+        # subprocess.run / call / check_*(…, timeout=…)
+        if (isinstance(f, ast.Attribute) and f.attr in SUBPROCESS_FNS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "subprocess" and "timeout" not in kwargs):
+            out.append(Finding(
+                path, node.lineno, "MV004",
+                f"subprocess.{f.attr}() without timeout= — a hung child "
+                f"wedges the whole bench run; bound it"))
+        # proc.communicate() / proc.wait() without timeout
+        if (isinstance(f, ast.Attribute) and f.attr in ("communicate", "wait")
+                and "timeout" not in kwargs and not node.args):
+            out.append(Finding(
+                path, node.lineno, "MV004",
+                f".{f.attr}() without timeout= — a hung child wedges the "
+                f"whole bench run; bound it"))
+    return out
+
+
+def lint_file(path):
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        tree = ast.parse(src, filename=path)
+    except (SyntaxError, UnicodeDecodeError) as exc:
+        return [Finding(path, getattr(exc, "lineno", 0) or 0, "MV000",
+                        f"unparseable: {exc.__class__.__name__}")]
+    findings = []
+    findings += check_ctypes_temporary(tree, path)
+    findings += check_dangling_async(tree, path)
+    if f"{os.sep}tables{os.sep}" in path or "/tables/" in path:
+        findings += check_host_sync_in_jit(tree, path)
+    if os.path.basename(path).startswith("bench"):
+        findings += check_unbounded_subprocess(tree, path)
+    # Per-line suppressions.
+    lines = src.splitlines()
+    kept = []
+    for f in findings:
+        line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        if f"mvlint: disable={f.rule}" not in line:
+            kept.append(f)
+    return kept
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in sorted(dirs) if d not in SKIP_DIRS]
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def main(argv):
+    paths = argv or [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    findings = []
+    nfiles = 0
+    for path in iter_py_files(paths):
+        nfiles += 1
+        findings.extend(lint_file(path))
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"mvlint: {len(findings)} finding(s) in {nfiles} file(s)",
+              file=sys.stderr)
+        return 1
+    print(f"mvlint: clean ({nfiles} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
