@@ -39,7 +39,16 @@ lint: mvlint
 	       "(mvlint ran; install clang for the thread-safety layer)"; \
 	fi
 
+# Chaos / fault-injection suite (docs/fault_tolerance.md): native wire
+# scenarios (send retry, drop/dup, barrier timeout, heartbeat report,
+# injection-off control) + the Python retry/injector/corruption tests,
+# under a fixed seed so failures reproduce.
+chaos:
+	$(MAKE) -C $(NATIVE) all
+	MVTPU_FAULT_SEED=1234 JAX_PLATFORMS=cpu \
+	  $(PYTHON) -m pytest tests/test_fault.py -q -p no:cacheprovider
+
 clean:
 	$(MAKE) -C $(NATIVE) clean
 
-.PHONY: all test tsan asan analyze mvlint lint clean
+.PHONY: all test tsan asan analyze mvlint lint chaos clean

@@ -42,11 +42,38 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import time
 import traceback
 
 import numpy as np
+
+# ---------------------------------------------------------------------------
+# Wall budget (VERDICT "budget-proof the harness"): the driver gives the
+# bench a finite window and may SIGTERM it at the end.  Every inner
+# subprocess deadline scales from what REMAINS of the budget instead of
+# a hardcoded 600/300 s, and main() traps SIGTERM/timeout to emit the
+# partial JSON accumulated so far — a budget kill costs the missing
+# sections, never the whole line.
+# ---------------------------------------------------------------------------
+_T0 = time.monotonic()
+_BUDGET_S = float(os.environ.get("MVTPU_BENCH_BUDGET_S", "3300"))
+
+
+class _BudgetExceeded(Exception):
+    """Raised by the SIGTERM handler / budget checks inside main()."""
+
+
+def _budget_left() -> float:
+    return _BUDGET_S - (time.monotonic() - _T0)
+
+
+def _bounded(cap: float, floor: float = 30.0) -> float:
+    """A subprocess timeout: at most ``cap``, at most the remaining wall
+    budget, never under ``floor`` (a too-tight bound would turn a
+    healthy child into a spurious TimeoutExpired)."""
+    return max(floor, min(cap, _budget_left()))
 
 
 def _time_loop(fn, *, warmup: int = 3, iters: int = 10) -> float:
@@ -166,7 +193,7 @@ def _run_native_workers(script_name: str, procs: int, marker: str,
     outs = []
     try:
         for p in children:
-            outs.append(p.communicate(timeout=600)[0])
+            outs.append(p.communicate(timeout=_bounded(600))[0])
     finally:
         for p in children:
             if p.poll() is None:
@@ -197,7 +224,7 @@ def _run_test_ranks(scenario: str, procs: int, extra=()):
                               "multiverso_tpu", "native")
     binary = os.path.join(native_dir, "build", "mvtpu_test")
     subprocess.run(["make", "-C", native_dir, "-j4", "build/mvtpu_test"],
-                   check=True, capture_output=True, timeout=600)
+                   check=True, capture_output=True, timeout=_bounded(600))
     socks = [socket.socket() for _ in range(procs)]
     for s in socks:
         s.bind(("127.0.0.1", 0))
@@ -214,7 +241,7 @@ def _run_test_ranks(scenario: str, procs: int, extra=()):
     outs = []
     try:
         for p in children:
-            outs.append(p.communicate(timeout=300)[0])
+            outs.append(p.communicate(timeout=_bounded(300))[0])
     finally:
         # A dead sibling must not leave the others polling forever and
         # skewing every later section's numbers.
@@ -269,7 +296,7 @@ def bench_wire_micro():
             out = subprocess.run(
                 ["mpirun", "-n", "2", binary, "wire_bench", "none", "0",
                  "mpi"],
-                capture_output=True, text=True, timeout=300)
+                capture_output=True, text=True, timeout=_bounded(300))
         except subprocess.TimeoutExpired:
             print("bench_wire_micro: mpirun wire sweep timed out; "
                   "keeping TCP keys", file=sys.stderr)
@@ -1058,12 +1085,32 @@ def main() -> None:
     # vs_baseline becomes w2v_fused_vs_native8.
     results = {"bench_schema": 6}
     errors = []
-    for section in _SECTIONS:
-        try:
-            results.update(section())
-        except Exception as exc:  # keep every other section's numbers
-            traceback.print_exc()
-            errors.append(f"{section.__name__}: {type(exc).__name__}: {exc}")
+
+    # A budget SIGTERM lands mid-section: convert it to an exception so
+    # the JSON accumulated so far still prints (the whole point of the
+    # one-line contract — a kill costs sections, not the line).
+    def on_sigterm(signum, frame):
+        raise _BudgetExceeded(f"signal {signum}")
+
+    prev_sigterm = signal.signal(signal.SIGTERM, on_sigterm)
+    try:
+        for section in _SECTIONS:
+            if _budget_left() < 90:
+                errors.append(f"{section.__name__}: skipped "
+                              f"({_budget_left():.0f}s of budget left)")
+                continue
+            try:
+                results.update(section())
+            except (_BudgetExceeded, KeyboardInterrupt) as exc:
+                errors.append(f"{section.__name__}: budget exceeded "
+                              f"({exc}); emitting partial results")
+                break
+            except Exception as exc:  # keep every other section's numbers
+                traceback.print_exc()
+                errors.append(
+                    f"{section.__name__}: {type(exc).__name__}: {exc}")
+    finally:
+        signal.signal(signal.SIGTERM, prev_sigterm)
     if {"lr_native8_samples_per_sec",
             "lr_fused_samples_per_sec"} <= results.keys():
         results["lr_fused_vs_native8"] = (

@@ -18,8 +18,9 @@ Top-level API mirrors the reference Python binding
 
 from __future__ import annotations
 
-from . import checkpoint, config, dashboard, io
+from . import checkpoint, config, dashboard, fault, io
 from .core import (
+    BarrierTimeout,
     barrier,
     clock,
     get_context,
@@ -82,5 +83,6 @@ __all__ = [
     "Table", "ArrayTable", "MatrixTable", "SparseMatrixTable", "KVTable",
     "create_table", "TableHandler", "ArrayTableHandler", "MatrixTableHandler",
     "AddOption", "GetOption", "get_updater",
-    "config", "dashboard", "Log", "checkpoint", "io",
+    "config", "dashboard", "Log", "checkpoint", "io", "fault",
+    "BarrierTimeout",
 ]

@@ -63,6 +63,12 @@ int MV_AddKVBatch(int32_t handle, const char* keys, const int32_t* key_lens,
 int MV_SetAddOption(float learning_rate, float momentum, float rho, float eps);
 int MV_StoreTable(int32_t handle, const char* path);
 int MV_LoadTable(int32_t handle, const char* path);
+int MV_QueryMonitor(const char* name, long long* count);
+int MV_SetFault(const char* kind, double rate);
+int MV_SetFaultN(const char* kind, long long n);
+int MV_SetFaultSeed(long long seed);
+int MV_ClearFaults(void);
+int MV_DeadPeerCount(void);
 ]]
 
 -- libmvtpu.so sits two directories up from this file (native/build/).
@@ -122,6 +128,35 @@ function mv.set_add_option(lr, momentum, rho, eps)
   check(C.MV_SetAddOption(lr or 0.1, momentum or 0.9, rho or 0.9,
                           eps or 1e-8), "MV_SetAddOption")
 end
+
+--- Hit count of a Dashboard monitor (0 when it never fired) — e.g.
+--- "net.retries" / "net.dropped" / "hb.missed" (docs/fault_tolerance.md).
+function mv.query_monitor(name)
+  local c = ffi.new("long long[1]")
+  check(C.MV_QueryMonitor(name, c), "MV_QueryMonitor")
+  return tonumber(c[0])
+end
+
+--- Fault injection (chaos testing; docs/fault_tolerance.md): kind is
+--- drop|delay|dup|fail_send with a per-op probability, or delay_ms to
+--- set the injected delay length; set_fault_n fires on exactly the
+--- next n ops.  Deterministic under set_fault_seed.
+function mv.set_fault(kind, rate)
+  check(C.MV_SetFault(kind, rate), "MV_SetFault")
+end
+
+function mv.set_fault_n(kind, n)
+  check(C.MV_SetFaultN(kind, n), "MV_SetFaultN")
+end
+
+function mv.set_fault_seed(seed)
+  check(C.MV_SetFaultSeed(seed), "MV_SetFaultSeed")
+end
+
+function mv.clear_faults() check(C.MV_ClearFaults(), "MV_ClearFaults") end
+
+--- Peers with expired heartbeat leases (rank 0 under -heartbeat_ms).
+function mv.dead_peer_count() return C.MV_DeadPeerCount() end
 
 -- Shared async-get handle (MV_GetAsync* wait tickets): wait() joins the
 -- pull and returns the filled buffer; a FAILED wait replays its error

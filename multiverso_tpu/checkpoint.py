@@ -14,35 +14,95 @@ table name.  Multi-host: only process 0 writes; everyone barriers after.
 
 from __future__ import annotations
 
+import json
+import os
 import pickle
+import struct
 import threading
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 from .core import context as core_context
+from .fault import RetryPolicy
 from .io import StreamFactory
 from .log import Log
 
 __all__ = ["save", "restore", "save_pytree", "restore_pytree",
-           "save_pytree_async", "AsyncSave"]
+           "save_pytree_async", "AsyncSave", "CheckpointCorrupt",
+           "CheckpointManager"]
 
-_MAGIC = b"MVTPUCKPT1"
-_MAGIC_TREE = b"MVTPUTREE1"
+# v2 framing: magic + <uint64 body_len, uint32 crc32> + pickle body.
+# The CRC turns "killed mid-write" / "bit-rotted storage" into a
+# CheckpointCorrupt at restore time instead of a pickle crash (or,
+# worse, silently-wrong weights).  v1 files (magic + bare pickle) are
+# still readable — only without the integrity check.
+_MAGIC = b"MVTPUCKPT2"
+_MAGIC_TREE = b"MVTPUTREE2"
+_MAGIC_V1 = b"MVTPUCKPT1"
+_MAGIC_TREE_V1 = b"MVTPUTREE1"
+_HEADER = struct.Struct("<QI")
+
+# Transient-IO retry for every snapshot read/write (docs/
+# fault_tolerance.md).  Module attribute so deployments (and the chaos
+# suite) can swap the schedule.
+IO_RETRY = RetryPolicy(attempts=3, backoff_s=0.05, retry_on=(OSError,))
+
+
+class CheckpointCorrupt(ValueError):
+    """The snapshot file is damaged (truncated, bit-flipped, or not a
+    checkpoint at all) — restore refuses to unpickle garbage.  Catchable
+    separately so callers (``CheckpointManager.restore_latest``) can
+    fall back to the previous good snapshot."""
 
 
 def _write_snapshot(uri: str, magic: bytes, obj: Any) -> None:
-    """THE one framing for every checkpoint file: magic + pickle body,
-    written through an atomic Stream (temp + rename)."""
-    with StreamFactory.open(uri, "wb", atomic=True) as s:
-        s.write(magic)
-        s.write(pickle.dumps(obj, protocol=4))
+    """THE one framing for every checkpoint file: magic + CRC32-framed
+    pickle body, written through an atomic Stream (temp + rename),
+    retried on transient IO errors."""
+    body = pickle.dumps(obj, protocol=4)
+    header = _HEADER.pack(len(body), zlib.crc32(body))
+
+    def write() -> None:
+        with StreamFactory.open(uri, "wb", atomic=True) as s:
+            s.write(magic)
+            s.write(header)
+            s.write(body)
+
+    IO_RETRY.run(write)
 
 
 def _read_snapshot(uri: str, magic: bytes, what: str) -> Any:
-    with StreamFactory.open(uri, "rb") as s:
-        got = s.read(len(magic))
-        if got != magic:
-            raise ValueError(f"{uri}: not a multiverso_tpu {what}")
-        return pickle.loads(s.read())
+    def read() -> bytes:
+        with StreamFactory.open(uri, "rb") as s:
+            return s.read()
+
+    raw = IO_RETRY.run(read)
+    legacy = _MAGIC_V1 if magic == _MAGIC else _MAGIC_TREE_V1
+    if raw.startswith(magic):
+        off = len(magic)
+        if len(raw) < off + _HEADER.size:
+            raise CheckpointCorrupt(
+                f"{uri}: truncated {what} (header incomplete)")
+        body_len, crc = _HEADER.unpack_from(raw, off)
+        body = raw[off + _HEADER.size:off + _HEADER.size + body_len]
+        if len(body) != body_len:
+            raise CheckpointCorrupt(
+                f"{uri}: truncated {what} ({len(body)} of {body_len} "
+                f"body bytes — killed mid-write?)")
+        if zlib.crc32(body) != crc:
+            raise CheckpointCorrupt(
+                f"{uri}: CRC mismatch in {what} body — storage "
+                f"corruption; restore from an earlier snapshot")
+    elif raw.startswith(legacy):
+        body = raw[len(legacy):]  # pre-CRC file: no integrity check
+    else:
+        raise CheckpointCorrupt(f"{uri}: not a multiverso_tpu {what}")
+    try:
+        return pickle.loads(body)
+    except Exception as exc:
+        raise CheckpointCorrupt(
+            f"{uri}: {what} body does not unpickle ({exc}) — corrupt "
+            f"file") from exc
 
 
 def save_pytree(uri: str, tree: Any) -> None:
@@ -286,3 +346,128 @@ def restore(uri: str, strict: bool = True) -> Dict[str, Any]:
     Log.info("checkpoint restored: %s (%d tables, clock=%d)",
              uri, len(snap["tables"]), ctx.clock)
     return snap["extra"]
+
+
+class CheckpointManager:
+    """Rolling snapshots behind an atomic MANIFEST — crash-safe resume.
+
+    ``save_step(step)`` writes one :func:`save` snapshot per call into
+    ``directory``, records it in ``MANIFEST.json`` (written atomically,
+    AFTER the snapshot is durable), and prunes beyond ``keep`` — so the
+    directory always holds N known-good restore points and a torn write
+    can never be the only copy.  ``restore_latest()`` walks the manifest
+    newest-first and FALLS BACK past corrupt/missing snapshots
+    (:class:`CheckpointCorrupt` per file is logged, not fatal) to the
+    last good one — a job killed mid-write resumes from the previous
+    step instead of dying on a half-written file.
+
+    Multi-host: rank 0 owns the manifest and pruning; :func:`save` /
+    :func:`restore` carry their own collectives and fences.
+    """
+
+    MANIFEST = "MANIFEST.json"
+
+    def __init__(self, directory: str, keep: Optional[int] = None,
+                 prefix: str = "step"):
+        from . import config
+
+        self.directory = directory
+        self.keep = int(config.get("ckpt_keep")) if keep is None else keep
+        if self.keep < 1:
+            raise ValueError(f"keep must be >= 1, got {self.keep}")
+        self.prefix = prefix
+        os.makedirs(directory, exist_ok=True)
+
+    # -- manifest ----------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.directory, self.MANIFEST)
+
+    def _entries(self) -> List[Dict[str, Any]]:
+        """Manifest entries, oldest first.  A damaged/absent manifest is
+        rebuilt from the snapshot files on disk (the manifest is an
+        index, never the only source of truth)."""
+        try:
+            with StreamFactory.open(self._manifest_path(), "rb") as s:
+                entries = json.loads(s.read().decode("utf-8"))
+            if isinstance(entries, list):
+                return entries
+        except (OSError, ValueError):
+            pass
+        entries = []
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return entries
+        for name in names:
+            if name.startswith(f"{self.prefix}_") and name.endswith(".ckpt"):
+                try:
+                    step = int(name[len(self.prefix) + 1:-len(".ckpt")])
+                except ValueError:
+                    continue
+                entries.append({"step": step, "file": name})
+        entries.sort(key=lambda e: e["step"])
+        return entries
+
+    def _write_manifest(self, entries: List[Dict[str, Any]]) -> None:
+        def write() -> None:
+            with StreamFactory.open(self._manifest_path(), "wb",
+                                    atomic=True) as s:
+                s.write(json.dumps(entries).encode("utf-8"))
+
+        IO_RETRY.run(write)
+
+    def steps(self) -> List[int]:
+        return [int(e["step"]) for e in self._entries()]
+
+    def _uri(self, name: str) -> str:
+        return os.path.join(self.directory, name)
+
+    # -- save / restore ----------------------------------------------------
+    def save_step(self, step: int,
+                  extra: Optional[Dict[str, Any]] = None) -> str:
+        """Snapshot all tables as snapshot ``step``; returns its path."""
+        ctx = core_context.get_context()
+        name = f"{self.prefix}_{step:010d}.ckpt"
+        uri = self._uri(name)
+        merged = dict(extra or {})
+        merged["__step__"] = step
+        save(uri, extra=merged)  # collective; durable after this returns
+        if ctx.node.rank == 0:
+            entries = [e for e in self._entries() if e["file"] != name]
+            entries.append({"step": step, "file": name})
+            entries.sort(key=lambda e: e["step"])
+            pruned, entries = entries[:-self.keep], entries[-self.keep:]
+            # Manifest first (atomic rename): from this instant the new
+            # snapshot is the restore point; only THEN drop old files.
+            self._write_manifest(entries)
+            for e in pruned:
+                try:
+                    os.unlink(self._uri(e["file"]))
+                except OSError:
+                    pass  # e.g. non-local scheme; stale files are benign
+        ctx.host_sync("mvtpu_ckpt_manager_save")
+        return uri
+
+    def restore_latest(self, strict: bool = True) -> Tuple[int, Dict[str, Any]]:
+        """Restore the newest GOOD snapshot; returns ``(step, extra)``.
+
+        Corrupt or missing snapshots are skipped (with an error log) in
+        favor of the previous entry; raises :class:`CheckpointCorrupt`
+        only when no snapshot in the manifest restores.
+        """
+        entries = self._entries()
+        for e in reversed(entries):
+            uri = self._uri(e["file"])
+            try:
+                extra = restore(uri, strict=strict)
+            except (CheckpointCorrupt, OSError) as exc:
+                Log.error("CheckpointManager: snapshot %s unusable (%s); "
+                          "falling back to the previous one", uri, exc)
+                continue
+            step = int(extra.pop("__step__", e["step"]))
+            Log.info("CheckpointManager: resumed from step %d (%s)",
+                     step, uri)
+            return step, extra
+        raise CheckpointCorrupt(
+            f"{self.directory}: no restorable snapshot among "
+            f"{[e['file'] for e in entries]}")

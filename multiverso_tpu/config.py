@@ -157,3 +157,9 @@ define_string("log_file", "", "optional log file sink")
 define_string("checkpoint_dir", "", "directory for table checkpoints")
 define_int("checkpoint_interval", 0,
            "clocks between automatic checkpoints (0 = disabled)")
+define_int("barrier_timeout_ms", 0,
+           "host_sync/barrier deadline: an unresponsive peer raises "
+           "BarrierTimeout instead of hanging; <=0 (default) waits "
+           "forever (native-flag parity)")
+define_int("ckpt_keep", 3,
+           "snapshots CheckpointManager retains behind its MANIFEST")

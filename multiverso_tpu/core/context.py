@@ -42,10 +42,23 @@ from .. import config, dashboard
 from ..log import Log
 
 __all__ = [
-    "Role", "Context", "init", "shutdown", "initialized", "barrier",
-    "get_context", "worker_id", "workers_num", "server_id", "servers_num",
-    "is_master_worker", "num_replicas", "clock",
+    "Role", "Context", "BarrierTimeout", "init", "shutdown", "initialized",
+    "barrier", "get_context", "worker_id", "workers_num", "server_id",
+    "servers_num", "is_master_worker", "num_replicas", "clock",
 ]
+
+
+class BarrierTimeout(TimeoutError):
+    """A host rendezvous did not complete within its deadline.
+
+    Raised instead of blocking forever when ``barrier()``/``host_sync``
+    is given a timeout (kwarg or the ``barrier_timeout_ms`` flag) and a
+    peer process never arrives — the SPMD-plane analog of the native
+    runtime's ``-barrier_timeout_ms`` (C API rc ``-3``).  NOTE the
+    underlying collective cannot be cancelled: the watcher thread stays
+    parked in it, so treat this as fatal for the job (checkpoint and
+    exit), not as something to retry.
+    """
 
 
 class Role:
@@ -107,20 +120,67 @@ class Context:
         return list(self._tables.values())
 
     # -- barrier / clock ----------------------------------------------------
-    def host_sync(self, name: str) -> None:
+    def host_sync(self, name: str,
+                  timeout_s: Optional[float] = None) -> None:
         """Cross-host rendezvous WITHOUT the BSP clock tick / flush.
 
         For control-plane sync points (checkpointing) that must not apply
         pending sync-mode adds or advance the training clock.
+
+        ``timeout_s`` (default: the ``barrier_timeout_ms`` flag; 0 =
+        wait forever) bounds the wait: a peer that never arrives raises
+        :class:`BarrierTimeout` naming the sync point instead of hanging
+        the job.  The wait runs on a watcher thread because the
+        underlying collective has no cancellation — on timeout that
+        thread is abandoned (daemon) and the error documents the job as
+        unrecoverable-but-diagnosable.
         """
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+        from .. import fault
 
-            multihost_utils.sync_global_devices(name)
+        if timeout_s is None:
+            ms = int(config.get("barrier_timeout_ms"))
+            timeout_s = ms / 1e3 if ms > 0 else None
 
-    def barrier(self, name: Optional[str] = None) -> None:
+        def wait() -> None:
+            # Chaos seam: the injector can delay (simulating a straggler
+            # peer) or fail this rendezvous (tests/test_fault.py).
+            fault.inject("barrier")
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(name)
+
+        if timeout_s is None:
+            wait()
+            return
+        done = threading.Event()
+        err: list = []
+
+        def body() -> None:
+            try:
+                wait()
+            except BaseException as exc:  # re-raised on the caller
+                err.append(exc)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=body, name="mvtpu-host-sync",
+                             daemon=True)
+        t.start()
+        if not done.wait(timeout_s):
+            raise BarrierTimeout(
+                f"host_sync '{name}' timed out after {timeout_s:.3f}s "
+                f"waiting for {jax.process_count()} process(es) — an "
+                f"unresponsive peer; treat as fatal (the collective "
+                f"cannot be cancelled)")
+        if err:
+            raise err[0]
+
+    def barrier(self, name: Optional[str] = None,
+                timeout_s: Optional[float] = None) -> None:
         with dashboard.monitor("Zoo::Barrier"):
-            self.host_sync(name or f"mvtpu_barrier_{self.clock}")
+            self.host_sync(name or f"mvtpu_barrier_{self.clock}",
+                           timeout_s=timeout_s)
             self.clock += 1
             for t in self.tables():
                 flush = getattr(t, "flush", None)
@@ -221,8 +281,8 @@ def get_context() -> Context:
     return _CONTEXT
 
 
-def barrier() -> None:
-    get_context().barrier()
+def barrier(timeout_s: Optional[float] = None) -> None:
+    get_context().barrier(timeout_s=timeout_s)
 
 
 def clock() -> int:

@@ -1,10 +1,18 @@
 """Stream abstraction — reference ``io/io.h`` (`Stream`, `StreamFactory`,
-`LocalStream`, `HDFSStream`; SURVEY.md §2.27)."""
+`LocalStream`, `HDFSStream`; SURVEY.md §2.27).
+
+Chaos seam: every LocalStream read/write passes ``fault.inject`` (sites
+``io.read`` / ``io.write``) so the chaos suite can script transient IO
+failures that the checkpoint layer's RetryPolicy must absorb.  With the
+injector disarmed (the default) the seam is a single bool check.
+"""
 
 from __future__ import annotations
 
 import os
 from typing import BinaryIO
+
+from .. import fault
 
 __all__ = ["Stream", "LocalStream", "HDFSStream", "StreamFactory"]
 
@@ -71,9 +79,11 @@ class LocalStream(Stream):
         self._f: BinaryIO = open(self._write_path, mode)
 
     def write(self, data: bytes) -> int:
+        fault.inject("io.write")
         return self._f.write(data)
 
     def read(self, size: int = -1) -> bytes:
+        fault.inject("io.read")
         return self._f.read(size)
 
     def seek(self, pos: int, whence: int = 0) -> int:

@@ -132,6 +132,19 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_DashboardReport.restype = ctypes.c_void_p
     lib.MV_FreeString.argtypes = [ctypes.c_void_p]
     lib.MV_FreeString.restype = None
+    lib.MV_QueryMonitor.argtypes = [ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.c_longlong)]
+    lib.MV_QueryMonitor.restype = ctypes.c_int
+    lib.MV_SetFault.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.MV_SetFault.restype = ctypes.c_int
+    lib.MV_SetFaultN.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.MV_SetFaultN.restype = ctypes.c_int
+    lib.MV_SetFaultSeed.argtypes = [ctypes.c_longlong]
+    lib.MV_SetFaultSeed.restype = ctypes.c_int
+    lib.MV_ClearFaults.argtypes = []
+    lib.MV_ClearFaults.restype = ctypes.c_int
+    lib.MV_DeadPeerCount.argtypes = []
+    lib.MV_DeadPeerCount.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -377,6 +390,38 @@ class NativeRuntime:
             return ctypes.cast(ptr, ctypes.c_char_p).value.decode()
         finally:
             self.lib.MV_FreeString(ptr)
+
+    def query_monitor(self, name: str) -> int:
+        """Hit count of one Dashboard monitor (0 if it never fired) —
+        e.g. ``net.retries`` / ``net.dropped`` / ``hb.missed``."""
+        c = ctypes.c_longlong(0)
+        self._check(self.lib.MV_QueryMonitor(name.encode(),
+                                             ctypes.byref(c)),
+                    "MV_QueryMonitor")
+        return c.value
+
+    # ------------------------------------------------- fault injection
+    def set_fault(self, kind: str, rate: float) -> None:
+        """Arm a wire fault (docs/fault_tolerance.md): kind in
+        drop|delay|dup|fail_send, probability per op; ``delay_ms`` sets
+        the injected delay length."""
+        self._check(self.lib.MV_SetFault(kind.encode(), rate),
+                    "MV_SetFault")
+
+    def set_fault_n(self, kind: str, n: int) -> None:
+        """Deterministic variant: fire on exactly the next ``n`` ops."""
+        self._check(self.lib.MV_SetFaultN(kind.encode(), n),
+                    "MV_SetFaultN")
+
+    def set_fault_seed(self, seed: int) -> None:
+        self._check(self.lib.MV_SetFaultSeed(seed), "MV_SetFaultSeed")
+
+    def clear_faults(self) -> None:
+        self._check(self.lib.MV_ClearFaults(), "MV_ClearFaults")
+
+    def dead_peer_count(self) -> int:
+        """Peers with expired heartbeat leases (rank 0, -heartbeat_ms)."""
+        return self.lib.MV_DeadPeerCount()
 
     @staticmethod
     def _check(rc: int, what: str) -> None:

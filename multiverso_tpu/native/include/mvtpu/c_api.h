@@ -103,6 +103,25 @@ int MV_LoadTable(int32_t handle, const char* path);
 // Dashboard report as a malloc'd C string; caller frees with MV_FreeString.
 char* MV_DashboardReport();
 void MV_FreeString(char* s);
+// One monitor's hit count (0 when the monitor never fired) — how the
+// chaos suite asserts `net.retries` / `net.dropped` / `hb.missed`.
+int MV_QueryMonitor(const char* name, long long* count);
+
+// ---- fault injection (mvtpu/fault.h; docs/fault_tolerance.md) --------
+// Chaos hooks on the wire plane, deterministic under MV_SetFaultSeed.
+// kinds: "drop" | "delay" | "dup" | "fail_send" (probability in [0,1]),
+// plus "delay_ms" whose `rate` sets the injected delay length.
+// MV_SetFaultN fires on exactly the next n matching ops instead of by
+// probability.  All return 0, -1 on unknown kind / bad rate.  With no
+// faults configured (the default) the hooks are a single atomic load.
+int MV_SetFault(const char* kind, double rate);
+int MV_SetFaultN(const char* kind, long long n);
+int MV_SetFaultSeed(long long seed);
+int MV_ClearFaults(void);
+
+// Heartbeat failure detection (rank 0 with `-heartbeat_ms`): number of
+// peers whose liveness lease is currently expired.  0 elsewhere.
+int MV_DeadPeerCount(void);
 
 #ifdef __cplusplus
 }

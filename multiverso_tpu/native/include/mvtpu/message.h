@@ -35,6 +35,11 @@ enum class MsgType : int32_t {
   // "min worker clock >= c" implies every rank's adds through clock c
   // landed — the bounded-staleness guarantee MV_Clock documents.
   ClockTick = 20,
+  // Liveness lease (docs/fault_tolerance.md): every non-zero rank
+  // announces itself to rank 0 every `-heartbeat_ms`; rank 0's lease
+  // loop reports peers whose announcements stop (Dashboard hb.missed)
+  // instead of letting the next barrier discover the corpse by hanging.
+  Heartbeat = 21,
   Exit = 64,
 };
 

@@ -55,7 +55,11 @@ class TcpNet : public Net {
   // allocation on the controller.
   static bool SendFramed(int fd, const Message& msg);
   static bool SendFramed(int fd, const Blob& wire);   // pre-serialized
-  static bool RecvFramed(int fd, Message* msg, int64_t max_bytes = 0);
+  // `body_timeout_ms > 0` bounds the read of a frame's BODY once its
+  // length prefix arrived (an idle connection may block forever on the
+  // prefix — that is legitimate; a peer that stalls mid-frame is not).
+  static bool RecvFramed(int fd, Message* msg, int64_t max_bytes = 0,
+                         int64_t body_timeout_ms = 0);
 
   // Dynamic registration (reference src/controller.cpp Control_Register,
   // SURVEY.md §2.7/§3.1): the controller listens on `ctrl_endpoint`,
@@ -86,7 +90,14 @@ class TcpNet : public Net {
             InboundFn fn, int64_t connect_retry_ms = 15000);
 
   // Serialize + frame + write to the peer (lazy connect with retries —
-  // peers start in any order).  Returns false on a dead peer.
+  // peers start in any order).  A failed write is retried up to
+  // `-send_retries` times with exponential backoff (`-send_backoff_ms`
+  // base), reconnecting between attempts; writes are bounded by
+  // `-io_timeout_ms` (SO_SNDTIMEO) so a wedged peer cannot park the
+  // sender forever.  Fault-injection hooks (mvtpu/fault.h) sit on this
+  // path: drop/delay/duplicate per logical message, fail per attempt.
+  // Dashboard counters: net.retries, net.dropped.  Returns false on a
+  // dead peer (after the retry budget).
   bool Send(int dst_rank, const Message& msg) override;
 
   void Stop() override;
@@ -98,6 +109,8 @@ class TcpNet : public Net {
   void AcceptLoop();
   void ReadLoop(int fd);
   int ConnectTo(int dst_rank);
+  // One connect-if-needed + framed-write attempt (no retry).
+  bool SendAttempt(int dst_rank, const Blob& wire);
 
   std::vector<std::string> endpoints_;
   int rank_ = 0;

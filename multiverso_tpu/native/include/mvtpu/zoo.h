@@ -17,6 +17,7 @@
 #include <atomic>
 #include <memory>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -63,7 +64,20 @@ class Zoo {
 
   // Blocks until every rank arrived; false when `-barrier_timeout_ms`
   // (default: infinite) expired or the barrier authority is unreachable.
+  // On timeout the error names the unresponsive rank(s): rank 0 lists
+  // the ranks that never announced arrival; other ranks name rank 0
+  // (the authority whose release never came).
   bool Barrier();
+
+  // ---- heartbeat / lease (docs/fault_tolerance.md) --------------------
+  // With `-heartbeat_ms > 0` and size > 1, every non-zero rank sends a
+  // Heartbeat to rank 0 each interval; rank 0's lease loop marks a peer
+  // dead after `-heartbeat_timeout_ms` of silence (default 5 intervals),
+  // logging the rank and counting Dashboard `hb.missed` — the job
+  // LEARNS about the corpse instead of discovering it by hanging.
+  void OnHeartbeat(int src_rank);      // controller actor inbound
+  int DeadPeerCount();                 // rank 0: currently-expired leases
+  std::vector<int> DeadPeers();
 
   // SSP (bounded staleness, SURVEY.md §2.9-bis): advance this worker's
   // clock and announce it to every server shard (async, FIFO behind this
@@ -203,6 +217,15 @@ class Zoo {
   Mutex flush_mu_;
   std::unordered_map<int64_t, std::shared_ptr<Waiter>> flush_pending_
       GUARDED_BY(flush_mu_);
+
+  // Heartbeat/lease state.  The loop thread is started by Start (when
+  // enabled) and joined by the Stop latch winner before actors die.
+  void HeartbeatLoop();
+  std::thread hb_thread_;
+  std::atomic<bool> hb_running_{false};
+  Mutex hb_mu_;
+  std::vector<int64_t> hb_last_seen_ GUARDED_BY(hb_mu_);  // ms, rank 0
+  std::vector<bool> hb_dead_ GUARDED_BY(hb_mu_);
 };
 
 }  // namespace mvtpu

@@ -7,6 +7,7 @@
 
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
+#include "mvtpu/fault.h"
 #include "mvtpu/mutex.h"
 #include "mvtpu/stream.h"
 #include "mvtpu/zoo.h"
@@ -343,5 +344,33 @@ char* MV_DashboardReport() {
 }
 
 void MV_FreeString(char* s) { free(s); }
+
+int MV_QueryMonitor(const char* name, long long* count) {
+  if (!name || !count) return -1;
+  long long c = 0;
+  double total = 0.0;
+  *count = mvtpu::Dashboard::Query(name, &c, &total) ? c : 0;
+  return 0;
+}
+
+int MV_SetFault(const char* kind, double rate) {
+  return mvtpu::Fault::Set(kind, rate);
+}
+
+int MV_SetFaultN(const char* kind, long long n) {
+  return mvtpu::Fault::SetBudget(kind, n);
+}
+
+int MV_SetFaultSeed(long long seed) {
+  mvtpu::Fault::SetSeed(static_cast<uint64_t>(seed));
+  return 0;
+}
+
+int MV_ClearFaults(void) {
+  mvtpu::Fault::Clear();
+  return 0;
+}
+
+int MV_DeadPeerCount(void) { return Zoo::Get()->DeadPeerCount(); }
 
 }  // extern "C"

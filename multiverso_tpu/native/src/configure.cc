@@ -174,6 +174,21 @@ void RegisterDefaults() {
               "per-destination connect retry budget");
     DefineInt("barrier_timeout_ms", 0,
               "barrier deadline; <=0 (default) waits forever (BSP)");
+    DefineInt("io_timeout_ms", 30000,
+              "per-socket send deadline + mid-frame recv deadline: a "
+              "peer that wedges mid-message errors out instead of "
+              "parking the thread; <=0 disables");
+    DefineInt("send_retries", 2,
+              "bounded wire-send retries after a failed write "
+              "(reconnect between attempts); 0 fails on first error");
+    DefineInt("send_backoff_ms", 50,
+              "base exponential backoff between send retries");
+    DefineInt("heartbeat_ms", 0,
+              "liveness lease interval: non-zero ranks announce to "
+              "rank 0 every interval, rank 0 reports silent peers "
+              "(Dashboard hb.missed); 0 (default) disables");
+    DefineInt("heartbeat_timeout_ms", 0,
+              "lease expiry; <=0 derives 5*heartbeat_ms");
     DefineString("log_level", "info", "debug|info|error|fatal");
     DefineString("log_file", "", "optional log sink path");
   });

@@ -13,6 +13,9 @@
 #include <cstring>
 #include <fstream>
 
+#include "mvtpu/configure.h"
+#include "mvtpu/dashboard.h"
+#include "mvtpu/fault.h"
 #include "mvtpu/log.h"
 
 namespace mvtpu {
@@ -53,6 +56,38 @@ bool ReadAll(int fd, void* buf, size_t n) {
   return true;
 }
 
+// Deadline-bounded ReadAll: a peer that stalls mid-frame (crashed after
+// the length prefix, wedged NIC) must not park the reader thread
+// forever.  timeout_ms <= 0 keeps the plain blocking read.
+bool ReadAllDeadline(int fd, void* buf, size_t n, int64_t timeout_ms) {
+  if (timeout_ms <= 0) return ReadAll(fd, buf, n);
+  char* p = static_cast<char*>(buf);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (n > 0) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    if (left <= 0) return false;
+    pollfd pfd{fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, static_cast<int>(std::min<int64_t>(left, 500)));
+    if (pr < 0) return false;
+    if (pr == 0) continue;
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// Flags may not be registered when TcpNet is driven standalone (tests,
+// the registration handshake before Zoo::Start finishes).
+int64_t FlagOr(const char* name, int64_t dflt) {
+  return mvtpu::configure::Has(name) ? mvtpu::configure::GetInt(name)
+                                     : dflt;
+}
+
 }  // namespace
 
 std::vector<std::string> TcpNet::ParseMachineFile(const std::string& path) {
@@ -90,13 +125,18 @@ bool TcpNet::SendFramed(int fd, const Blob& wire) {
          WriteAll(fd, wire.data(), wire.size());
 }
 
-bool TcpNet::RecvFramed(int fd, Message* msg, int64_t max_bytes) {
+bool TcpNet::RecvFramed(int fd, Message* msg, int64_t max_bytes,
+                        int64_t body_timeout_ms) {
   if (max_bytes <= 0) max_bytes = kMaxFrameBytes;
   int64_t len = 0;
+  // The prefix read may block indefinitely — an idle connection is
+  // healthy.  Once a frame STARTED, the rest must arrive within the
+  // deadline or the connection is declared dead.
   if (!ReadAll(fd, &len, sizeof(len)) || len <= 0 || len > max_bytes)
     return false;
   Blob buf(static_cast<size_t>(len));
-  if (!ReadAll(fd, buf.data(), buf.size())) return false;
+  if (!ReadAllDeadline(fd, buf.data(), buf.size(), body_timeout_ms))
+    return false;
   *msg = Message::Deserialize(buf);
   return true;
 }
@@ -342,9 +382,10 @@ void TcpNet::AcceptLoop() {
 }
 
 void TcpNet::ReadLoop(int fd) {
+  const int64_t body_timeout = FlagOr("io_timeout_ms", 30000);
   while (true) {
     Message m;
-    if (!RecvFramed(fd, &m)) {
+    if (!RecvFramed(fd, &m, 0, body_timeout)) {
       ::close(fd);
       return;
     }
@@ -374,6 +415,15 @@ int TcpNet::ConnectTo(int dst_rank) {
     if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // Bounded writes: a peer that stops draining its socket (wedged,
+      // SIGSTOPped) turns ::send into a deadline error instead of an
+      // indefinite block — the write-side half of the recv deadline.
+      int64_t io_ms = FlagOr("io_timeout_ms", 30000);
+      if (io_ms > 0) {
+        timeval tv{static_cast<time_t>(io_ms / 1000),
+                   static_cast<suseconds_t>((io_ms % 1000) * 1000)};
+        ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      }
       break;
     }
     ::close(fd);
@@ -388,13 +438,7 @@ int TcpNet::ConnectTo(int dst_rank) {
   return fd;
 }
 
-bool TcpNet::Send(int dst_rank, const Message& msg) {
-  if (dst_rank < 0 || dst_rank >= static_cast<int>(endpoints_.size()))
-    return false;
-  // Serialize BEFORE taking the send mutex — a full-payload copy inside
-  // the critical section would queue every concurrent sender to this
-  // rank behind it.
-  Blob wire = msg.Serialize();
+bool TcpNet::SendAttempt(int dst_rank, const Blob& wire) {
   // Connect OUTSIDE the per-destination send mutex: the retry loop can
   // take seconds, and holding the mutex through it would stall Stop()
   // (which closes fds under the same mutex) and serialize every sender
@@ -420,6 +464,16 @@ bool TcpNet::Send(int dst_rank, const Message& msg) {
                endpoints_[dst_rank].c_str());
     return false;
   }
+  // Injected wire failure (chaos suite): indistinguishable from a real
+  // failed write downstream of here — the connection is torn down and
+  // the retry loop, if any budget remains, reconnects.
+  if (Fault::Enabled() && Fault::FailSendAttempt()) {
+    Dashboard::Record("fault.fail_send", 0.0);
+    ::close(fd);
+    send_fds_[dst_rank] = -1;
+    Log::Error("TcpNet: send to rank %d failed (injected)", dst_rank);
+    return false;
+  }
   if (!SendFramed(fd, wire)) {
     ::close(fd);
     send_fds_[dst_rank] = -1;
@@ -427,6 +481,64 @@ bool TcpNet::Send(int dst_rank, const Message& msg) {
     return false;
   }
   return true;
+}
+
+bool TcpNet::Send(int dst_rank, const Message& msg) {
+  if (dst_rank < 0 || dst_rank >= static_cast<int>(endpoints_.size()))
+    return false;
+  // Serialize BEFORE taking any send mutex — a full-payload copy inside
+  // the critical section would queue every concurrent sender to this
+  // rank behind it.
+  Blob wire = msg.Serialize();
+
+  bool duplicate = false;
+  if (Fault::Enabled()) {
+    int64_t delay_ms = 0;
+    switch (Fault::OnSend(&delay_ms)) {
+      case Fault::Action::kDrop:
+        // The message silently vanishes (a lossy wire): the caller sees
+        // success and the reply deadline upstream turns it into -3.
+        Dashboard::Record("net.dropped", 0.0);
+        return true;
+      case Fault::Action::kDelay:
+        Dashboard::Record("net.delayed", 0.0);
+        std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
+        break;
+      case Fault::Action::kDuplicate:
+        duplicate = true;
+        break;
+      case Fault::Action::kNone:
+        break;
+    }
+  }
+
+  // Bounded retry with exponential backoff: a transient failure (peer
+  // restarting, injected fault, send buffer deadline) is retried after
+  // reconnecting; a genuinely dead peer exhausts the budget and fails.
+  const int retries =
+      static_cast<int>(std::max<int64_t>(0, FlagOr("send_retries", 2)));
+  int64_t backoff_ms = std::max<int64_t>(1, FlagOr("send_backoff_ms", 50));
+  for (int attempt = 0; attempt <= retries; ++attempt) {
+    if (attempt > 0) {
+      Dashboard::Record("net.retries", 0.0);
+      std::this_thread::sleep_for(std::chrono::milliseconds(backoff_ms));
+      backoff_ms *= 2;
+      MutexLock lk(mu_);
+      if (!running_) return false;
+    }
+    if (SendAttempt(dst_rank, wire)) {
+      if (duplicate) {
+        // Second copy best-effort: a duplicating wire does not get to
+        // also claim a delivery failure.
+        Dashboard::Record("net.duplicated", 0.0);
+        SendAttempt(dst_rank, wire);
+      }
+      return true;
+    }
+  }
+  Log::Error("TcpNet: send to rank %d failed after %d attempt(s)",
+             dst_rank, retries + 1);
+  return false;
 }
 
 void TcpNet::Stop() {

@@ -126,10 +126,15 @@ class ControllerActor : public Actor {
     RegisterHandler(MsgType::ControlBarrierReply, [](MessagePtr& m) {
       Zoo::Get()->OnBarrierRelease(m->msg_id);
     });
+    RegisterHandler(MsgType::Heartbeat, [](MessagePtr& m) {
+      Zoo::Get()->OnHeartbeat(m->src);
+    });
   }
 };
 
 }  // namespace
+
+static int64_t NowMs();
 
 Zoo* Zoo::Get() {
   static Zoo zoo;
@@ -250,6 +255,15 @@ bool Zoo::Start(int argc, const char* const* argv) {
   worker_actor_->Start();
   server_actor_->Start();
   controller_actor_->Start();
+  if (size_ > 1 && configure::GetInt("heartbeat_ms") > 0) {
+    {
+      MutexLock hlk(hb_mu_);
+      hb_last_seen_.assign(static_cast<size_t>(size_), NowMs());
+      hb_dead_.assign(static_cast<size_t>(size_), false);
+    }
+    hb_running_ = true;
+    hb_thread_ = std::thread([this] { HeartbeatLoop(); });
+  }
   started_ = true;
   Log::Info("mvtpu native runtime started (rank %d/%d, updater=%s)", rank_,
             size_, upd.c_str());
@@ -268,6 +282,10 @@ void Zoo::Stop() {
   // Cross-process: no rank may tear down while peers still need its
   // server shard — rendezvous first (also flushes every pipeline).
   if (size_ > 1) Barrier();
+  // Lease loop dies before the transport it sends through.
+  if (hb_running_.exchange(false)) {
+    if (hb_thread_.joinable()) hb_thread_.join();
+  }
   // Un-waited async-get tickets hold pointers into the worker tables —
   // reclaim them before the registry dies (c_api.cc).
   CApiReclaimAsyncGets();
@@ -308,6 +326,11 @@ void Zoo::Stop() {
     MutexLock blk(barrier_mu_);
     barrier_arrived_.clear();
     barrier_failed_ = false;
+  }
+  {
+    MutexLock hlk(hb_mu_);
+    hb_last_seen_.clear();
+    hb_dead_.clear();
   }
   Log::Info("%s", Dashboard::Report().c_str());
 }
@@ -377,9 +400,31 @@ bool Zoo::Barrier() {
   // peer into an error return instead of a hang (the release message may
   // still arrive later: OnBarrierRelease tolerates a cleared waiter).
   bool ok = waiter->WaitFor(configure::GetInt("barrier_timeout_ms"));
-  if (!ok)
-    Log::Error("Zoo::Barrier: timed out waiting for release (rank %d)",
-               rank_);
+  if (!ok) {
+    // Name the unresponsive rank(s): the authority knows exactly who
+    // never announced arrival; everyone else can only name the silent
+    // authority.  Dead-lease info (heartbeats) rides along when on.
+    std::string who;
+    if (rank_ == 0) {
+      MutexLock lk(barrier_mu_);
+      for (int r = 0; r < size_; ++r) {
+        bool arrived = r < static_cast<int>(barrier_arrived_.size()) &&
+                       barrier_arrived_[r];
+        if (!arrived) who += (who.empty() ? "" : ",") + std::to_string(r);
+      }
+    } else {
+      who = "0 (barrier authority)";
+    }
+    Log::Error("Zoo::Barrier: rank %d timed out after %lld ms waiting "
+               "for rank(s) %s",
+               rank_,
+               static_cast<long long>(
+                   configure::GetInt("barrier_timeout_ms")),
+               who.c_str());
+    for (int r : DeadPeers())
+      Log::Error("Zoo::Barrier: rank %d's heartbeat lease is expired "
+                 "(likely dead)", r);
+  }
   bool failed;
   {
     MutexLock lk(barrier_mu_);
@@ -428,18 +473,21 @@ void Zoo::OnBarrierArrive(int src_rank, int64_t round) {
     for (int r = 0; r < size_; ++r)
       release.emplace_back(r, barrier_rounds_[r]);
   }
+  // Remote releases FIRST, the local one last: the local release wakes
+  // this rank's Barrier() caller, and anything it does next (e.g. the
+  // chaos suite arming a fault) must not race releases still queued for
+  // the wire.
   for (auto& [r, r_round] : release) {
-    if (r == rank_) {
-      OnBarrierRelease(r_round);
-    } else {
-      Message reply;
-      reply.type = MsgType::ControlBarrierReply;
-      reply.msg_id = r_round;  // echo the receiver's announced round
-      reply.src = rank_;
-      reply.dst = r;
-      net_->Send(r, reply);
-    }
+    if (r == rank_) continue;
+    Message reply;
+    reply.type = MsgType::ControlBarrierReply;
+    reply.msg_id = r_round;  // echo the receiver's announced round
+    reply.src = rank_;
+    reply.dst = r;
+    net_->Send(r, reply);
   }
+  for (auto& [r, r_round] : release)
+    if (r == rank_) OnBarrierRelease(r_round);
 }
 
 void Zoo::OnBarrierRelease(int64_t round) {
@@ -456,6 +504,71 @@ void Zoo::OnBarrierRelease(int64_t round) {
     return;
   }
   if (barrier_waiter_) barrier_waiter_->Notify();
+}
+
+void Zoo::HeartbeatLoop() {
+  const int64_t interval = configure::GetInt("heartbeat_ms");
+  int64_t timeout = configure::GetInt("heartbeat_timeout_ms");
+  if (timeout <= 0) timeout = 5 * interval;
+  while (hb_running_) {
+    // Sleep in small steps so Stop never waits a full interval.
+    for (int64_t slept = 0; slept < interval && hb_running_; slept += 20)
+      std::this_thread::sleep_for(std::chrono::milliseconds(
+          std::min<int64_t>(20, interval - slept)));
+    if (!hb_running_) break;
+    if (rank_ != 0) {
+      // Lease renewal.  A failed send is already logged by the
+      // transport; the lease simply expires on rank 0's side.
+      Message hb;
+      hb.type = MsgType::Heartbeat;
+      hb.src = rank_;
+      hb.dst = 0;
+      if (net_) net_->Send(0, hb);
+      continue;
+    }
+    // Rank 0: scan the leases.  A peer transitions to dead ONCE per
+    // outage (hb.missed counts outages, not scans) and recovers when a
+    // late heartbeat arrives — report-only, the reference's missing
+    // failure detector; eviction/replacement stays the operator's call.
+    int64_t now = NowMs();
+    MutexLock lk(hb_mu_);
+    for (int r = 1; r < size_; ++r) {
+      bool silent = now - hb_last_seen_[r] > timeout;
+      if (silent && !hb_dead_[r]) {
+        hb_dead_[r] = true;
+        Dashboard::Record("hb.missed", 0.0);
+        Log::Error("heartbeat: rank %d silent for over %lld ms — lease "
+                   "expired, reporting peer dead",
+                   r, static_cast<long long>(timeout));
+      }
+    }
+  }
+}
+
+void Zoo::OnHeartbeat(int src_rank) {
+  MutexLock lk(hb_mu_);
+  if (src_rank < 0 || src_rank >= static_cast<int>(hb_last_seen_.size()))
+    return;
+  hb_last_seen_[src_rank] = NowMs();
+  if (hb_dead_[src_rank]) {
+    hb_dead_[src_rank] = false;
+    Log::Info("heartbeat: rank %d is back — lease renewed", src_rank);
+  }
+}
+
+int Zoo::DeadPeerCount() {
+  MutexLock lk(hb_mu_);
+  int n = 0;
+  for (bool d : hb_dead_) n += d ? 1 : 0;
+  return n;
+}
+
+std::vector<int> Zoo::DeadPeers() {
+  MutexLock lk(hb_mu_);
+  std::vector<int> out;
+  for (size_t r = 0; r < hb_dead_.size(); ++r)
+    if (hb_dead_[r]) out.push_back(static_cast<int>(r));
+  return out;
 }
 
 void Zoo::Clock() {
@@ -700,6 +813,7 @@ void Zoo::RouteInbound(Message&& m) {
       break;
     case MsgType::ControlBarrier:
     case MsgType::ControlBarrierReply:
+    case MsgType::Heartbeat:
       SendTo(actor::kController, std::move(msg));
       break;
     default:

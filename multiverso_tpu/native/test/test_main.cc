@@ -1119,6 +1119,207 @@ static int AsyncOverlapChild(const char* machine_file, const char* rank) {
   return 0;
 }
 
+// ---------------------------------------------------------------- chaos
+// Scripted-failure scenarios (docs/fault_tolerance.md): the injection
+// hooks in mvtpu/fault.h let these DRIVE the failure modes the dead_*
+// scenarios can only approximate with real process death.  All run with
+// a fixed seed so CI is deterministic.
+
+static int ChaosRetryChild(const char* machine_file, const char* rank) {
+  // Send retry-then-succeed: the first two write attempts of rank 0's
+  // blocking Add are injected failures; the bounded-backoff retry loop
+  // reconnects and lands the delta.  Proves retries are counted and the
+  // payload survives the faulty wire.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+                         "-log_level=error", "-rpc_timeout_ms=30000",
+                         "-barrier_timeout_ms=30000", "-send_retries=3",
+                         "-send_backoff_ms=20", "-connect_retry_ms=2000"};
+  CHECK(MV_Init(9, argv2) == 0);
+  CHECK(MV_SetFaultSeed(1234) == 0);
+  int me = MV_WorkerId();
+  int32_t h;
+  CHECK(MV_NewArrayTable(10, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+  if (me == 0) {
+    CHECK(MV_SetFaultN("fail_send", 2) == 0);
+    std::vector<float> ones(10, 1.0f);
+    CHECK(MV_AddArrayTable(h, ones.data(), 10) == 0);  // survives the faults
+    long long retries = 0, injected = 0;
+    CHECK(MV_QueryMonitor("net.retries", &retries) == 0);
+    CHECK(MV_QueryMonitor("fault.fail_send", &injected) == 0);
+    CHECK(retries >= 2);
+    CHECK(injected == 2);
+    CHECK(MV_ClearFaults() == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  std::vector<float> out(10, -1.0f);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
+  for (float v : out) CHECK(v == 1.0f);
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("CHAOS_RETRY_OK %d\n", me);
+  return 0;
+}
+
+static int ChaosDropDupChild(const char* machine_file, const char* rank) {
+  // Lossy/duplicating wire: rank 0 drops exactly one async-add message
+  // (the remote shard misses the delta; the local shard applies), then
+  // duplicates exactly one (the remote shard double-applies) — counters
+  // and values both assert the injected behavior.  Shards split 5/5
+  // (balanced contiguous partition): elements 0-4 live on rank 0,
+  // 5-9 on rank 1; only the remote partition rides the faulty wire.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+                         "-log_level=error", "-rpc_timeout_ms=30000",
+                         "-barrier_timeout_ms=30000"};
+  CHECK(MV_Init(6, argv2) == 0);
+  CHECK(MV_SetFaultSeed(1234) == 0);
+  int me = MV_WorkerId();
+  int32_t h;
+  CHECK(MV_NewArrayTable(10, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+  std::vector<float> ones(10, 1.0f), out(10, -1.0f);
+  // Rank 1 STAGGERS its entry into the barrier that follows each armed
+  // add: its own barrier-flush request would otherwise race rank 0's
+  // add for the injected budget (rank 0's ReplyFlush to it is also a
+  // wire send), and the budget must deterministically hit the add.
+  if (me == 0) {
+    CHECK(MV_SetFaultN("drop", 1) == 0);
+    CHECK(MV_AddAsyncArrayTable(h, ones.data(), 10) == 0);  // remote lost
+  } else {
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
+  for (int i = 0; i < 5; ++i) CHECK(out[i] == 1.0f);   // local applied
+  for (int i = 5; i < 10; ++i) CHECK(out[i] == 0.0f);  // dropped on wire
+  CHECK(MV_Barrier() == 0);
+  if (me == 0) {
+    CHECK(MV_SetFaultN("dup", 1) == 0);
+    CHECK(MV_AddAsyncArrayTable(h, ones.data(), 10) == 0);  // remote 2x
+  } else {
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
+  for (int i = 0; i < 10; ++i) CHECK(out[i] == 2.0f);  // 1+1 local, 0+2 remote
+  if (me == 0) {
+    long long dropped = 0, duped = 0;
+    CHECK(MV_QueryMonitor("net.dropped", &dropped) == 0);
+    CHECK(MV_QueryMonitor("net.duplicated", &duped) == 0);
+    CHECK(dropped == 1);
+    CHECK(duped == 1);
+    CHECK(MV_ClearFaults() == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("CHAOS_DROPDUP_OK %d\n", me);
+  return 0;
+}
+
+static int ChaosBarrierTimeoutChild(const char* machine_file,
+                                    const char* rank) {
+  // Deadline-bounded barrier: rank 1 simply never arrives (busy for 4 s)
+  // — rank 0's barrier must return -3 within the configured deadline
+  // with an error NAMING rank 1 (asserted by the pytest side on this
+  // process's stderr), never hang.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+                         "-log_level=error", "-rpc_timeout_ms=3000",
+                         "-barrier_timeout_ms=1500",
+                         "-connect_retry_ms=300"};
+  CHECK(MV_Init(7, argv2) == 0);
+  int me = MV_WorkerId();
+  int32_t h;
+  CHECK(MV_NewArrayTable(4, &h) == 0);
+  if (me == 1) {
+    // The straggler: never joins this barrier round, then leaves
+    // without a goodbye (its own shutdown barrier would also time out).
+    std::this_thread::sleep_for(std::chrono::milliseconds(4000));
+    fflush(stdout);
+    printf("CHAOS_BARRIER_OK 1\n");
+    fflush(stdout);
+    _exit(0);
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  CHECK(MV_Barrier() == -3);
+  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
+  CHECK(ms >= 1400 && ms < 10000);  // deadline honored, not a hang
+  CHECK(MV_ShutDown() == 0);        // its barrier times out and proceeds
+  printf("CHAOS_BARRIER_OK %d\n", me);
+  return 0;
+}
+
+static int ChaosHeartbeatChild(const char* machine_file, const char* rank) {
+  // Dropped-peer heartbeat report: leases on (-heartbeat_ms=100), rank 1
+  // crashes after the rendezvous; within a few intervals rank 0 reports
+  // the dead peer (MV_DeadPeerCount, Dashboard hb.missed) WITHOUT any
+  // blocking call having to discover it the hard way.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+                         "-log_level=error", "-rpc_timeout_ms=3000",
+                         "-barrier_timeout_ms=1500", "-heartbeat_ms=100",
+                         "-heartbeat_timeout_ms=400",
+                         "-connect_retry_ms=300"};
+  CHECK(MV_Init(9, argv2) == 0);
+  int me = MV_WorkerId();
+  CHECK(MV_Barrier() == 0);
+  if (me == 1) _exit(0);  // crash: no shutdown, no goodbye
+
+  CHECK(MV_DeadPeerCount() == 0);  // lease still fresh at the crash
+  // Lease expiry is 400 ms of silence; poll up to 3 s for the report.
+  int dead = 0;
+  for (int tries = 0; tries < 150 && dead == 0; ++tries) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    dead = MV_DeadPeerCount();
+  }
+  CHECK(dead == 1);
+  long long missed = 0;
+  CHECK(MV_QueryMonitor("hb.missed", &missed) == 0);
+  CHECK(missed >= 1);
+  CHECK(MV_ShutDown() == 0);  // shutdown barrier times out and proceeds
+  printf("CHAOS_HB_OK %d\n", me);
+  return 0;
+}
+
+static int ChaosQuietChild(const char* machine_file, const char* rank) {
+  // Injection disabled ⇒ zero observable difference: a normal 2-rank
+  // round trip leaves every injected-path counter at exactly zero.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+                         "-log_level=error", "-rpc_timeout_ms=30000",
+                         "-barrier_timeout_ms=30000"};
+  CHECK(MV_Init(6, argv2) == 0);
+  int me = MV_WorkerId();
+  int32_t h;
+  CHECK(MV_NewArrayTable(10, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+  std::vector<float> ones(10, 1.0f), out(10, -1.0f);
+  CHECK(MV_AddArrayTable(h, ones.data(), 10) == 0);
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
+  for (float v : out) CHECK(v == 2.0f);
+  for (const char* counter :
+       {"net.retries", "net.dropped", "net.delayed", "net.duplicated",
+        "fault.fail_send", "hb.missed"}) {
+    long long c = -1;
+    CHECK(MV_QueryMonitor(counter, &c) == 0);
+    CHECK(c == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("CHAOS_QUIET_OK %d\n", me);
+  return 0;
+}
+
 // masking the CHECK diagnostic — _exit skips teardown and keeps rc=1.
 static int ScenarioExit(int rc) {
   fflush(stdout);
@@ -1147,6 +1348,16 @@ int main(int argc, char** argv) {
     return ScenarioExit(WireBenchChild(argv[2], argv[3], argv[4]));
   if (argc == 4 && std::string(argv[1]) == "async_overlap")
     return ScenarioExit(AsyncOverlapChild(argv[2], argv[3]));
+  if (argc == 4 && std::string(argv[1]) == "chaos_retry")
+    return ScenarioExit(ChaosRetryChild(argv[2], argv[3]));
+  if (argc == 4 && std::string(argv[1]) == "chaos_dropdup")
+    return ScenarioExit(ChaosDropDupChild(argv[2], argv[3]));
+  if (argc == 4 && std::string(argv[1]) == "chaos_barrier")
+    return ScenarioExit(ChaosBarrierTimeoutChild(argv[2], argv[3]));
+  if (argc == 4 && std::string(argv[1]) == "chaos_heartbeat")
+    return ScenarioExit(ChaosHeartbeatChild(argv[2], argv[3]));
+  if (argc == 4 && std::string(argv[1]) == "chaos_quiet")
+    return ScenarioExit(ChaosQuietChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "dead_peer")
     return ScenarioExit(DeadPeerChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "dead_server")

@@ -35,7 +35,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-from .. import dashboard
+from .. import dashboard, fault
 from ..core import context as core_context
 from ..updaters import AddOption, get_updater
 
@@ -511,8 +511,11 @@ class Table:
     def _monitor(self, op: str):
         # Every public eager op opens with this — it doubles as the
         # closed-table guard (a closed table's sync buffers would
-        # otherwise swallow adds silently).
+        # otherwise swallow adds silently) and as the chaos seam: the
+        # fault injector can script a Get/Add failure here exactly where
+        # a real transport error would surface (tests/test_fault.py).
         if self._closed:
             raise RuntimeError(
                 f"table '{self.name}' is closed (close() was called)")
+        fault.inject(f"table.{op}")
         return dashboard.monitor(f"{type(self).__name__}::{op}")

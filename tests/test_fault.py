@@ -1,0 +1,423 @@
+"""Tier-1 chaos suite (docs/fault_tolerance.md): every injected failure
+mode driven end-to-end, with a fixed seed so CI is deterministic.
+
+Python plane: RetryPolicy schedules, the FaultInjector seams (streams /
+table ops / barrier), CRC-framed checkpoint corruption + the
+CheckpointManager fallback.  Native plane (g++-gated): the scripted-wire
+scenarios in test_main.cc — send retry-then-succeed, drop/duplicate,
+barrier timeout naming the missing rank, dropped-peer heartbeat report,
+and the quiet control run proving injection-off changes nothing.
+
+``make chaos`` runs exactly this file with MVTPU_FAULT_SEED pinned.
+"""
+
+import os
+import shutil
+import socket
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "multiverso_tpu", "native")
+SEED = int(os.environ.get("MVTPU_FAULT_SEED", "1234"))
+
+
+@pytest.fixture()
+def chaos(mv):
+    """mv runtime + a disarmed injector and a zeroed counter ledger on
+    both sides of the test (monitors are process-global)."""
+    from multiverso_tpu import dashboard, fault
+
+    fault.reset()
+    dashboard.reset()
+    yield mv
+    fault.reset()
+    dashboard.reset()
+
+
+# ---------------------------------------------------------------- RetryPolicy
+
+def test_retry_policy_recovers_from_transient_failures(chaos):
+    from multiverso_tpu.fault import RetryPolicy
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert RetryPolicy(attempts=3, backoff_s=0.001,
+                       seed=SEED).run(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_policy_exhausts_and_reraises(chaos):
+    from multiverso_tpu.fault import RetryPolicy
+
+    with pytest.raises(OSError, match="always"):
+        RetryPolicy(attempts=3, backoff_s=0.001, seed=SEED).run(
+            lambda: (_ for _ in ()).throw(OSError("always")))
+
+
+def test_retry_policy_schedule_is_deterministic_and_exponential(chaos):
+    from multiverso_tpu.fault import RetryPolicy
+
+    p = RetryPolicy(attempts=4, backoff_s=0.1, multiplier=2.0,
+                    jitter=0.1, seed=SEED)
+    a, b = list(p.delays()), list(p.delays())
+    assert a == b                       # same seed, same schedule
+    assert len(a) == 3
+    for i, d in enumerate(a):           # exponential within jitter bounds
+        base = 0.1 * 2.0 ** i
+        assert base * 0.9 <= d <= base * 1.1
+
+
+def test_retry_policy_deadline_stops_early(chaos):
+    import time
+
+    from multiverso_tpu.fault import RetryPolicy
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        # 10 attempts of 0.5 s backoff would take ~4.5 s; the 0.2 s
+        # deadline must cut the schedule off almost immediately.
+        RetryPolicy(attempts=10, backoff_s=0.5, jitter=0.0,
+                    deadline_s=0.2).run(
+            lambda: (_ for _ in ()).throw(OSError("down")))
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_retry_policy_does_not_catch_unlisted_errors(chaos):
+    from multiverso_tpu.fault import RetryPolicy
+
+    calls = []
+
+    def bug():
+        calls.append(1)
+        raise ValueError("a real bug, not a transient")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(attempts=5, backoff_s=0.001).run(bug)
+    assert len(calls) == 1              # no retry on non-transients
+
+
+# ------------------------------------------------------------- FaultInjector
+
+def test_injector_disabled_is_a_noop_with_zero_counters(chaos):
+    from multiverso_tpu import dashboard, fault
+
+    fault.inject("io.write")            # disarmed: must not raise
+    fault.inject("table.Add")
+    assert not fault.is_enabled()
+    monitors = dashboard.report(log=False)
+    assert not any(name.startswith("fault.") for name in monitors)
+
+
+def test_injector_times_budget_fires_exactly_n(chaos):
+    from multiverso_tpu import fault
+
+    fault.configure(seed=SEED, sites={"io.write": {"times": 2}})
+    for _ in range(2):
+        with pytest.raises(fault.FaultError, match="io.write"):
+            fault.inject("io.write")
+    fault.inject("io.write")            # budget spent: clean
+    assert fault.count("fault.io.write") == 2
+
+
+def test_injector_rate_is_deterministic_under_seed(chaos):
+    from multiverso_tpu import fault
+
+    def pattern():
+        fault.reset()
+        fault.configure(seed=SEED, sites={"op": 0.5})
+        hits = []
+        for _ in range(64):
+            try:
+                fault.inject("op")
+                hits.append(0)
+            except fault.FaultError:
+                hits.append(1)
+        return hits
+
+    a, b = pattern(), pattern()
+    assert a == b                       # same seed → same failure script
+    assert 0 < sum(a) < 64              # and it actually fires sometimes
+
+
+# ----------------------------------------------------------- injected seams
+
+def test_stream_write_faults_are_absorbed_by_checkpoint_retry(
+        tmp_path, chaos):
+    """Two injected write failures < the retry budget: save() succeeds
+    anyway and the ledger shows the retries."""
+    from multiverso_tpu import checkpoint, fault
+
+    chaos.init()
+    t = chaos.ArrayTable(8, name="t")
+    t.add(np.arange(8, dtype=np.float32))
+    fault.configure(seed=SEED,
+                    sites={"io.write": {"times": 2, "error": OSError}})
+    path = str(tmp_path / "ck.bin")
+    checkpoint.save(path, extra={"step": 1})
+    assert fault.count("fault.io.write") == 2
+    assert fault.count("retry.attempts") >= 2
+    fault.reset()
+    assert checkpoint.restore(path) == {"step": 1}
+    np.testing.assert_allclose(t.get(), np.arange(8))
+
+
+def test_stream_write_faults_beyond_budget_surface(tmp_path, chaos):
+    from multiverso_tpu import checkpoint, fault
+
+    chaos.init()
+    chaos.ArrayTable(8, name="t")
+    fault.configure(seed=SEED,
+                    sites={"io.write": {"times": 99, "error": OSError}})
+    with pytest.raises(OSError):
+        checkpoint.save(str(tmp_path / "ck.bin"))
+
+
+def test_table_op_fault_seam(chaos):
+    from multiverso_tpu import fault
+
+    chaos.init()
+    t = chaos.ArrayTable(4, name="t")
+    fault.configure(seed=SEED, sites={"table.Add": {"times": 1}})
+    with pytest.raises(fault.FaultError, match="table.Add"):
+        t.add(np.ones(4, np.float32))
+    t.add(np.ones(4, np.float32))       # budget spent: lands
+    np.testing.assert_allclose(t.get(), 1.0)
+    assert fault.count("fault.table.Add") == 1
+
+
+def test_barrier_timeout_names_the_sync_point(chaos):
+    """An injected straggler (the barrier seam sleeps past the deadline)
+    turns into BarrierTimeout naming the rendezvous — never a hang."""
+    from multiverso_tpu import fault
+    from multiverso_tpu.core.context import BarrierTimeout
+
+    chaos.init()
+    fault.configure(seed=SEED,
+                    sites={"barrier": {"delay_s": 3.0, "times": 1}})
+    with pytest.raises(BarrierTimeout, match="mvtpu_barrier"):
+        chaos.barrier(timeout_s=0.2)
+    fault.reset()
+    chaos.barrier(timeout_s=5.0)        # healthy rendezvous still works
+
+
+def test_barrier_timeout_flag_parity(chaos):
+    """The barrier_timeout_ms flag is the kwarg's default — native-flag
+    parity on the SPMD plane."""
+    from multiverso_tpu import config, fault
+    from multiverso_tpu.core.context import BarrierTimeout
+
+    chaos.init()
+    config.set_flag("barrier_timeout_ms", 200)
+    fault.configure(seed=SEED,
+                    sites={"barrier": {"delay_s": 3.0, "times": 1}})
+    with pytest.raises(BarrierTimeout):
+        chaos.barrier()
+
+
+# ------------------------------------------------- checkpoint corruption
+
+def test_truncated_checkpoint_raises_checkpoint_corrupt(tmp_path, chaos):
+    from multiverso_tpu import checkpoint
+
+    chaos.init()
+    t = chaos.ArrayTable(16, name="t")
+    t.add(np.ones(16, np.float32))
+    path = str(tmp_path / "ck.bin")
+    checkpoint.save(path)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:len(raw) // 2])       # killed mid-write
+    with pytest.raises(checkpoint.CheckpointCorrupt, match="truncated"):
+        checkpoint.restore(path)
+
+
+def test_bitflipped_checkpoint_raises_checkpoint_corrupt(tmp_path, chaos):
+    from multiverso_tpu import checkpoint
+
+    chaos.init()
+    t = chaos.ArrayTable(16, name="t")
+    t.add(np.ones(16, np.float32))
+    path = str(tmp_path / "ck.bin")
+    checkpoint.save(path)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) - 8] ^= 0xFF                          # storage bit rot
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(checkpoint.CheckpointCorrupt, match="CRC"):
+        checkpoint.restore(path)
+
+
+def test_legacy_v1_checkpoint_still_restores(tmp_path, chaos):
+    """Pre-CRC files (magic v1 + bare pickle) keep working — only
+    without the integrity check."""
+    import pickle
+
+    from multiverso_tpu import checkpoint
+
+    chaos.init()
+    t = chaos.ArrayTable(4, name="t")
+    t.add(np.full(4, 5.0, np.float32))
+    snap = {"clock": 0, "extra": {"legacy": True},
+            "tables": {"t": t.store_state()}}
+    path = str(tmp_path / "v1.bin")
+    with open(path, "wb") as f:
+        f.write(b"MVTPUCKPT1")
+        f.write(pickle.dumps(snap, protocol=4))
+    t.add(np.ones(4, np.float32))
+    assert checkpoint.restore(path) == {"legacy": True}
+    np.testing.assert_allclose(t.get(), 5.0)
+
+
+def test_checkpoint_manager_keeps_n_and_falls_back(tmp_path, chaos):
+    """keep=N rotation + restore_latest falling past a corrupt newest
+    snapshot to the previous good one."""
+    from multiverso_tpu import checkpoint
+
+    chaos.init()
+    t = chaos.ArrayTable(4, name="t")
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "ckpts"), keep=3)
+    for step in range(1, 5):
+        t.add(np.ones(4, np.float32))   # value == step
+        mgr.save_step(step, extra={"value": float(step)})
+    assert mgr.steps() == [2, 3, 4]     # step 1 pruned
+    files = sorted(os.listdir(tmp_path / "ckpts"))
+    assert len([f for f in files if f.endswith(".ckpt")]) == 3
+
+    # Corrupt the newest snapshot: resume lands on step 3.
+    newest = str(tmp_path / "ckpts" / "step_0000000004.ckpt")
+    raw = bytearray(open(newest, "rb").read())
+    raw[-4] ^= 0xFF
+    open(newest, "wb").write(bytes(raw))
+    step, extra = mgr.restore_latest()
+    assert step == 3 and extra == {"value": 3.0}
+    np.testing.assert_allclose(t.get(), 3.0)
+
+
+def test_checkpoint_manager_all_corrupt_raises(tmp_path, chaos):
+    from multiverso_tpu import checkpoint
+
+    chaos.init()
+    chaos.ArrayTable(4, name="t")
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "ckpts"), keep=2)
+    mgr.save_step(1)
+    for name in os.listdir(tmp_path / "ckpts"):
+        if name.endswith(".ckpt"):
+            p = str(tmp_path / "ckpts" / name)
+            open(p, "wb").write(b"garbage")
+    with pytest.raises(checkpoint.CheckpointCorrupt, match="no restorable"):
+        mgr.restore_latest()
+
+
+def test_checkpoint_manager_rebuilds_lost_manifest(tmp_path, chaos):
+    """The manifest is an index, not the source of truth: deleting it
+    must not orphan the snapshots."""
+    from multiverso_tpu import checkpoint
+
+    chaos.init()
+    t = chaos.ArrayTable(4, name="t")
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "ckpts"), keep=3)
+    t.add(np.ones(4, np.float32))
+    mgr.save_step(7, extra={"value": 1.0})
+    os.unlink(str(tmp_path / "ckpts" / checkpoint.CheckpointManager.MANIFEST))
+    step, extra = mgr.restore_latest()
+    assert step == 7 and extra == {"value": 1.0}
+
+
+# ------------------------------------------------------- native chaos tier
+
+pytestmark_native = pytest.mark.skipif(shutil.which("g++") is None,
+                                       reason="no C++ toolchain")
+
+
+def _machine_file(tmp_path, n=2):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = tmp_path / "machines.txt"
+    mf.write_text("".join(f"{e}\n" for e in eps))
+    return str(mf)
+
+
+def _binary():
+    b = os.path.join(NATIVE_DIR, "build", "mvtpu_test")
+    subprocess.run(["make", "-C", NATIVE_DIR, "-j4", "build/mvtpu_test"],
+                   check=True, capture_output=True, timeout=600)
+    return b
+
+
+def _run_ranks(scenario, mf, n):
+    procs = [subprocess.Popen([_binary(), scenario, mf, str(r)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(n)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=120)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outs
+
+
+@pytestmark_native
+def test_native_chaos_send_retry_then_succeed(tmp_path):
+    """Two injected write failures, bounded backoff, payload lands;
+    net.retries/fault.fail_send counters asserted inside the scenario."""
+    procs, outs = _run_ranks("chaos_retry", _machine_file(tmp_path), 2)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"CHAOS_RETRY_OK {r}" in out, out[-2000:]
+
+
+@pytestmark_native
+def test_native_chaos_drop_and_duplicate(tmp_path):
+    """A lossy then duplicating wire, one message each — shard values
+    and net.dropped/net.duplicated counters asserted in the scenario."""
+    procs, outs = _run_ranks("chaos_dropdup", _machine_file(tmp_path), 2)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"CHAOS_DROPDUP_OK {r}" in out, out[-2000:]
+
+
+@pytestmark_native
+def test_native_chaos_barrier_timeout_names_missing_rank(tmp_path):
+    """Zoo::Barrier with a deadline: rank 1 never arrives; rank 0 gets
+    rc=-3 within the deadline and the error NAMES rank 1."""
+    procs, outs = _run_ranks("chaos_barrier", _machine_file(tmp_path), 2)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"CHAOS_BARRIER_OK {r}" in out, out[-2000:]
+    assert "waiting for rank(s) 1" in outs[0], outs[0][-2000:]
+
+
+@pytestmark_native
+def test_native_chaos_heartbeat_reports_dead_peer(tmp_path):
+    """Leases on, rank 1 crashes: rank 0 reports the dead peer via
+    MV_DeadPeerCount + hb.missed without any call having to hang."""
+    procs, outs = _run_ranks("chaos_heartbeat", _machine_file(tmp_path), 2)
+    assert procs[0].returncode == 0, outs[0][-3000:]
+    assert "CHAOS_HB_OK 0" in outs[0], outs[0][-2000:]
+    assert "lease expired" in outs[0], outs[0][-2000:]
+    assert procs[1].returncode == 0, outs[1][-3000:]  # _exit(0) crash sim
+
+
+@pytestmark_native
+def test_native_chaos_disabled_counters_zero(tmp_path):
+    """Control run: no injection, identical workload — every injected-
+    path counter is exactly zero (asserted inside the scenario)."""
+    procs, outs = _run_ranks("chaos_quiet", _machine_file(tmp_path), 2)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"CHAOS_QUIET_OK {r}" in out, out[-2000:]

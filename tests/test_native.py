@@ -144,6 +144,30 @@ def test_native_dashboard(native):
     assert "ArrayWorker::Get" in report
 
 
+def test_native_fault_api_surface(native):
+    """The fault/monitor C API through ctypes: counters read 0 when
+    never fired, fault knobs validate their kinds, disarmed injection
+    changes nothing (the single-process twin of the chaos scenarios in
+    tests/test_fault.py)."""
+    assert native.query_monitor("no.such.counter") == 0
+    assert native.query_monitor("net.retries") == 0
+    assert native.dead_peer_count() == 0
+    native.set_fault_seed(1234)
+    native.set_fault("drop", 0.5)       # armed...
+    native.clear_faults()               # ...and disarmed again
+    with pytest.raises(RuntimeError, match="rc=-1"):
+        native.set_fault("no_such_kind", 0.5)
+    with pytest.raises(RuntimeError, match="rc=-1"):
+        native.set_fault("drop", 2.0)   # probability out of range
+    # Single-process: no wire, so even an armed injector is inert.
+    native.set_fault_n("drop", 5)
+    h = native.new_array_table(8)
+    native.array_add(h, np.ones(8, np.float32))
+    np.testing.assert_allclose(native.array_get(h, 8), 1.0)
+    native.clear_faults()
+    assert native.query_monitor("net.dropped") == 0
+
+
 def test_native_updater_math_matches_jax(mv):
     """SGD through the native server == SGD through the JAX table (float32).
 

@@ -134,6 +134,44 @@ def test_mv004_fires_on_unbounded_subprocess(tmp_path):
     assert [r for r, _ in rules] == ["MV004", "MV004"], rules
 
 
+def test_mv005_fires_on_unbounded_retry(tmp_path):
+    """Runtime code spinning `while True` around a swallow-all except
+    with no exit is an unbounded retry loop; adding any exit (break on
+    success, re-raise after a cap) or moving to tests/ silences it."""
+    src = """\
+        import time
+
+        def keep_alive(conn):
+            while True:
+                try:
+                    conn.send(b"ping")             # unbounded: BAD
+                except Exception:
+                    time.sleep(1)
+
+        def bounded(conn):
+            for attempt in range(5):
+                try:
+                    conn.send(b"ping")
+                    break
+                except Exception:
+                    time.sleep(1)
+
+        def drain(q):
+            while True:                            # bounded by break: fine
+                try:
+                    item = q.get()
+                except Exception:
+                    break
+                if item is None:
+                    return
+        """
+    rules = _lint_src(tmp_path, src, name="runtime_snippet.py")
+    assert [r for r, _ in rules] == ["MV005"], rules
+    # The identical loop inside a test file is exempt (tests may
+    # legitimately spin on a child process).
+    assert _lint_src(tmp_path, src, name="test_snippet.py") == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

@@ -31,6 +31,13 @@ Rules (docs/static_analysis.md has the full rationale):
   ``.communicate()``/``.wait()``): a hung child otherwise wedges the
   whole bench run instead of costing one section.
 
+- **MV005 unbounded-retry** — runtime code (not tests) may not spin a
+  ``while True`` loop whose broad ``except``/``except Exception``
+  swallows every failure with no exit (no ``break``/``return``/
+  ``raise`` anywhere in the loop): a persistent error then becomes a
+  silent busy-loop forever.  Bound it — ``fault.RetryPolicy`` is the
+  house schedule (attempt cap + exponential backoff + deadline).
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -211,6 +218,53 @@ def check_unbounded_subprocess(tree, path):
     return out
 
 
+def _walk_same_scope(node):
+    """Walk a statement subtree WITHOUT descending into nested function/
+    class bodies — a `break` inside a nested def cannot exit this loop."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def check_unbounded_retry(tree, path):
+    """MV005: `while True` + a swallow-all except and no way out."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.While)
+                and isinstance(node.test, ast.Constant)
+                and node.test.value is True):
+            continue
+        scope = list(_walk_same_scope(node))
+        # Any exit anywhere in the loop bounds it (break / return /
+        # re-raise — including inside handlers).
+        if any(isinstance(n, (ast.Break, ast.Return, ast.Raise))
+               for n in scope):
+            continue
+        for sub in scope:
+            if not isinstance(sub, ast.Try):
+                continue
+            for handler in sub.handlers:
+                broad = handler.type is None or (
+                    isinstance(handler.type, ast.Name)
+                    and handler.type.id in ("Exception", "BaseException"))
+                if broad:
+                    out.append(Finding(
+                        path, handler.lineno, "MV005",
+                        "unbounded retry: `while True` whose broad "
+                        "except swallows every failure with no "
+                        "break/return/raise — a persistent error spins "
+                        "forever; cap attempts + back off "
+                        "(fault.RetryPolicy)"))
+                    break
+    return out
+
+
 def lint_file(path):
     try:
         with open(path, "r", encoding="utf-8") as fh:
@@ -226,6 +280,11 @@ def lint_file(path):
         findings += check_host_sync_in_jit(tree, path)
     if os.path.basename(path).startswith("bench"):
         findings += check_unbounded_subprocess(tree, path)
+    # Runtime code only: a test may legitimately spin-wait on a child.
+    in_tests = (f"{os.sep}tests{os.sep}" in path or "/tests/" in path
+                or os.path.basename(path).startswith("test_"))
+    if not in_tests:
+        findings += check_unbounded_retry(tree, path)
     # Per-line suppressions.
     lines = src.splitlines()
     kept = []
