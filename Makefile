@@ -48,7 +48,15 @@ chaos:
 	MVTPU_FAULT_SEED=1234 JAX_PLATFORMS=cpu \
 	  $(PYTHON) -m pytest tests/test_fault.py -q -p no:cacheprovider
 
+# Observability smoke (docs/observability.md): a 2-process native
+# session with tracing on — bridges every Dashboard monitor via one
+# MV_DumpMonitors call, merges per-rank Chrome traces, and asserts a
+# worker Get span correlates with the remote server apply by trace id.
+metrics-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/metrics_demo.py
+
 clean:
 	$(MAKE) -C $(NATIVE) clean
 
-.PHONY: all test tsan asan analyze mvlint lint chaos clean
+.PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo clean

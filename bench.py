@@ -69,6 +69,74 @@ def _budget_left() -> float:
     return _BUDGET_S - (time.monotonic() - _T0)
 
 
+# ---------------------------------------------------------------------------
+# Incremental emission + per-benchmark latency percentiles.
+#
+# Round-5 lesson (BENCH_r05.json: rc=124, parsed null): the JSON line
+# printed only at exit, so `timeout`'s SIGTERM landing in an unlucky spot
+# (or the follow-up SIGKILL) cost the WHOLE trajectory.  Now every
+# completed section re-prints the full cumulative line — the last
+# parseable stdout line is always the freshest state, no matter how the
+# process dies.  Each section's measured iteration times also feed a
+# metrics histogram, so the line carries p50/p95/p99 per benchmark
+# (docs/observability.md; PERF.md).
+# ---------------------------------------------------------------------------
+_CURRENT_SECTION = None
+
+
+def _observe_iter(seconds: float) -> None:
+    """Feed one measured iteration into the running section's histogram."""
+    if _CURRENT_SECTION is not None:
+        from multiverso_tpu import metrics
+
+        metrics.histogram(f"bench.{_CURRENT_SECTION}").observe(seconds)
+
+
+def _section_percentiles(name: str, results: dict,
+                         wall_s: float) -> None:
+    """Flatten the section's latency percentiles into the results dict
+    (section wall time stands in when nothing sampled iterations)."""
+    from multiverso_tpu import metrics
+
+    h = metrics.histogram(f"bench.{name}")
+    if h.count == 0:
+        h.observe(wall_s)
+    for q, key in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+        results[f"{name}_{key}_ms"] = h.quantile(q) * 1e3
+
+
+def _render_line(results: dict, errors: list) -> dict:
+    for metric, unit, ratio_key in _PRIMARY:
+        if metric in results:
+            line = {
+                "metric": metric,
+                "value": round(results[metric], 1),
+                "unit": unit,
+                # LR: fused TPU path vs the measured 8-process
+                # native-wire run (the reference-mechanism baseline,
+                # bench_lr_native8); other primaries keep the
+                # same-hardware push-pull ratio.  The reference's OWN
+                # binary stays unmeasurable (mount empty).
+                "vs_baseline": round(results[ratio_key], 2)
+                if ratio_key and ratio_key in results else None,
+                "extras": {k: round(v, 2) for k, v in results.items()},
+            }
+            if errors:
+                line["errors"] = errors
+            return line
+    return {"metric": "bench_partial", "value": 0, "unit": "none",
+            "vs_baseline": None,
+            "extras": {k: round(v, 2) for k, v in results.items()},
+            "errors": list(errors)}
+
+
+def _emit(results: dict, errors: list) -> dict:
+    """Print the full cumulative JSON line NOW (last line wins)."""
+    line = _render_line(results, errors)
+    print(json.dumps(line), flush=True)
+    return line
+
+
 def _bounded(cap: float, floor: float = 30.0) -> float:
     """A subprocess timeout: at most ``cap``, at most the remaining wall
     budget, never under ``floor`` (a too-tight bound would turn a
@@ -85,6 +153,7 @@ def _time_loop(fn, *, warmup: int = 3, iters: int = 10) -> float:
         t0 = time.perf_counter()
         fn()
         times.append(time.perf_counter() - t0)
+        _observe_iter(times[-1])
     return float(np.median(times))
 
 
@@ -111,6 +180,7 @@ def _time_pipelined(enqueue, *, steps: int = 50, warmup: int = 5,
             r = enqueue()
         np.asarray(r)
         times.append((time.perf_counter() - t0) / steps)
+        _observe_iter(times[-1])
     return float(np.median(times))
 
 
@@ -1082,33 +1152,48 @@ def main() -> None:
     # of the north-star ledger the same way (VERDICT r4 action 1); also
     # adds wire_tcp_*/wire_mpi_* (direct transport sweep),
     # ssp_vs_bsp_speedup, longctx256k_*, and the w2v primary's
-    # vs_baseline becomes w2v_fused_vs_native8.
-    results = {"bench_schema": 6}
+    # vs_baseline becomes w2v_fused_vs_native8;
+    # 7 = incremental emission (the cumulative line re-prints after
+    # EVERY completed section — the last stdout line survives SIGTERM
+    # and SIGKILL alike) + per-benchmark latency percentiles
+    # (<section>_p50_ms/_p95_ms/_p99_ms from the measured iterations).
+    results = {"bench_schema": 7}
     errors = []
 
     # A budget SIGTERM lands mid-section: convert it to an exception so
     # the JSON accumulated so far still prints (the whole point of the
-    # one-line contract — a kill costs sections, not the line).
+    # one-line contract — a kill costs sections, not the line).  The
+    # per-section _emit below is the belt to this suspender: even an
+    # uncatchable SIGKILL only costs the in-flight section.
     def on_sigterm(signum, frame):
         raise _BudgetExceeded(f"signal {signum}")
 
+    global _CURRENT_SECTION
     prev_sigterm = signal.signal(signal.SIGTERM, on_sigterm)
     try:
         for section in _SECTIONS:
+            name = section.__name__
             if _budget_left() < 90:
-                errors.append(f"{section.__name__}: skipped "
+                errors.append(f"{name}: skipped "
                               f"({_budget_left():.0f}s of budget left)")
                 continue
+            _CURRENT_SECTION = name
+            t_section = time.monotonic()
             try:
                 results.update(section())
+                _section_percentiles(name, results,
+                                     time.monotonic() - t_section)
             except (_BudgetExceeded, KeyboardInterrupt) as exc:
-                errors.append(f"{section.__name__}: budget exceeded "
+                errors.append(f"{name}: budget exceeded "
                               f"({exc}); emitting partial results")
                 break
             except Exception as exc:  # keep every other section's numbers
                 traceback.print_exc()
                 errors.append(
-                    f"{section.__name__}: {type(exc).__name__}: {exc}")
+                    f"{name}: {type(exc).__name__}: {exc}")
+            finally:
+                _CURRENT_SECTION = None
+                _emit(results, errors)
     finally:
         signal.signal(signal.SIGTERM, prev_sigterm)
     if {"lr_native8_samples_per_sec",
@@ -1126,28 +1211,9 @@ def main() -> None:
     except Exception:
         traceback.print_exc()
 
-    for metric, unit, ratio_key in _PRIMARY:
-        if metric in results:
-            line = {
-                "metric": metric,
-                "value": round(results[metric], 1),
-                "unit": unit,
-                # LR: fused TPU path vs the measured 8-process
-                # native-wire run (the reference-mechanism baseline,
-                # bench_lr_native8); other primaries keep the
-                # same-hardware push-pull ratio.  The reference's OWN
-                # binary stays unmeasurable (mount empty).
-                "vs_baseline": round(results[ratio_key], 2)
-                if ratio_key and ratio_key in results else None,
-                "extras": {k: round(v, 2) for k, v in results.items()},
-            }
-            if errors:
-                line["errors"] = errors
-            print(json.dumps(line))
-            return
-    print(json.dumps({"metric": "bench_failed", "value": 0, "unit": "none",
-                      "vs_baseline": None, "errors": errors}))
-    sys.exit(1)
+    line = _emit(results, errors)
+    if line["metric"] == "bench_partial":
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -18,7 +18,7 @@ Top-level API mirrors the reference Python binding
 
 from __future__ import annotations
 
-from . import checkpoint, config, dashboard, fault, io
+from . import checkpoint, config, dashboard, fault, io, metrics, tracing
 from .core import (
     BarrierTimeout,
     barrier,
@@ -84,5 +84,5 @@ __all__ = [
     "create_table", "TableHandler", "ArrayTableHandler", "MatrixTableHandler",
     "AddOption", "GetOption", "get_updater",
     "config", "dashboard", "Log", "checkpoint", "io", "fault",
-    "BarrierTimeout",
+    "metrics", "tracing", "BarrierTimeout",
 ]

@@ -64,6 +64,9 @@ int MV_SetAddOption(float learning_rate, float momentum, float rho, float eps);
 int MV_StoreTable(int32_t handle, const char* path);
 int MV_LoadTable(int32_t handle, const char* path);
 int MV_QueryMonitor(const char* name, long long* count);
+int MV_SetTraceEnabled(int on);
+int MV_SetTraceId(long long trace_id);
+int MV_ClearSpans(void);
 int MV_SetFault(const char* kind, double rate);
 int MV_SetFaultN(const char* kind, long long n);
 int MV_SetFaultSeed(long long seed);
@@ -136,6 +139,20 @@ function mv.query_monitor(name)
   check(C.MV_QueryMonitor(name, c), "MV_QueryMonitor")
   return tonumber(c[0])
 end
+
+--- Span tracing (docs/observability.md): arm native span recording
+--- (worker ops, server applies, wire sends share cross-rank trace ids;
+--- dump via the C API's MV_DumpSpans from the host-side tooling).
+function mv.set_trace_enabled(on)
+  check(C.MV_SetTraceEnabled(on and 1 or 0), "MV_SetTraceEnabled")
+end
+
+--- Pin this thread's trace id for subsequent ops (0 = auto per-op ids).
+function mv.set_trace_id(id)
+  check(C.MV_SetTraceId(id), "MV_SetTraceId")
+end
+
+function mv.clear_spans() check(C.MV_ClearSpans(), "MV_ClearSpans") end
 
 --- Fault injection (chaos testing; docs/fault_tolerance.md): kind is
 --- drop|delay|dup|fail_send with a per-op probability, or delay_ms to

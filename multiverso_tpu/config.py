@@ -163,3 +163,12 @@ define_int("barrier_timeout_ms", 0,
            "forever (native-flag parity)")
 define_int("ckpt_keep", 3,
            "snapshots CheckpointManager retains behind its MANIFEST")
+define_int("metrics_flush_ms", 0,
+           "periodic metrics export interval: every interval the registry "
+           "renders to <trace_dir>/metrics_rank<r>.prom (Prometheus text; "
+           "debug log when no trace_dir); 0 (default) disables "
+           "(docs/observability.md)")
+define_string("trace_dir", "",
+              "arm span tracing and write trace_rank<r>.json (Chrome "
+              "trace-event JSON, Perfetto-loadable) here at shutdown; "
+              "merge ranks with tracing.merge_dir (docs/observability.md)")

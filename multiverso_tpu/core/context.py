@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
-from .. import config, dashboard
+from .. import config, dashboard, metrics, tracing
 from ..log import Log
 
 __all__ = [
@@ -244,6 +244,24 @@ def init(args: Optional[List[str]] = None,
 
         node = Node(rank=jax.process_index(), size=jax.process_count(),
                     role=role)
+
+        # Observability (docs/observability.md): -trace_dir arms span
+        # recording (shutdown writes trace_rank<r>.json there);
+        # -metrics_flush_ms starts the periodic Prometheus exporter.
+        # After the distributed bring-up so process_index() is final.
+        trace_dir = str(config.get("trace_dir"))
+        if trace_dir:
+            tracing.enable(rank=node.rank)
+        flush_ms = int(config.get("metrics_flush_ms"))
+        if flush_ms > 0:
+            import os
+
+            metrics.start_flush(
+                flush_ms,
+                path=os.path.join(trace_dir,
+                                  f"metrics_rank{node.rank}.prom")
+                if trace_dir else None)
+
         _CONTEXT = Context(mesh=mesh, node=node,
                            sync=sync_val,
                            updater_type=updater_val)
@@ -264,9 +282,20 @@ def shutdown(finalize: bool = True) -> None:
         if _CONTEXT is None:
             return
         _CONTEXT.barrier("mvtpu_shutdown")
+        # Observability teardown: final metrics flush, then the span
+        # export (-trace_dir), then the classic Dashboard dump — which
+        # now prints percentiles from the same registry.
+        metrics.stop_flush()
+        trace_dir = str(config.get("trace_dir"))
+        if trace_dir and tracing.enabled():
+            import os
+
+            os.makedirs(trace_dir, exist_ok=True)
+            tracing.save(tracing.default_trace_path(trace_dir))
         dashboard.report(log=True)
         if finalize:
             dashboard.reset()
+            tracing.clear()
         _CONTEXT = None
 
 

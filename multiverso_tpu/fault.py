@@ -15,9 +15,10 @@ Two pieces:
   change, zero counters.  Deterministic under :func:`configure`'s seed
   (env: ``MVTPU_FAULT_SEED``).
 
-Every injected event counts a Dashboard monitor ``fault.<site>``;
-every retry counts ``retry.attempts`` — the observable ledger the
-acceptance tests assert on.
+Every injected event counts a metrics-registry counter
+``fault.<site>``; every retry counts ``retry.attempts`` — the
+observable ledger the acceptance tests (and ``metrics.snapshot()``)
+read.
 """
 
 from __future__ import annotations
@@ -28,7 +29,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple, Type
 
-from . import dashboard
+from . import metrics
 from .log import Log
 
 __all__ = ["FaultError", "RetryPolicy", "configure", "inject", "reset",
@@ -44,15 +45,14 @@ class FaultError(RuntimeError):
 
 
 def _tick(name: str) -> None:
-    """Count one hit on a named monitor (zero-duration record)."""
-    m = dashboard.get_monitor(name)
-    m.end(m.begin())
+    """Count one hit on the named registry counter (the observable
+    ledger: fault.<site> / retry.attempts in metrics.snapshot())."""
+    metrics.counter(name).inc()
 
 
 def count(name: str) -> int:
-    """Current hit count of a monitor (0 when it never fired)."""
-    m = dashboard.report(log=False).get(name)
-    return m.count if m else 0
+    """Current hit count of a fault/retry counter (0 if it never fired)."""
+    return int(metrics.counter(name).value)
 
 
 # ---------------------------------------------------------------------------
@@ -167,11 +167,16 @@ def configure(seed: Optional[int] = None,
 
 
 def reset() -> None:
-    """Disarm completely (test isolation)."""
+    """Disarm completely and zero the counter ledger (test isolation)."""
     global _ENABLED
     with _LOCK:
         _SITES.clear()
         _ENABLED = False
+    for s in metrics.REGISTRY.series():
+        if isinstance(s, metrics.Counter) and (
+                s.name.startswith("fault.")
+                or s.name.startswith("retry.")):
+            metrics.REGISTRY.remove(s.name, s.labels or None)
 
 
 def _lookup(site: str) -> Optional[_Site]:

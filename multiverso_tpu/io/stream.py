@@ -5,6 +5,11 @@ Chaos seam: every LocalStream read/write passes ``fault.inject`` (sites
 ``io.read`` / ``io.write``) so the chaos suite can script transient IO
 failures that the checkpoint layer's RetryPolicy must absorb.  With the
 injector disarmed (the default) the seam is a single bool check.
+
+Observability: LocalStream counts bytes moved into the metrics registry
+(``io.bytes{dir=read|write}``), so checkpoint/trace IO volume shows up
+in ``metrics.snapshot()`` next to the op latencies
+(docs/observability.md).
 """
 
 from __future__ import annotations
@@ -12,9 +17,15 @@ from __future__ import annotations
 import os
 from typing import BinaryIO
 
-from .. import fault
+from .. import fault, metrics
 
 __all__ = ["Stream", "LocalStream", "HDFSStream", "StreamFactory"]
+
+# Looked up per call (a dict hit under the registry lock — noise next to
+# the file IO itself) so a metrics.reset() mid-run re-mints live series
+# instead of feeding detached ones.
+_READ_LABELS = {"dir": "read"}
+_WRITE_LABELS = {"dir": "write"}
 
 
 class Stream:
@@ -80,11 +91,15 @@ class LocalStream(Stream):
 
     def write(self, data: bytes) -> int:
         fault.inject("io.write")
-        return self._f.write(data)
+        n = self._f.write(data)
+        metrics.counter("io.bytes", _WRITE_LABELS).inc(n)
+        return n
 
     def read(self, size: int = -1) -> bytes:
         fault.inject("io.read")
-        return self._f.read(size)
+        data = self._f.read(size)
+        metrics.counter("io.bytes", _READ_LABELS).inc(len(data))
+        return data
 
     def seek(self, pos: int, whence: int = 0) -> int:
         return self._f.seek(pos, whence)

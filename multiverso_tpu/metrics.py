@@ -1,0 +1,512 @@
+"""Unified metrics registry — counters, gauges, and fixed-bucket
+histograms with p50/p95/p99 (docs/observability.md).
+
+The reference Multiverso only dumps named timers at shutdown
+(SURVEY.md §2.26); this registry is the superset every signal source in
+the port now feeds:
+
+- ``dashboard.py`` monitors (every table op, ``Zoo::Barrier``, jitted
+  steps) are histograms here — ``dashboard.monitor()`` stays as a shim;
+- ``fault.py`` injector/retry events are counters;
+- ``io/stream.py`` counts stream bytes;
+- ALL native ``Dashboard`` monitors (wire sends, server applies,
+  ``net.retries``/``hb.missed``, chaos counters) bridge in through one
+  ``MV_DumpMonitors`` call (:func:`bridge_native`).
+
+Surface: :func:`counter` / :func:`gauge` / :func:`histogram` mint (or
+look up) a series, optionally labeled (per-table, per-rank, ...);
+:func:`snapshot` renders everything to a plain dict;
+:func:`render_prometheus` emits Prometheus text format;
+:func:`start_flush` runs a periodic export thread gated by the
+``-metrics_flush_ms`` / ``-trace_dir`` flags (wired up by ``init()``).
+
+Thread safety: every series carries its own lock; the registry map has
+another.  A disabled-path observation costs one lock + a few adds.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from .log import Log
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry",
+    "counter", "gauge", "histogram", "snapshot", "render_prometheus",
+    "reset", "bridge_native", "start_flush", "stop_flush",
+    "NATIVE_TIME_BUCKETS", "DEFAULT_TIME_BUCKETS",
+]
+
+# Mirror of the native Dashboard's fixed log2 latency buckets
+# (mvtpu/dashboard.h kDashboardBuckets): bucket i holds values
+# <= 1e-6 * 2^i seconds, the implicit last bucket is +inf.  The two
+# lists MUST stay identical or bridged percentiles silently skew.
+NATIVE_TIME_BUCKETS: Tuple[float, ...] = tuple(
+    1e-6 * 2.0 ** i for i in range(27))
+DEFAULT_TIME_BUCKETS = NATIVE_TIME_BUCKETS
+
+# A labeled metric name may not explode into unbounded series (a bug
+# that labels by value — row id, msg id — would OOM the registry);
+# beyond the cap new label sets collapse into one overflow series.
+MAX_SERIES_PER_NAME = 256
+_OVERFLOW_LABELS = (("overflow", "true"),)
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _series_name(name: str, key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic count (events, bytes)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, key: Tuple[Tuple[str, str], ...] = ()):
+        self.name = name
+        self.labels = dict(key)
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """Point-in-time value (queue depth, dead peers, clock)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, key: Tuple[Tuple[str, str], ...] = ()):
+        self.name = name
+        self.labels = dict(key)
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated quantiles.
+
+    ``bounds`` are inclusive upper bucket bounds (ascending); one
+    implicit +inf bucket follows.  Quantiles interpolate linearly inside
+    the target bucket (clamped to the observed min/max), so with the
+    default log2 time buckets the p99 of a latency series is exact to
+    within one bucket ratio (2x) — the right fidelity for "where did
+    the time go" at zero allocation per observation.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, key: Tuple[Tuple[str, str], ...] = (),
+                 bounds: Iterable[float] = DEFAULT_TIME_BUCKETS):
+        self.name = name
+        self.labels = dict(key)
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(self.bounds):
+            raise ValueError(f"histogram bounds must ascend: {bounds}")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._min = math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = self._bucket_of(v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            if v > self._max:
+                self._max = v
+            if v < self._min:
+                self._min = v
+
+    def _bucket_of(self, v: float) -> int:
+        lo, hi = 0, len(self.bounds)
+        while lo < hi:              # first bound >= v (bisect_left)
+            mid = (lo + hi) // 2
+            if self.bounds[mid] < v:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    def _load(self, count: int, total: float, vmax: float,
+              bucket_counts: Iterable[int]) -> None:
+        """Replace state wholesale (the native-bridge import path)."""
+        counts = [int(c) for c in bucket_counts]
+        if len(counts) != len(self.bounds) + 1:
+            raise ValueError(
+                f"{self.name}: {len(counts)} bucket counts for "
+                f"{len(self.bounds)} bounds (+inf)")
+        with self._lock:
+            self._counts = counts
+            self._count = int(count)
+            self._sum = float(total)
+            self._max = float(vmax)
+            self._min = 0.0 if count else math.inf
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def max(self) -> float:
+        with self._lock:
+            return self._max
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Interpolated q-quantile (q in [0, 1]) of the observations."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if self._count == 0:
+                return 0.0
+            vmin, vmax = self._min, self._max
+            target = q * self._count
+            cum = 0
+            for i, c in enumerate(self._counts):
+                if c and cum + c >= target:
+                    lo = self.bounds[i - 1] if i > 0 else vmin
+                    hi = self.bounds[i] if i < len(self.bounds) else vmax
+                    v = lo + (hi - lo) * (target - cum) / c
+                    return max(min(v, vmax), vmin)
+                cum += c
+            return vmax
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            count, total, vmax = self._count, self._sum, self._max
+        return {
+            "type": "histogram",
+            "count": count,
+            "sum": total,
+            "max": vmax,
+            "mean": total / count if count else 0.0,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+
+
+class Registry:
+    """Name+labels -> series map; the process-global one is module-level."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Any] = {}
+        self._per_name: Dict[str, int] = {}
+
+    def _get(self, cls, name: str, labels: Optional[Dict[str, str]],
+             **kwargs: Any):
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get((name, key))
+            if s is not None:
+                if not isinstance(s, cls):
+                    raise TypeError(
+                        f"metric '{name}' already registered as {s.kind}")
+                return s
+            if key and self._per_name.get(name, 0) >= MAX_SERIES_PER_NAME:
+                # Cardinality guard: collapse, don't grow without bound.
+                key = _OVERFLOW_LABELS
+                s = self._series.get((name, key))
+                if s is not None:
+                    return s
+            s = cls(name, key, **kwargs)
+            self._series[(name, key)] = s
+            self._per_name[name] = self._per_name.get(name, 0) + 1
+            return s
+
+    def counter(self, name: str,
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str,
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str,
+                  labels: Optional[Dict[str, str]] = None,
+                  bounds: Iterable[float] = DEFAULT_TIME_BUCKETS
+                  ) -> Histogram:
+        return self._get(Histogram, name, labels, bounds=bounds)
+
+    def series(self):
+        with self._lock:
+            return list(self._series.values())
+
+    def remove(self, name: str,
+               labels: Optional[Dict[str, str]] = None) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            if self._series.pop((name, key), None) is not None:
+                self._per_name[name] = self._per_name.get(name, 1) - 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._per_name.clear()
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Every series as plain data, keyed ``name`` or ``name{k="v"}``."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for s in self.series():
+            out[_series_name(s.name, _label_key(s.labels))] = s.to_dict()
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (histograms with cumulative
+        ``_bucket{le=...}`` plus ``_sum``/``_count``)."""
+        lines = []
+        by_name: Dict[str, list] = {}
+        for s in self.series():
+            by_name.setdefault(s.name, []).append(s)
+        for name in sorted(by_name):
+            group = by_name[name]
+            pname = _prom_name(name)
+            lines.append(f"# TYPE {pname} {group[0].kind}")
+            for s in sorted(group, key=lambda x: _label_key(x.labels)):
+                key = _label_key(s.labels)
+                if isinstance(s, Histogram):
+                    with s._lock:
+                        counts = list(s._counts)
+                        total, count = s._sum, s._count
+                    cum = 0
+                    for bound, c in zip(s.bounds, counts):
+                        cum += c
+                        lines.append(
+                            f"{pname}_bucket"
+                            f"{_prom_labels(key, le=_fmt(bound))} {cum}")
+                    cum += counts[-1]
+                    lines.append(
+                        f"{pname}_bucket{_prom_labels(key, le='+Inf')} "
+                        f"{cum}")
+                    lines.append(
+                        f"{pname}_sum{_prom_labels(key)} {_fmt(total)}")
+                    lines.append(
+                        f"{pname}_count{_prom_labels(key)} {count}")
+                else:
+                    lines.append(
+                        f"{pname}{_prom_labels(key)} {_fmt(s.value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _prom_name(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        ok = ch.isalnum() and ch.isascii() or ch in "_:"
+        if ok and ch.isdigit() and i == 0:
+            ok = False
+        out.append(ch if ok else "_")
+    return "".join(out)
+
+
+def _prom_labels(key: Tuple[Tuple[str, str], ...], **extra: str) -> str:
+    items = list(key) + sorted(extra.items())
+    if not items:
+        return ""
+    return "{" + ",".join(f'{_prom_name(k)}="{v}"' for k, v in items) + "}"
+
+
+def _fmt(v: float) -> str:
+    return repr(float(v))
+
+
+# ---------------------------------------------------------------------------
+# Process-global registry + module-level convenience surface.
+# ---------------------------------------------------------------------------
+
+REGISTRY = Registry()
+
+
+def counter(name: str, labels: Optional[Dict[str, str]] = None) -> Counter:
+    return REGISTRY.counter(name, labels)
+
+
+def gauge(name: str, labels: Optional[Dict[str, str]] = None) -> Gauge:
+    return REGISTRY.gauge(name, labels)
+
+
+def histogram(name: str, labels: Optional[Dict[str, str]] = None,
+              bounds: Iterable[float] = DEFAULT_TIME_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, labels, bounds)
+
+
+def snapshot() -> Dict[str, Dict[str, Any]]:
+    return REGISTRY.snapshot()
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()
+
+
+def reset() -> None:
+    """Drop every series AND stop the flush thread (test isolation)."""
+    stop_flush()
+    REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# Native bridge: ALL Dashboard monitors in one MV_DumpMonitors call.
+# ---------------------------------------------------------------------------
+
+def parse_native_dump(text: str) -> Dict[str, Tuple[int, float, float,
+                                                    Tuple[int, ...]]]:
+    """Parse ``MV_DumpMonitors`` text → {name: (count, total, max,
+    bucket_counts)} (wire format documented in c_api.h)."""
+    out = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        name, count, total, vmax, buckets = line.split("\t")
+        out[name] = (int(count), float(total), float(vmax),
+                     tuple(int(b) for b in buckets.split(",")))
+    return out
+
+
+def bridge_native(runtime: Any, prefix: str = "native.") -> int:
+    """Import every native Dashboard monitor into the registry as a
+    ``<prefix><name>`` histogram (absolute state, so re-bridging after
+    more native work just refreshes).  ``runtime`` is a
+    ``native.NativeRuntime`` (anything with ``dump_monitors()``; a
+    ``dead_peer_count()`` rides along as a gauge when present).
+    Returns the number of monitors bridged.
+    """
+    dump = runtime.dump_monitors()
+    n = 0
+    for name, (count, total, vmax, buckets) in dump.items():
+        h = REGISTRY.histogram(prefix + name, bounds=NATIVE_TIME_BUCKETS)
+        h._load(count, total, vmax, buckets)
+        n += 1
+    dead = getattr(runtime, "dead_peer_count", None)
+    if dead is not None:
+        REGISTRY.gauge(prefix + "dead_peers").set(float(dead()))
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Periodic flush thread (gated by -metrics_flush_ms / -trace_dir).
+# ---------------------------------------------------------------------------
+
+_FLUSH_LOCK = threading.Lock()
+_FLUSHER: Optional["_Flusher"] = None
+
+
+class _Flusher(threading.Thread):
+    def __init__(self, interval_s: float, path: Optional[str]):
+        super().__init__(name="mvtpu-metrics-flush", daemon=True)
+        self.interval_s = interval_s
+        self.path = path
+        self._stop_evt = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            self.flush_once()
+
+    def flush_once(self) -> None:
+        try:
+            if self.path:
+                from .io.stream import LocalStream
+
+                with LocalStream(self.path, "wb", atomic=True) as s:
+                    s.write(render_prometheus().encode())
+            else:
+                snap = snapshot()
+                Log.debug("metrics flush: %d series", len(snap))
+        except Exception as exc:  # a flush must never kill training
+            Log.error("metrics flush failed: %s", exc)
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+
+def start_flush(interval_ms: int, path: Optional[str] = None) -> None:
+    """Start (or retarget) the periodic exporter: every ``interval_ms``
+    the registry is rendered to ``path`` (Prometheus text, atomic
+    replace) or, with no path, summarized to the debug log."""
+    global _FLUSHER
+    if interval_ms <= 0:
+        return
+    with _FLUSH_LOCK:
+        if _FLUSHER is not None:
+            _FLUSHER.stop()
+        _FLUSHER = _Flusher(interval_ms / 1e3, path)
+        _FLUSHER.start()
+
+
+def stop_flush(final_flush: bool = True) -> None:
+    global _FLUSHER
+    with _FLUSH_LOCK:
+        f, _FLUSHER = _FLUSHER, None
+    if f is not None:
+        f.stop()
+        f.join(timeout=5.0)
+        if final_flush:
+            f.flush_once()
+
+
+# Convenience timer mirroring dashboard.monitor but registry-native:
+#   with metrics.timed("io.open", {"scheme": "file"}): ...
+class timed:
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
+        self._h = histogram(name, labels)
+
+    def __enter__(self) -> "timed":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._h.observe(time.perf_counter() - self._t0)

@@ -135,6 +135,16 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_QueryMonitor.argtypes = [ctypes.c_char_p,
                                     ctypes.POINTER(ctypes.c_longlong)]
     lib.MV_QueryMonitor.restype = ctypes.c_int
+    lib.MV_DumpMonitors.argtypes = []
+    lib.MV_DumpMonitors.restype = ctypes.c_void_p
+    lib.MV_SetTraceEnabled.argtypes = [ctypes.c_int]
+    lib.MV_SetTraceEnabled.restype = ctypes.c_int
+    lib.MV_SetTraceId.argtypes = [ctypes.c_longlong]
+    lib.MV_SetTraceId.restype = ctypes.c_int
+    lib.MV_DumpSpans.argtypes = []
+    lib.MV_DumpSpans.restype = ctypes.c_void_p
+    lib.MV_ClearSpans.argtypes = []
+    lib.MV_ClearSpans.restype = ctypes.c_int
     lib.MV_SetFault.argtypes = [ctypes.c_char_p, ctypes.c_double]
     lib.MV_SetFault.restype = ctypes.c_int
     lib.MV_SetFaultN.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
@@ -399,6 +409,44 @@ class NativeRuntime:
                                              ctypes.byref(c)),
                     "MV_QueryMonitor")
         return c.value
+
+    def _dump_string(self, fn, what: str) -> str:
+        ptr = fn()
+        if not ptr:
+            raise RuntimeError(f"{what} returned NULL")
+        try:
+            return ctypes.cast(ptr, ctypes.c_char_p).value.decode()
+        finally:
+            self.lib.MV_FreeString(ptr)
+
+    # ------------------------------------------------- observability
+    def dump_monitors(self) -> dict:
+        """EVERY Dashboard monitor in one MV_DumpMonitors call:
+        {name: (count, total_s, max_s, bucket_counts)} — the enumeration
+        ``metrics.bridge_native`` imports (docs/observability.md)."""
+        from .. import metrics as _metrics
+
+        return _metrics.parse_native_dump(
+            self._dump_string(self.lib.MV_DumpMonitors,
+                              "MV_DumpMonitors"))
+
+    def set_trace_enabled(self, on: bool = True) -> None:
+        """Arm native span recording (also via the ``-trace`` flag)."""
+        self._check(self.lib.MV_SetTraceEnabled(1 if on else 0),
+                    "MV_SetTraceEnabled")
+
+    def set_trace_id(self, trace_id: int) -> None:
+        """Pin this thread's native trace id (0 = auto per-op ids) so
+        native spans nest under a host-side ``tracing.span``."""
+        self._check(self.lib.MV_SetTraceId(trace_id), "MV_SetTraceId")
+
+    def dump_spans(self) -> str:
+        """Raw MV_DumpSpans text (``tracing.parse_native_spans`` /
+        ``tracing.add_native_spans`` turn it into events)."""
+        return self._dump_string(self.lib.MV_DumpSpans, "MV_DumpSpans")
+
+    def clear_spans(self) -> None:
+        self._check(self.lib.MV_ClearSpans(), "MV_ClearSpans")
 
     # ------------------------------------------------- fault injection
     def set_fault(self, kind: str, rate: float) -> None:

@@ -107,6 +107,31 @@ void MV_FreeString(char* s);
 // chaos suite asserts `net.retries` / `net.dropped` / `hb.missed`.
 int MV_QueryMonitor(const char* name, long long* count);
 
+// ---- observability (docs/observability.md) ---------------------------
+// EVERY Dashboard monitor in one call (the enumeration the Python
+// metrics registry bridges instead of name-by-name MV_QueryMonitor):
+// one line per monitor, tab-separated
+//   name \t count \t total_s \t max_s \t b0,b1,...,b27
+// where bucket i counts observations <= 1e-6 * 2^i seconds (the last
+// bucket is +inf) — enough to reconstruct p50/p95/p99 host-side.
+// malloc'd; caller frees with MV_FreeString.
+char* MV_DumpMonitors(void);
+// Span recording: with tracing on, every monitored op (worker Get/Add,
+// server apply, wire send) records a wall-clock span tagged with a
+// trace id that PROPAGATES through message headers — a worker Get and
+// its server-side apply on another rank share the id.  `-trace=true`
+// arms it at MV_Init; these toggle it at runtime.
+int MV_SetTraceEnabled(int on);
+// Pin this thread's trace id for subsequent ops (0 = auto per-op ids);
+// lets a host-side tracer stitch native spans under its own span.
+int MV_SetTraceId(long long trace_id);
+// All recorded spans, one line each, tab-separated
+//   name \t trace_id \t ts_us \t dur_us \t rank \t tid
+// (ts_us is wall-clock, so per-rank dumps merge onto one timeline).
+// malloc'd; caller frees with MV_FreeString.
+char* MV_DumpSpans(void);
+int MV_ClearSpans(void);
+
 // ---- fault injection (mvtpu/fault.h; docs/fault_tolerance.md) --------
 // Chaos hooks on the wire plane, deterministic under MV_SetFaultSeed.
 // kinds: "drop" | "delay" | "dup" | "fail_send" (probability in [0,1]),

@@ -49,6 +49,11 @@ struct Message {
   MsgType type = MsgType::RequestGet;
   int32_t table_id = -1;
   int64_t msg_id = -1;
+  // Observability span id (0 = none): stamped by the worker-side op that
+  // originated the request, adopted by the server actor before
+  // ProcessGet/ProcessAdd, and echoed on replies — the cross-rank
+  // correlation key for merged traces (docs/observability.md).
+  int64_t trace_id = 0;
   std::vector<Blob> data;
 
   // Serialize to one contiguous buffer (header + per-blob length prefix) —

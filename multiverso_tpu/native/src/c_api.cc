@@ -336,11 +336,39 @@ int MV_LoadTable(int32_t handle, const char* path) {
   return rc;
 }
 
-char* MV_DashboardReport() {
-  std::string r = mvtpu::Dashboard::Report();
+namespace {
+char* MallocString(const std::string& r) {
   char* out = static_cast<char*>(malloc(r.size() + 1));
   std::memcpy(out, r.c_str(), r.size() + 1);
   return out;
+}
+}  // namespace
+
+char* MV_DashboardReport() {
+  return MallocString(mvtpu::Dashboard::Report());
+}
+
+char* MV_DumpMonitors(void) {
+  return MallocString(mvtpu::Dashboard::Dump());
+}
+
+int MV_SetTraceEnabled(int on) {
+  mvtpu::Dashboard::SetTraceEnabled(on != 0);
+  return 0;
+}
+
+int MV_SetTraceId(long long trace_id) {
+  mvtpu::Dashboard::SetThreadTraceId(static_cast<int64_t>(trace_id));
+  return 0;
+}
+
+char* MV_DumpSpans(void) {
+  return MallocString(mvtpu::Dashboard::DumpSpans());
+}
+
+int MV_ClearSpans(void) {
+  mvtpu::Dashboard::ClearSpans();
+  return 0;
 }
 
 void MV_FreeString(char* s) { free(s); }

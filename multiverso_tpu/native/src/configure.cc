@@ -191,6 +191,10 @@ void RegisterDefaults() {
               "lease expiry; <=0 derives 5*heartbeat_ms");
     DefineString("log_level", "info", "debug|info|error|fatal");
     DefineString("log_file", "", "optional log sink path");
+    DefineBool("trace", false,
+               "record per-op spans (worker Get/Add, server apply, wire "
+               "send) with cross-rank trace ids; dump via MV_DumpSpans "
+               "(docs/observability.md)");
   });
 }
 

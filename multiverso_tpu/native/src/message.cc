@@ -8,6 +8,7 @@ namespace {
 struct Header {
   int32_t src, dst, type, table_id;
   int64_t msg_id;
+  int64_t trace_id;
   int32_t num_blobs;
 };
 }  // namespace
@@ -18,7 +19,7 @@ Blob Message::Serialize() const {
   Blob out(total);
   char* p = out.data();
   Header h{src, dst, static_cast<int32_t>(type), table_id, msg_id,
-           static_cast<int32_t>(data.size())};
+           trace_id, static_cast<int32_t>(data.size())};
   std::memcpy(p, &h, sizeof(h));
   p += sizeof(h);
   for (const auto& b : data) {
@@ -42,6 +43,7 @@ Message Message::Deserialize(const Blob& buf) {
   m.type = static_cast<MsgType>(h.type);
   m.table_id = h.table_id;
   m.msg_id = h.msg_id;
+  m.trace_id = h.trace_id;
   m.data.reserve(h.num_blobs);
   for (int32_t i = 0; i < h.num_blobs; ++i) {
     int64_t len;

@@ -8,6 +8,7 @@
 #include <vector>
 
 #include "mvtpu/configure.h"
+#include "mvtpu/dashboard.h"
 #include "mvtpu/log.h"
 
 namespace mvtpu {
@@ -182,6 +183,8 @@ size_t MpiNet::OrphanedSendBufCount() {
 bool MpiNet::Send(int dst_rank, const Message& msg) {
   MpiApi& api = Api();
   if (!running_.load() || dst_rank < 0 || dst_rank >= size_) return false;
+  // Wire-send latency + trace span (same contract as TcpNet::Send).
+  Monitor mon("Net::Send", msg.trace_id);
   // Serialize OUTSIDE the MPI lock (full-payload copy).
   Blob wire = msg.Serialize();
   if (wire.size() > static_cast<size_t>(INT_MAX)) {

@@ -486,6 +486,10 @@ bool TcpNet::SendAttempt(int dst_rank, const Blob& wire) {
 bool TcpNet::Send(int dst_rank, const Message& msg) {
   if (dst_rank < 0 || dst_rank >= static_cast<int>(endpoints_.size()))
     return false;
+  // Wire-send latency (with percentile buckets via MV_DumpMonitors);
+  // the span shares the message's trace id, so a merged trace shows the
+  // hop that carried a Get between its worker and server spans.
+  Monitor mon("Net::Send", msg.trace_id);
   // Serialize BEFORE taking any send mutex — a full-payload copy inside
   // the critical section would queue every concurrent sender to this
   // rank behind it.

@@ -443,6 +443,10 @@ MessagePtr MakeReq(MsgType type, int32_t table_id, int64_t msg_id,
   req->type = type;
   req->table_id = table_id;
   req->msg_id = msg_id;
+  // Span propagation: the enclosing op's Monitor set the thread trace id
+  // (0 when tracing is off), and the server actor adopts it before the
+  // apply — worker op and server apply share one id across ranks.
+  req->trace_id = Dashboard::ThreadTraceId();
   req->src = Zoo::Get()->rank();
   req->dst = Zoo::Get()->server_rank(shard_idx);
   return req;

@@ -15,6 +15,26 @@ namespace mvtpu {
 
 namespace {
 
+// Adopt a wire message's trace id as this thread's span context for the
+// scope (restored on exit).  No-op when tracing is off or id == 0.
+class TraceScope {
+ public:
+  explicit TraceScope(int64_t trace_id) {
+    if (trace_id != 0 && Dashboard::TraceEnabled()) {
+      prev_ = Dashboard::ThreadTraceId();
+      Dashboard::SetThreadTraceId(trace_id);
+      set_ = true;
+    }
+  }
+  ~TraceScope() {
+    if (set_) Dashboard::SetThreadTraceId(prev_);
+  }
+
+ private:
+  bool set_ = false;
+  int64_t prev_ = 0;
+};
+
 // The actor chain worker → server → controller carries barrier messages
 // so every request enqueued before the barrier is processed before it
 // completes (the flush guarantee); across processes the server leg
@@ -74,8 +94,13 @@ class ServerActor : public Actor {
       reply->type = MsgType::ReplyGet;
       reply->table_id = m->table_id;
       reply->msg_id = m->msg_id;
+      reply->trace_id = m->trace_id;  // span id rides the full round trip
       reply->src = Zoo::Get()->rank();
       reply->dst = m->src;
+      // Adopt the requester's span id for the handler's duration so the
+      // server-side ProcessGet monitor's span (and any send it triggers)
+      // correlates with the worker's Get across ranks.
+      TraceScope scope(m->trace_id);
       table->ProcessGet(*m, reply.get());
       Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
     });
@@ -89,12 +114,14 @@ class ServerActor : public Actor {
                    m->table_id);
         return;
       }
+      TraceScope scope(m->trace_id);  // correlate apply with the Add
       table->ProcessAdd(*m);
       if (m->msg_id >= 0) {  // blocking add wants an ack
         auto reply = std::make_unique<Message>();
         reply->type = MsgType::ReplyAdd;
         reply->table_id = m->table_id;
         reply->msg_id = m->msg_id;
+        reply->trace_id = m->trace_id;
         reply->src = Zoo::Get()->rank();
         reply->dst = m->src;
         Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
@@ -264,6 +291,10 @@ bool Zoo::Start(int argc, const char* const* argv) {
     hb_running_ = true;
     hb_thread_ = std::thread([this] { HeartbeatLoop(); });
   }
+  // Observability: rank-salt span ids (and the pid column of span
+  // dumps); `-trace=true` arms span recording from the first op.
+  Dashboard::SetTraceRank(rank_);
+  if (configure::GetBool("trace")) Dashboard::SetTraceEnabled(true);
   started_ = true;
   Log::Info("mvtpu native runtime started (rank %d/%d, updater=%s)", rank_,
             size_, upd.c_str());

@@ -4,6 +4,7 @@
 //                        updater|checkpoint|threads
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cassert>
 #include <chrono>
@@ -95,6 +96,7 @@ static int TestMessage() {
   m.type = mvtpu::MsgType::RequestAdd;
   m.table_id = 7;
   m.msg_id = 99;
+  m.trace_id = 0x5551234;
   float payload[3] = {1.0f, 2.0f, 3.0f};
   int32_t rows[2] = {4, 5};
   m.data.emplace_back(payload, sizeof(payload));
@@ -103,11 +105,55 @@ static int TestMessage() {
   mvtpu::Message back = mvtpu::Message::Deserialize(wire);
   CHECK(back.src == 1 && back.dst == 2 && back.table_id == 7 &&
         back.msg_id == 99);
+  CHECK(back.trace_id == 0x5551234);
   CHECK(back.type == mvtpu::MsgType::RequestAdd);
   CHECK(back.data.size() == 2);
   CHECK(back.data[0].count<float>() == 3);
   CHECK(back.data[0].As<float>()[2] == 3.0f);
   CHECK(back.data[1].As<int32_t>()[1] == 5);
+  return 0;
+}
+
+static int TestDashboard() {
+  using mvtpu::Dashboard;
+  Dashboard::Reset();
+  Dashboard::Record("Unit::fast", 2e-6);   // bucket 1 (<= 2 µs)
+  Dashboard::Record("Unit::fast", 2e-6);
+  Dashboard::Record("Unit::slow", 1e-3);
+  long long c = 0;
+  double t = 0.0;
+  CHECK(Dashboard::Query("Unit::fast", &c, &t) && c == 2);
+  // One-call enumeration: both monitors, with bucket columns.
+  std::string dump = Dashboard::Dump();
+  CHECK(dump.find("Unit::fast\t2\t") != std::string::npos);
+  CHECK(dump.find("Unit::slow\t1\t") != std::string::npos);
+  CHECK(std::count(dump.begin(), dump.end(), '\n') == 2);
+  // Spans: a Monitor under tracing records one span; nested monitors on
+  // the same thread share the generated trace id.
+  Dashboard::SetTraceRank(3);
+  Dashboard::SetTraceEnabled(true);
+  {
+    mvtpu::Monitor outer("Unit::outer");
+    mvtpu::Monitor inner("Unit::inner");
+  }
+  Dashboard::SetTraceEnabled(false);
+  std::string spans = Dashboard::DumpSpans();
+  CHECK(spans.find("Unit::outer\t") != std::string::npos);
+  CHECK(spans.find("Unit::inner\t") != std::string::npos);
+  // Same trace id on both lines (field 2), carrying the rank-3 salt.
+  long long id_outer = 0, id_inner = 0;
+  CHECK(sscanf(spans.c_str() + spans.find("Unit::inner\t") + 12, "%lld",
+               &id_inner) == 1);
+  CHECK(sscanf(spans.c_str() + spans.find("Unit::outer\t") + 12, "%lld",
+               &id_outer) == 1);
+  CHECK(id_outer == id_inner);
+  CHECK((id_outer >> 40) == 4);  // rank + 1
+  // Thread-local cleaned up: next monitor outside tracing stays span-free.
+  CHECK(Dashboard::ThreadTraceId() == 0);
+  Dashboard::ClearSpans();
+  CHECK(Dashboard::DumpSpans().empty());
+  Dashboard::SetTraceRank(0);
+  Dashboard::Reset();
   return 0;
 }
 
@@ -1374,6 +1420,7 @@ int main(int argc, char** argv) {
   Case cases[] = {
       {"blob", TestBlob},         {"queue", TestQueue},
       {"configure", TestConfigure}, {"message", TestMessage},
+      {"dashboard", TestDashboard},
       {"updater", TestUpdater},   {"array", TestArray},
       {"matrix", TestMatrix},     {"sparse", TestSparseMatrix},
       {"checkpoint", TestCheckpoint},

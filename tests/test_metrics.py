@@ -1,0 +1,406 @@
+"""Tier-1 gate for the observability layer (docs/observability.md):
+metrics registry math, label cardinality, Prometheus rendering, the
+dashboard.monitor shim, Chrome-trace export, the one-call native
+bridge (MV_DumpMonitors), and span-id propagation worker -> server —
+in the in-process zoo and across a real 2-process wire session
+(tools/metrics_demo.py).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def registry():
+    """Fresh metrics + tracing state on both sides of a test."""
+    from multiverso_tpu import dashboard, metrics, tracing
+
+    dashboard.reset()
+    metrics.reset()
+    tracing.disable()
+    tracing.clear()
+    yield metrics
+    dashboard.reset()
+    metrics.reset()
+    tracing.disable()
+    tracing.clear()
+
+
+# ------------------------------------------------------------ histogram math
+
+def test_histogram_bucket_and_quantile_math(registry):
+    """Known distribution, unit-wide buckets: interpolated quantiles are
+    exact to within one bucket, min/max clamp, count/sum/mean hold."""
+    h = registry.histogram("t.uniform",
+                           bounds=[float(i) for i in range(1, 101)])
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.sum == pytest.approx(5050.0)
+    assert h.mean == pytest.approx(50.5)
+    assert h.max == 100.0
+    for q, want in ((0.50, 50.0), (0.95, 95.0), (0.99, 99.0)):
+        assert h.quantile(q) == pytest.approx(want, abs=1.0), q
+    assert h.quantile(0.0) <= 1.0
+    assert h.quantile(1.0) == 100.0
+
+
+def test_histogram_overflow_bucket_and_skew(registry):
+    """Values beyond the last bound land in +inf and quantiles clamp to
+    the observed max instead of inventing an upper bound."""
+    h = registry.histogram("t.skew", bounds=[1.0, 2.0, 4.0])
+    for _ in range(99):
+        h.observe(0.5)
+    h.observe(1000.0)                       # overflow bucket
+    assert h.quantile(0.5) <= 1.0
+    # The tail quantile lands in the +inf bucket, whose upper edge is
+    # the observed max (interpolated, clamped — never an invented bound).
+    assert 4.0 < h.quantile(0.999) <= 1000.0
+    assert h.quantile(1.0) == pytest.approx(1000.0)
+
+
+def test_histogram_rejects_unsorted_bounds(registry):
+    with pytest.raises(ValueError):
+        registry.histogram("t.bad", bounds=[2.0, 1.0])
+
+
+def test_counter_and_gauge(registry):
+    c = registry.counter("t.count")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == pytest.approx(3.5)
+    g = registry.gauge("t.gauge")
+    g.set(7)
+    g.dec(2)
+    assert g.value == pytest.approx(5.0)
+    snap = registry.snapshot()
+    assert snap["t.count"] == {"type": "counter", "value": 3.5}
+    assert snap["t.gauge"]["value"] == 5.0
+
+
+# ------------------------------------------------------------------- labels
+
+def test_labels_mint_distinct_series(registry):
+    a = registry.counter("t.lbl", labels={"table": "a"})
+    b = registry.counter("t.lbl", labels={"table": "b"})
+    assert a is not b
+    a.inc(1)
+    b.inc(2)
+    # Same labels -> same series, key order irrelevant.
+    assert registry.counter("t.lbl", {"table": "a"}) is a
+    snap = registry.snapshot()
+    assert snap['t.lbl{table="a"}']["value"] == 1
+    assert snap['t.lbl{table="b"}']["value"] == 2
+
+
+def test_label_cardinality_cap_collapses_to_overflow(registry):
+    for i in range(registry.MAX_SERIES_PER_NAME + 50):
+        registry.counter("t.card", labels={"k": str(i)}).inc()
+    snap = registry.snapshot()
+    series = [k for k in snap if k.startswith("t.card")]
+    # Capped: the explosion collapsed into one overflow series.
+    assert len(series) <= registry.MAX_SERIES_PER_NAME + 1
+    assert snap['t.card{overflow="true"}']["value"] >= 50
+
+
+def test_type_collision_raises(registry):
+    registry.counter("t.kind")
+    with pytest.raises(TypeError):
+        registry.gauge("t.kind")
+
+
+# -------------------------------------------------------------- prometheus
+
+def _parse_prom(text):
+    """Tiny exposition parser: {series_line_name: float} + type map."""
+    values, types = {}, {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split()
+            types[name] = kind
+            continue
+        name, value = line.rsplit(" ", 1)
+        values[name] = float(value)
+    return values, types
+
+
+def test_prometheus_rendering_round_trip(registry):
+    registry.counter("req_total", {"table": "emb"}).inc(5)
+    registry.gauge("depth").set(2)
+    h = registry.histogram("lat", bounds=[0.1, 1.0])
+    for v in (0.05, 0.5, 2.0):
+        h.observe(v)
+    values, types = _parse_prom(registry.render_prometheus())
+    assert types == {"req_total": "counter", "depth": "gauge",
+                     "lat": "histogram"}
+    assert values['req_total{table="emb"}'] == 5.0
+    assert values["depth"] == 2.0
+    # Cumulative buckets + sum/count.
+    assert values['lat_bucket{le="0.1"}'] == 1.0
+    assert values['lat_bucket{le="1.0"}'] == 2.0
+    assert values['lat_bucket{le="+Inf"}'] == 3.0
+    assert values["lat_count"] == 3.0
+    assert values["lat_sum"] == pytest.approx(2.55)
+
+
+def test_prometheus_name_sanitization(registry):
+    registry.histogram("ArrayTable::Get")         # valid (colons legal)
+    registry.counter("io.bytes", {"dir": "read"})  # dots -> underscores
+    text = registry.render_prometheus()
+    assert "ArrayTable::Get_count" in text
+    assert 'io_bytes{dir="read"}' in text
+
+
+# ------------------------------------------------------- dashboard.monitor shim
+
+def test_dashboard_monitor_shim_parity(registry):
+    """The legacy monitor()/report() surface holds (count/total_s/max_s)
+    AND every monitor shows up in metrics.snapshot() with percentiles."""
+    from multiverso_tpu import dashboard
+
+    with dashboard.monitor("Shim::Op"):
+        pass
+    with dashboard.monitor("Shim::Op"):
+        pass
+    mons = dashboard.report(log=False)
+    m = mons["Shim::Op"]
+    assert m.count == 2
+    assert m.total_s >= 0.0
+    assert m.max_s >= 0.0
+    assert m.mean_ms >= 0.0
+    assert m.p50_ms <= m.p99_ms <= m.max_s * 1e3 + 1e-6
+    assert "p50" in str(m) and "p99" in str(m)
+    snap = registry.snapshot()
+    assert snap["Shim::Op"]["count"] == 2
+    assert {"p50", "p95", "p99"} <= set(snap["Shim::Op"])
+    # reset() drops the registry series too (no ghost accumulation).
+    dashboard.reset()
+    assert "Shim::Op" not in registry.snapshot()
+
+
+def test_table_ops_report_percentiles(registry, mv):
+    """Acceptance: every table op exposes p50/p95/p99 via snapshot()."""
+    import numpy as np
+
+    mv.init()
+    t = mv.ArrayTable(16, name="t_metrics")
+    t.add(np.ones(16, np.float32), sync=True)
+    t.get()
+    snap = registry.snapshot()
+    for op in ("ArrayTable::Add", "ArrayTable::Get"):
+        assert op in snap, sorted(snap)
+        assert {"p50", "p95", "p99"} <= set(snap[op])
+        assert snap[op]["count"] >= 1
+
+
+def test_fault_and_io_counters_land_in_snapshot(registry, tmp_path):
+    from multiverso_tpu import fault
+    from multiverso_tpu.io.stream import LocalStream
+
+    fault.reset()
+    fault.configure(sites={"io.write": {"times": 1}})
+    with pytest.raises(fault.FaultError):
+        fault.inject("io.write")
+    p = str(tmp_path / "f.bin")
+    with LocalStream(p, "wb") as s:
+        s.write(b"x" * 100)
+    with LocalStream(p, "rb") as s:
+        s.read()
+    snap = registry.snapshot()
+    assert snap["fault.io.write"]["value"] == 1
+    assert snap['io.bytes{dir="write"}']["value"] >= 100
+    assert snap['io.bytes{dir="read"}']["value"] >= 100
+    fault.reset()
+    assert "fault.io.write" not in registry.snapshot()
+
+
+# ------------------------------------------------------------ flush thread
+
+def test_flush_thread_writes_prometheus_file(registry, tmp_path):
+    from multiverso_tpu import metrics
+
+    registry.counter("flush.me").inc(3)
+    path = str(tmp_path / "metrics.prom")
+    metrics.start_flush(10, path=path)
+    try:
+        import time
+
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not os.path.exists(path):
+            time.sleep(0.02)
+    finally:
+        metrics.stop_flush()
+    assert os.path.exists(path)
+    assert "flush_me 3.0" in open(path).read()
+
+
+# ------------------------------------------------------------- chrome trace
+
+def test_chrome_trace_schema_and_merge(registry, tmp_path):
+    from multiverso_tpu import tracing
+
+    tracing.enable(rank=1)
+    with tracing.span("Test::outer", detail="x") as tid:
+        with tracing.span("Test::inner"):
+            pass
+    assert tid != 0 and (tid >> 40) == 2        # rank salt
+    evts = tracing.events()
+    assert {e.name for e in evts} == {"Test::outer", "Test::inner"}
+    assert len({e.trace_id for e in evts}) == 1  # nested spans share ids
+
+    p1 = str(tmp_path / "trace_rank1.json")
+    tracing.save(p1)
+    doc = json.load(open(p1))
+    assert doc["displayTimeUnit"] == "ms"
+    for e in doc["traceEvents"]:
+        assert {"name", "ph", "ts", "dur", "pid", "tid", "args"} <= set(e)
+        assert e["ph"] == "X"
+        assert e["pid"] == 1
+        assert e["args"]["trace_id"].startswith("0x")
+    # A second rank's file merges onto one timeline.
+    other = {"traceEvents": [{"name": "Remote::op", "ph": "X", "ts": 1,
+                              "dur": 2, "pid": 0, "tid": 7, "args": {}}],
+             "displayTimeUnit": "ms"}
+    with open(tmp_path / "trace_rank0.json", "w") as f:
+        json.dump(other, f)
+    merged = tracing.merge_dir(str(tmp_path))
+    mdoc = json.load(open(merged))
+    names = [e["name"] for e in mdoc["traceEvents"]]
+    assert "Remote::op" in names and "Test::outer" in names
+    # Re-merging skips the previous merge file (no event doubling).
+    n = len(json.load(open(tracing.merge_dir(str(tmp_path))))["traceEvents"])
+    assert n == len(names)
+
+
+def test_span_disabled_is_free(registry):
+    from multiverso_tpu import tracing
+
+    with tracing.span("Never::recorded") as tid:
+        assert tid == 0
+    assert tracing.events() == []
+
+
+# ------------------------------------------------------------- native plane
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def native_rt():
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    rt = nat.NativeRuntime(args=["-updater_type=default",
+                                 "-log_level=error"])
+    yield rt
+    rt.shutdown()
+
+
+@needs_gxx
+def test_native_bridge_one_call_enumeration(registry, native_rt):
+    """MV_DumpMonitors: every native Dashboard monitor arrives in one
+    call, with bucket detail enough for host-side percentiles."""
+    import numpy as np
+
+    h = native_rt.new_array_table(32)
+    native_rt.array_add(h, np.ones(32, np.float32))
+    native_rt.array_get(h, 32)
+    dump = native_rt.dump_monitors()
+    for op in ("ArrayWorker::Get", "ArrayWorker::Add",
+               "ArrayServer::ProcessGet", "ArrayServer::ProcessAdd"):
+        assert op in dump, sorted(dump)
+        count, total, vmax, buckets = dump[op]
+        assert count >= 1 and total >= 0.0 and vmax >= 0.0
+        assert len(buckets) == 28 and sum(buckets) == count
+    n = registry.bridge_native(native_rt)
+    assert n >= len(dump) - 1            # dead_peers gauge not counted
+    snap = registry.snapshot()
+    assert {"p50", "p95", "p99"} <= set(snap["native.ArrayWorker::Get"])
+    assert snap["native.dead_peers"]["value"] == 0.0
+    # Legacy name-by-name query agrees with the enumeration.
+    assert native_rt.query_monitor("ArrayWorker::Get") == \
+        dump["ArrayWorker::Get"][0]
+
+
+@needs_gxx
+def test_native_span_propagation_in_process_zoo(registry, native_rt):
+    """Worker op and server-side apply share one trace id through the
+    in-process zoo (message-header propagation, the same mechanism the
+    wire uses)."""
+    import numpy as np
+
+    from multiverso_tpu import tracing
+
+    native_rt.clear_spans()
+    native_rt.set_trace_enabled(True)
+    try:
+        h = native_rt.new_matrix_table(8, 4)
+        native_rt.matrix_add_rows(h, [1, 3], np.ones((2, 4), np.float32))
+        native_rt.matrix_get_rows(h, [1, 3], 4)
+    finally:
+        native_rt.set_trace_enabled(False)
+    evts = tracing.parse_native_spans(native_rt.dump_spans())
+    by_name = {}
+    for e in evts:
+        by_name.setdefault(e.name, []).append(e)
+    assert "MatrixWorker::GetRows" in by_name, sorted(by_name)
+    assert "MatrixServer::ProcessGet" in by_name, sorted(by_name)
+    get_ids = {e.trace_id for e in by_name["MatrixWorker::GetRows"]}
+    assert any(e.trace_id in get_ids
+               for e in by_name["MatrixServer::ProcessGet"])
+    add_ids = {e.trace_id for e in by_name["MatrixWorker::AddRows"]}
+    assert any(e.trace_id in add_ids
+               for e in by_name["MatrixServer::ProcessAdd"])
+    assert get_ids.isdisjoint(add_ids)   # per-op ids, not one blob
+    native_rt.clear_spans()
+    assert native_rt.dump_spans() == ""
+
+
+@needs_gxx
+def test_native_pinned_trace_id_nests_under_host_span(registry, native_rt):
+    """NativeRuntime.set_trace_id stitches native spans under a Python
+    tracing span's id (the cross-plane correlation path)."""
+    import numpy as np
+
+    from multiverso_tpu import tracing
+
+    tracing.enable(rank=0)
+    native_rt.clear_spans()
+    native_rt.set_trace_enabled(True)
+    try:
+        h = native_rt.new_array_table(8)
+        with tracing.span("host.step") as tid:
+            native_rt.set_trace_id(tid)
+            try:
+                native_rt.array_get(h, 8)
+            finally:
+                native_rt.set_trace_id(0)
+    finally:
+        native_rt.set_trace_enabled(False)
+    evts = tracing.parse_native_spans(native_rt.dump_spans())
+    assert any(e.name == "ArrayWorker::Get" and e.trace_id == tid
+               for e in evts), evts
+    native_rt.clear_spans()
+
+
+@needs_gxx
+def test_metrics_demo_two_process_trace(tmp_path):
+    """The acceptance smoke end-to-end: a 2-process wire session emits a
+    merged Chrome trace where a worker Get and the remote server apply
+    share a trace id (tools/metrics_demo.py, `make metrics-demo`)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "metrics_demo.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO)
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    assert "METRICS_DEMO_OK" in out.stdout

@@ -172,6 +172,33 @@ def test_mv005_fires_on_unbounded_retry(tmp_path):
     assert _lint_src(tmp_path, src, name="test_snippet.py") == []
 
 
+def test_mv006_fires_on_print_in_library(tmp_path):
+    """Library code (the multiverso_tpu package, apps/ exempt) may not
+    print() or mint ad-hoc loggers — output must route through
+    multiverso_tpu.log.Log so -log_level/-log_file keep working."""
+    d = tmp_path / "multiverso_tpu"
+    d.mkdir()
+    src = """\
+        import logging
+        from .log import Log
+
+        def noisy(x):
+            print("value:", x)                          # BAD
+            log = logging.getLogger(__name__)           # BAD
+            anon = logging.getLogger()                  # BAD
+            named = logging.getLogger("multiverso_tpu") # explicit sink: fine
+            Log.info("value: %s", x)                    # the house logger
+        """
+    rules = _lint_src(d, src)
+    assert [r for r, _ in rules] == ["MV006", "MV006", "MV006"], rules
+    # The same code inside apps/ (executable worker scripts whose stdout
+    # IS their protocol) and tests/ is exempt.
+    apps = d / "apps"
+    apps.mkdir()
+    assert _lint_src(apps, src) == []
+    assert _lint_src(d, src, name="test_snippet.py") == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

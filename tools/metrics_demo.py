@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""metrics-demo — CI-style smoke for the observability export path
+(docs/observability.md; `make metrics-demo`).
+
+Runs a short TWO-PROCESS native session over the loopback TcpNet wire
+with tracing armed (`-trace=true`), then:
+
+1. each rank bridges every native Dashboard monitor into the Python
+   metrics registry through ONE ``MV_DumpMonitors`` call and writes its
+   spans (worker Get/Add, server apply, wire Send — trace ids propagated
+   through message headers) as Chrome trace-event JSON;
+2. the parent merges the per-rank files with ``tracing.merge_dir`` and
+   asserts the merged trace holds a worker-side ``Get`` span and a
+   server-side apply span from the OTHER rank sharing one trace id;
+3. the parent asserts the bridged snapshot carries p50/p95/p99 for the
+   table ops and the wire send.
+
+Prints ``METRICS_DEMO_OK`` and exits 0 on success.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def child(machine_file: str, rank: int, trace_dir: str) -> int:
+    import numpy as np
+
+    from multiverso_tpu import metrics, native as nat, tracing
+
+    rt = nat.NativeRuntime(args=[f"-machine_file={machine_file}",
+                                 f"-rank={rank}", "-trace=true",
+                                 "-log_level=error"])
+    tracing.enable(rank=rank)
+    h = rt.new_array_table(64)          # sharded across both ranks
+    rt.barrier()
+    with tracing.span("demo.step", rank=str(rank)):
+        rt.array_add(h, np.ones(64, np.float32))
+        out = rt.array_get(h, 64)
+    rt.barrier()                         # both ranks' adds applied
+    assert out.shape == (64,)
+
+    # One-call native enumeration -> registry -> percentile snapshot.
+    n = metrics.bridge_native(rt)
+    snap = metrics.snapshot()
+    for op in ("native.ArrayWorker::Get", "native.Net::Send"):
+        assert op in snap and "p99" in snap[op], sorted(snap)
+    # Both planes into one per-rank Chrome trace file.
+    tracing.add_native_spans(rt)
+    tracing.save(tracing.default_trace_path(trace_dir))
+    rt.barrier()                         # nobody tears down early
+    rt.shutdown()
+    print(f"METRICS_DEMO_CHILD_OK rank={rank} monitors={n}")
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) == 4:               # child mode
+        return child(sys.argv[1], int(sys.argv[2]), sys.argv[3])
+
+    from multiverso_tpu import native as nat, tracing
+
+    nat.ensure_built()
+    nprocs = 2
+    socks = [socket.socket() for _ in range(nprocs)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    workdir = tempfile.mkdtemp(prefix="mvtpu_metrics_demo_")
+    mf = os.path.join(workdir, "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+    trace_dir = os.path.join(workdir, "traces")
+    os.makedirs(trace_dir, exist_ok=True)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), mf, str(r), trace_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+        for r in range(nprocs)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or f"METRICS_DEMO_CHILD_OK rank={r}" not in out:
+            print(f"rank {r} failed:\n{out[-3000:]}", file=sys.stderr)
+            return 1
+
+    merged = tracing.merge_dir(trace_dir)
+    with open(merged) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert events, "merged trace is empty"
+
+    # Cross-rank correlation: a worker Get span and a server-side apply
+    # span recorded on the OTHER rank must share one trace id.
+    by_id = {}
+    for e in events:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            by_id.setdefault(tid, []).append(e)
+    linked = [
+        tid for tid, evs in by_id.items()
+        if any(e["name"] == "ArrayWorker::Get" for e in evs)
+        and any(e["name"] == "ArrayServer::ProcessGet"
+                and e["pid"] != next(x["pid"] for x in evs
+                                     if x["name"] == "ArrayWorker::Get")
+                for e in evs)
+    ]
+    assert linked, (
+        "no worker Get correlated with a remote server apply; ids: "
+        + str(list(by_id)[:10]))
+    print(f"METRICS_DEMO_OK merged={len(events)} spans, "
+          f"{len(linked)} cross-rank Get trace(s) -> {merged}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,15 @@ Rules (docs/static_analysis.md has the full rationale):
   silent busy-loop forever.  Bound it — ``fault.RetryPolicy`` is the
   house schedule (attempt cap + exponential backoff + deadline).
 
+- **MV006 print-in-library** — library code (the ``multiverso_tpu``
+  package, minus the executable ``apps/`` worker scripts) must not call
+  ``print()`` or mint ad-hoc loggers via ``logging.getLogger(__name__)``
+  / ``logging.getLogger()``: output that bypasses
+  ``multiverso_tpu.log.Log`` ignores the ``-log_level``/``-log_file``
+  flags, interleaves across ranks, and is invisible to the file sink a
+  postmortem reads.  Route through ``Log`` (named getLogger calls with
+  an explicit sink string — ``log.py`` itself — stay legal).
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -265,6 +274,36 @@ def check_unbounded_retry(tree, path):
     return out
 
 
+def check_print_in_library(tree, path):
+    """MV006: print()/getLogger(__name__) in library code — use Log."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "print":
+            out.append(Finding(
+                path, node.lineno, "MV006",
+                "print() in library code bypasses the leveled logger "
+                "(-log_level/-log_file are ignored and ranks interleave) "
+                "— route through multiverso_tpu.log.Log"))
+        # logging.getLogger(__name__) / logging.getLogger(): an ad-hoc
+        # logger outside the configured multiverso_tpu sink hierarchy.
+        if (isinstance(f, ast.Attribute) and f.attr == "getLogger"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "logging"):
+            anonymous = (not node.args
+                         or (isinstance(node.args[0], ast.Name)
+                             and node.args[0].id == "__name__"))
+            if anonymous:
+                out.append(Finding(
+                    path, node.lineno, "MV006",
+                    "logging.getLogger(__name__) in library code mints a "
+                    "logger outside the configured multiverso_tpu sinks "
+                    "— route through multiverso_tpu.log.Log"))
+    return out
+
+
 def lint_file(path):
     try:
         with open(path, "r", encoding="utf-8") as fh:
@@ -285,6 +324,13 @@ def lint_file(path):
                 or os.path.basename(path).startswith("test_"))
     if not in_tests:
         findings += check_unbounded_retry(tree, path)
+    # Library code only: apps/ are executable worker scripts whose
+    # stdout IS their protocol (NATIVE_LR_OK markers etc.).
+    in_library = (("multiverso_tpu" in path)
+                  and f"{os.sep}apps{os.sep}" not in path
+                  and "/apps/" not in path and not in_tests)
+    if in_library:
+        findings += check_print_in_library(tree, path)
     # Per-line suppressions.
     lines = src.splitlines()
     kept = []
