@@ -56,7 +56,17 @@ metrics-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/metrics_demo.py
 
+# Hot-path serve smoke (docs/serving.md): a 2-process wire session
+# proving (a) 8 concurrent gets coalesce into <= 2 round trips, (b)
+# repeat reads in the staleness bound are served with ZERO wire
+# messages, (c) -server_inflight_max=1 sheds retry and converge with
+# no lost adds under injected wire chaos.
+serve-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/serve_demo.py
+
 clean:
 	$(MAKE) -C $(NATIVE) clean
 
-.PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo clean
+.PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
+        serve-demo clean

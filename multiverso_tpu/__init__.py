@@ -18,7 +18,8 @@ Top-level API mirrors the reference Python binding
 
 from __future__ import annotations
 
-from . import checkpoint, config, dashboard, fault, io, metrics, tracing
+from . import (checkpoint, config, dashboard, fault, io, metrics, serve,
+               tracing)
 from .core import (
     BarrierTimeout,
     barrier,

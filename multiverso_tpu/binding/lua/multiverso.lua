@@ -64,6 +64,10 @@ int MV_SetAddOption(float learning_rate, float momentum, float rho, float eps);
 int MV_StoreTable(int32_t handle, const char* path);
 int MV_LoadTable(int32_t handle, const char* path);
 int MV_QueryMonitor(const char* name, long long* count);
+int MV_TableVersion(int32_t handle, long long* version);
+int MV_LastVersion(int32_t handle, long long* version);
+int MV_CacheStats(long long* hits, long long* misses);
+int MV_ServeQueueDepth(void);
 int MV_SetTraceEnabled(int on);
 int MV_SetTraceId(long long trace_id);
 int MV_ClearSpans(void);
@@ -138,6 +142,37 @@ function mv.query_monitor(name)
   local c = ffi.new("long long[1]")
   check(C.MV_QueryMonitor(name, c), "MV_QueryMonitor")
   return tonumber(c[0])
+end
+
+--- Serve layer (docs/serving.md): version probe — the table's current
+--- max server-side version in ONE header-only round trip (the cheap
+--- cache-validation alternative to a full fetch).  rc -6 = the server
+--- shed the probe under -server_inflight_max backpressure (retryable).
+function mv.table_version(handle)
+  local v = ffi.new("long long[1]")
+  check(C.MV_TableVersion(handle, v), "MV_TableVersion")
+  return tonumber(v[0])
+end
+
+--- Highest version stamp observed in any reply to this process — a
+--- free local lower bound on the server version (no wire traffic).
+function mv.last_version(handle)
+  local v = ffi.new("long long[1]")
+  check(C.MV_LastVersion(handle, v), "MV_LastVersion")
+  return tonumber(v[0])
+end
+
+--- Native worker-side row-cache counters: returns hits, misses.
+function mv.cache_stats()
+  local h = ffi.new("long long[1]")
+  local m = ffi.new("long long[1]")
+  check(C.MV_CacheStats(h, m), "MV_CacheStats")
+  return tonumber(h[0]), tonumber(m[0])
+end
+
+--- Server-actor mailbox backlog (the -server_inflight_max gauge).
+function mv.serve_queue_depth()
+  return check(C.MV_ServeQueueDepth(), "MV_ServeQueueDepth")
 end
 
 --- Span tracing (docs/observability.md): arm native span recording

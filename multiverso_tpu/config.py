@@ -172,3 +172,26 @@ define_string("trace_dir", "",
               "arm span tracing and write trace_rank<r>.json (Chrome "
               "trace-event JSON, Perfetto-loadable) here at shutdown; "
               "merge ranks with tracing.merge_dir (docs/observability.md)")
+
+# --- serve layer (docs/serving.md) -----------------------------------------
+define_int("serve_cache_entries", 0,
+           "versioned client cache size (entries) for table reads; 0 "
+           "(default) disables the serve cache — tables and ServeClient "
+           "read this at construction")
+define_int("max_staleness", 0,
+           "serve-cache staleness bound in VERSIONS (server-side "
+           "applies a served read may be behind); 0 = cached reads are "
+           "never stale.  Distinct from the SSP -staleness clock bound "
+           "(docs/serving.md maps the two)")
+define_double("coalesce_window_us", 200.0,
+              "request-coalescing window: concurrent/adjacent reads on "
+              "one table arriving within this window merge into one "
+              "wire round trip (0 = only truly concurrent calls merge)")
+define_int("serve_max_batch", 64,
+           "size cap per coalescing window — a full batch seals (and "
+           "executes) early")
+define_double("version_lease_ms", 50.0,
+              "how long a learned server version stays trusted before "
+              "a cached read pays a header-only version probe; 0 = "
+              "probe every cached read (never stale even at "
+              "max_staleness=0, at one tiny round trip per read)")

@@ -10,7 +10,11 @@ Two pieces:
 - The **fault injector** — a process-global seam the chaos suite
   (``tests/test_fault.py``) uses to script failures at named sites:
   ``io.read`` / ``io.write`` (Streams), ``table.<Op>`` (every eager
-  table op), ``barrier`` (``context.host_sync``).  Disabled (the
+  table op), ``barrier`` (``context.host_sync``), and the serve layer
+  (docs/serving.md): ``serve.busy`` fires inside the wire fetch
+  (configure it with ``error=native.BusyError`` to script shed storms
+  the RetryPolicy must absorb) and ``serve.stale`` fires at the
+  cache-hit decision, forcing that read to miss.  Disabled (the
   default) :func:`inject` is a single bool check — zero behavior
   change, zero counters.  Deterministic under :func:`configure`'s seed
   (env: ``MVTPU_FAULT_SEED``).

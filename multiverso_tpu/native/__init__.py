@@ -24,7 +24,18 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["ensure_built", "load", "NativeRuntime", "lib_path"]
+__all__ = ["ensure_built", "load", "NativeRuntime", "lib_path",
+           "BusyError"]
+
+
+class BusyError(RuntimeError):
+    """A server SHED the request under ``-server_inflight_max``
+    backpressure (C API rc -6; docs/serving.md).
+
+    Retryable — and unlike the indeterminate rc -3, the server did NO
+    work, so a retry cannot double-apply.  ``fault.RetryPolicy`` with
+    ``retry_on=(BusyError,)`` is the house backoff (the serve client
+    wires this up by default)."""
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB = os.path.join(_DIR, "build", "libmvtpu.so")
@@ -155,6 +166,15 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_ClearFaults.restype = ctypes.c_int
     lib.MV_DeadPeerCount.argtypes = []
     lib.MV_DeadPeerCount.restype = ctypes.c_int
+    for name in ("MV_TableVersion", "MV_LastVersion"):
+        getattr(lib, name).argtypes = [ctypes.c_int32,
+                                       ctypes.POINTER(ctypes.c_longlong)]
+        getattr(lib, name).restype = ctypes.c_int
+    lib.MV_CacheStats.argtypes = [ctypes.POINTER(ctypes.c_longlong),
+                                  ctypes.POINTER(ctypes.c_longlong)]
+    lib.MV_CacheStats.restype = ctypes.c_int
+    lib.MV_ServeQueueDepth.argtypes = []
+    lib.MV_ServeQueueDepth.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -471,7 +491,48 @@ class NativeRuntime:
         """Peers with expired heartbeat leases (rank 0, -heartbeat_ms)."""
         return self.lib.MV_DeadPeerCount()
 
+    # ------------------------------------------------- serve layer
+    def table_version(self, handle: int) -> int:
+        """Current max server-side version of the table (docs/serving.md)
+        — ONE header-only wire round trip (the cheap cache-validation
+        probe), not a full fetch.  Raises :class:`BusyError` when a
+        server shed it under ``-server_inflight_max``."""
+        v = ctypes.c_longlong(0)
+        self._check(self.lib.MV_TableVersion(handle, ctypes.byref(v)),
+                    "MV_TableVersion")
+        return v.value
+
+    def last_version(self, handle: int) -> int:
+        """Highest version stamp observed in any reply to this process
+        (free local lower bound on the server version — no wire)."""
+        v = ctypes.c_longlong(0)
+        self._check(self.lib.MV_LastVersion(handle, ctypes.byref(v)),
+                    "MV_LastVersion")
+        return v.value
+
+    def cache_stats(self) -> tuple:
+        """(hits, misses) of the native worker-side row cache (the
+        sparse matrix table); the Python serve cache counts separately
+        in the metrics registry (serve.cache.*)."""
+        h = ctypes.c_longlong(0)
+        m = ctypes.c_longlong(0)
+        self._check(self.lib.MV_CacheStats(ctypes.byref(h),
+                                           ctypes.byref(m)),
+                    "MV_CacheStats")
+        return h.value, m.value
+
+    def serve_queue_depth(self) -> int:
+        """Server-actor mailbox backlog (the -server_inflight_max
+        gauge)."""
+        d = self.lib.MV_ServeQueueDepth()
+        self._check(min(d, 0), "MV_ServeQueueDepth")
+        return d
+
     @staticmethod
     def _check(rc: int, what: str) -> None:
+        if rc == -6:
+            raise BusyError(
+                f"{what} shed by server backpressure "
+                f"(-server_inflight_max) — retry after backoff")
         if rc != 0:
             raise RuntimeError(f"{what} failed with rc={rc}")

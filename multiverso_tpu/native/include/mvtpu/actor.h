@@ -29,6 +29,9 @@ class Actor {
   void Start();          // spawn the mailbox-drain thread
   void Stop();           // push Exit, join
   void Receive(MessagePtr msg) { mailbox_.Push(std::move(msg)); }
+  // Mailbox backlog (messages queued behind the one being processed) —
+  // the serve layer's inflight measure (-server_inflight_max).
+  size_t QueueSize() const { return mailbox_.Size(); }
 
  protected:
   using Handler = std::function<void(MessagePtr&)>;

@@ -6,7 +6,10 @@
 // -1 bad args / not started, -2 unknown handle, -3 unreachable peer or
 // `-rpc_timeout_ms`/`-barrier_timeout_ms` deadline expired (fail-fast
 // instead of hanging on a dead rank), -4 shard (de)serialization
-// failed, -5 local stream open failed (an IO problem, NOT peer death).
+// failed, -5 local stream open failed (an IO problem, NOT peer death),
+// -6 a server SHED the request under `-server_inflight_max`
+// backpressure (docs/serving.md) — retryable after backoff, and unlike
+// -3 it is NOT indeterminate: the server did no work.
 // A -3 from a DEADLINE is indeterminate, not at-most-once: a slow
 // server may still apply the Add after the caller gave up (a blind
 // retry can double-apply), and a timed-out Get's output buffer may be
@@ -131,6 +134,26 @@ int MV_SetTraceId(long long trace_id);
 // malloc'd; caller frees with MV_FreeString.
 char* MV_DumpSpans(void);
 int MV_ClearSpans(void);
+
+// ---- serve layer (docs/serving.md) -----------------------------------
+// Version probe: one header-only round trip filling *version with the
+// max CURRENT version over every server shard of the table — the cheap
+// alternative to a full fetch when a client must validate a cached
+// copy.  Every server-side apply bumps the table's monotonic version
+// (row/key adds bump per-bucket versions; replies stamp the version
+// covering the data they serve).  rc: 0 / -1 / -2 / -3 / -6.
+int MV_TableVersion(int32_t handle, long long* version);
+// The highest version stamp observed in ANY reply to this process's
+// worker stub (Get payloads and blocking-Add acks) — a FREE local
+// lower bound on the server version, no wire traffic.
+int MV_LastVersion(int32_t handle, long long* version);
+// Native worker-side cache counters (the sparse matrix row cache):
+// calls fully served from cache vs calls that paid a wire fetch
+// (Dashboard serve.cache.hit / serve.cache.miss).
+int MV_CacheStats(long long* hits, long long* misses);
+// Current server-actor mailbox backlog — the queue-depth gauge behind
+// `-server_inflight_max` shedding.  >= 0; -1 when not started.
+int MV_ServeQueueDepth(void);
 
 // ---- fault injection (mvtpu/fault.h; docs/fault_tolerance.md) --------
 // Chaos hooks on the wire plane, deterministic under MV_SetFaultSeed.

@@ -30,6 +30,19 @@ enum class MsgType : int32_t {
   ControlReply = 17,
   ControlBarrier = 18,
   ControlBarrierReply = 19,
+  // Serve layer (docs/serving.md): version probe.  A read-optimized
+  // client that holds a cached copy asks for the table's CURRENT
+  // version instead of paying a full fetch — the request's `version`
+  // field carries a bucket index (>= 0) for bucket-granular tables
+  // (KV/matrix) or -1 for the whole table; the reply's `version` field
+  // carries the answer.
+  RequestVersion = 8,
+  ReplyVersion = 9,
+  // Serve backpressure shed (docs/serving.md): the server actor's
+  // mailbox exceeded `-server_inflight_max`, so this Get/probe was
+  // answered WITHOUT processing.  Retryable — and unlike a deadline -3
+  // it is not indeterminate: the server did no work.
+  ReplyBusy = 10,
   // SSP clock announcement (msg_id = the worker's new clock).  Rides
   // each worker->server connection BEHIND that clock's adds (FIFO), so
   // "min worker clock >= c" implies every rank's adds through clock c
@@ -54,6 +67,12 @@ struct Message {
   // ProcessGet/ProcessAdd, and echoed on replies — the cross-rank
   // correlation key for merged traces (docs/observability.md).
   int64_t trace_id = 0;
+  // Serve-layer version stamp (docs/serving.md): every server-side
+  // apply bumps a per-table (and per-row-bucket) monotonic counter;
+  // replies carry the version covering the data they serve so clients
+  // can bound cache staleness.  On a RequestVersion it instead carries
+  // the REQUESTED bucket (-1 = whole table).  0 = unversioned.
+  int64_t version = 0;
   std::vector<Blob> data;
 
   // Serialize to one contiguous buffer (header + per-blob length prefix) —

@@ -11,6 +11,7 @@
 #pragma once
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <memory>
 #include <string>
@@ -53,6 +54,9 @@ inline int OwnerOf(int64_t index, int64_t n, int size) {
 // ---------------------------------------------------------------- server
 class ServerTable {
  public:
+  ServerTable() {
+    for (auto& b : bucket_versions_) b.store(0, std::memory_order_relaxed);
+  }
   virtual ~ServerTable() = default;
   // Fill reply blobs for a get request.
   virtual void ProcessGet(const Message& req, Message* reply) = 0;
@@ -61,6 +65,40 @@ class ServerTable {
   // one file per rank, the reference's per-server dump model).
   virtual bool Store(Stream* out) const = 0;
   virtual bool Load(Stream* in) = 0;
+
+  // ---- serve-layer versions (docs/serving.md) ------------------------
+  // Every ProcessAdd bumps a per-shard monotonic counter; row/key adds
+  // additionally stamp the touched BUCKETS, so a read of untouched
+  // buckets can report an older (still-valid) version and client caches
+  // miss less.  Replies stamp the version covering the data they serve.
+  static constexpr int kVersionBuckets = 64;
+  int64_t version() const {
+    return version_.load(std::memory_order_acquire);
+  }
+  int64_t bucket_version(int b) const {
+    if (b < 0 || b >= kVersionBuckets) return version();
+    return bucket_versions_[b].load(std::memory_order_acquire);
+  }
+
+ protected:
+  // bucket < 0 stamps EVERY bucket (whole-table adds).
+  void BumpVersion(int64_t bucket = -1) {
+    int64_t v = version_.fetch_add(1, std::memory_order_acq_rel) + 1;
+    if (bucket < 0) {
+      for (auto& b : bucket_versions_) b.store(v, std::memory_order_release);
+    } else {
+      bucket_versions_[bucket % kVersionBuckets].store(
+          v, std::memory_order_release);
+    }
+  }
+  static int RowBucket(int64_t row) {
+    return static_cast<int>(((row % kVersionBuckets) + kVersionBuckets) %
+                            kVersionBuckets);
+  }
+
+ private:
+  std::atomic<int64_t> version_{0};
+  std::atomic<int64_t> bucket_versions_[kVersionBuckets];
 };
 
 class ArrayServerTable : public ServerTable {
@@ -134,6 +172,7 @@ class AsyncGetHandle {
   int64_t msg_id_;          // -1: empty request, trivially complete
   std::shared_ptr<Waiter> waiter_;  // shared with pending_ (see Notify)
   bool failed_ GUARDED_BY(table_->mu_) = false;  // written by Notify
+  bool busy_ GUARDED_BY(table_->mu_) = false;    // ReplyBusy shed
   // Owner-thread state (only the thread driving Wait()/~ touches these;
   // no lock, so they carry no capability annotation).
   bool waited_ = false;
@@ -155,6 +194,24 @@ class WorkerTable {
   // Clock boundary hook (Zoo::Barrier success): worker-side caches drop
   // entries here — peers' adds from the closed clock are now visible.
   virtual void OnClockInvalidate() {}
+
+  // ---- serve layer (docs/serving.md) ---------------------------------
+  // Highest server-side version stamp observed in ANY reply to this
+  // worker stub — a free (no wire) lower bound on the server version,
+  // refreshed by every Get/Add ack.
+  int64_t last_version() const {
+    return last_version_.load(std::memory_order_acquire);
+  }
+  // Cheap wire probe: fills *version with the max CURRENT version over
+  // every server shard (`bucket >= 0` asks one bucket of a KV/matrix
+  // table).  One tiny header-only round trip instead of a full fetch.
+  // False on dead shard / deadline / busy-shed (see last_call_busy).
+  bool QueryVersion(int64_t* version, int bucket = -1);
+  // True when THIS THREAD's most recent blocking round trip (Get/Add/
+  // QueryVersion/Wait) failed because a server SHED it under
+  // `-server_inflight_max` backpressure (ReplyBusy) rather than dying
+  // or timing out — the retryable case (C API rc -6 vs -3).
+  static bool last_call_busy();
 
  protected:
   // Send all reqs (same msg_id) via the Zoo, block until each got its
@@ -187,8 +244,10 @@ class WorkerTable {
     void* arg;
     int remaining;
     bool* failed;
+    bool* busy = nullptr;  // set when a shard answered ReplyBusy
   };
   std::unordered_map<int64_t, Pending> pending_ GUARDED_BY(mu_);
+  std::atomic<int64_t> last_version_{0};
 };
 
 class ArrayWorkerTable : public WorkerTable {

@@ -92,6 +92,16 @@ class Zoo {
   bool MaybeHoldGet(MessagePtr& msg);
   void OnClockTick(int src_rank, int64_t clock);
 
+  // ---- serve backpressure (docs/serving.md) ---------------------------
+  // Current server-actor mailbox backlog (the inflight gauge MV_Serve-
+  // QueueDepth exposes); 0 when the runtime is down.
+  int ServeQueueDepth();
+  // With `-server_inflight_max=N` > 0: when the backlog still queued
+  // behind the request being processed reaches N, answer `msg` with a
+  // retryable ReplyBusy (no table work) and return true.  Gets and
+  // version probes only — adds are never shed ("no lost adds").
+  bool ShedIfOverloaded(MessagePtr& msg);
+
   // Deliver to a LOCAL actor's mailbox.
   void SendTo(const std::string& actor_name, MessagePtr msg);
 

@@ -22,6 +22,11 @@ thread_local AddOption g_add_option;
 
 int RequireStarted() { return Zoo::Get()->started() ? 0 : -1; }
 
+// Failure rc for a blocking table round trip: -6 when a server SHED it
+// under -server_inflight_max (retryable, no work done), -3 otherwise
+// (dead shard / deadline — indeterminate; see the header contract).
+int FailRc() { return mvtpu::WorkerTable::last_call_busy() ? -6 : -3; }
+
 // Outstanding MV_GetAsync* tickets.  Tickets index AsyncGetHandles so
 // the FFI surface stays integer-only; MV_WaitGet consumes the entry.
 Mutex g_gets_mu;
@@ -95,7 +100,7 @@ int MV_GetArrayTable(int32_t handle, float* data, int64_t size) {
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->array_worker(handle);
   if (!t) return -2;
-  return t->Get(data, size) ? 0 : -3;
+  return t->Get(data, size) ? 0 : FailRc();
 }
 
 static int AddArray(int32_t handle, const float* delta, int64_t size,
@@ -103,7 +108,7 @@ static int AddArray(int32_t handle, const float* delta, int64_t size,
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->array_worker(handle);
   if (!t) return -2;
-  return t->Add(delta, size, g_add_option, blocking) ? 0 : -3;
+  return t->Add(delta, size, g_add_option, blocking) ? 0 : FailRc();
 }
 
 int MV_AddArrayTable(int32_t h, const float* d, int64_t n) {
@@ -129,14 +134,14 @@ int MV_GetMatrixTableAll(int32_t handle, float* data, int64_t /*size*/) {
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->matrix_worker(handle);
   if (!t) return -2;
-  return t->GetAll(data) ? 0 : -3;
+  return t->GetAll(data) ? 0 : FailRc();
 }
 
 static int AddMatrixAll(int32_t handle, const float* delta, bool blocking) {
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->matrix_worker(handle);
   if (!t) return -2;
-  return t->AddAll(delta, g_add_option, blocking) ? 0 : -3;
+  return t->AddAll(delta, g_add_option, blocking) ? 0 : FailRc();
 }
 
 int MV_AddMatrixTableAll(int32_t h, const float* d, int64_t) {
@@ -152,7 +157,7 @@ int MV_GetMatrixTableByRows(int32_t handle, float* data,
   if (RequireStarted()) return -1;
   auto* t = Zoo::Get()->matrix_worker(handle);
   if (!t) return -2;
-  return t->GetRows(row_ids, num_rows, data) ? 0 : -3;
+  return t->GetRows(row_ids, num_rows, data) ? 0 : FailRc();
 }
 
 static int AddMatrixRows(int32_t handle, const float* delta,
@@ -163,7 +168,7 @@ static int AddMatrixRows(int32_t handle, const float* delta,
   if (!t) return -2;
   return t->AddRows(row_ids, num_rows, delta, g_add_option, blocking)
              ? 0
-             : -3;
+             : FailRc();
 }
 
 int MV_AddMatrixTableByRows(int32_t h, const float* d, const int32_t* ids,
@@ -205,7 +210,7 @@ int MV_WaitGet(int32_t wait_handle) {
     h = std::move(it->second);
     Gets().erase(it);
   }
-  return h->Wait() ? 0 : -3;  // Wait outside the registry lock
+  return h->Wait() ? 0 : FailRc();  // Wait outside the registry lock
 }
 
 int MV_CancelGet(int32_t wait_handle) {
@@ -249,7 +254,7 @@ int MV_GetKV(int32_t handle, const char* key, float* value) {
   if (RequireStarted() || !key || !value) return -1;
   auto* t = Zoo::Get()->kv_worker(handle);
   if (!t) return -2;
-  return t->Get({std::string(key)}, value) ? 0 : -3;
+  return t->Get({std::string(key)}, value) ? 0 : FailRc();
 }
 
 static int AddKV(int32_t handle, const char* key, float delta,
@@ -257,7 +262,7 @@ static int AddKV(int32_t handle, const char* key, float delta,
   if (RequireStarted() || !key) return -1;
   auto* t = Zoo::Get()->kv_worker(handle);
   if (!t) return -2;
-  return t->Add({std::string(key)}, &delta, g_add_option, blocking) ? 0 : -3;
+  return t->Add({std::string(key)}, &delta, g_add_option, blocking) ? 0 : FailRc();
 }
 
 int MV_AddKV(int32_t h, const char* key, float delta) {
@@ -273,7 +278,7 @@ int MV_GetKVBatch(int32_t handle, const char* keys, const int32_t* key_lens,
     return -1;
   auto* t = Zoo::Get()->kv_worker(handle);
   if (!t) return -2;
-  return t->Get(SplitKeys(keys, key_lens, num_keys), values) ? 0 : -3;
+  return t->Get(SplitKeys(keys, key_lens, num_keys), values) ? 0 : FailRc();
 }
 
 int MV_AddKVBatch(int32_t handle, const char* keys, const int32_t* key_lens,
@@ -285,7 +290,7 @@ int MV_AddKVBatch(int32_t handle, const char* keys, const int32_t* key_lens,
   return t->Add(SplitKeys(keys, key_lens, num_keys), deltas, g_add_option,
                 true)
              ? 0
-             : -3;
+             : FailRc();
 }
 
 int MV_SetAddOption(float learning_rate, float momentum, float rho,
@@ -400,5 +405,39 @@ int MV_ClearFaults(void) {
 }
 
 int MV_DeadPeerCount(void) { return Zoo::Get()->DeadPeerCount(); }
+
+// ---- serve layer (docs/serving.md) -----------------------------------
+
+int MV_TableVersion(int32_t handle, long long* version) {
+  if (RequireStarted() || !version) return -1;
+  auto* t = Zoo::Get()->worker_table(handle);
+  if (!t) return -2;
+  int64_t v = 0;
+  if (!t->QueryVersion(&v)) return FailRc();
+  *version = v;
+  return 0;
+}
+
+int MV_LastVersion(int32_t handle, long long* version) {
+  if (RequireStarted() || !version) return -1;
+  auto* t = Zoo::Get()->worker_table(handle);
+  if (!t) return -2;
+  *version = t->last_version();
+  return 0;
+}
+
+int MV_CacheStats(long long* hits, long long* misses) {
+  if (!hits || !misses) return -1;
+  long long c = 0;
+  double total = 0.0;
+  *hits = mvtpu::Dashboard::Query("serve.cache.hit", &c, &total) ? c : 0;
+  *misses = mvtpu::Dashboard::Query("serve.cache.miss", &c, &total) ? c : 0;
+  return 0;
+}
+
+int MV_ServeQueueDepth(void) {
+  if (RequireStarted()) return -1;
+  return Zoo::Get()->ServeQueueDepth();
+}
 
 }  // extern "C"

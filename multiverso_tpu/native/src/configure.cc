@@ -189,6 +189,12 @@ void RegisterDefaults() {
               "(Dashboard hb.missed); 0 (default) disables");
     DefineInt("heartbeat_timeout_ms", 0,
               "lease expiry; <=0 derives 5*heartbeat_ms");
+    DefineInt("server_inflight_max", 0,
+              "serve backpressure (docs/serving.md): when the server "
+              "actor's mailbox backlog reaches this, incoming Gets and "
+              "version probes are shed with a retryable ReplyBusy (C "
+              "API rc -6) instead of growing the queue; adds are never "
+              "shed.  0 (default) disables shedding");
     DefineString("log_level", "info", "debug|info|error|fatal");
     DefineString("log_file", "", "optional log sink path");
     DefineBool("trace", false,

@@ -9,6 +9,7 @@ struct Header {
   int32_t src, dst, type, table_id;
   int64_t msg_id;
   int64_t trace_id;
+  int64_t version;
   int32_t num_blobs;
 };
 }  // namespace
@@ -19,7 +20,7 @@ Blob Message::Serialize() const {
   Blob out(total);
   char* p = out.data();
   Header h{src, dst, static_cast<int32_t>(type), table_id, msg_id,
-           trace_id, static_cast<int32_t>(data.size())};
+           trace_id, version, static_cast<int32_t>(data.size())};
   std::memcpy(p, &h, sizeof(h));
   p += sizeof(h);
   for (const auto& b : data) {
@@ -44,6 +45,7 @@ Message Message::Deserialize(const Blob& buf) {
   m.table_id = h.table_id;
   m.msg_id = h.msg_id;
   m.trace_id = h.trace_id;
+  m.version = h.version;
   m.data.reserve(h.num_blobs);
   for (int32_t i = 0; i < h.num_blobs; ++i) {
     int64_t len;

@@ -32,6 +32,7 @@ ArrayServerTable::ArrayServerTable(int64_t global_size, UpdaterType updater,
 void ArrayServerTable::ProcessGet(const Message& req, Message* reply) {
   (void)req;
   Monitor mon("ArrayServer::ProcessGet");
+  reply->version = version();  // serve-layer staleness stamp
   MutexLock lk(mu_);
   reply->data.emplace_back(data_.data(), data_.size() * sizeof(float));
 }
@@ -48,6 +49,7 @@ void ArrayServerTable::ProcessAdd(const Message& req) {
   }
   ApplyUpdate(updater_, *opt, data_.data(),
               slot0_.empty() ? nullptr : slot0_.data(), delta, n);
+  BumpVersion();  // whole-array add: every bucket advances
 }
 
 bool ArrayServerTable::Store(Stream* out) const {
@@ -87,11 +89,19 @@ void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
   Monitor mon("MatrixServer::ProcessGet");
   MutexLock lk(mu_);
   if (req.data.empty()) {  // GetAll: reply with the local row block
+    reply->version = version();
     reply->data.emplace_back(data_.data(), data_.size() * sizeof(float));
     return;
   }
   const int32_t* ids = req.data[0].As<int32_t>();
   size_t k = req.data[0].count<int32_t>();
+  // Bucket-granular stamp: the max version over the TOUCHED row
+  // buckets — adds to other rows don't invalidate this read's cache.
+  int64_t stamp = 0;
+  for (size_t i = 0; i < k; ++i)
+    if (ids[i] >= 0)
+      stamp = std::max(stamp, bucket_version(RowBucket(ids[i])));
+  reply->version = stamp;
   Blob out(k * cols_ * sizeof(float));
   float* dst = out.As<float>();
   for (size_t i = 0; i < k; ++i) {
@@ -119,6 +129,7 @@ void MatrixServerTable::ProcessAdd(const Message& req) {
       return;
     }
     ApplyUpdate(updater_, *opt, data_.data(), slots, delta, data_.size());
+    BumpVersion();
     return;
   }
   const int32_t* ids = req.data[1].As<int32_t>();
@@ -137,6 +148,7 @@ void MatrixServerTable::ProcessAdd(const Message& req) {
         continue;
       ApplyUpdate(updater_, *opt, data_.data() + r * cols_, nullptr,
                   delta + i * cols_, static_cast<size_t>(cols_));
+      BumpVersion(RowBucket(ids[i]));
     }
     return;
   }
@@ -157,6 +169,7 @@ void MatrixServerTable::ProcessAdd(const Message& req) {
     ApplyUpdate(updater_, *opt, data_.data() + kv.first * cols_,
                 slots + kv.first * cols_, kv.second.data(),
                 static_cast<size_t>(cols_));
+    BumpVersion(RowBucket(kv.first + range_.begin));  // global row bucket
   }
 }
 
@@ -221,6 +234,12 @@ void KVServerTable::ProcessGet(const Message& req, Message* reply) {
   auto keys = UnpackKeys(req.data[0]);
   Blob out(keys.size() * sizeof(float));
   float* vals = out.As<float>();
+  // Bucket-granular stamp: max version over the touched key buckets.
+  int64_t stamp = 0;
+  for (const auto& k : keys)
+    stamp = std::max(stamp, bucket_version(static_cast<int>(
+        KVHash(k.data(), k.size()) % kVersionBuckets)));
+  reply->version = stamp;
   MutexLock lk(mu_);
   for (size_t i = 0; i < keys.size(); ++i) {
     auto it = data_.find(keys[i]);
@@ -241,19 +260,27 @@ void KVServerTable::ProcessAdd(const Message& req) {
     return;
   }
   bool stateful = NumSlots(updater_) > 0;
+  auto bump_key = [this](const std::string& k) {
+    BumpVersion(static_cast<int64_t>(KVHash(k.data(), k.size()) %
+                                     kVersionBuckets));
+  };
   MutexLock lk(mu_);
   if (!stateful) {
-    for (size_t i = 0; i < keys.size(); ++i)
+    for (size_t i = 0; i < keys.size(); ++i) {
       ApplyUpdate(updater_, *opt, &data_[keys[i]], nullptr, deltas + i, 1);
+      bump_key(keys[i]);
+    }
     return;
   }
   // Pre-aggregate duplicate keys so stateful updaters see one delta per
   // key (the same contract as the matrix row path / the JAX plane).
   std::unordered_map<std::string, float> agg;
   for (size_t i = 0; i < keys.size(); ++i) agg[keys[i]] += deltas[i];
-  for (auto& kv : agg)
+  for (auto& kv : agg) {
     ApplyUpdate(updater_, *opt, &data_[kv.first], &slot0_[kv.first],
                 &kv.second, 1);
+    bump_key(kv.first);
+  }
 }
 
 size_t KVServerTable::size() const {
@@ -311,7 +338,25 @@ bool KVServerTable::Load(Stream* in) {
 
 // ---------------------------------------------------------------- worker
 
+// Per-thread busy latch: RoundTrip/Wait run on the CALLER's thread, so
+// this distinguishes "server shed it (retryable, rc -6)" from "dead
+// shard / deadline (indeterminate, rc -3)" without widening the bool
+// return every table op and binding already speaks.
+namespace {
+thread_local bool g_rt_busy = false;
+}  // namespace
+
+bool WorkerTable::last_call_busy() { return g_rt_busy; }
+
 void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
+  // Serve layer: every reply's version stamp refreshes the free local
+  // lower bound on the server version (max-merge; replies can race).
+  if (reply.version > 0) {
+    int64_t cur = last_version_.load(std::memory_order_relaxed);
+    while (cur < reply.version &&
+           !last_version_.compare_exchange_weak(cur, reply.version)) {
+    }
+  }
   // Everything — lookup, consume, waiter notify — runs under mu_ so it
   // serializes with RoundTrip's timeout path: once the timeout erases
   // the entry, a late reply finds nothing and cannot touch the (gone)
@@ -326,6 +371,9 @@ void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
   Pending& p = it->second;
   if (reply.type == MsgType::ReplyError) {
     *p.failed = true;                   // shard unreachable — no payload
+  } else if (reply.type == MsgType::ReplyBusy) {
+    *p.failed = true;                   // shed — retryable, no payload
+    if (p.busy) *p.busy = true;
   } else if (p.consume) {
     p.consume(p.arg, reply);
   }
@@ -337,20 +385,24 @@ void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
 bool WorkerTable::RoundTrip(std::vector<MessagePtr> reqs,
                             void (*consume)(void*, const Message&),
                             void* arg) {
+  g_rt_busy = false;
   if (reqs.empty()) return true;
   auto waiter = std::make_shared<Waiter>(static_cast<int>(reqs.size()));
   bool failed = false;
+  bool busy = false;
   int64_t msg_id = reqs[0]->msg_id;
   {
     MutexLock lk(mu_);
     pending_[msg_id] = Pending{waiter, consume, arg,
-                               static_cast<int>(reqs.size()), &failed};
+                               static_cast<int>(reqs.size()), &failed,
+                               &busy};
   }
   for (auto& req : reqs)
     Zoo::Get()->SendTo(actor::kWorker, std::move(req));
   int64_t timeout_ms = configure::GetInt("rpc_timeout_ms");
   if (waiter->WaitFor(timeout_ms)) {
     MutexLock lk(mu_);
+    g_rt_busy = busy;
     return !failed;
   }
   // Deadline passed: withdraw the pending entry so late replies are
@@ -365,7 +417,10 @@ bool WorkerTable::RoundTrip(std::vector<MessagePtr> reqs,
   // at MV_* in c_api.h as well.)
   MutexLock lk(mu_);
   auto it = pending_.find(msg_id);
-  if (it == pending_.end()) return !failed;  // raced: replies completed
+  if (it == pending_.end()) {           // raced: replies completed
+    g_rt_busy = busy;
+    return !failed;
+  }
   pending_.erase(it);
   Log::Error("WorkerTable %d: request %lld timed out after %lld ms",
              table_id_, static_cast<long long>(msg_id),
@@ -386,7 +441,8 @@ AsyncGetPtr WorkerTable::StartRoundTrip(std::vector<MessagePtr> reqs,
   {
     MutexLock lk(mu_);
     pending_[msg_id] = Pending{h->waiter_, consume, arg,
-                               static_cast<int>(reqs.size()), &h->failed_};
+                               static_cast<int>(reqs.size()), &h->failed_,
+                               &h->busy_};
   }
   for (auto& req : reqs)
     Zoo::Get()->SendTo(actor::kWorker, std::move(req));
@@ -396,6 +452,7 @@ AsyncGetPtr WorkerTable::StartRoundTrip(std::vector<MessagePtr> reqs,
 bool AsyncGetHandle::Wait() {
   if (waited_) return ok_;
   waited_ = true;
+  g_rt_busy = false;
   if (msg_id_ < 0) {      // empty request: nothing was on the wire
     ok_ = true;
     return ok_;
@@ -405,12 +462,14 @@ bool AsyncGetHandle::Wait() {
   int64_t timeout_ms = configure::GetInt("rpc_timeout_ms");
   if (waiter_->WaitFor(timeout_ms)) {
     MutexLock lk(table_->mu_);
+    g_rt_busy = busy_;
     ok_ = !failed_;
     return ok_;
   }
   MutexLock lk(table_->mu_);
   auto it = table_->pending_.find(msg_id_);
   if (it == table_->pending_.end()) {  // raced: replies completed
+    g_rt_busy = busy_;
     ok_ = !failed_;
     return ok_;
   }
@@ -499,7 +558,27 @@ void ScatterRowsReply(void* arg, const Message& reply) {
 
 void DiscardReply(void*, const Message&) {}
 
+// QueryVersion's consume: max-merge every shard's reply stamp.
+void MaxVersionReply(void* arg, const Message& reply) {
+  auto* out = static_cast<int64_t*>(arg);
+  if (reply.version > *out) *out = reply.version;
+}
+
 }  // namespace
+
+bool WorkerTable::QueryVersion(int64_t* version, int bucket) {
+  Monitor mon("Worker::QueryVersion");
+  *version = 0;
+  int64_t msg_id = Zoo::Get()->NextMsgId();
+  int servers = Zoo::Get()->num_servers();
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers; ++r) {
+    auto req = MakeReq(MsgType::RequestVersion, table_id_, msg_id, r);
+    req->version = bucket;  // -1 = whole table (see message.h)
+    reqs.push_back(std::move(req));
+  }
+  return RoundTrip(std::move(reqs), MaxVersionReply, version);
+}
 
 bool ArrayWorkerTable::Get(float* data, int64_t size) {
   Monitor mon("ArrayWorker::Get");
@@ -692,6 +771,10 @@ bool SparseMatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
       }
     }
   }
+  // Serve-layer observability: one counter tick per call — all-hit
+  // calls skip the wire entirely (MV_CacheStats reads these).
+  Dashboard::Record(missing.empty() ? "serve.cache.hit"
+                                    : "serve.cache.miss", 0.0);
   std::vector<float> fetched(missing.size() * cols_);
   if (!missing.empty() &&
       !MatrixWorkerTable::GetRows(missing.data(),

@@ -51,6 +51,10 @@ class WorkerActor : public Actor {
     RegisterHandler(MsgType::RequestFlush, [](MessagePtr& m) {
       Zoo::Get()->Deliver(actor::kServer, std::move(m));
     });
+    RegisterHandler(MsgType::RequestVersion, [](MessagePtr& m) {
+      // Serve-layer probe: same worker->server leg as Get.
+      Zoo::Get()->Deliver(actor::kServer, std::move(m));
+    });
     RegisterHandler(MsgType::ClockTick, [](MessagePtr& m) {
       // Outbound SSP tick: same worker->server leg as Get/Add, so the
       // per-connection FIFO keeps it behind this clock's adds.
@@ -74,6 +78,14 @@ class WorkerActor : public Actor {
       // unblocks the pending RoundTrip with an error.
       Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
     });
+    RegisterHandler(MsgType::ReplyVersion, [](MessagePtr& m) {
+      Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
+    });
+    RegisterHandler(MsgType::ReplyBusy, [](MessagePtr& m) {
+      // Server shed the request under -server_inflight_max: fail the
+      // pending round trip as BUSY (retryable; rc -6 at the C API).
+      Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
+    });
   }
 };
 
@@ -87,6 +99,9 @@ class ServerActor : public Actor {
                    m->table_id);
         return;
       }
+      // Serve backpressure: shed BEFORE any table work so an overloaded
+      // server drains its backlog at ReplyBusy speed (docs/serving.md).
+      if (Zoo::Get()->ShedIfOverloaded(m)) return;
       // SSP: park the get while its sender runs too far ahead of the
       // slowest worker; OnClockTick re-delivers it here when admitted.
       if (Zoo::Get()->MaybeHoldGet(m)) return;
@@ -102,6 +117,29 @@ class ServerActor : public Actor {
       // correlates with the worker's Get across ranks.
       TraceScope scope(m->trace_id);
       table->ProcessGet(*m, reply.get());
+      Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
+    });
+    RegisterHandler(MsgType::RequestVersion, [](MessagePtr& m) {
+      // Serve-layer probe: answer with the current table (or bucket)
+      // version — a header-only reply, no payload, no table lock.
+      auto* table = Zoo::Get()->server_table(m->table_id);
+      if (!table) {
+        Log::Error("RequestVersion for table %d on non-server rank",
+                   m->table_id);
+        return;
+      }
+      if (Zoo::Get()->ShedIfOverloaded(m)) return;
+      auto reply = std::make_unique<Message>();
+      reply->type = MsgType::ReplyVersion;
+      reply->table_id = m->table_id;
+      reply->msg_id = m->msg_id;
+      reply->trace_id = m->trace_id;
+      reply->src = Zoo::Get()->rank();
+      reply->dst = m->src;
+      reply->version = m->version >= 0
+                           ? table->bucket_version(
+                                 static_cast<int>(m->version))
+                           : table->version();
       Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
     });
     RegisterHandler(MsgType::ClockTick, [](MessagePtr& m) {
@@ -124,6 +162,9 @@ class ServerActor : public Actor {
         reply->trace_id = m->trace_id;
         reply->src = Zoo::Get()->rank();
         reply->dst = m->src;
+        // The ack carries the post-apply version: a write-through
+        // client learns its own add's version for free (serving.md).
+        reply->version = table->version();
         Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
       }
     });
@@ -764,6 +805,32 @@ void Zoo::SetRoles(const std::vector<int>& roles) {
     Log::Error("no server-role rank registered — tables have no shards");
 }
 
+int Zoo::ServeQueueDepth() {
+  MutexLock lk(mu_);
+  return server_actor_ ? static_cast<int>(server_actor_->QueueSize()) : 0;
+}
+
+bool Zoo::ShedIfOverloaded(MessagePtr& msg) {
+  int64_t max_inflight = configure::GetInt("server_inflight_max");
+  if (max_inflight <= 0) return false;
+  int depth = ServeQueueDepth();
+  // Depth histogram in the µs-bucket Dashboard (1 unit = 1 µs): bucket
+  // i ≈ depth 2^i, so the Dump shows the backlog distribution and
+  // `serve.queue_depth`'s total/count is the mean depth per sample.
+  Dashboard::Record("serve.queue_depth", depth * 1e-6);
+  if (depth < max_inflight) return false;
+  Dashboard::Record("serve.shed", 0.0);
+  auto reply = std::make_unique<Message>();
+  reply->type = MsgType::ReplyBusy;
+  reply->table_id = msg->table_id;
+  reply->msg_id = msg->msg_id;
+  reply->trace_id = msg->trace_id;
+  reply->src = rank_;
+  reply->dst = msg->src;
+  Deliver(actor::kWorker, std::move(reply));
+  return true;
+}
+
 void Zoo::SendTo(const std::string& actor_name, MessagePtr msg) {
   // Snapshot the pointer AND push under mu_ so a concurrent Stop cannot
   // free the actor between the lookup and the mailbox push.
@@ -788,7 +855,8 @@ void Zoo::Deliver(const std::string& actor_name, MessagePtr msg) {
   // Unreachable peer: fail blocking callers fast instead of hanging.
   switch (msg->type) {
     case MsgType::RequestGet:
-    case MsgType::RequestAdd: {
+    case MsgType::RequestAdd:
+    case MsgType::RequestVersion: {
       if (msg->msg_id < 0) return;  // async add: nothing waits
       auto err = std::make_unique<Message>();
       err->type = MsgType::ReplyError;
@@ -834,12 +902,15 @@ void Zoo::RouteInbound(Message&& m) {
     case MsgType::RequestGet:
     case MsgType::RequestAdd:
     case MsgType::RequestFlush:
+    case MsgType::RequestVersion:
     case MsgType::ClockTick:
       SendTo(actor::kServer, std::move(msg));
       break;
     case MsgType::ReplyGet:
     case MsgType::ReplyAdd:
     case MsgType::ReplyFlush:
+    case MsgType::ReplyVersion:
+    case MsgType::ReplyBusy:
       SendTo(actor::kWorker, std::move(msg));
       break;
     case MsgType::ControlBarrier:

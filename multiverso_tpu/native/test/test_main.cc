@@ -297,6 +297,55 @@ static int TestKV() {
   return 0;
 }
 
+static int TestServeVersions() {
+  // Serve-layer version protocol (docs/serving.md), single process:
+  // fresh tables read version 0; every apply bumps monotonically; the
+  // header-only probe (MV_TableVersion) and the free local bound
+  // (MV_LastVersion, refreshed by reply stamps) agree; bucket stamps
+  // let reads of untouched rows/keys report an older version.
+  int32_t h;
+  CHECK(MV_NewArrayTable(8, &h) == 0);
+  long long v = -1;
+  CHECK(MV_TableVersion(h, &v) == 0);
+  CHECK(v == 0);
+  std::vector<float> ones(8, 1.0f), out(8);
+  CHECK(MV_AddArrayTable(h, ones.data(), 8) == 0);
+  CHECK(MV_TableVersion(h, &v) == 0);
+  CHECK(v == 1);
+  // The blocking-add ack stamped the post-apply version locally.
+  long long lv = -1;
+  CHECK(MV_LastVersion(h, &lv) == 0);
+  CHECK(lv == 1);
+  CHECK(MV_AddArrayTable(h, ones.data(), 8) == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 8) == 0);
+  CHECK(MV_LastVersion(h, &lv) == 0);
+  CHECK(lv == 2);
+  // KV: adds to one key leave OTHER buckets' read stamps behind the
+  // table version (bucket-granular staleness).  Async adds (no ack →
+  // no local stamp) so the READ stamps are what last_version observes.
+  int32_t kv;
+  CHECK(MV_NewKVTable(&kv) == 0);
+  CHECK(MV_AddAsyncKV(kv, "hot", 1.0f) == 0);
+  CHECK(MV_AddAsyncKV(kv, "hot", 1.0f) == 0);
+  CHECK(MV_Barrier() == 0);                 // flush the async adds
+  float val = -1.0f;
+  CHECK(MV_GetKV(kv, "cold", &val) == 0);   // untouched bucket
+  CHECK(MV_LastVersion(kv, &lv) == 0);
+  CHECK(lv == 0);  // cold bucket never bumped — read stamped 0
+  CHECK(MV_GetKV(kv, "hot", &val) == 0);
+  CHECK(val == 2.0f);
+  CHECK(MV_LastVersion(kv, &lv) == 0);
+  CHECK(lv == 2);  // hot bucket carries both applies
+  long long kvv = -1;
+  CHECK(MV_TableVersion(kv, &kvv) == 0);
+  CHECK(kvv == 2);
+  CHECK(MV_ServeQueueDepth() >= 0);
+  long long hits = -1, misses = -1;
+  CHECK(MV_CacheStats(&hits, &misses) == 0);
+  CHECK(hits >= 0 && misses >= 0);
+  return 0;
+}
+
 static int TestThreads() {
   // Concurrent blocking adds from many app threads — the actor pipeline
   // must serialize them without loss (reference MtQueue/actor guarantee).
@@ -1425,6 +1474,7 @@ int main(int argc, char** argv) {
       {"matrix", TestMatrix},     {"sparse", TestSparseMatrix},
       {"checkpoint", TestCheckpoint},
       {"kv", TestKV},             {"threads", TestThreads},
+      {"serve", TestServeVersions},
   };
   int failures = 0;
   std::string only = argc > 1 ? argv[1] : "";

@@ -1,0 +1,35 @@
+"""Hot-path serve layer (docs/serving.md).
+
+The reference Multiverso design is a pure push-pull TRAINING fabric:
+every worker ``Get()`` is a synchronous whole-table fetch and the server
+actor processes one message at a time.  This subsystem converts that
+fabric into something that can take READ traffic — three cooperating
+parts in the tradition of parameter-server client caches:
+
+- :class:`~multiverso_tpu.serve.coalescer.Coalescer` — a worker-side
+  batcher merging concurrent/adjacent reads (and adds) on one table
+  into a single wire round trip (PS-Lite-style request batching),
+  windowed by ``-coalesce_window_us`` and capped by ``-serve_max_batch``.
+- :class:`~multiverso_tpu.serve.cache.VersionedLRUCache` — a bounded
+  client cache serving repeat reads locally while
+  ``cached_version >= server_version - max_staleness`` (SSPTable-style
+  bounded-staleness reads over the wire plane's monotonic per-table /
+  per-bucket version stamps).
+- :class:`~multiverso_tpu.serve.client.ServeClient` — the facade wiring
+  both over a :class:`~multiverso_tpu.native.NativeRuntime`, plus
+  busy-retry against ``-server_inflight_max`` backpressure sheds
+  (``BusyError`` → ``fault.RetryPolicy`` backoff).
+
+The JAX-plane tables wear the same cache/coalescer directly (see
+``tables/base.py``: ``-serve_cache_entries`` arms it); there the
+"server version" is the table's local apply counter, which advances in
+lockstep across ranks, so cached reads stay collective-safe.
+"""
+
+from __future__ import annotations
+
+from .cache import VersionedLRUCache
+from .client import ServeClient
+from .coalescer import Coalescer
+
+__all__ = ["Coalescer", "ServeClient", "VersionedLRUCache"]

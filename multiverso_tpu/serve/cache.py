@@ -1,0 +1,113 @@
+"""Versioned client cache — the read side of the serve layer
+(docs/serving.md).
+
+A bounded LRU keyed by arbitrary tuples, where every entry carries the
+SERVER VERSION it was fetched at.  A lookup names the freshest version
+the caller may not be behind (``min_version`` — typically
+``server_version - max_staleness``); entries older than that miss, in
+the SSPTable tradition of bounded-staleness reads (PAPERS.md: Cui et
+al. ATC'14) — except the bound here is a VERSION distance (number of
+server-side applies), not the SSP clock distance the training plane's
+``-staleness`` flag speaks (see docs/serving.md for the mapping).
+
+Thread-safe; every operation is O(1).  Counters land in the metrics
+registry: ``serve.cache.hit`` / ``serve.cache.miss`` /
+``serve.cache.evict`` / ``serve.cache.stale`` (a miss specifically
+caused by the version bound).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable, Optional, Tuple
+
+from .. import metrics
+
+__all__ = ["VersionedLRUCache"]
+
+
+class VersionedLRUCache:
+    """Bounded LRU of (key -> value, version) with staleness-gated reads.
+
+    ``max_entries`` is a hard bound: inserting into a full cache evicts
+    the least-recently-used entry (mvlint MV007 — client-side caches in
+    library code must be bounded).
+    """
+
+    def __init__(self, max_entries: int, name: str = "serve"):
+        if max_entries <= 0:
+            raise ValueError(f"max_entries must be > 0, got {max_entries}")
+        self.max_entries = int(max_entries)
+        self._name = name
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Hashable, Tuple[Any, int]]" = \
+            OrderedDict()  # bounded: see store()'s popitem eviction
+
+    def _tick(self, what: str) -> None:
+        metrics.counter(f"{self._name}.cache.{what}").inc()
+
+    def lookup(self, key: Hashable,
+               min_version: Optional[int] = None) -> Optional[Tuple[Any, int]]:
+        """Return ``(value, version)`` when present AND fresh enough,
+        else None.  ``min_version=None`` accepts any cached version
+        (version gating disabled); otherwise an entry whose version is
+        below ``min_version`` misses (and counts ``cache.stale``)."""
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+        if hit is None:
+            self._tick("miss")
+            return None
+        if min_version is not None and hit[1] < min_version:
+            self._tick("stale")
+            self._tick("miss")
+            return None
+        self._tick("hit")
+        return hit
+
+    def store(self, key: Hashable, value: Any, version: int) -> None:
+        """Insert/refresh an entry; never lowers a cached version (a
+        racing slow fetch must not roll a fresher entry back)."""
+        with self._lock:
+            old = self._entries.get(key)
+            if old is not None and old[1] > version:
+                return
+            self._entries[key] = (value, int(version))
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)   # LRU eviction bound
+                self._tick("evict")
+
+    def invalidate(self, prefix: Optional[Hashable] = None) -> int:
+        """Drop entries (write-through invalidation on a local add).
+
+        ``prefix=None`` clears everything; otherwise drops every tuple
+        key whose FIRST element equals ``prefix`` (the serve client keys
+        entries as ``(handle, ...)`` / the tables as ``(kind, ...)``).
+        Returns the number dropped."""
+        with self._lock:
+            if prefix is None:
+                n = len(self._entries)
+                self._entries.clear()
+                return n
+            doomed = [k for k in self._entries
+                      if isinstance(k, tuple) and k and k[0] == prefix]
+            for k in doomed:
+                del self._entries[k]
+            return len(doomed)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self),
+            "max_entries": self.max_entries,
+            "hits": int(metrics.counter(f"{self._name}.cache.hit").value),
+            "misses": int(metrics.counter(f"{self._name}.cache.miss").value),
+            "evictions": int(
+                metrics.counter(f"{self._name}.cache.evict").value),
+        }

@@ -1,0 +1,289 @@
+"""ServeClient — the hot-path read client over the native wire plane
+(docs/serving.md).
+
+Wraps a :class:`~multiverso_tpu.native.NativeRuntime` with the three
+serve-layer mechanisms so concurrent readers stop paying one full wire
+round trip per ``get()``:
+
+1. **Coalescing** — concurrent/window-adjacent gets on the same table
+   merge into one wire round trip (``-coalesce_window_us``, size-capped
+   by ``-serve_max_batch``); row gets union their ids; adds aggregate
+   into one delta per AddOption.
+2. **Versioned cache** — a bounded LRU serves repeat reads locally while
+   ``cached_version >= server_version - max_staleness``.  Knowledge of
+   the server version comes free from reply stamps
+   (``NativeRuntime.last_version``), stays trusted for
+   ``-version_lease_ms``, and is refreshed past the lease by a cheap
+   header-only probe (``MV_TableVersion``) instead of a full fetch.
+   ``max_staleness=0`` + ``lease_ms=0`` never serves a stale read —
+   every cached read pays one probe (still far cheaper than the fetch).
+3. **Busy retry** — a server shedding under ``-server_inflight_max``
+   raises :class:`~multiverso_tpu.native.BusyError`; the client's
+   :class:`~multiverso_tpu.fault.RetryPolicy` backs off and retries
+   (PR 2's schedule; ``retry.attempts`` counts in the registry).
+
+Chaos seams (tests/test_serve.py): ``fault.inject("serve.busy")`` fires
+inside the wire path — configure it with ``error=BusyError`` to script
+shed storms; ``fault.inject("serve.stale")`` fires at the hit decision
+and forces that read to miss.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .. import config, fault, metrics, tracing
+from ..native import BusyError
+from .cache import VersionedLRUCache
+from .coalescer import Coalescer
+
+__all__ = ["ServeClient"]
+
+
+def _flag(value, name):
+    return config.get(name) if value is None else value
+
+
+class ServeClient:
+    """Read-optimized facade over a NativeRuntime (one per process).
+
+    All knobs default to the config flags so launch scripts tune the
+    serve layer the same way they tune the wire (``-coalesce_window_us``
+    etc.).  ``max_staleness`` is a VERSION distance: how many server-side
+    applies a served read may be behind (0 = reads are never stale).
+    """
+
+    def __init__(self, rt: Any, *,
+                 max_staleness: Optional[int] = None,
+                 cache_entries: Optional[int] = None,
+                 window_us: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 lease_ms: Optional[float] = None,
+                 retry: Optional[fault.RetryPolicy] = None):
+        self.rt = rt
+        self.max_staleness = int(_flag(max_staleness, "max_staleness"))
+        entries = int(_flag(cache_entries, "serve_cache_entries"))
+        self.cache = VersionedLRUCache(max(entries, 1))
+        self._cache_on = entries > 0
+        self.coalescer = Coalescer(
+            window_s=float(_flag(window_us, "coalesce_window_us")) * 1e-6,
+            max_batch=int(_flag(max_batch, "serve_max_batch")))
+        self.lease_s = float(_flag(lease_ms, "version_lease_ms")) * 1e-3
+        self.retry = retry or fault.RetryPolicy(
+            attempts=6, backoff_s=0.01, max_backoff_s=0.5,
+            retry_on=(BusyError,))
+        # Version-knowledge lease per handle: (version, monotonic ts).
+        # Bounded by the process's table-handle count, not by data.
+        self._known: dict = {}  # mvlint: disable=MV007 — one entry per table handle
+
+    # ------------------------------------------------ version knowledge
+    def _note(self, handle: int) -> None:
+        """Fold the latest reply stamp into the lease (free, no wire)."""
+        v = self.rt.last_version(handle)
+        old = self._known.get(handle)
+        if old is None or v > old[0]:
+            self._known[handle] = (v, time.monotonic())
+
+    def _server_version(self, handle: int) -> int:
+        """Best-known server version, probing past the lease.
+
+        Within ``-version_lease_ms`` of the last observation the cached
+        knowledge is trusted (zero wire traffic — the demo's repeat-read
+        path); beyond it, one header-only RequestVersion round trip
+        refreshes it (``serve.probe`` counts them).
+        """
+        known = self._known.get(handle)
+        if known is not None and self.lease_s > 0 and \
+                time.monotonic() - known[1] <= self.lease_s:
+            return known[0]
+        metrics.counter("serve.probe").inc()
+        v = self.retry.run(self.rt.table_version, handle)
+        self._known[handle] = (v, time.monotonic())
+        return v
+
+    def _read_version(self, handle: int) -> Optional[int]:
+        """Server-version estimate gating THIS read (None = cache off).
+
+        Doubles as the cache stamp for the value a miss fetches: the
+        fetch runs AFTER this estimate, so the data is at least this
+        new — stamping with a post-fetch ``last_version`` instead could
+        over-stamp (a concurrent add's ack landing between fetch and
+        stamp would mark pre-add data post-add fresh)."""
+        if not self._cache_on:
+            return None
+        return self._server_version(handle)
+
+    @staticmethod
+    def _forced_stale() -> bool:
+        """``serve.stale`` chaos seam: an injected fault forces this
+        read to miss (scriptable staleness storms)."""
+        try:
+            fault.inject("serve.stale")
+        except fault.FaultError:
+            return True
+        return False
+
+    # ------------------------------------------------------------ reads
+    def _cached(self, handle: int, key: tuple, fetch) -> np.ndarray:
+        """Shared read path: cache -> coalesced fetch -> store."""
+        v0 = self._read_version(handle)
+        if v0 is not None and not self._forced_stale():
+            hit = self.cache.lookup(key,
+                                    min_version=v0 - self.max_staleness)
+            if hit is not None:
+                return hit[0].copy()
+        else:
+            metrics.counter("serve.cache.miss").inc()
+
+        def execute(items):
+            def wire():
+                fault.inject("serve.busy")
+                return fetch()
+            out = self.retry.run(wire)
+            # One wire value serves every coalesced waiter.
+            return [out] * len(items)
+
+        with tracing.span("serve::get", table=str(handle)):
+            val = self.coalescer.submit(key, None, execute)
+        self._note(handle)
+        if v0 is not None:
+            self.cache.store(key, val.copy(), v0)
+        return val
+
+    def array_get(self, handle: int, size: int) -> np.ndarray:
+        return self._cached(handle, (handle, "array", size),
+                            lambda: self.rt.array_get(handle, size))
+
+    def matrix_get_all(self, handle: int, rows: int, cols: int) -> np.ndarray:
+        return self._cached(handle, (handle, "all", rows, cols),
+                            lambda: self.rt.matrix_get_all(handle, rows,
+                                                           cols))
+
+    def matrix_get_rows(self, handle: int, row_ids: Sequence[int],
+                        cols: int) -> np.ndarray:
+        """Row-range read: concurrent callers' id sets UNION into one
+        wire request; each gets back exactly its rows.  Per-id-set cache
+        entries ride the same versioned staleness bound."""
+        ids = np.ascontiguousarray(row_ids, dtype=np.int32)
+        key = (handle, "rows", tuple(ids.tolist()))
+        v0 = self._read_version(handle)
+        if v0 is not None and not self._forced_stale():
+            hit = self.cache.lookup(key,
+                                    min_version=v0 - self.max_staleness)
+            if hit is not None:
+                return hit[0].copy()
+        else:
+            metrics.counter("serve.cache.miss").inc()
+
+        def execute(items):
+            union = np.unique(np.concatenate(items))
+
+            def wire():
+                fault.inject("serve.busy")
+                return self.rt.matrix_get_rows(handle, union, cols)
+            fetched = self.retry.run(wire)
+            # Scatter each waiter its own rows (union is sorted).
+            return [fetched[np.searchsorted(union, it)] for it in items]
+
+        with tracing.span("serve::get_rows", table=str(handle),
+                          k=int(ids.size)):
+            val = self.coalescer.submit((handle, "rows"), ids, execute)
+        self._note(handle)
+        if v0 is not None:
+            self.cache.store(key, val.copy(), v0)
+        return val
+
+    def kv_get(self, handle: int, keys) -> Any:
+        """KV read (str or list of str), cached per key set."""
+        single = isinstance(keys, str)
+        tup = (keys,) if single else tuple(keys)
+        key = (handle, "kv", tup)
+        v0 = self._read_version(handle)
+        if v0 is not None and not self._forced_stale():
+            hit = self.cache.lookup(key,
+                                    min_version=v0 - self.max_staleness)
+            if hit is not None:
+                out = hit[0]
+                return out if single else np.array(out, copy=True)
+        else:
+            metrics.counter("serve.cache.miss").inc()
+
+        def execute(items):
+            def wire():
+                fault.inject("serve.busy")
+                return self.rt.kv_get(handle, keys)
+            out = self.retry.run(wire)
+            return [out] * len(items)
+
+        with tracing.span("serve::kv_get", table=str(handle)):
+            val = self.coalescer.submit(key, None, execute)
+        self._note(handle)
+        if v0 is not None:
+            stored = val if single else np.array(val, copy=True)
+            self.cache.store(key, stored, v0)
+        return val
+
+    # ----------------------------------------------------------- writes
+    def array_add(self, handle: int, delta, *, coalesce: bool = True,
+                  sync: bool = True) -> None:
+        """Write path: deltas queued inside one coalescing window merge
+        into ONE aggregated wire add (sum — the linear-composition
+        contract every BSP flush in this repo already relies on), then
+        every cached read of the table is invalidated (write-through).
+        """
+        d = np.ascontiguousarray(delta, dtype=np.float32)
+        if not coalesce:
+            self.retry.run(self.rt.array_add, handle, d, sync=sync)
+        else:
+            def execute(items):
+                agg = items[0] if len(items) == 1 else np.sum(items, axis=0)
+
+                def wire():
+                    fault.inject("serve.busy")
+                    self.rt.array_add(handle, agg, sync=sync)
+                self.retry.run(wire)
+                metrics.counter("serve.coalesce.adds").inc(len(items))
+                return [None] * len(items)
+
+            with tracing.span("serve::add", table=str(handle)):
+                self.coalescer.submit((handle, "add"), d, execute)
+        self.invalidate(handle)
+        if sync:
+            self._note(handle)  # the ack stamped the post-apply version
+
+    def matrix_add_rows(self, handle: int, row_ids, delta, *,
+                        sync: bool = True) -> None:
+        self.retry.run(self.rt.matrix_add_rows, handle, row_ids, delta,
+                       sync=sync)
+        self.invalidate(handle)
+        if sync:
+            self._note(handle)
+
+    def kv_add(self, handle: int, keys, deltas, *, sync: bool = True) -> None:
+        self.retry.run(self.rt.kv_add, handle, keys, deltas, sync=sync)
+        self.invalidate(handle)
+        if sync:
+            self._note(handle)
+
+    # ------------------------------------------------------------ admin
+    def invalidate(self, handle: Optional[int] = None) -> int:
+        """Write-through invalidation: drop this handle's cached reads
+        (all handles when None) and void the version lease so the next
+        read re-learns the server version."""
+        if handle is None:
+            self._known.clear()
+        else:
+            self._known.pop(handle, None)
+        return self.cache.invalidate(handle)
+
+    def stats(self) -> dict:
+        s = self.cache.stats()
+        s["probes"] = int(metrics.counter("serve.probe").value)
+        s["retries"] = int(metrics.counter("retry.attempts").value)
+        h = metrics.histogram("serve.coalesce.batch")
+        s["coalesced_batches"] = h.count
+        s["coalesce_batch_p95"] = h.quantile(0.95)
+        return s

@@ -63,8 +63,14 @@ class ArrayTable(Table):
         with self._monitor("Get"):
             if device:
                 return self._slice_device((self.size,))
-            return self._locked_read(
-                lambda d, s: host_fetch(d))[: self.size]
+            # Serve layer (docs/serving.md): repeat host reads within the
+            # version-staleness bound serve from the client cache;
+            # concurrent misses coalesce into one fetch.  No-op unless
+            # -serve_cache_entries armed the cache.
+            return self._serve_read(
+                ("get",),
+                lambda: self._locked_read(
+                    lambda d, s: host_fetch(d))[: self.size])
 
     # ------------------------------------------------------------------ Add
     def add(self, delta, option: Optional[AddOption] = None,

@@ -35,7 +35,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-from .. import dashboard, fault
+from .. import config, dashboard, fault, metrics, tracing
 from ..core import context as core_context
 from ..updaters import AddOption, get_updater
 
@@ -150,11 +150,19 @@ class Table:
 
     kind = "table"
 
+    # Serve-layer version buckets (docs/serving.md): row/key applies
+    # stamp only their bucket, so reads of untouched buckets can keep
+    # hitting the cache across unrelated adds.  Must match the native
+    # plane's ServerTable::kVersionBuckets.
+    SERVE_BUCKETS = 64
+
     def __init__(self, name: Optional[str] = None,
                  updater_type: Optional[str] = None,
                  sync: Optional[bool] = None,
                  default_option: Optional[AddOption] = None,
-                 staleness: int = 0):
+                 staleness: int = 0,
+                 serve_cache: Optional[int] = None,
+                 max_staleness: Optional[int] = None):
         ctx = core_context.get_context()
         self._ctx = ctx
         if updater_type is None:
@@ -185,9 +193,41 @@ class Table:
                     f"duplicate table name '{self.name}' (held by another "
                     f"{other.kind} table); pass a unique name=")
         self._lock = threading.Lock()
-        self._dense_cache: dict = {}
+        # Jitted-apply memo, NOT a data cache: keyed by (AddOption,
+        # shape/path) — bounded by call-site diversity (a handful of
+        # compiled fns per table), never by traffic.
+        self._dense_cache: dict = {}  # mvlint: disable=MV007
         self._compressor = None  # lazy OneBitCompressor (error feedback)
         self._closed = False
+        # --- serve layer (docs/serving.md): versioned read cache -----------
+        # The "server version" of a JAX-plane table is its local apply
+        # counter; eager applies are lockstep collectives under
+        # multi-host, so the counter advances IDENTICALLY on every rank
+        # and cached whole-table reads stay collective-safe (all ranks
+        # hit or all miss together).  Arm via -serve_cache_entries (or
+        # the serve_cache= kwarg); max_staleness is a VERSION distance
+        # (0 = cached reads never stale), NOT the SSP clock staleness=.
+        self._serve_version = 0
+        self._serve_buckets = None              # lazily [SERVE_BUCKETS]
+        self._serve_ver_lock = threading.Lock()
+        self._serve_staleness = int(
+            config.get("max_staleness") if max_staleness is None
+            else max_staleness)
+        if self._serve_staleness < 0:
+            raise ValueError(
+                f"max_staleness must be >= 0, got {self._serve_staleness}")
+        entries = int(config.get("serve_cache_entries")
+                      if serve_cache is None else serve_cache)
+        if entries > 0:
+            from ..serve import Coalescer, VersionedLRUCache
+
+            self._serve_cache = VersionedLRUCache(entries)
+            self._serve_coalescer = Coalescer(
+                window_s=float(config.get("coalesce_window_us")) * 1e-6,
+                max_batch=int(config.get("serve_max_batch")))
+        else:
+            self._serve_cache = None
+            self._serve_coalescer = None
 
     def _apply_dense_padded(self, delta, option, *,
                             presummed: bool = False) -> None:
@@ -226,6 +266,7 @@ class Table:
         d = host_put(padded, self._sharding)
         with self._lock:
             self._data, self._state = fn(self._data, self._state, d)
+        self._serve_bump()
 
     def _add_compressed(self, delta, option, compress: str,
                         blocking: bool) -> None:
@@ -322,6 +363,7 @@ class Table:
         with self._lock:
             self._data, self._state = fn(self._data, self._state,
                                          packed, scales)
+        self._serve_bump()
 
     def _apply_dense_device(self, delta, option) -> None:
         """Device-resident eager add: the delta is already a ``jax.Array``.
@@ -353,6 +395,7 @@ class Table:
             self._dense_cache[key] = fn
         with self._lock:
             self._data, self._state = fn(self._data, self._state, delta)
+        self._serve_bump()
 
     def _try_device_add(self, delta, expected_shape, option,
                         blocking: bool) -> bool:
@@ -398,6 +441,7 @@ class Table:
             self._data = host_put(pad(data), self._sharding)
             self._state = tuple(host_put(pad(s), self._sharding)
                                 for s in state)
+        self._serve_bump()   # restored timeline: cached reads are void
         if self._compressor is not None:
             # Carried quantization error belongs to the abandoned timeline.
             self._compressor.reset()
@@ -455,6 +499,8 @@ class Table:
             self._data = None
             self._state = ()
             self._dense_cache.clear()
+        if self._serve_cache is not None:
+            self._serve_cache.invalidate()
 
     # -- BSP clock boundary --------------------------------------------------
     def _ssp_defer(self, apply_fn=None) -> None:
@@ -507,6 +553,98 @@ class Table:
 
     def load_state(self, state: Any) -> None:
         raise NotImplementedError
+
+    # -- serve layer (docs/serving.md) ---------------------------------------
+    @staticmethod
+    def serve_key_bucket(key: Any) -> int:
+        """Stable bucket of a KV key — crc32, NOT hash(): ranks must
+        agree (PYTHONHASHSEED randomizes str hash per process)."""
+        import zlib
+
+        return zlib.crc32(repr(key).encode()) % Table.SERVE_BUCKETS
+
+    def _serve_bump(self, buckets=None) -> None:
+        """Advance the table version after a local apply — the JAX-plane
+        analog of the native server's per-apply version stamp.  Bumping
+        IS the write-through invalidation: cached entries below the new
+        version fail the staleness gate at lookup.  ``buckets`` (row ids
+        or key buckets) stamps only the touched buckets."""
+        if self._serve_cache is None:
+            return
+        import numpy as np
+
+        with self._serve_ver_lock:
+            self._serve_version += 1
+            v = self._serve_version
+            if buckets is None:
+                if self._serve_buckets is not None:
+                    self._serve_buckets[:] = v
+                return
+            if self._serve_buckets is None:
+                self._serve_buckets = np.zeros(self.SERVE_BUCKETS, np.int64)
+            idx = np.asarray(list(buckets), np.int64) % self.SERVE_BUCKETS
+            self._serve_buckets[idx] = v
+
+    def _serve_current(self, buckets=None) -> int:
+        """Version gating a read: table version, or the max over the
+        touched buckets (adds elsewhere don't invalidate this read)."""
+        import numpy as np
+
+        with self._serve_ver_lock:
+            if buckets is None or self._serve_buckets is None:
+                return self._serve_version
+            idx = np.asarray(list(buckets), np.int64)
+            if idx.size == 0:
+                return 0
+            return int(self._serve_buckets[idx % self.SERVE_BUCKETS].max())
+
+    def _serve_read(self, key: tuple, fetch, buckets=None,
+                    collective_safe: bool = True, copy=None):
+        """Cache + coalesce an eager host read (docs/serving.md).
+
+        ``fetch`` is the full existing read path (including any
+        multi-host collective); it runs at most once per coalescing
+        window.  ``collective_safe=False`` marks reads whose cache keys
+        can DIFFER per rank (row-id / key-set reads): a rank-local hit
+        there would break the lockstep fetch collective, so they bypass
+        the cache under ``process_count() > 1``.  ``copy`` clones a
+        value on the cache boundary (default: ndarray ``.copy()``) so
+        caller mutation cannot corrupt the cached copy.
+        """
+        cache = self._serve_cache
+        if cache is None or (not collective_safe and is_multiprocess()):
+            return fetch()
+        if copy is None:
+            def copy(v):
+                return v.copy()
+        cur = self._serve_current(buckets)
+        forced = False
+        try:
+            # Chaos seam: an injected serve.stale forces this read to
+            # miss (tests script staleness storms without real adds).
+            fault.inject("serve.stale")
+        except fault.FaultError:
+            forced = True
+        if not forced:
+            hit = cache.lookup(key, min_version=cur - self._serve_staleness)
+            if hit is not None:
+                return copy(hit[0])
+        else:
+            metrics.counter("serve.cache.miss").inc()
+
+        def execute(items):
+            out = fetch()
+            return [out] * len(items)   # one fetch serves every waiter
+
+        with tracing.span("serve::table_get", table=self.name,
+                          key=str(key)):
+            val = self._serve_coalescer.submit((id(self),) + key, None,
+                                               execute)
+        # Stamp with the PRE-fetch version: the fetch ran after the
+        # estimate, so the data is at least that new (a post-fetch stamp
+        # could mark pre-add data as post-add fresh).
+        cache.store(key, copy(val), cur)
+        return copy(val)
 
     def _monitor(self, op: str):
         # Every public eager op opens with this — it doubles as the

@@ -68,7 +68,11 @@ class KVTable(Table):
         self.coalesce = bool(coalesce)
         self._store: Dict[Any, np.ndarray] = {}
         self._state: Dict[Any, List[np.ndarray]] = {}
-        self._cache: Dict[Any, np.ndarray] = {}
+        # Reference-parity worker mirror (KVWorkerTable::raw): holds
+        # exactly the keys the app Get()s, i.e. it tracks the store's
+        # own key universe — not an eviction candidate without breaking
+        # the reference raw() contract.
+        self._cache: Dict[Any, np.ndarray] = {}  # mvlint: disable=MV007
         self._pending: List[Tuple[Dict[Any, np.ndarray],
                                   Optional[AddOption]]] = []
 
@@ -83,12 +87,26 @@ class KVTable(Table):
     def get(self, keys) -> Dict[Any, np.ndarray]:
         """Refresh the local cache for ``keys`` from the store."""
         with self._monitor("Get"):
-            with self._lock:
-                for k in keys:
-                    w = self._store.get(k)
-                    self._cache[k] = (w.copy() if w is not None
-                                      else self._zero())
-            return {k: self._cache[k] for k in keys}
+            keys = list(keys)
+
+            def fetch():
+                with self._lock:
+                    for k in keys:
+                        w = self._store.get(k)
+                        self._cache[k] = (w.copy() if w is not None
+                                          else self._zero())
+                return {k: self._cache[k] for k in keys}
+
+            # Serve layer: per-key-set entries gated by the touched key
+            # BUCKETS (crc32 — rank-stable), so adds to unrelated keys
+            # keep these hitting.  Values are copied on both cache
+            # boundaries — a caller mutating its dict must not corrupt
+            # the cached copy.
+            return self._serve_read(
+                ("kv", tuple(keys)), fetch,
+                buckets=[self.serve_key_bucket(k) for k in keys],
+                collective_safe=False,
+                copy=lambda d: {k: v.copy() for k, v in d.items()})
 
     def add(self, updates: Dict[Any, Any],
             option: Optional[AddOption] = None, sync: bool = False) -> None:
@@ -221,6 +239,10 @@ class KVTable(Table):
                     self._state[k] = st
                 self._store[k] = _np_apply(
                     self.updater_type, w.copy(), st, d, opt)
+        if ups:
+            # Serve layer: one version bump per apply batch, stamping
+            # only the touched key buckets.
+            self._serve_bump([self.serve_key_bucket(k) for k in ups])
 
     # ------------------------------------------------------------ checkpoint
     def store_state(self) -> Any:
@@ -239,3 +261,4 @@ class KVTable(Table):
             self._state = {k: [np.asarray(s) for s in v]
                            for k, v in snap["state"].items()}
             self._cache.clear()
+        self._serve_bump()   # restored timeline: cached reads are void

@@ -58,7 +58,9 @@ class MatrixTable(Table):
         self._pending_dense: Dict[Optional[AddOption], np.ndarray] = {}
         self._pending_sparse: List[
             Tuple[np.ndarray, np.ndarray, Optional[AddOption]]] = []
-        self._rows_cache: Dict[AddOption, Any] = {}
+        # Jitted-apply memo keyed per AddOption — bounded by call-site
+        # diversity, not data (see base._dense_cache).
+        self._rows_cache: Dict[AddOption, Any] = {}  # mvlint: disable=MV007
         # jax.jit caches per input shape internally; one gather fn suffices.
         self._gather_fn = jax.jit(lambda data, r: data[r])
 
@@ -70,8 +72,12 @@ class MatrixTable(Table):
         with self._monitor("Get"):
             if device:
                 return self._slice_device((self.num_rows, self.num_cols))
-            return self._locked_read(
-                lambda d, s: host_fetch(d))[: self.num_rows]
+            # Serve layer: cached + coalesced whole-matrix host read
+            # (collective-safe — the key is identical on every rank).
+            return self._serve_read(
+                ("get",),
+                lambda: self._locked_read(
+                    lambda d, s: host_fetch(d))[: self.num_rows])
 
     def get_rows(self, row_ids, option=None) -> np.ndarray:
         """Row-subset pull — the sparse hot read path.
@@ -88,18 +94,30 @@ class MatrixTable(Table):
 
         with self._monitor("GetRows"):
             rows = np.asarray(row_ids, dtype=np.int64)
-            if is_multiprocess():
-                union = self._allgather_row_ids(rows)
-                k = union.shape[0]
-                if k == 0:
-                    return np.zeros((0, self.num_cols), dtype=self.dtype)
-                fetched = self._gather_host(union.astype(np.int32))
+
+            def fetch():
+                if is_multiprocess():
+                    union = self._allgather_row_ids(rows)
+                    k = union.shape[0]
+                    if k == 0:
+                        return np.zeros((0, self.num_cols),
+                                        dtype=self.dtype)
+                    fetched = self._gather_host(union.astype(np.int32))
+                    if rows.shape[0] == 0:
+                        return np.zeros((0, self.num_cols),
+                                        dtype=self.dtype)
+                    return fetched[np.searchsorted(union, rows)]
                 if rows.shape[0] == 0:
                     return np.zeros((0, self.num_cols), dtype=self.dtype)
-                return fetched[np.searchsorted(union, rows)]
-            if rows.shape[0] == 0:
-                return np.zeros((0, self.num_cols), dtype=self.dtype)
-            return self._gather_host(rows.astype(np.int32))
+                return self._gather_host(rows.astype(np.int32))
+
+            # Serve layer: per-id-set cache entries, gated by the max
+            # version over the TOUCHED row buckets (adds to other rows
+            # keep these hitting).  collective_safe=False — ranks may
+            # request different ids, and a rank-local hit would break
+            # the union collective, so multi-host bypasses the cache.
+            return self._serve_read(("rows", tuple(rows.tolist())), fetch,
+                                    buckets=rows, collective_safe=False)
 
     def _gather_host(self, rows: np.ndarray) -> np.ndarray:
         """Bucketed compiled gather + host fetch of ``rows`` (all ranks
@@ -251,6 +269,9 @@ class MatrixTable(Table):
             self._data, self._state = fn(
                 self._data, self._state, jnp.asarray(prows),
                 jnp.asarray(pdelta))
+        # Serve layer: bucket-granular bump — uniq is already the
+        # cross-rank union, so every rank stamps identical buckets.
+        self._serve_bump(uniq)
 
     # ------------------------------------------------- fused (in-jit) path
     def raw_value(self) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:

@@ -1,0 +1,33 @@
+"""Environment-capability probe (not a pytest module).
+
+Run as ``python mp_probe.py <port> <pid> <nprocs>``.  Joins a minimal
+``jax.distributed`` job over localhost and attempts ONE cross-process
+collective (``sync_global_devices``) — the exact operation every real
+multi-process test needs first.  Prints ``MP_PROBE_OK <pid>`` on
+success.
+
+Some jaxlib builds cannot run collectives across processes on the CPU
+backend at all (``XlaRuntimeError: Multiprocess computations aren't
+implemented on the CPU backend``) — an environment limit, not a repo
+bug.  ``tests/test_multiprocess.py`` runs this probe once per session
+and skips the multi-process suite with an explicit reason when it
+fails, instead of failing tier-1 on an impossible prerequisite.
+"""
+
+import os
+import sys
+
+port, pid, nprocs = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nprocs, process_id=pid)
+from jax.experimental import multihost_utils  # noqa: E402
+
+multihost_utils.sync_global_devices("mvtpu_mp_probe")
+print("MP_PROBE_OK", pid, flush=True)

@@ -146,6 +146,39 @@ gc = kvc.get(["tot"] + [f"c{r}" for r in range(nprocs)])
 np.testing.assert_allclose(gc["tot"], 5.0 * nprocs)
 for r in range(nprocs):
     np.testing.assert_allclose(gc[f"c{r}"], 5.0)
+# --- serve layer: version protocol across ranks (docs/serving.md) ----------
+# On this plane every eager add is a lockstep collective apply, so "a
+# remote rank's add" bumps the table version IDENTICALLY everywhere —
+# the cache must then MISS at max_staleness=0 (never a stale read), HIT
+# within a non-zero bound (the documented stale read), and hit/miss in
+# lockstep so the fetch collective stays deadlock-free.
+from multiverso_tpu import metrics as _metrics  # noqa: E402
+
+tsrv = mv.ArrayTable(8, name="mp_serve", serve_cache=16, max_staleness=0)
+tsrv.add(np.ones(8, np.float32))               # collective apply -> v1
+g1 = tsrv.get()                                # miss -> cached at v1
+np.testing.assert_allclose(g1, float(nprocs))
+_h0 = _metrics.counter("serve.cache.hit").value
+g2 = tsrv.get()                                # repeat read: cache hit
+assert _metrics.counter("serve.cache.hit").value == _h0 + 1
+np.testing.assert_allclose(g2, g1)
+tsrv.add(np.ones(8, np.float32))               # remote+local adds -> v2
+g3 = tsrv.get()                                # stale entry must MISS
+assert _metrics.counter("serve.cache.hit").value == _h0 + 1
+np.testing.assert_allclose(g3, 2.0 * nprocs)
+
+tstale = mv.ArrayTable(8, name="mp_stale", serve_cache=16, max_staleness=1)
+tstale.add(np.ones(8, np.float32))
+s1 = tstale.get()                              # cached at v1
+tstale.add(np.ones(8, np.float32))             # v2: within the bound
+s2 = tstale.get()                              # stale HIT (documented)
+np.testing.assert_allclose(s2, s1)
+tstale.add(np.ones(8, np.float32))             # v3: bound exceeded
+s3 = tstale.get()                              # fresh
+np.testing.assert_allclose(s3, 3.0 * nprocs)
+tsrv.close()
+tstale.close()
+
 # Scratch tables out of the registry (also keeps the checkpoint below
 # restorable by the parent test, which re-creates only the core tables).
 tssp.close()

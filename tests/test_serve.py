@@ -1,0 +1,431 @@
+"""Serve-layer tests (docs/serving.md): versioned client cache, request
+coalescer, ServeClient over the native wire, and the busy-shed/retry
+protocol.
+
+Three tiers:
+
+1. pure-unit (cache + coalescer mechanics — no runtime at all);
+2. JAX-plane tables with the serve cache armed (the ``mv`` fixture);
+3. the native ``ServeClient`` (g++-gated) — version protocol, probe
+   economics, chaos seams (``serve.busy`` / ``serve.stale``).
+
+The 2-process wire acceptance (8 concurrent gets in <= 2 round trips,
+zero-wire cache hits, busy-shed convergence under chaos) lives in
+``tools/serve_demo.py`` and runs here g++-gated.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- cache unit
+
+def _fresh_metrics():
+    from multiverso_tpu import metrics
+
+    metrics.reset()
+    return metrics
+
+
+def test_cache_version_gating_and_lru_bound():
+    from multiverso_tpu.serve import VersionedLRUCache
+
+    _fresh_metrics()
+    c = VersionedLRUCache(max_entries=2)
+    c.store(("t", 1), np.ones(3), version=5)
+    assert c.lookup(("t", 1), min_version=5)[1] == 5
+    assert c.lookup(("t", 1), min_version=6) is None      # too stale
+    assert c.lookup(("t", 1), min_version=4)[1] == 5      # within bound
+    # A racing slow fetch may not roll a fresher entry back.
+    c.store(("t", 1), np.zeros(3), version=3)
+    assert c.lookup(("t", 1), min_version=None)[1] == 5
+    # Hard LRU bound: the eldest entry falls out.
+    c.store(("t", 2), np.ones(1), version=1)
+    c.store(("t", 3), np.ones(1), version=1)
+    assert len(c) == 2
+    assert c.lookup(("t", 1), min_version=None) is None   # evicted (LRU)
+    assert c.stats()["evictions"] == 1
+
+
+def test_cache_prefix_invalidation():
+    from multiverso_tpu.serve import VersionedLRUCache
+
+    c = VersionedLRUCache(max_entries=8)
+    c.store((7, "array", 16), 1, version=1)
+    c.store((7, "rows", (1, 2)), 2, version=1)
+    c.store((8, "array", 16), 3, version=1)
+    assert c.invalidate(7) == 2            # handle 7's entries only
+    assert c.lookup((8, "array", 16), min_version=None) is not None
+    assert c.invalidate() == 1             # full clear
+    assert len(c) == 0
+
+
+def test_cache_rejects_nonpositive_bound():
+    from multiverso_tpu.serve import VersionedLRUCache
+
+    with pytest.raises(ValueError):
+        VersionedLRUCache(max_entries=0)
+
+
+# ------------------------------------------------------------ coalescer unit
+
+def test_coalescer_merges_concurrent_submits():
+    from multiverso_tpu.serve import Coalescer
+
+    co = Coalescer(window_s=0.05, max_batch=64)
+    calls = []
+    done = threading.Barrier(8)
+
+    def execute(items):
+        calls.append(list(items))
+        return [i * 10 for i in items]
+
+    out = [None] * 8
+
+    def go(i):
+        done.wait()                      # release all 8 together
+        out[i] = co.submit("k", i, execute)
+
+    ts = [threading.Thread(target=go, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert out == [i * 10 for i in range(8)]     # each got ITS result
+    assert len(calls) <= 2                        # merged, not 8 fetches
+    assert sum(len(c) for c in calls) == 8
+
+
+def test_coalescer_size_cap_seals_early():
+    from multiverso_tpu.serve import Coalescer
+
+    co = Coalescer(window_s=5.0, max_batch=2)    # window too long to wait
+    calls = []
+
+    def execute(items):
+        calls.append(list(items))
+        return items
+
+    out = [None] * 2
+
+    def go(i):
+        out[i] = co.submit("k", i, execute)
+
+    ts = [threading.Thread(target=go, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=3.0)              # full batch must NOT wait 5 s
+    assert out[0] is not None and out[1] is not None
+    assert len(calls) == 1 and len(calls[0]) == 2
+
+
+def test_coalescer_failure_fans_out_and_result_count_checked():
+    from multiverso_tpu.serve import Coalescer
+
+    co = Coalescer(window_s=0.0, max_batch=4)
+
+    def boom(items):
+        raise RuntimeError("wire died")
+
+    with pytest.raises(RuntimeError, match="wire died"):
+        co.submit("k", 0, boom)
+
+    with pytest.raises(RuntimeError, match="results"):
+        co.submit("k", 0, lambda items: [])   # wrong result arity
+
+
+# ------------------------------------------------- JAX-plane table caching
+
+def test_table_cache_hit_and_write_through_invalidation(mv):
+    from multiverso_tpu import metrics
+
+    mv.init()
+    metrics.reset()
+    t = mv.ArrayTable(16, name="srv_a", serve_cache=16, max_staleness=0)
+    t.add(np.ones(16, np.float32))
+    np.testing.assert_allclose(t.get(), 1.0)        # miss -> cached
+    h0 = metrics.counter("serve.cache.hit").value
+    got = t.get()                                    # repeat read: hit
+    np.testing.assert_allclose(got, 1.0)
+    assert metrics.counter("serve.cache.hit").value == h0 + 1
+    # The hit hands back a COPY: caller mutation can't poison the cache.
+    got[:] = 99.0
+    np.testing.assert_allclose(t.get(), 1.0)    # second hit (h0 + 2)
+    # Local add bumps the version -> stale entry misses (never stale
+    # at max_staleness=0), fresh value lands and re-caches.
+    t.add(np.ones(16, np.float32))
+    np.testing.assert_allclose(t.get(), 2.0)
+    hits_after = metrics.counter("serve.cache.hit").value
+    assert hits_after == h0 + 2                 # the fresh read was a miss
+    np.testing.assert_allclose(t.get(), 2.0)
+    assert metrics.counter("serve.cache.hit").value == hits_after + 1
+
+
+def test_table_max_staleness_window(mv):
+    mv.init()
+    t = mv.ArrayTable(8, name="srv_b", serve_cache=16, max_staleness=1)
+    t.add(np.ones(8, np.float32))
+    np.testing.assert_allclose(t.get(), 1.0)        # cached at v1
+    t.add(np.ones(8, np.float32))                    # v2: within bound
+    np.testing.assert_allclose(t.get(), 1.0)        # documented stale HIT
+    t.add(np.ones(8, np.float32))                    # v3: bound exceeded
+    np.testing.assert_allclose(t.get(), 3.0)        # fresh
+
+
+def test_table_serve_disabled_by_default(mv):
+    from multiverso_tpu import metrics
+
+    mv.init()
+    metrics.reset()
+    t = mv.ArrayTable(8, name="srv_off")
+    t.add(np.ones(8, np.float32))
+    np.testing.assert_allclose(t.get(), 1.0)
+    np.testing.assert_allclose(t.get(), 1.0)
+    assert metrics.counter("serve.cache.hit").value == 0
+
+
+def test_matrix_bucket_granularity(mv):
+    from multiverso_tpu import metrics
+
+    mv.init()
+    metrics.reset()
+    m = mv.MatrixTable(256, 4, name="srv_m", serve_cache=32)
+    m.add_rows(np.array([1]), np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(m.get_rows(np.array([1]))[0], 1.0)
+    h0 = metrics.counter("serve.cache.hit").value
+    m.get_rows(np.array([1]))                        # hit
+    assert metrics.counter("serve.cache.hit").value == h0 + 1
+    # Row 70 lives in bucket 6; row 1's entry (bucket 1) must survive.
+    m.add_rows(np.array([70]), np.ones((1, 4), np.float32))
+    m.get_rows(np.array([1]))                        # still a hit
+    assert metrics.counter("serve.cache.hit").value == h0 + 2
+    # Row 65 shares bucket 1 -> invalidates row 1's entry.
+    m.add_rows(np.array([65]), np.ones((1, 4), np.float32))
+    m.get_rows(np.array([1]))                        # miss
+    assert metrics.counter("serve.cache.hit").value == h0 + 2
+
+
+def test_kv_bucket_granularity_and_copy_safety(mv):
+    from multiverso_tpu import metrics
+    from multiverso_tpu.tables.base import Table
+
+    mv.init()
+    metrics.reset()
+    kv = mv.KVTable(value_shape=(2,), name="srv_kv", serve_cache=32)
+    kv.add({"a": np.ones(2)})
+    g = kv.get(["a"])
+    np.testing.assert_allclose(g["a"], 1.0)
+    h0 = metrics.counter("serve.cache.hit").value
+    g2 = kv.get(["a"])                               # hit
+    assert metrics.counter("serve.cache.hit").value == h0 + 1
+    g2["a"][:] = 99.0                                # mutate the copy
+    np.testing.assert_allclose(kv.get(["a"])["a"], 1.0)
+    # A key in a DIFFERENT bucket leaves "a"'s entry valid.
+    other = next(k for k in (f"k{i}" for i in range(200))
+                 if Table.serve_key_bucket(k) != Table.serve_key_bucket("a"))
+    kv.add({other: np.ones(2)})
+    kv.get(["a"])                                    # still a hit
+    assert metrics.counter("serve.cache.hit").value >= h0 + 2
+    kv.add({"a": np.ones(2)})                        # same bucket: miss
+    np.testing.assert_allclose(kv.get(["a"])["a"], 2.0)
+
+
+def test_concurrent_gets_coalesce_to_one_fetch(mv):
+    from multiverso_tpu import metrics
+
+    mv.init()
+    metrics.reset()
+    t = mv.ArrayTable(1024, name="srv_c", serve_cache=16)
+    t.add(np.ones(1024, np.float32))
+    res = [None] * 8
+    start = threading.Barrier(8)
+
+    def go(i):
+        start.wait()
+        res[i] = t.get()
+
+    ts = [threading.Thread(target=go, args=(i,)) for i in range(8)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    assert all(r[0] == 1.0 for r in res)
+    # 8 logical gets -> very few actual fetches: misses count fetch
+    # attempts, the coalesce histogram shows the batching.
+    h = metrics.histogram("serve.coalesce.batch")
+    assert h.count >= 1
+    assert h.count + int(metrics.counter("serve.cache.hit").value) <= 8
+    assert h.sum >= 8 - int(metrics.counter("serve.cache.hit").value)
+
+
+def test_serve_stale_chaos_seam_forces_miss(mv):
+    from multiverso_tpu import fault, metrics
+
+    mv.init()
+    metrics.reset()
+    t = mv.ArrayTable(8, name="srv_f", serve_cache=16)
+    t.add(np.ones(8, np.float32))
+    t.get()                                          # cached
+    fault.configure(sites={"serve.stale": {"times": 1}})
+    try:
+        m0 = metrics.counter("serve.cache.miss").value
+        np.testing.assert_allclose(t.get(), 1.0)     # forced miss
+        assert metrics.counter("serve.cache.miss").value == m0 + 1
+        assert fault.count("fault.serve.stale") == 1
+        h0 = metrics.counter("serve.cache.hit").value
+        t.get()                                      # seam disarmed: hit
+        assert metrics.counter("serve.cache.hit").value == h0 + 1
+    finally:
+        fault.reset()
+
+
+# ------------------------------------------------------- native ServeClient
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def srt():
+    from multiverso_tpu import native as nat
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    nat.ensure_built()
+    rt = nat.NativeRuntime(args=["-updater_type=default",
+                                 "-log_level=error"])
+    yield rt
+    rt.shutdown()
+
+
+@needs_gxx
+def test_native_version_protocol(srt):
+    h = srt.new_array_table(16)
+    assert srt.table_version(h) == 0
+    srt.array_add(h, np.ones(16, np.float32))
+    assert srt.table_version(h) == 1
+    assert srt.last_version(h) == 1          # blocking-add ack stamped it
+    srt.array_add(h, np.ones(16, np.float32))
+    srt.array_get(h, 16)
+    assert srt.last_version(h) == 2
+    assert srt.serve_queue_depth() >= 0
+    hits, misses = srt.cache_stats()
+    assert hits >= 0 and misses >= 0
+
+
+@needs_gxx
+def test_serve_client_cache_skips_wire(srt):
+    from multiverso_tpu import metrics
+    from multiverso_tpu.serve import ServeClient
+
+    metrics.reset()
+    c = ServeClient(srt, cache_entries=32, max_staleness=0, lease_ms=60000)
+    h = srt.new_array_table(32)
+    srt.array_add(h, np.ones(32, np.float32))
+    np.testing.assert_allclose(c.array_get(h, 32), 1.0)   # miss -> cached
+    wire0 = srt.query_monitor("ArrayWorker::Get")
+    probes0 = metrics.counter("serve.probe").value
+    for _ in range(5):
+        np.testing.assert_allclose(c.array_get(h, 32), 1.0)
+    assert srt.query_monitor("ArrayWorker::Get") == wire0  # ZERO wire gets
+    assert metrics.counter("serve.probe").value == probes0  # lease held
+    assert metrics.counter("serve.cache.hit").value >= 5
+    # Write-through: the client's own add invalidates + re-learns.
+    c.array_add(h, np.ones(32, np.float32))
+    np.testing.assert_allclose(c.array_get(h, 32), 2.0)
+
+
+@needs_gxx
+def test_serve_client_probe_instead_of_fetch(srt):
+    """lease_ms=0 + max_staleness=0: every cached read pays one cheap
+    version probe and NEVER serves stale — the full fetch only reruns
+    when the version really moved."""
+    from multiverso_tpu import metrics
+    from multiverso_tpu.serve import ServeClient
+
+    metrics.reset()
+    c = ServeClient(srt, cache_entries=32, max_staleness=0, lease_ms=0)
+    h = srt.new_array_table(8)
+    srt.array_add(h, np.ones(8, np.float32))
+    np.testing.assert_allclose(c.array_get(h, 8), 1.0)
+    wire0 = srt.query_monitor("ArrayWorker::Get")
+    np.testing.assert_allclose(c.array_get(h, 8), 1.0)    # probe + hit
+    assert srt.query_monitor("ArrayWorker::Get") == wire0
+    assert metrics.counter("serve.probe").value >= 2
+    # An out-of-band add (not via the client) MUST be seen: the probe
+    # reveals the bump, the stale entry misses, the fetch reruns.
+    srt.array_add(h, np.ones(8, np.float32))
+    np.testing.assert_allclose(c.array_get(h, 8), 2.0)
+    assert srt.query_monitor("ArrayWorker::Get") == wire0 + 1
+
+
+@needs_gxx
+def test_serve_client_busy_retry(srt):
+    """Scripted shed storm: serve.busy raises BusyError twice; the
+    client's RetryPolicy backs off and converges."""
+    from multiverso_tpu import fault, metrics
+    from multiverso_tpu.native import BusyError
+    from multiverso_tpu.serve import ServeClient
+
+    metrics.reset()
+    c = ServeClient(srt, cache_entries=32)
+    h = srt.new_array_table(8)
+    srt.array_add(h, np.ones(8, np.float32))
+    fault.configure(sites={"serve.busy": {"times": 2, "error": BusyError}})
+    try:
+        np.testing.assert_allclose(c.array_get(h, 8), 1.0)
+        assert fault.count("retry.attempts") >= 2
+    finally:
+        fault.reset()
+
+
+@needs_gxx
+def test_serve_client_rows_union(srt):
+    from multiverso_tpu.serve import ServeClient
+
+    c = ServeClient(srt, cache_entries=32, window_us=20000)
+    hm = srt.new_matrix_table(64, 4)
+    srt.matrix_add_rows(hm, [1, 2, 3], np.ones((3, 4), np.float32))
+    wire0 = srt.query_monitor("MatrixWorker::GetRows")
+    res = [None] * 8
+    start = threading.Barrier(8)
+
+    def go(i):
+        start.wait()
+        res[i] = c.matrix_get_rows(hm, [i % 4], 4)
+
+    ts = [threading.Thread(target=go, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for i in range(8):
+        want = 1.0 if i % 4 in (1, 2, 3) else 0.0
+        np.testing.assert_allclose(res[i][0], want)
+    # 8 concurrent row reads -> at most 2 wire round trips.
+    assert srt.query_monitor("MatrixWorker::GetRows") - wire0 <= 2
+
+
+# ----------------------------------------------------- 2-process acceptance
+
+@needs_gxx
+def test_serve_demo_two_process():
+    """The acceptance demo (make serve-demo): coalescing <= 2 round
+    trips for 8 concurrent gets, zero-wire cache hits, and busy-shed
+    retry convergence with no lost adds under -server_inflight_max=1 +
+    chaos."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_demo.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO)
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-2000:]
+    assert "SERVE_DEMO_OK" in out.stdout, out.stdout[-2000:]

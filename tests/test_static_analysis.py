@@ -199,6 +199,41 @@ def test_mv006_fires_on_print_in_library(tmp_path):
     assert _lint_src(d, src, name="test_snippet.py") == []
 
 
+def test_mv007_fires_on_unbounded_client_cache(tmp_path):
+    """Library code may not grow a cache/queue without a size bound;
+    bounding it (deque maxlen, an LRU with eviction) or moving out of
+    library scope silences the rule."""
+    d = tmp_path / "multiverso_tpu"
+    d.mkdir()
+    src = """\
+        from collections import OrderedDict, deque
+
+        class RowClient:
+            def __init__(self):
+                self._row_cache = {}                 # unbounded: BAD
+                self._reply_queue = deque()          # unbounded: BAD
+                self._pending = {}                   # not cache-named: fine
+
+        class BoundedClient:
+            def __init__(self, max_entries):
+                self.max_entries = max_entries
+                self._row_cache = OrderedDict()      # bounded below: fine
+                self._reply_queue = deque(maxlen=64)
+
+            def put(self, k, v):
+                self._row_cache[k] = v
+                while len(self._row_cache) > self.max_entries:
+                    self._row_cache.popitem(last=False)
+        """
+    rules = _lint_src(d, src)
+    assert [r for r, _ in rules] == ["MV007", "MV007"], rules
+    # Outside library scope (tests, apps) the identical code is exempt.
+    assert _lint_src(d, src, name="test_snippet.py") == []
+    apps = d / "apps"
+    apps.mkdir()
+    assert _lint_src(apps, src) == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

@@ -47,6 +47,16 @@ Rules (docs/static_analysis.md has the full rationale):
   postmortem reads.  Route through ``Log`` (named getLogger calls with
   an explicit sink string — ``log.py`` itself — stay legal).
 
+- **MV007 unbounded-client-cache** — library code may not grow a
+  client-side cache/queue without a size bound: a ``self.*cache*`` /
+  ``self.*queue*`` attribute initialized to a bare ``{}`` / ``dict()``
+  / ``OrderedDict()`` / ``deque()`` (no ``maxlen``) in a class showing
+  no eviction evidence (no ``popitem``/``maxlen``/``max_entries``/
+  ``capacity``/``evict`` anywhere in the class) accumulates forever
+  under serve-style traffic and OOMs the process.  Bound it (the serve
+  layer's ``VersionedLRUCache`` is the house pattern) or annotate WHY
+  the growth is bounded with a suppression comment.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -304,6 +314,71 @@ def check_print_in_library(tree, path):
     return out
 
 
+# Identifiers that count as eviction evidence for MV007: a class that
+# pops/limits anywhere is treated as managing its own bound.
+BOUND_EVIDENCE = {"popitem", "maxlen", "max_entries", "capacity", "evict",
+                  "max_size", "popleft"}
+
+
+def _is_unbounded_container(value):
+    """True for `{}` / `dict()` / `OrderedDict()` / `deque()` with no
+    maxlen — the constructions MV007 polices."""
+    if isinstance(value, ast.Dict) and not value.keys:
+        return True
+    if not isinstance(value, ast.Call):
+        return False
+    name = _call_name(value.func)
+    if name in ("dict", "OrderedDict", "defaultdict"):
+        return not value.args and not value.keywords
+    if name == "deque":
+        return not any(k.arg == "maxlen" for k in value.keywords) and \
+            len(value.args) < 2
+    return False
+
+
+def check_unbounded_client_cache(tree, path):
+    """MV007: self.*cache*/self.*queue* dict/deque with no bound."""
+    out = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        evidence = any(
+            (isinstance(n, ast.Attribute) and n.attr in BOUND_EVIDENCE)
+            or (isinstance(n, ast.Name) and n.id in BOUND_EVIDENCE)
+            or (isinstance(n, ast.keyword) and n.arg in BOUND_EVIDENCE)
+            or (isinstance(n, ast.arg) and n.arg in BOUND_EVIDENCE)
+            for n in ast.walk(cls))
+        if evidence:
+            continue
+        for node in ast.walk(cls):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            for t in targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                lname = t.attr.lower()
+                if "cache" not in lname and "queue" not in lname:
+                    continue
+                if _is_unbounded_container(value):
+                    out.append(Finding(
+                        path, node.lineno, "MV007",
+                        f"self.{t.attr} is an unbounded client-side "
+                        f"cache/queue (dict/deque with no size bound, "
+                        f"class has no eviction) — serve-style traffic "
+                        f"grows it until OOM; bound it (LRU/maxlen) or "
+                        f"annotate why growth is bounded"))
+    return out
+
+
 def lint_file(path):
     try:
         with open(path, "r", encoding="utf-8") as fh:
@@ -331,6 +406,7 @@ def lint_file(path):
                   and "/apps/" not in path and not in_tests)
     if in_library:
         findings += check_print_in_library(tree, path)
+        findings += check_unbounded_client_cache(tree, path)
     # Per-line suppressions.
     lines = src.splitlines()
     kept = []

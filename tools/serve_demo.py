@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""serve-demo — acceptance smoke for the hot-path serve layer
+(docs/serving.md; ``make serve-demo``).
+
+Runs a TWO-PROCESS native session over the loopback TcpNet wire with
+tracing armed and walks the three serve-layer claims:
+
+(a) **Coalescing** — 8 concurrent ``get()``s on one table complete in
+    <= 2 wire round trips (asserted via the ``ArrayWorker::Get``
+    monitor; the merged Chrome trace shows the ``serve::coalesced``
+    span whose ``n`` arg is the batch that collapsed).
+(b) **Versioned cache** — repeat reads within the staleness bound are
+    served locally with ZERO wire messages (``Net::Send`` count
+    unchanged, ``serve.cache.hit`` > 0), and a REMOTE rank's add bumps
+    the version so a probing client (lease 0) must re-fetch.
+(c) **Backpressure** — with ``-server_inflight_max=1`` under injected
+    wire delay, servers shed gets with ReplyBusy; shed requests retry
+    (``retry.attempts`` > 0) and every blocking add still lands exactly
+    once (final value checked — no lost adds).
+
+Prints ``SERVE_DEMO_OK`` and exits 0 on success.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SIZE = 64
+CHAOS_ADDS = 12
+READERS = 8
+
+
+def child(machine_file: str, rank: int, trace_dir: str) -> int:
+    from multiverso_tpu import metrics, native as nat, tracing
+    from multiverso_tpu.serve import ServeClient
+
+    rt = nat.NativeRuntime(args=[f"-machine_file={machine_file}",
+                                 f"-rank={rank}", "-trace=true",
+                                 "-log_level=error",
+                                 "-rpc_timeout_ms=30000"])
+    tracing.enable(rank=rank)
+    client = ServeClient(rt, cache_entries=64, max_staleness=0,
+                         lease_ms=60000.0, window_us=20000.0)
+    h = rt.new_array_table(SIZE)
+    rt.barrier()
+
+    # ---------------- (a) coalescing: 8 gets -> <= 2 round trips --------
+    if rank == 0:
+        rt.array_add(h, np.ones(SIZE, np.float32))   # seed (+ version note)
+        wire0 = rt.query_monitor("ArrayWorker::Get")
+        res = [None] * READERS
+        start = threading.Barrier(READERS)
+
+        def go(i):
+            start.wait()
+            res[i] = client.array_get(h, SIZE)
+
+        ts = [threading.Thread(target=go, args=(i,)) for i in range(READERS)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(r[0] == 1.0 for r in res)
+        a_round_trips = rt.query_monitor("ArrayWorker::Get") - wire0
+        assert a_round_trips <= 2, f"coalescing broke: {a_round_trips}"
+    else:
+        a_round_trips = 0
+    rt.barrier()
+
+    # ---------------- (b) cache: repeat reads, ZERO wire messages -------
+    if rank == 0:
+        client.array_get(h, SIZE)                    # ensure cached
+        sends0 = rt.query_monitor("Net::Send")
+        hits0 = metrics.counter("serve.cache.hit").value
+        for _ in range(5):
+            got = client.array_get(h, SIZE)
+            assert got[0] == 1.0
+        assert rt.query_monitor("Net::Send") == sends0, "cache hit sent wire"
+        assert metrics.counter("serve.cache.hit").value >= hits0 + 5
+    rt.barrier()
+
+    # (b') remote add bumps the version: a lease-0 client probes, sees
+    # the bump, and re-fetches the fresh value — never a stale read.
+    probing = ServeClient(rt, cache_entries=8, max_staleness=0,
+                          lease_ms=0.0, window_us=0.0)
+    if rank == 0:
+        v1 = probing.array_get(h, SIZE)              # probe + fetch + cache
+        assert v1[0] == 1.0
+    rt.barrier()
+    if rank == 1:
+        rt.array_add(h, np.ones(SIZE, np.float32))   # the REMOTE add
+    rt.barrier()
+    if rank == 0:
+        wire0 = rt.query_monitor("ArrayWorker::Get")
+        v2 = probing.array_get(h, SIZE)              # probe reveals bump
+        assert v2[0] == 2.0, f"stale read served: {v2[0]}"
+        assert rt.query_monitor("ArrayWorker::Get") == wire0 + 1
+    rt.barrier()
+
+    # ---------------- (c) backpressure + chaos: shed -> retry -----------
+    rt.lib.MV_SetFlag(b"server_inflight_max", b"1")
+    rt.set_fault_seed(1234 + rank)
+    rt.set_fault("delay_ms", 3)
+    rt.set_fault("delay", 0.5)                       # jam the wire
+    stop = threading.Event()
+    errors: list = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                client.array_get(h, SIZE)
+            except Exception as exc:  # retry budget exhausted etc.
+                errors.append(exc)
+                return
+
+    readers = [threading.Thread(target=hammer) for _ in range(READERS)]
+    for t in readers:
+        t.start()
+    if rank == 0:
+        for _ in range(CHAOS_ADDS):                  # adds are never shed
+            client.array_add(h, np.ones(SIZE, np.float32),
+                             coalesce=False)
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not errors, f"reader died under chaos: {errors[:1]}"
+    rt.clear_faults()
+    rt.lib.MV_SetFlag(b"server_inflight_max", b"0")
+    rt.barrier()
+    shed = rt.query_monitor("serve.shed")
+    retries = int(metrics.counter("retry.attempts").value)
+    if rank == 0:
+        client.invalidate()
+        final = client.array_get(h, SIZE)
+        want = 2.0 + CHAOS_ADDS
+        assert final[0] == want, f"lost adds: {final[0]} != {want}"
+    rt.barrier()
+
+    # Export spans (both planes) for the parent's merged-trace check.
+    from multiverso_tpu import tracing as tr
+
+    tr.add_native_spans(rt)
+    tr.save(tr.default_trace_path(trace_dir))
+    rt.barrier()
+    rt.shutdown()
+    print(f"SERVE_DEMO_CHILD_OK rank={rank} round_trips={a_round_trips} "
+          f"shed={shed} retries={retries}", flush=True)
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) == 4:               # child mode
+        return child(sys.argv[1], int(sys.argv[2]), sys.argv[3])
+
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    nprocs = 2
+    socks = [socket.socket() for _ in range(nprocs)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    workdir = tempfile.mkdtemp(prefix="mvtpu_serve_demo_")
+    mf = os.path.join(workdir, "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+    trace_dir = os.path.join(workdir, "traces")
+    os.makedirs(trace_dir, exist_ok=True)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), mf, str(r), trace_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+        for r in range(nprocs)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=240)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or f"SERVE_DEMO_CHILD_OK rank={r}" not in out:
+            print(f"rank {r} failed:\n{out[-3000:]}", file=sys.stderr)
+            return 1
+
+    # Busy sheds + retries must actually have happened somewhere in the
+    # fleet (inflight_max=1 + 8 hammering readers): "shed requests retry
+    # and converge" needs sheds to exist, not just convergence.
+    import re
+
+    shed = sum(int(re.search(r"shed=(\d+)", o).group(1)) for o in outs)
+    retries = sum(int(re.search(r"retries=(\d+)", o).group(1))
+                  for o in outs)
+    assert shed > 0, "no request was ever shed — backpressure untested"
+    assert retries > 0, "no retry recorded — the shed path never retried"
+
+    # Merged trace: the coalescer's span shows N logical gets collapsing
+    # into one wire op.
+    from multiverso_tpu import tracing
+
+    merged = tracing.merge_dir(trace_dir)
+    with open(merged) as f:
+        events = json.load(f)["traceEvents"]
+    coalesced = [e for e in events if e["name"] == "serve::coalesced"
+                 and e.get("args", {}).get("n", 0) >= 2]
+    assert coalesced, "no serve::coalesced span with n >= 2 in the trace"
+    biggest = max(e["args"]["n"] for e in coalesced)
+    print(f"SERVE_DEMO_OK sheds={shed} retries={retries} "
+          f"max_coalesced={biggest} trace={merged}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
