@@ -130,13 +130,16 @@ class ServeClient:
     def _cached(self, handle: int, key: tuple, fetch) -> np.ndarray:
         """Shared read path: cache -> coalesced fetch -> store."""
         v0 = self._read_version(handle)
-        if v0 is not None and not self._forced_stale():
-            hit = self.cache.lookup(key,
-                                    min_version=v0 - self.max_staleness)
-            if hit is not None:
-                return hit[0].copy()
-        else:
-            metrics.counter("serve.cache.miss").inc()
+        if v0 is not None:
+            # Chaos misses count only with the cache armed — a disabled
+            # cache (serve_cache_entries=0) must not accrue miss stats.
+            if self._forced_stale():
+                metrics.counter("serve.cache.miss").inc()
+            else:
+                hit = self.cache.lookup(key,
+                                        min_version=v0 - self.max_staleness)
+                if hit is not None:
+                    return hit[0].copy()
 
         def execute(items):
             def wire():
@@ -151,7 +154,11 @@ class ServeClient:
         self._note(handle)
         if v0 is not None:
             self.cache.store(key, val.copy(), v0)
-        return val
+        # Per-caller copy: coalesced waiters all hold the SAME wire
+        # ndarray — returned uncopied, one caller's in-place mutation
+        # would corrupt every other waiter's result (the hit path above
+        # already copies).
+        return val.copy()
 
     def array_get(self, handle: int, size: int) -> np.ndarray:
         return self._cached(handle, (handle, "array", size),
@@ -170,13 +177,14 @@ class ServeClient:
         ids = np.ascontiguousarray(row_ids, dtype=np.int32)
         key = (handle, "rows", tuple(ids.tolist()))
         v0 = self._read_version(handle)
-        if v0 is not None and not self._forced_stale():
-            hit = self.cache.lookup(key,
-                                    min_version=v0 - self.max_staleness)
-            if hit is not None:
-                return hit[0].copy()
-        else:
-            metrics.counter("serve.cache.miss").inc()
+        if v0 is not None:
+            if self._forced_stale():
+                metrics.counter("serve.cache.miss").inc()
+            else:
+                hit = self.cache.lookup(key,
+                                        min_version=v0 - self.max_staleness)
+                if hit is not None:
+                    return hit[0].copy()
 
         def execute(items):
             union = np.unique(np.concatenate(items))
@@ -202,14 +210,15 @@ class ServeClient:
         tup = (keys,) if single else tuple(keys)
         key = (handle, "kv", tup)
         v0 = self._read_version(handle)
-        if v0 is not None and not self._forced_stale():
-            hit = self.cache.lookup(key,
-                                    min_version=v0 - self.max_staleness)
-            if hit is not None:
-                out = hit[0]
-                return out if single else np.array(out, copy=True)
-        else:
-            metrics.counter("serve.cache.miss").inc()
+        if v0 is not None:
+            if self._forced_stale():
+                metrics.counter("serve.cache.miss").inc()
+            else:
+                hit = self.cache.lookup(key,
+                                        min_version=v0 - self.max_staleness)
+                if hit is not None:
+                    out = hit[0]
+                    return out if single else np.array(out, copy=True)
 
         def execute(items):
             def wire():
@@ -224,7 +233,9 @@ class ServeClient:
         if v0 is not None:
             stored = val if single else np.array(val, copy=True)
             self.cache.store(key, stored, v0)
-        return val
+        # Single-key reads are python floats (immutable); batch reads are
+        # one ndarray SHARED by every coalesced waiter — copy per caller.
+        return val if single else np.array(val, copy=True)
 
     # ----------------------------------------------------------- writes
     def array_add(self, handle: int, delta, *, coalesce: bool = True,

@@ -581,7 +581,15 @@ class Table:
                     self._serve_buckets[:] = v
                 return
             if self._serve_buckets is None:
-                self._serve_buckets = np.zeros(self.SERVE_BUCKETS, np.int64)
+                # Lazily created on the FIRST bucket-granular bump: seed
+                # every bucket with the pre-bump version, not zero —
+                # whole-table bumps (dense adds, load_state) that ran
+                # while the array was None must stay visible to the
+                # staleness gate, else entries cached before them would
+                # hit forever.  (The native ServerTable sidesteps this:
+                # its bucket array exists from construction.)
+                self._serve_buckets = np.full(self.SERVE_BUCKETS, v - 1,
+                                              np.int64)
             idx = np.asarray(list(buckets), np.int64) % self.SERVE_BUCKETS
             self._serve_buckets[idx] = v
 

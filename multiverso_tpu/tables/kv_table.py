@@ -102,11 +102,17 @@ class KVTable(Table):
             # keep these hitting.  Values are copied on both cache
             # boundaries — a caller mutating its dict must not corrupt
             # the cached copy.
-            return self._serve_read(
+            out = self._serve_read(
                 ("kv", tuple(keys)), fetch,
                 buckets=[self.serve_key_bucket(k) for k in keys],
                 collective_safe=False,
                 copy=lambda d: {k: v.copy() for k, v in d.items()})
+            # raw() contract: the mirror holds every key the app Get()s
+            # even when the serve cache short-circuits fetch() above.
+            with self._lock:
+                for k, v in out.items():
+                    self._cache[k] = v.copy()
+            return out
 
     def add(self, updates: Dict[Any, Any],
             option: Optional[AddOption] = None, sync: bool = False) -> None:

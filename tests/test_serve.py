@@ -143,6 +143,71 @@ def test_coalescer_failure_fans_out_and_result_count_checked():
         co.submit("k", 0, lambda items: [])   # wrong result arity
 
 
+# ---------------------------------------------------- client unit (stub rt)
+
+class _StubRT:
+    """Duck-typed NativeRuntime: enough surface for ServeClient reads."""
+
+    def __init__(self):
+        self.version = 1
+        self.gets = 0
+
+    def last_version(self, handle):
+        return self.version
+
+    def table_version(self, handle):
+        return self.version
+
+    def array_get(self, handle, size):
+        self.gets += 1
+        return np.full(size, 7.0, np.float32)
+
+
+def test_client_coalesced_waiters_get_private_copies():
+    """Regression: every coalesced waiter of one wire fetch used to get
+    the SAME ndarray — one caller mutating its result corrupted every
+    sibling's.  Each waiter must own a private copy (like the hit path).
+    """
+    from multiverso_tpu.serve import ServeClient
+
+    _fresh_metrics()
+    c = ServeClient(_StubRT(), cache_entries=8, max_staleness=0,
+                    window_us=20000, lease_ms=60000)
+    out = [None] * 4
+    start = threading.Barrier(4)
+
+    def go(i):
+        start.wait()
+        a = c.array_get(1, 8)
+        a[:] = float(i)              # caller-owned: must not leak out
+        out[i] = a
+
+    ts = [threading.Thread(target=go, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for i in range(4):
+        np.testing.assert_allclose(out[i], float(i))
+    np.testing.assert_allclose(c.array_get(1, 8), 7.0)  # cache unpoisoned
+
+
+def test_client_disabled_cache_counts_no_misses():
+    """serve_cache_entries=0: no cache exists, so stats must not accrue
+    a growing miss count (and no version probes fire either)."""
+    from multiverso_tpu import metrics
+    from multiverso_tpu.serve import ServeClient
+
+    _fresh_metrics()
+    rt = _StubRT()
+    c = ServeClient(rt, cache_entries=0, max_staleness=0)
+    np.testing.assert_allclose(c.array_get(1, 4), 7.0)
+    np.testing.assert_allclose(c.array_get(1, 4), 7.0)
+    assert rt.gets == 2                       # every read pays the wire
+    assert metrics.counter("serve.cache.miss").value == 0
+    assert metrics.counter("serve.probe").value == 0
+
+
 # ------------------------------------------------- JAX-plane table caching
 
 def test_table_cache_hit_and_write_through_invalidation(mv):
@@ -214,6 +279,24 @@ def test_matrix_bucket_granularity(mv):
     assert metrics.counter("serve.cache.hit").value == h0 + 2
 
 
+def test_lazy_buckets_inherit_whole_table_version(mv):
+    """Regression: the bucket array is created lazily on the FIRST
+    bucket-granular bump.  Whole-table bumps (dense adds) that ran while
+    it was None must stay visible — seeding the new array with zeros
+    instead of the pre-bump version would let entries cached BEFORE
+    those dense adds hit forever (a stale serve at max_staleness=0)."""
+    mv.init()
+    m = mv.MatrixTable(256, 4, name="srv_lz", serve_cache=32,
+                       max_staleness=0)
+    m.add(np.ones((256, 4), np.float32))             # whole-table bump
+    np.testing.assert_allclose(m.get_rows(np.array([1]))[0], 1.0)  # cached
+    m.add(np.ones((256, 4), np.float32))             # bump w/ buckets None
+    # First bucket-granular bump (row 70, bucket 6) materializes the
+    # bucket array; bucket 1 must inherit the dense-add version.
+    m.add_rows(np.array([70]), np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(m.get_rows(np.array([1]))[0], 2.0)
+
+
 def test_kv_bucket_granularity_and_copy_safety(mv):
     from multiverso_tpu import metrics
     from multiverso_tpu.tables.base import Table
@@ -229,6 +312,11 @@ def test_kv_bucket_granularity_and_copy_safety(mv):
     assert metrics.counter("serve.cache.hit").value == h0 + 1
     g2["a"][:] = 99.0                                # mutate the copy
     np.testing.assert_allclose(kv.get(["a"])["a"], 1.0)
+    # raw() contract survives the serve cache: a HIT skips fetch(), but
+    # the mirror must still hold every key the app Get()s.
+    kv.raw.clear()
+    kv.get(["a"])                                    # hit — no fetch
+    np.testing.assert_allclose(kv.raw["a"], 1.0)
     # A key in a DIFFERENT bucket leaves "a"'s entry valid.
     other = next(k for k in (f"k{i}" for i in range(200))
                  if Table.serve_key_bucket(k) != Table.serve_key_bucket("a"))
