@@ -65,8 +65,18 @@ serve-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/serve_demo.py
 
+# Compressed wire data plane smoke (docs/wire_compression.md): a
+# 2-process wire session proving (a) 1bit adds ship >= 3x fewer bytes
+# than raw at equal served values (error feedback), (b) >= 4 small
+# async adds collapse into one wire message with read-your-writes
+# intact, (c) the native byte/message ledger bridges into the metrics
+# registry as net.bytes{dir=...}/net.msgs.
+wire-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/wire_demo.py
+
 clean:
 	$(MAKE) -C $(NATIVE) clean
 
 .PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
-        serve-demo clean
+        serve-demo wire-demo clean

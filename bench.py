@@ -363,6 +363,45 @@ def bench_wire_micro():
     outs = _run_test_ranks("wire_bench", 2, ("tcp",))
     parse(outs[0], "wire_tcp", res)
 
+    # --- payload-codec sweep (docs/wire_compression.md) ----------------
+    # The same dense-add workload raw vs 1bit through the FULL runtime
+    # (tables + actors + wire), bytes measured at the transport ledger
+    # (net.bytes.sent): wire_{raw,1bit}_{bytes,msgs}_per_s plus the
+    # headline payload-byte ratio (acceptance: >= 3x; ~30x measured).
+    try:
+        import re
+
+        codec_outs = _run_test_ranks("codec_wire", 2)
+        for m in re.finditer(
+                r"CODEC (\w+) bytes=(\d+) msgs=(\d+) secs=([0-9.]+)",
+                codec_outs[0]):
+            name, nbytes, msgs, secs = m.groups()
+            secs = max(float(secs), 1e-9)
+            res[f"wire_{name}_bytes_per_s"] = float(nbytes) / secs
+            res[f"wire_{name}_msgs_per_s"] = float(msgs) / secs
+        m = re.search(r"CODEC_RATIO ([0-9.]+)", codec_outs[0])
+        if m:
+            res["wire_1bit_bytes_ratio"] = float(m.group(1))
+    except Exception:
+        traceback.print_exc()
+
+    # --- add-aggregation sub-section -----------------------------------
+    # adds-per-wire-message collapse ratio from the agg scenario's
+    # counters (agg.adds / agg.flush; acceptance: >= 4 in the demo).
+    try:
+        agg_outs = _run_test_ranks("agg_bench", 2)
+        import re
+
+        m = re.search(r"AGG_BENCH adds=(\d+) flushes=(\d+) secs=([0-9.]+)",
+                      agg_outs[0])
+        if m:
+            adds, flushes, secs = (float(m.group(1)), float(m.group(2)),
+                                   max(float(m.group(3)), 1e-9))
+            res["add_agg_ratio"] = adds / max(flushes, 1.0)
+            res["add_agg_adds_per_s"] = adds / secs
+    except Exception:
+        traceback.print_exc()
+
     # MPI sweep: only meaningful under a launcher.
     if shutil.which("mpirun"):
         native_dir = os.path.join(
@@ -401,6 +440,19 @@ def bench_ssp():
     return {"ssp_vs_bsp_speedup": bsp_ms / ssp_ms}
 
 
+def _lr_native_loss(procs: int, steps: int, batch: int, codec: str):
+    """Mean final LR loss over a native-wire fleet running `codec`
+    (lr_native_worker.py prints loss= after the final barrier)."""
+    import re
+
+    outs = _spawn_native_workers("lr_native_worker.py", procs,
+                                 "NATIVE_LR_OK",
+                                 (steps, batch, codec))
+    return float(np.mean([
+        float(re.search(r"loss=([0-9.]+)", out).group(1))
+        for out in outs]))
+
+
 def bench_lr_native8(procs: int = 8, steps: int = 60, batch: int = 1024):
     """The BASELINE.json north-star denominator (LR half), measured as
     honestly as the empty reference mount allows: LR through the native
@@ -414,10 +466,24 @@ def bench_lr_native8(procs: int = 8, steps: int = 60, batch: int = 1024):
     loop."""
     wall = _run_native_workers("lr_native_worker.py", procs,
                                "NATIVE_LR_OK", (steps, batch))
-    return {
+    out = {
         "lr_native8_samples_per_sec": procs * steps * batch / wall,
         "lr_native8_procs": float(procs),
     }
+    # Codec convergence ledger (docs/wire_compression.md): the SAME job
+    # at equal steps on the raw vs the 1bit wire — acceptance is the
+    # final losses matching within 5% (error feedback paying back the
+    # 32x byte saving).  Smaller fleet: the claim is about the codec,
+    # not the throughput.
+    try:
+        loss_raw = _lr_native_loss(4, 40, 512, "raw")
+        loss_1bit = _lr_native_loss(4, 40, 512, "1bit")
+        out["lr_native_loss_raw"] = loss_raw
+        out["lr_native_loss_1bit"] = loss_1bit
+        out["lr_native_1bit_loss_ratio"] = loss_1bit / loss_raw
+    except Exception:
+        traceback.print_exc()
+    return out
 
 
 def bench_w2v_native8(procs: int = 8, steps: int = 20, batch: int = 512):
@@ -1172,6 +1238,22 @@ _PRIMARY = [
 
 
 def main() -> None:
+    # Backend guard (BENCH_r05 regression: rc=124, parsed=null): on a
+    # host whose default JAX platform is experimental/broken, the FIRST
+    # jax import can wedge or die before any JSON ever printed.  When
+    # the caller did not pick a platform, pin the CPU backend — every
+    # accelerator-path section still runs (they measure whatever devices
+    # the chosen backend exposes), and a caller that wants the real TPU
+    # sets JAX_PLATFORMS explicitly.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    # Schema/partial line FIRST — before any JAX-touching import — so
+    # even a backend-init hang killed by `timeout` leaves one parseable
+    # line on stdout.
+    results = {"bench_schema": 9}
+    errors = []
+    _emit(results, errors)
+
     import multiverso_tpu as mv
 
     mv.init(args=["-log_level=error"], updater_type="sgd")
@@ -1195,9 +1277,14 @@ def main() -> None:
     # 8 = serve section (serve_{cold,cached,coal8}_{p50,p95,p99}_ms/_qps
     # over the 2-process native wire + serve_cached_vs_cold_p50, the
     # cached-read speedup headline — docs/serving.md), and `bench.py
-    # <name>` now runs only the sections whose names contain <name>.
-    results = {"bench_schema": 8}
-    errors = []
+    # <name>` now runs only the sections whose names contain <name>;
+    # 9 = compressed wire data plane (docs/wire_compression.md): the
+    # schema line now prints BEFORE the first JAX-touching import (and
+    # JAX_PLATFORMS defaults to cpu when unset — the r05 parsed-null
+    # fix), wire_{raw,1bit}_{bytes,msgs}_per_s + wire_1bit_bytes_ratio
+    # (codec sweep via net.bytes counters), add_agg_ratio/_adds_per_s
+    # (aggregation collapse), and lr_native_loss_{raw,1bit} +
+    # lr_native_1bit_loss_ratio (equal-steps codec convergence).
 
     # A budget SIGTERM lands mid-section: convert it to an exception so
     # the JSON accumulated so far still prints (the whole point of the

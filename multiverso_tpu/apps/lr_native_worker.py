@@ -16,7 +16,13 @@ path's speedup over it as ``lr_fused_vs_native8`` — a real
 distributed-wire denominator rather than a same-chip loop.
 
 Run: ``python lr_native_worker.py <machine_file> <rank> <steps>
-<batch>`` (spawned by ``bench.py``; stands alone for debugging).
+<batch> [codec]`` (spawned by ``bench.py``; stands alone for
+debugging).  ``codec`` (default ``raw``) selects the wire payload codec
+(docs/wire_compression.md): with ``1bit`` every gradient Add ships as
+sign bits + two scales with worker-side error feedback — ~32x fewer
+payload bytes for the same training trajectory, which the printed
+``loss=`` (final mean cross-entropy on this rank's batch) lets the
+bench verify stays within 5% of the raw run.
 """
 
 import os
@@ -33,12 +39,14 @@ import numpy as np  # noqa: E402
 def main(argv) -> None:
     mf, rank = argv[0], int(argv[1])
     steps, batch = int(argv[2]), int(argv[3])
+    codec = argv[4] if len(argv) > 4 else "raw"
     features, classes = 784, 10
 
     from multiverso_tpu import native as nat
 
     rt = nat.NativeRuntime(args=[f"-machine_file={mf}", f"-rank={rank}",
-                                 "-updater_type=sgd", "-log_level=error"])
+                                 "-updater_type=sgd", "-log_level=error",
+                                 f"-wire_codec={codec}"])
     n = features * classes
     h = rt.new_array_table(n)
     rt.set_add_option(learning_rate=0.1)
@@ -61,8 +69,17 @@ def main(argv) -> None:
     rt.barrier()              # every rank's adds applied
     dt = time.perf_counter() - t0
 
+    # Final mean cross-entropy on this rank's batch — the convergence
+    # ledger the codec comparison reads (equal steps, raw vs 1bit).
+    w = rt.array_get(h, n).reshape(features, classes)
+    logits = x @ w
+    logits -= logits.max(axis=1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(axis=1, keepdims=True)
+    loss = float(-(y * np.log(p + 1e-12)).sum(axis=1).mean())
+
     print(f"NATIVE_LR_OK rank={rank} dt={dt:.6f} steps={steps} "
-          f"batch={batch}", flush=True)
+          f"batch={batch} loss={loss:.6f} codec={codec}", flush=True)
     rt.shutdown()
 
 

@@ -76,6 +76,10 @@ int MV_SetFaultN(const char* kind, long long n);
 int MV_SetFaultSeed(long long seed);
 int MV_ClearFaults(void);
 int MV_DeadPeerCount(void);
+int MV_SetTableCodec(int32_t handle, const char* codec);
+int MV_FlushAdds(int32_t handle);
+int MV_WireStats(long long* sent_bytes, long long* recv_bytes,
+                 long long* sent_msgs, long long* recv_msgs);
 ]]
 
 -- libmvtpu.so sits two directories up from this file (native/build/).
@@ -209,6 +213,31 @@ function mv.clear_faults() check(C.MV_ClearFaults(), "MV_ClearFaults") end
 
 --- Peers with expired heartbeat leases (rank 0 under -heartbeat_ms).
 function mv.dead_peer_count() return C.MV_DeadPeerCount() end
+
+--- Wire data plane (docs/wire_compression.md): retarget one table's
+--- payload codec — "raw" | "1bit" (sign bits + scales with worker-side
+--- error feedback) | "sparse" (lossless nonzero pairs).  Tables start
+--- on the -wire_codec flag's value.
+function mv.set_table_codec(handle, codec)
+  check(C.MV_SetTableCodec(handle, codec), "MV_SetTableCodec")
+end
+
+--- Drain the add-aggregation buffer (-add_agg_ms/-add_agg_bytes) of one
+--- table, or of every table when handle is nil/negative.
+function mv.flush_adds(handle)
+  check(C.MV_FlushAdds(handle or -1), "MV_FlushAdds")
+end
+
+--- Transport byte/frame ledger: returns sent_bytes, recv_bytes,
+--- sent_msgs, recv_msgs over the native wire (headers included).
+function mv.wire_stats()
+  local sb = ffi.new("long long[1]")
+  local rb = ffi.new("long long[1]")
+  local sm = ffi.new("long long[1]")
+  local rm = ffi.new("long long[1]")
+  check(C.MV_WireStats(sb, rb, sm, rm), "MV_WireStats")
+  return tonumber(sb[0]), tonumber(rb[0]), tonumber(sm[0]), tonumber(rm[0])
+end
 
 -- Shared async-get handle (MV_GetAsync* wait tickets): wait() joins the
 -- pull and returns the filled buffer; a FAILED wait replays its error

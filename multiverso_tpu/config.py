@@ -173,6 +173,24 @@ define_string("trace_dir", "",
               "trace-event JSON, Perfetto-loadable) here at shutdown; "
               "merge ranks with tracing.merge_dir (docs/observability.md)")
 
+# --- wire data plane (docs/wire_compression.md) ----------------------------
+define_string("wire_codec", "raw",
+              "payload codec for table wire traffic: raw|1bit|sparse. "
+              "On the JAX plane, 1bit makes sign-bit+scales compression "
+              "(error feedback) the default for host dense adds on "
+              "float ASP tables (the explicit compress= kwarg still "
+              "wins); on the native plane every new table negotiates "
+              "this codec at creation (MV_SetTableCodec retargets one)")
+define_int("add_agg_ms", 0,
+           "native-plane add aggregation window (ms): async dense adds "
+           "within the window sum worker-side and ship as ONE "
+           "codec-encoded wire message; flushed by Get/Clock/Barrier/"
+           "shutdown so BSP/SSP semantics hold (native-flag parity; the "
+           "lockstep JAX plane has no per-add wire messages to collapse)")
+define_int("add_agg_bytes", 0,
+           "native-plane add aggregation size bound: flush once absorbed "
+           "payload bytes reach this (native-flag parity)")
+
 # --- serve layer (docs/serving.md) -----------------------------------------
 define_int("serve_cache_entries", 0,
            "versioned client cache size (entries) for table reads; 0 "

@@ -83,6 +83,12 @@ class Counter:
         with self._lock:
             self._value += n
 
+    def _load(self, value: float) -> None:
+        """Set absolute state (the native bridge imports cumulative
+        counters, so re-bridging refreshes rather than double-counts)."""
+        with self._lock:
+            self._value = float(value)
+
     @property
     def value(self) -> float:
         with self._lock:
@@ -431,6 +437,14 @@ def bridge_native(runtime: Any, prefix: str = "native.") -> int:
         h = REGISTRY.histogram(prefix + name, bounds=NATIVE_TIME_BUCKETS)
         h._load(count, total, vmax, buckets)
         n += 1
+        # Wire-byte observability parity (docs/wire_compression.md):
+        # the native transport ledgers record 1 unit = 1 byte with
+        # count = frames, so they land as the same labelled counters
+        # the Python io layer uses (io.bytes{dir=...} -> net.bytes).
+        if name in ("net.bytes.sent", "net.bytes.recv"):
+            direction = name.rsplit(".", 1)[1]
+            REGISTRY.counter("net.bytes", {"dir": direction})._load(total)
+            REGISTRY.counter("net.msgs", {"dir": direction})._load(count)
     dead = getattr(runtime, "dead_peer_count", None)
     if dead is not None:
         REGISTRY.gauge(prefix + "dead_peers").set(float(dead()))

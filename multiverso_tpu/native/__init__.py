@@ -166,6 +166,12 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_ClearFaults.restype = ctypes.c_int
     lib.MV_DeadPeerCount.argtypes = []
     lib.MV_DeadPeerCount.restype = ctypes.c_int
+    lib.MV_SetTableCodec.argtypes = [ctypes.c_int32, ctypes.c_char_p]
+    lib.MV_SetTableCodec.restype = ctypes.c_int
+    lib.MV_FlushAdds.argtypes = [ctypes.c_int32]
+    lib.MV_FlushAdds.restype = ctypes.c_int
+    lib.MV_WireStats.argtypes = [ctypes.POINTER(ctypes.c_longlong)] * 4
+    lib.MV_WireStats.restype = ctypes.c_int
     for name in ("MV_TableVersion", "MV_LastVersion"):
         getattr(lib, name).argtypes = [ctypes.c_int32,
                                        ctypes.POINTER(ctypes.c_longlong)]
@@ -490,6 +496,36 @@ class NativeRuntime:
     def dead_peer_count(self) -> int:
         """Peers with expired heartbeat leases (rank 0, -heartbeat_ms)."""
         return self.lib.MV_DeadPeerCount()
+
+    # ------------------------------------------------- wire data plane
+    def set_table_codec(self, handle: int, codec: str) -> None:
+        """Retarget one table's wire codec (docs/wire_compression.md):
+        ``raw`` | ``1bit`` (sign bits + scales, worker-side error
+        feedback) | ``sparse`` (lossless nonzero pairs with raw
+        fallback).  Tables start on the ``-wire_codec`` flag."""
+        self._check(self.lib.MV_SetTableCodec(handle, codec.encode()),
+                    "MV_SetTableCodec")
+
+    def flush_adds(self, handle: int = -1) -> None:
+        """Drain the add-aggregation buffer (``-add_agg_ms`` /
+        ``-add_agg_bytes``) of one table — or every table when
+        ``handle < 0`` — onto the wire.  Get/Clock/Barrier/shutdown
+        flush implicitly; this is the explicit trigger."""
+        from .. import fault
+
+        fault.inject("agg.flush")
+        self._check(self.lib.MV_FlushAdds(handle), "MV_FlushAdds")
+
+    def wire_stats(self) -> dict:
+        """Transport byte/frame ledger: ``{"sent_bytes", "recv_bytes",
+        "sent_msgs", "recv_msgs"}`` over the native wire (headers
+        included) — the numbers behind ``net.bytes{dir=...}`` /
+        ``net.msgs`` in the metrics registry."""
+        vals = [ctypes.c_longlong(0) for _ in range(4)]
+        self._check(self.lib.MV_WireStats(*(ctypes.byref(v) for v in vals)),
+                    "MV_WireStats")
+        return {"sent_bytes": vals[0].value, "recv_bytes": vals[1].value,
+                "sent_msgs": vals[2].value, "recv_msgs": vals[3].value}
 
     # ------------------------------------------------- serve layer
     def table_version(self, handle: int) -> int:

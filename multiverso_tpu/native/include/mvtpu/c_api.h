@@ -171,6 +171,26 @@ int MV_ClearFaults(void);
 // peers whose liveness lease is currently expired.  0 elsewhere.
 int MV_DeadPeerCount(void);
 
+// ---- wire data plane (docs/wire_compression.md) ----------------------
+// Retarget one table's wire codec: "raw" | "1bit" (sign bits + two
+// scales per message, worker-side error feedback so the quantization
+// loss re-enters the next add) | "sparse" (lossless nonzero
+// index/value pairs, per-message raw fallback when not smaller).
+// Tables start on the `-wire_codec` flag's value.  -1 on an unknown
+// codec name, -2 on a bad handle.
+int MV_SetTableCodec(int32_t handle, const char* codec);
+// Drain the add-aggregation buffer (`-add_agg_ms`/`-add_agg_bytes`) of
+// one table — or of EVERY table when handle < 0 — onto the wire.
+// Get/Clock/Barrier/shutdown flush implicitly; this is the explicit
+// trigger ("Flush" in the aggregation contract).
+int MV_FlushAdds(int32_t handle);
+// Transport byte/message ledger: total wire bytes and frames this
+// process sent/received (TcpNet + MpiNet, headers included).  The
+// counters behind the Python `net.bytes{dir=...}`/`net.msgs` bridge;
+// any output pointer may be NULL.
+int MV_WireStats(long long* sent_bytes, long long* recv_bytes,
+                 long long* sent_msgs, long long* recv_msgs);
+
 #ifdef __cplusplus
 }
 #endif

@@ -56,6 +56,45 @@ enum class MsgType : int32_t {
   Exit = 64,
 };
 
+// Payload codec (docs/wire_compression.md): how the LAST blob of a
+// message's data (the float delta/value payload) is encoded on the
+// wire.  Negotiated per table at creation (`-wire_codec` /
+// MV_SetTableCodec) and stamped per MESSAGE in the wire header — a
+// sparse-codec table falls back to kRaw on payloads where the sparse
+// form would be larger, so the receiver must trust the stamp, not the
+// table setting.
+enum class Codec : int32_t {
+  kRaw = 0,     // float32, one element per 4 bytes (the reference wire)
+  kOneBit = 1,  // sign bits + two scales, worker-side error feedback
+  kSparse = 2,  // (index, value) pairs of nonzeros — lossless
+};
+
+// Header flag bits: the codecs the SENDER of a request accepts in the
+// reply.  Every request carries kAcceptRaw; tables with a non-raw codec
+// additionally advertise the lossless sparse codec so large mostly-zero
+// Get replies can shrink.  (Replies are never 1-bit encoded: error
+// feedback needs a per-receiver residual the server does not hold.)
+namespace msgflag {
+inline constexpr int32_t kAcceptRaw = 1 << 0;
+inline constexpr int32_t kAccept1Bit = 1 << 1;
+inline constexpr int32_t kAcceptSparse = 1 << 2;
+}  // namespace msgflag
+
+// Fixed-size wire header — ONE definition shared by Message::Serialize
+// (contiguous form: tests, MpiNet) and TcpNet's scatter-gather send
+// (header + blob iovecs, no payload copy).  Layout changes here change
+// the wire format; both sides memcpy this struct.
+struct WireHeader {
+  int32_t src, dst, type, table_id;
+  int64_t msg_id;
+  int64_t trace_id;
+  int64_t version;
+  int32_t codec;      // Codec of data.back() (kRaw when data is empty)
+  int32_t flags;      // msgflag:: accept bits for the reply
+  int32_t num_blobs;
+  int32_t pad = 0;    // keep sizeof a multiple of 8 explicitly
+};
+
 struct Message {
   int32_t src = -1;
   int32_t dst = -1;
@@ -73,11 +112,28 @@ struct Message {
   // can bound cache staleness.  On a RequestVersion it instead carries
   // the REQUESTED bucket (-1 = whole table).  0 = unversioned.
   int64_t version = 0;
+  // Wire codec of data.back() (docs/wire_compression.md).  kRaw unless a
+  // worker-side encode stamped it; the server decodes before ProcessAdd
+  // and the worker actor decodes replies before Notify, so the table
+  // layer itself only ever sees raw float payloads.
+  Codec codec = Codec::kRaw;
+  // msgflag:: accept bits: the reply codecs this request's sender can
+  // decode (stamped by Get/version requests; replies echo kAcceptRaw).
+  int32_t flags = msgflag::kAcceptRaw;
   std::vector<Blob> data;
 
-  // Serialize to one contiguous buffer (header + per-blob length prefix) —
-  // the shape a cross-process transport would ship. Exercised by tests and
-  // available to future DCN transports; in-process routing skips it.
+  // Header <-> message field marshalling (shared by Serialize and the
+  // transport's scatter-gather framing).
+  void FillWireHeader(WireHeader* h) const;
+  void AdoptWireHeader(const WireHeader& h);
+  // Total framed byte count (header + per-blob length prefixes + blob
+  // payloads) — what one wire frame of this message occupies.
+  int64_t WireBytes() const;
+
+  // Serialize to one contiguous buffer (header + per-blob length prefix):
+  // the MpiNet wire shape and the test-suite round-trip form.  TcpNet
+  // ships the identical layout via scatter-gather iovecs instead
+  // (net.cc SendFramed) — no full-payload copy on the hot path.
   Blob Serialize() const;
   static Message Deserialize(const Blob& buf);
 };

@@ -47,19 +47,25 @@ class TcpNet : public Net {
   // Parse a machine file into "host:port" endpoints; empty on error.
   static std::vector<std::string> ParseMachineFile(const std::string& path);
 
-  // One length-prefixed serialized Message over a raw fd (used by the
+  // One length-prefixed Message frame over a raw fd (used by the
   // dynamic-registration handshake, which runs before the transport,
-  // and by the transport's own ReadLoop/Send).  `max_bytes <= 0` means
+  // and by the transport's own ReadLoop/Send).  The frame is written
+  // SCATTER-GATHER (sendmsg over header + per-blob iovecs): the payload
+  // blobs go to the kernel in place — no full-message Serialize() copy
+  // on the send path (the frame layout is identical to Serialize()'s,
+  // so RecvFramed/Deserialize are unchanged).  `max_bytes <= 0` means
   // the transport-wide frame cap; the handshake passes a tight bound so
   // a hostile/garbled registration connection cannot force a huge
   // allocation on the controller.
   static bool SendFramed(int fd, const Message& msg);
-  static bool SendFramed(int fd, const Blob& wire);   // pre-serialized
   // `body_timeout_ms > 0` bounds the read of a frame's BODY once its
   // length prefix arrived (an idle connection may block forever on the
   // prefix — that is legitimate; a peer that stalls mid-frame is not).
+  // `frame_bytes` (optional) receives the frame's byte count — the
+  // receive-side feed for the net.bytes.recv counter.
   static bool RecvFramed(int fd, Message* msg, int64_t max_bytes = 0,
-                         int64_t body_timeout_ms = 0);
+                         int64_t body_timeout_ms = 0,
+                         int64_t* frame_bytes = nullptr);
 
   // Dynamic registration (reference src/controller.cpp Control_Register,
   // SURVEY.md §2.7/§3.1): the controller listens on `ctrl_endpoint`,
@@ -89,8 +95,9 @@ class TcpNet : public Net {
   bool Init(const std::vector<std::string>& endpoints, int rank,
             InboundFn fn, int64_t connect_retry_ms = 15000);
 
-  // Serialize + frame + write to the peer (lazy connect with retries —
-  // peers start in any order).  A failed write is retried up to
+  // Frame + write to the peer (lazy connect with retries — peers start
+  // in any order; scatter-gather, so the payload is never copied into a
+  // contiguous wire buffer first).  A failed write is retried up to
   // `-send_retries` times with exponential backoff (`-send_backoff_ms`
   // base), reconnecting between attempts; writes are bounded by
   // `-io_timeout_ms` (SO_SNDTIMEO) so a wedged peer cannot park the
@@ -109,8 +116,11 @@ class TcpNet : public Net {
   void AcceptLoop();
   void ReadLoop(int fd);
   int ConnectTo(int dst_rank);
-  // One connect-if-needed + framed-write attempt (no retry).
-  bool SendAttempt(int dst_rank, const Blob& wire);
+  // One connect-if-needed + framed-write attempt (no retry).  The retry
+  // loop re-invokes this with the same Message — the iovec set is
+  // rebuilt per attempt, so a partial write on a torn-down connection
+  // never leaks into the next one.
+  bool SendAttempt(int dst_rank, const Message& msg);
 
   std::vector<std::string> endpoints_;
   int rank_ = 0;

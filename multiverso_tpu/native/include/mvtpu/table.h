@@ -18,6 +18,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "mvtpu/codec.h"
 #include "mvtpu/message.h"
 #include "mvtpu/mutex.h"
 #include "mvtpu/stream.h"
@@ -213,6 +214,62 @@ class WorkerTable {
   // or timing out — the retryable case (C API rc -6 vs -3).
   static bool last_call_busy();
 
+  // ---- wire codec (docs/wire_compression.md) -------------------------
+  // Negotiated at table creation from `-wire_codec` (overridable per
+  // table via MV_SetTableCodec) and stamped per message: dense Add
+  // payloads ship 1-bit (sign + two scales, worker-side error feedback)
+  // or sparse (nonzero index/value pairs, lossless, with per-message
+  // raw fallback when not smaller); Get requests advertise the accept
+  // set so large mostly-zero replies can come back sparse.
+  void set_codec(Codec c) {
+    codec_.store(static_cast<int32_t>(c), std::memory_order_release);
+  }
+  Codec wire_codec() const {
+    return static_cast<Codec>(codec_.load(std::memory_order_acquire));
+  }
+  // msgflag:: bits for requests: raw always; non-raw tables also accept
+  // the lossless sparse reply form (1-bit replies never happen — error
+  // feedback needs a per-receiver residual the server does not hold).
+  int32_t accept_flags() const {
+    Codec c = wire_codec();
+    int32_t f = msgflag::kAcceptRaw;
+    if (c != Codec::kRaw) f |= msgflag::kAcceptSparse;
+    if (c == Codec::kOneBit) f |= msgflag::kAccept1Bit;
+    return f;
+  }
+
+  // ---- add aggregation (docs/wire_compression.md) --------------------
+  // With `-add_agg_ms`/`-add_agg_bytes` armed, ASYNC dense adds are
+  // summed into a local per-table buffer and shipped as ONE
+  // codec-encoded wire message per flush window.  Flush triggers: the
+  // size/time bound, any Get/QueryVersion, any blocking or
+  // differently-shaped add, Clock (the tick must ride BEHIND the adds
+  // it announces), Barrier (via FlushPipelines) and shutdown — so
+  // BSP/SSP visibility semantics are unchanged.  The time window is
+  // checked lazily at the next table op (no flusher thread).
+  void FlushAdds();
+
+ protected:
+  // Absorb an async dense add of n elements into the aggregation
+  // buffer.  True = absorbed (nothing on the wire yet); false = the
+  // aggregation feature is off and the caller sends normally.  An
+  // incompatible buffered aggregate (different length or AddOption) is
+  // flushed first; a full/expired buffer is flushed right after.
+  bool MaybeAggregate(const float* delta, int64_t n, const AddOption& opt);
+  // Subclass hook: ship `sum` (n elements) as one async add.
+  virtual void SendAggregate(const float* sum, int64_t n,
+                             const AddOption& opt) {
+    (void)sum;
+    (void)n;
+    (void)opt;
+  }
+  // Append the delta payload blob to `req`, encoded per this table's
+  // codec, stamping req->codec.  `elem_offset` locates the slice inside
+  // the table's flat element space (the 1-bit error-feedback residual
+  // is per element and spans the whole table, `table_elems` long).
+  void AppendEncodedDelta(Message* req, const float* delta, int64_t n,
+                          int64_t elem_offset, int64_t table_elems);
+
  protected:
   // Send all reqs (same msg_id) via the Zoo, block until each got its
   // reply; `consume` runs once per reply (serialized — one worker-actor
@@ -248,6 +305,24 @@ class WorkerTable {
   };
   std::unordered_map<int64_t, Pending> pending_ GUARDED_BY(mu_);
   std::atomic<int64_t> last_version_{0};
+
+  // Wire codec (set at registration; MV_SetTableCodec may retarget).
+  std::atomic<int32_t> codec_{static_cast<int32_t>(Codec::kRaw)};
+
+  // 1-bit error-feedback residual: per element over the WHOLE table's
+  // flat space, lazily sized on first encode.  Worker-side state (the
+  // reference keeps it with the sender), never on the wire.
+  Mutex residual_mu_;
+  std::vector<float> residual_ GUARDED_BY(residual_mu_);
+
+  // Add-aggregation buffer: one delta-shaped sum + the option it rides
+  // under.  Bounded by construction (one payload) and drained by the
+  // flush triggers documented at FlushAdds().
+  Mutex agg_mu_;
+  std::vector<float> agg_sum_ GUARDED_BY(agg_mu_);
+  AddOption agg_opt_ GUARDED_BY(agg_mu_);
+  int64_t agg_count_ GUARDED_BY(agg_mu_) = 0;
+  int64_t agg_first_ms_ GUARDED_BY(agg_mu_) = 0;
 };
 
 class ArrayWorkerTable : public WorkerTable {
@@ -261,7 +336,14 @@ class ArrayWorkerTable : public WorkerTable {
   bool Add(const float* delta, int64_t size, const AddOption& opt,
            bool blocking);
 
+ protected:
+  void SendAggregate(const float* sum, int64_t n,
+                     const AddOption& opt) override;
+
  private:
+  // The one sharded-send plan for Add and the aggregation flush.
+  bool SendAdd(const float* delta, int64_t size, const AddOption& opt,
+               bool blocking);
   int64_t global_;
   int servers_;
 };
@@ -289,10 +371,14 @@ class MatrixWorkerTable : public WorkerTable {
                        bool blocking);
 
  protected:
+  void SendAggregate(const float* sum, int64_t n,
+                     const AddOption& opt) override;
   int64_t rows_, cols_;
   int servers_;
 
  private:
+  // The one sharded-send plan for AddAll and the aggregation flush.
+  bool SendAddAll(const float* delta, const AddOption& opt, bool blocking);
   // THE one owner-partitioning plan for GetRows/GetRowsAsync: fills
   // `positions` (caller slots per shard), zero-fills the output (the
   // out-of-range-id contract), returns the per-shard requests.  Both

@@ -153,7 +153,17 @@ class Zoo {
 
   // Blocking: one RequestFlush per remote server shard, acked when that
   // server drained every earlier message on the same connection.
+  // Always drains the add-aggregation buffers first (the flush marker
+  // must ride behind the adds it certifies).
   bool FlushPipelines();
+
+ public:
+  // Drain every worker table's add-aggregation buffer onto the wire
+  // (docs/wire_compression.md).  Called by FlushPipelines/Clock/Stop
+  // and the MV_FlushAdds C API.
+  void FlushWorkerAdds();
+
+ private:
 
   void RouteInbound(Message&& m);       // transport reader threads
 

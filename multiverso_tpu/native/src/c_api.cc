@@ -5,6 +5,7 @@
 #include <stdexcept>
 #include <unordered_map>
 
+#include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/fault.h"
@@ -405,6 +406,44 @@ int MV_ClearFaults(void) {
 }
 
 int MV_DeadPeerCount(void) { return Zoo::Get()->DeadPeerCount(); }
+
+// ---- wire data plane (docs/wire_compression.md) ----------------------
+
+int MV_SetTableCodec(int32_t handle, const char* codec) {
+  if (RequireStarted() || !codec) return -1;
+  if (!mvtpu::codec::IsCodecName(codec)) return -1;
+  auto* t = Zoo::Get()->worker_table(handle);
+  if (!t) return -2;
+  t->set_codec(mvtpu::codec::FromName(codec));
+  return 0;
+}
+
+int MV_FlushAdds(int32_t handle) {
+  if (RequireStarted()) return -1;
+  if (handle < 0) {
+    Zoo::Get()->FlushWorkerAdds();
+    return 0;
+  }
+  auto* t = Zoo::Get()->worker_table(handle);
+  if (!t) return -2;
+  t->FlushAdds();
+  return 0;
+}
+
+int MV_WireStats(long long* sent_bytes, long long* recv_bytes,
+                 long long* sent_msgs, long long* recv_msgs) {
+  long long c = 0;
+  double total = 0.0;
+  bool have = mvtpu::Dashboard::Query("net.bytes.sent", &c, &total);
+  if (sent_bytes) *sent_bytes = have ? static_cast<long long>(total) : 0;
+  if (sent_msgs) *sent_msgs = have ? c : 0;
+  c = 0;
+  total = 0.0;
+  have = mvtpu::Dashboard::Query("net.bytes.recv", &c, &total);
+  if (recv_bytes) *recv_bytes = have ? static_cast<long long>(total) : 0;
+  if (recv_msgs) *recv_msgs = have ? c : 0;
+  return 0;
+}
 
 // ---- serve layer (docs/serving.md) -----------------------------------
 

@@ -195,6 +195,27 @@ void RegisterDefaults() {
               "version probes are shed with a retryable ReplyBusy (C "
               "API rc -6) instead of growing the queue; adds are never "
               "shed.  0 (default) disables shedding");
+    DefineString("wire_codec", "raw",
+                 "payload codec for table wire traffic "
+                 "(docs/wire_compression.md): raw|1bit|sparse.  1bit "
+                 "ships dense adds as sign bits + two scales with "
+                 "worker-side error feedback (~32x fewer payload "
+                 "bytes); sparse ships nonzero (index,value) pairs "
+                 "losslessly, falling back to raw per message when not "
+                 "smaller.  Negotiated per table at creation; "
+                 "MV_SetTableCodec retargets one table");
+    DefineInt("add_agg_ms", 0,
+              "worker-side add aggregation window (ms): async dense "
+              "adds within the window sum locally and ship as ONE "
+              "codec-encoded wire message.  Flushed by the window "
+              "(checked at the next table op), -add_agg_bytes, any "
+              "Get, blocking Add, Clock, Barrier, and shutdown — "
+              "BSP/SSP visibility is unchanged.  0 (default) with "
+              "add_agg_bytes=0 disables aggregation");
+    DefineInt("add_agg_bytes", 0,
+              "worker-side add aggregation size bound: flush once the "
+              "absorbed payload bytes (adds x delta size) reach this. "
+              "0 (default) with add_agg_ms=0 disables aggregation");
     DefineString("log_level", "info", "debug|info|error|fatal");
     DefineString("log_file", "", "optional log sink path");
     DefineBool("trace", false,

@@ -4,23 +4,44 @@
 
 namespace mvtpu {
 
-namespace {
-struct Header {
-  int32_t src, dst, type, table_id;
-  int64_t msg_id;
-  int64_t trace_id;
-  int64_t version;
-  int32_t num_blobs;
-};
-}  // namespace
+void Message::FillWireHeader(WireHeader* h) const {
+  *h = WireHeader{src,
+                  dst,
+                  static_cast<int32_t>(type),
+                  table_id,
+                  msg_id,
+                  trace_id,
+                  version,
+                  static_cast<int32_t>(codec),
+                  flags,
+                  static_cast<int32_t>(data.size()),
+                  0};
+}
+
+void Message::AdoptWireHeader(const WireHeader& h) {
+  src = h.src;
+  dst = h.dst;
+  type = static_cast<MsgType>(h.type);
+  table_id = h.table_id;
+  msg_id = h.msg_id;
+  trace_id = h.trace_id;
+  version = h.version;
+  codec = static_cast<Codec>(h.codec);
+  flags = h.flags;
+}
+
+int64_t Message::WireBytes() const {
+  int64_t total = static_cast<int64_t>(sizeof(WireHeader));
+  for (const auto& b : data)
+    total += static_cast<int64_t>(sizeof(int64_t) + b.size());
+  return total;
+}
 
 Blob Message::Serialize() const {
-  size_t total = sizeof(Header);
-  for (const auto& b : data) total += sizeof(int64_t) + b.size();
-  Blob out(total);
+  Blob out(static_cast<size_t>(WireBytes()));
   char* p = out.data();
-  Header h{src, dst, static_cast<int32_t>(type), table_id, msg_id,
-           trace_id, version, static_cast<int32_t>(data.size())};
+  WireHeader h;
+  FillWireHeader(&h);
   std::memcpy(p, &h, sizeof(h));
   p += sizeof(h);
   for (const auto& b : data) {
@@ -36,17 +57,11 @@ Blob Message::Serialize() const {
 Message Message::Deserialize(const Blob& buf) {
   Message m;
   const char* p = buf.data();
-  Header h;
+  WireHeader h;
   std::memcpy(&h, p, sizeof(h));
   p += sizeof(h);
-  m.src = h.src;
-  m.dst = h.dst;
-  m.type = static_cast<MsgType>(h.type);
-  m.table_id = h.table_id;
-  m.msg_id = h.msg_id;
-  m.trace_id = h.trace_id;
-  m.version = h.version;
-  m.data.reserve(h.num_blobs);
+  m.AdoptWireHeader(h);
+  m.data.reserve(static_cast<size_t>(h.num_blobs));
   for (int32_t i = 0; i < h.num_blobs; ++i) {
     int64_t len;
     std::memcpy(&len, p, sizeof(len));

@@ -234,7 +234,12 @@ bool MpiNet::Send(int dst_rank, const Message& msg) {
                    "freed, payload parked", dst_rank);
         return false;
       }
-      if (done) return true;
+      if (done) {
+        // Same wire-byte ledger as TcpNet (count = msgs, total = bytes).
+        Dashboard::Record("net.bytes.sent",
+                          static_cast<double>(wire.size()));
+        return true;
+      }
       if (timeout_ms > 0 && std::chrono::steady_clock::now() >= deadline) {
         api.cancel(&req);
         api.request_free(&req);
@@ -272,9 +277,10 @@ void MpiNet::ProbeLoop() {
           got = true;
       }
     }
-    if (got)
+    if (got) {
+      Dashboard::Record("net.bytes.recv", static_cast<double>(buf.size()));
       inbound_(Message::Deserialize(buf));  // outside the MPI lock
-    else
+    } else
       std::this_thread::sleep_for(std::chrono::microseconds(200));
   }
 }

@@ -1,11 +1,13 @@
 #include "mvtpu/net.h"
 
 #include <arpa/inet.h>
+#include <limits.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -34,13 +36,35 @@ bool SplitHostPort(const std::string& ep, std::string* host, int* port) {
   return *port > 0 && *port < 65536;
 }
 
-bool WriteAll(int fd, const void* buf, size_t n) {
-  const char* p = static_cast<const char*>(buf);
-  while (n > 0) {
-    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+// Gather-write the whole iovec set (sendmsg with MSG_NOSIGNAL — the
+// scatter-gather replacement for the old contiguous WriteAll path).
+// Mutates the vector in place to advance past partial writes — callers
+// pass a scratch copy.
+bool WriteVAll(int fd, std::vector<iovec>* iov) {
+  size_t idx = 0;
+#ifdef IOV_MAX
+  const size_t max_iov = IOV_MAX;
+#else
+  const size_t max_iov = 1024;
+#endif
+  while (idx < iov->size()) {
+    msghdr mh{};
+    mh.msg_iov = iov->data() + idx;
+    mh.msg_iovlen = std::min(iov->size() - idx, max_iov);
+    ssize_t w = ::sendmsg(fd, &mh, MSG_NOSIGNAL);
     if (w <= 0) return false;
-    p += w;
-    n -= static_cast<size_t>(w);
+    size_t left = static_cast<size_t>(w);
+    while (left > 0 && idx < iov->size()) {
+      iovec& v = (*iov)[idx];
+      if (left >= v.iov_len) {
+        left -= v.iov_len;
+        ++idx;
+      } else {
+        v.iov_base = static_cast<char*>(v.iov_base) + left;
+        v.iov_len -= left;
+        left = 0;
+      }
+    }
   }
   return true;
 }
@@ -116,17 +140,33 @@ constexpr int64_t kMaxFrameBytes = int64_t{1} << 40;
 }  // namespace
 
 bool TcpNet::SendFramed(int fd, const Message& msg) {
-  return SendFramed(fd, msg.Serialize());
-}
-
-bool TcpNet::SendFramed(int fd, const Blob& wire) {
-  int64_t len = static_cast<int64_t>(wire.size());
-  return WriteAll(fd, &len, sizeof(len)) &&
-         WriteAll(fd, wire.data(), wire.size());
+  // Scatter-gather framing: the kernel reads the payload blobs in place
+  // — the only bytes assembled host-side are the tiny prefix/header/
+  // per-blob-length scratch.  Layout must stay identical to
+  // Message::Serialize() (RecvFramed decodes both the same way).
+  int64_t frame = msg.WireBytes();
+  struct {
+    int64_t frame_len;
+    WireHeader h;
+  } head;
+  head.frame_len = frame;
+  msg.FillWireHeader(&head.h);
+  std::vector<int64_t> lens(msg.data.size());
+  std::vector<iovec> iov;
+  iov.reserve(1 + 2 * msg.data.size());
+  iov.push_back({&head, sizeof(head)});
+  for (size_t i = 0; i < msg.data.size(); ++i) {
+    lens[i] = static_cast<int64_t>(msg.data[i].size());
+    iov.push_back({&lens[i], sizeof(int64_t)});
+    if (msg.data[i].size())
+      iov.push_back({const_cast<char*>(msg.data[i].data()),
+                     msg.data[i].size()});
+  }
+  return WriteVAll(fd, &iov);
 }
 
 bool TcpNet::RecvFramed(int fd, Message* msg, int64_t max_bytes,
-                        int64_t body_timeout_ms) {
+                        int64_t body_timeout_ms, int64_t* frame_bytes) {
   if (max_bytes <= 0) max_bytes = kMaxFrameBytes;
   int64_t len = 0;
   // The prefix read may block indefinitely — an idle connection is
@@ -138,6 +178,7 @@ bool TcpNet::RecvFramed(int fd, Message* msg, int64_t max_bytes,
   if (!ReadAllDeadline(fd, buf.data(), buf.size(), body_timeout_ms))
     return false;
   *msg = Message::Deserialize(buf);
+  if (frame_bytes) *frame_bytes = len + static_cast<int64_t>(sizeof(len));
   return true;
 }
 
@@ -385,10 +426,15 @@ void TcpNet::ReadLoop(int fd) {
   const int64_t body_timeout = FlagOr("io_timeout_ms", 30000);
   while (true) {
     Message m;
-    if (!RecvFramed(fd, &m, 0, body_timeout)) {
+    int64_t frame_bytes = 0;
+    if (!RecvFramed(fd, &m, 0, body_timeout, &frame_bytes)) {
       ::close(fd);
       return;
     }
+    // Wire-byte ledger (docs/wire_compression.md): count = messages,
+    // total = bytes (1 unit = 1 byte) — MV_WireStats / the Python
+    // net.bytes{dir=recv} bridge read both from this one monitor.
+    Dashboard::Record("net.bytes.recv", static_cast<double>(frame_bytes));
     if (inbound_) inbound_(std::move(m));
   }
 }
@@ -438,7 +484,7 @@ int TcpNet::ConnectTo(int dst_rank) {
   return fd;
 }
 
-bool TcpNet::SendAttempt(int dst_rank, const Blob& wire) {
+bool TcpNet::SendAttempt(int dst_rank, const Message& msg) {
   // Connect OUTSIDE the per-destination send mutex: the retry loop can
   // take seconds, and holding the mutex through it would stall Stop()
   // (which closes fds under the same mutex) and serialize every sender
@@ -474,12 +520,17 @@ bool TcpNet::SendAttempt(int dst_rank, const Blob& wire) {
     Log::Error("TcpNet: send to rank %d failed (injected)", dst_rank);
     return false;
   }
-  if (!SendFramed(fd, wire)) {
+  if (!SendFramed(fd, msg)) {
     ::close(fd);
     send_fds_[dst_rank] = -1;
     Log::Error("TcpNet: send to rank %d failed", dst_rank);
     return false;
   }
+  // Per successful write attempt (retries resend the frame — those
+  // bytes really crossed the wire too): count = messages, total = bytes.
+  Dashboard::Record("net.bytes.sent",
+                    static_cast<double>(msg.WireBytes() +
+                                        static_cast<int64_t>(sizeof(int64_t))));
   return true;
 }
 
@@ -490,10 +541,9 @@ bool TcpNet::Send(int dst_rank, const Message& msg) {
   // the span shares the message's trace id, so a merged trace shows the
   // hop that carried a Get between its worker and server spans.
   Monitor mon("Net::Send", msg.trace_id);
-  // Serialize BEFORE taking any send mutex — a full-payload copy inside
-  // the critical section would queue every concurrent sender to this
-  // rank behind it.
-  Blob wire = msg.Serialize();
+  // No Serialize() here: SendAttempt gather-writes the message's blobs
+  // in place (header + iovecs), so the old full-payload copy — and the
+  // allocation behind it — is gone from the hot path entirely.
 
   bool duplicate = false;
   if (Fault::Enabled()) {
@@ -530,12 +580,12 @@ bool TcpNet::Send(int dst_rank, const Message& msg) {
       MutexLock lk(mu_);
       if (!running_) return false;
     }
-    if (SendAttempt(dst_rank, wire)) {
+    if (SendAttempt(dst_rank, msg)) {
       if (duplicate) {
         // Second copy best-effort: a duplicating wire does not get to
         // also claim a delivery failure.
         Dashboard::Record("net.duplicated", 0.0);
-        SendAttempt(dst_rank, wire);
+        SendAttempt(dst_rank, msg);
       }
       return true;
     }

@@ -11,14 +11,31 @@
 // contacted shard replied; msg_id < 0 is async.
 #include "mvtpu/table.h"
 
+#include <chrono>
 #include <cstring>
 
+#include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/log.h"
 #include "mvtpu/zoo.h"
 
 namespace mvtpu {
+
+namespace {
+
+// Flags may not be registered when tables are driven standalone.
+int64_t TableFlagOr(const char* name, int64_t dflt) {
+  return configure::Has(name) ? configure::GetInt(name) : dflt;
+}
+
+int64_t SteadyNowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
 
 // ---------------------------------------------------------------- server
 
@@ -348,6 +365,103 @@ thread_local bool g_rt_busy = false;
 
 bool WorkerTable::last_call_busy() { return g_rt_busy; }
 
+// ---- wire codec + add aggregation (docs/wire_compression.md) ---------
+
+void WorkerTable::AppendEncodedDelta(Message* req, const float* delta,
+                                     int64_t n, int64_t elem_offset,
+                                     int64_t table_elems) {
+  Codec c = wire_codec();
+  size_t raw_bytes = static_cast<size_t>(n) * sizeof(float);
+  if (c == Codec::kOneBit) {
+    float* res;
+    Blob enc;
+    {
+      MutexLock lk(residual_mu_);
+      if (residual_.size() < static_cast<size_t>(table_elems))
+        residual_.resize(static_cast<size_t>(table_elems), 0.0f);
+      res = residual_.data() + elem_offset;
+      enc = codec::EncodeOneBit(delta, static_cast<size_t>(n), res);
+    }
+    req->codec = Codec::kOneBit;
+    req->data.push_back(std::move(enc));
+  } else if (c == Codec::kSparse) {
+    Blob enc = codec::EncodeSparse(delta, static_cast<size_t>(n));
+    if (enc.size() == 0) {  // denser than the sparse form: ship raw
+      req->data.emplace_back(delta, raw_bytes);
+    } else {
+      req->codec = Codec::kSparse;
+      req->data.push_back(std::move(enc));
+    }
+  } else {
+    req->data.emplace_back(delta, raw_bytes);
+    return;  // raw tables keep the encode path at zero cost — no ratio
+  }
+  // Per-table compression ledger: mean of (encoded / raw payload bytes)
+  // — `codec.ratio.t<id>` count = encoded messages, total/count = mean.
+  if (raw_bytes > 0)
+    Dashboard::Record("codec.ratio.t" + std::to_string(table_id_),
+                      static_cast<double>(req->data.back().size()) /
+                          static_cast<double>(raw_bytes));
+}
+
+bool WorkerTable::MaybeAggregate(const float* delta, int64_t n,
+                                 const AddOption& opt) {
+  int64_t agg_ms = TableFlagOr("add_agg_ms", 0);
+  int64_t agg_bytes = TableFlagOr("add_agg_bytes", 0);
+  if (agg_ms <= 0 && agg_bytes <= 0) return false;
+  bool flush_incompatible = false;
+  bool flush_now = false;
+  {
+    MutexLock lk(agg_mu_);
+    if (agg_count_ > 0 &&
+        (static_cast<int64_t>(agg_sum_.size()) != n ||
+         std::memcmp(&agg_opt_, &opt, sizeof(opt)) != 0))
+      flush_incompatible = true;
+    else {
+      if (agg_count_ == 0) {
+        agg_sum_.assign(static_cast<size_t>(n), 0.0f);
+        agg_opt_ = opt;
+        agg_first_ms_ = SteadyNowMs();
+      }
+      for (int64_t i = 0; i < n; ++i) agg_sum_[i] += delta[i];
+      ++agg_count_;
+      Dashboard::Record("agg.adds", 0.0);
+      // Bounds: absorbed payload bytes (count × delta size — the wire
+      // traffic this window is collapsing) and the lazy time window.
+      if (agg_bytes > 0 && agg_count_ * n * 4 >= agg_bytes)
+        flush_now = true;
+      if (agg_ms > 0 && SteadyNowMs() - agg_first_ms_ >= agg_ms)
+        flush_now = true;
+    }
+  }
+  if (flush_incompatible) {
+    // Different shape/option: FIFO order demands the buffered aggregate
+    // ships first; the new add then starts a fresh window.
+    FlushAdds();
+    return MaybeAggregate(delta, n, opt);
+  }
+  if (flush_now) FlushAdds();
+  return true;
+}
+
+void WorkerTable::FlushAdds() {
+  std::vector<float> sum;
+  AddOption opt;
+  int64_t adds;
+  {
+    MutexLock lk(agg_mu_);
+    if (agg_count_ == 0) return;
+    sum.swap(agg_sum_);
+    opt = agg_opt_;
+    adds = agg_count_;
+    agg_count_ = 0;
+  }
+  // count = flush windows, total = adds collapsed: total/count is the
+  // adds-per-wire-message ratio the bench/demo report.
+  Dashboard::Record("agg.flush", static_cast<double>(adds));
+  SendAggregate(sum.data(), static_cast<int64_t>(sum.size()), opt);
+}
+
 void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
   // Serve layer: every reply's version stamp refreshes the free local
   // lower bound on the server version (max-merge; replies can race).
@@ -495,13 +609,17 @@ AsyncGetHandle::~AsyncGetHandle() {
 namespace {
 
 MessagePtr MakeReq(MsgType type, int32_t table_id, int64_t msg_id,
-                   int shard_idx) {
+                   int shard_idx,
+                   int32_t accept_flags = msgflag::kAcceptRaw) {
   // Requests address SHARD indices; the wire needs the owning global
   // rank (they differ when worker-only/server-only roles exist).
   auto req = std::make_unique<Message>();
   req->type = type;
   req->table_id = table_id;
   req->msg_id = msg_id;
+  // Reply-codec negotiation: the server may sparse-encode its reply
+  // payload only when this request advertises kAcceptSparse.
+  req->flags = accept_flags;
   // Span propagation: the enclosing op's Monitor set the thread trace id
   // (0 when tracing is off), and the server actor adopts it before the
   // apply — worker op and server apply share one id across ranks.
@@ -568,6 +686,7 @@ void MaxVersionReply(void* arg, const Message& reply) {
 
 bool WorkerTable::QueryVersion(int64_t* version, int bucket) {
   Monitor mon("Worker::QueryVersion");
+  FlushAdds();  // the probed version must cover our buffered adds
   *version = 0;
   int64_t msg_id = Zoo::Get()->NextMsgId();
   int servers = Zoo::Get()->num_servers();
@@ -582,29 +701,32 @@ bool WorkerTable::QueryVersion(int64_t* version, int bucket) {
 
 bool ArrayWorkerTable::Get(float* data, int64_t size) {
   Monitor mon("ArrayWorker::Get");
+  FlushAdds();  // read-your-aggregated-writes: flush rides ahead (FIFO)
   int64_t msg_id = Zoo::Get()->NextMsgId();
   std::vector<MessagePtr> reqs;
   for (int r = 0; r < servers_; ++r)
-    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r));
+    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
+                           accept_flags()));
   GatherDest d{data, static_cast<size_t>(size), global_, servers_, 1};
   return RoundTrip(std::move(reqs), GatherReply, &d);
 }
 
 AsyncGetPtr ArrayWorkerTable::GetAsync(float* data, int64_t size) {
   Monitor mon("ArrayWorker::GetAsync");
+  FlushAdds();
   int64_t msg_id = Zoo::Get()->NextMsgId();
   std::vector<MessagePtr> reqs;
   for (int r = 0; r < servers_; ++r)
-    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r));
+    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
+                           accept_flags()));
   auto d = std::make_shared<GatherDest>();
   *d = GatherDest{data, static_cast<size_t>(size), global_, servers_, 1};
   GatherDest* raw = d.get();
   return StartRoundTrip(std::move(reqs), GatherReply, raw, std::move(d));
 }
 
-bool ArrayWorkerTable::Add(const float* delta, int64_t size,
-                           const AddOption& opt, bool blocking) {
-  Monitor mon("ArrayWorker::Add");
+bool ArrayWorkerTable::SendAdd(const float* delta, int64_t size,
+                               const AddOption& opt, bool blocking) {
   int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
   std::vector<MessagePtr> reqs;
   for (int r = 0; r < servers_; ++r) {
@@ -612,9 +734,9 @@ bool ArrayWorkerTable::Add(const float* delta, int64_t size,
     if (rg.begin >= size) continue;
     auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
     req->data.emplace_back(&opt, sizeof(opt));
-    req->data.emplace_back(delta + rg.begin,
-                           std::min(rg.len(), size - rg.begin) *
-                               sizeof(float));
+    AppendEncodedDelta(req.get(), delta + rg.begin,
+                       std::min(rg.len(), size - rg.begin), rg.begin,
+                       global_);
     reqs.push_back(std::move(req));
   }
   if (blocking)
@@ -624,12 +746,32 @@ bool ArrayWorkerTable::Add(const float* delta, int64_t size,
   return true;
 }
 
+void ArrayWorkerTable::SendAggregate(const float* sum, int64_t n,
+                                     const AddOption& opt) {
+  SendAdd(sum, n, opt, /*blocking=*/false);
+}
+
+bool ArrayWorkerTable::Add(const float* delta, int64_t size,
+                           const AddOption& opt, bool blocking) {
+  Monitor mon("ArrayWorker::Add");
+  if (blocking) {
+    // The ack must cover everything this caller pushed — earlier
+    // aggregated adds included (FIFO keeps them ahead on the wire).
+    FlushAdds();
+  } else if (size == global_ && MaybeAggregate(delta, size, opt)) {
+    return true;  // absorbed; ships with the next flush window
+  }
+  return SendAdd(delta, size, opt, blocking);
+}
+
 bool MatrixWorkerTable::GetAll(float* data) {
   Monitor mon("MatrixWorker::GetAll");
+  FlushAdds();
   int64_t msg_id = Zoo::Get()->NextMsgId();
   std::vector<MessagePtr> reqs;
   for (int r = 0; r < servers_; ++r)
-    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r));
+    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
+                           accept_flags()));
   GatherDest d{data, static_cast<size_t>(rows_ * cols_), rows_, servers_,
                cols_};
   return RoundTrip(std::move(reqs), GatherReply, &d);
@@ -649,11 +791,13 @@ std::vector<MessagePtr> MatrixWorkerTable::PlanRowsGet(
     (*positions)[owner].push_back(i);
   }
   std::memset(data, 0, static_cast<size_t>(k * cols_) * sizeof(float));
+  FlushAdds();  // planned reads must see our buffered adds (FIFO)
   int64_t msg_id = Zoo::Get()->NextMsgId();
   std::vector<MessagePtr> reqs;
   for (int r = 0; r < servers_; ++r) {
     if (per_rank_ids[r].empty()) continue;
-    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r);
+    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
+                       accept_flags());
     req->data.emplace_back(per_rank_ids[r].data(),
                            per_rank_ids[r].size() * sizeof(int32_t));
     reqs.push_back(std::move(req));
@@ -690,9 +834,8 @@ AsyncGetPtr MatrixWorkerTable::GetRowsAsync(const int32_t* row_ids,
                         std::move(state));
 }
 
-bool MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,
-                               bool blocking) {
-  Monitor mon("MatrixWorker::AddAll");
+bool MatrixWorkerTable::SendAddAll(const float* delta, const AddOption& opt,
+                                   bool blocking) {
   int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
   std::vector<MessagePtr> reqs;
   for (int r = 0; r < servers_; ++r) {
@@ -700,8 +843,8 @@ bool MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,
     if (rg.len() == 0) continue;
     auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
     req->data.emplace_back(&opt, sizeof(opt));
-    req->data.emplace_back(delta + rg.begin * cols_,
-                           rg.len() * cols_ * sizeof(float));
+    AppendEncodedDelta(req.get(), delta + rg.begin * cols_,
+                       rg.len() * cols_, rg.begin * cols_, rows_ * cols_);
     reqs.push_back(std::move(req));
   }
   if (blocking)
@@ -711,10 +854,29 @@ bool MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,
   return true;
 }
 
+void MatrixWorkerTable::SendAggregate(const float* sum, int64_t n,
+                                      const AddOption& opt) {
+  if (n != rows_ * cols_) return;  // only whole-table adds aggregate
+  SendAddAll(sum, opt, /*blocking=*/false);
+}
+
+bool MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,
+                               bool blocking) {
+  Monitor mon("MatrixWorker::AddAll");
+  if (blocking)
+    FlushAdds();  // the ack must cover buffered aggregates too
+  else if (MaybeAggregate(delta, rows_ * cols_, opt))
+    return true;
+  return SendAddAll(delta, opt, blocking);
+}
+
 bool MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
                                 const float* delta, const AddOption& opt,
                                 bool blocking) {
   Monitor mon("MatrixWorker::AddRows");
+  // FIFO with any buffered whole-table aggregate: it ships first so the
+  // server applies adds in submission order.
+  FlushAdds();
   std::vector<std::vector<int32_t>> per_rank_ids(servers_);
   std::vector<std::vector<float>> per_rank_delta(servers_);
   for (int64_t i = 0; i < k; ++i) {
@@ -733,8 +895,17 @@ bool MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
     req->data.emplace_back(&opt, sizeof(opt));
     req->data.emplace_back(per_rank_ids[r].data(),
                            per_rank_ids[r].size() * sizeof(int32_t));
-    req->data.emplace_back(per_rank_delta[r].data(),
-                           per_rank_delta[r].size() * sizeof(float));
+    if (wire_codec() == Codec::kSparse) {
+      // Row-subset adds take the lossless sparse codec only: the 1-bit
+      // error-feedback residual is indexed by STABLE element offsets,
+      // which a varying packed row set does not have.
+      AppendEncodedDelta(req.get(), per_rank_delta[r].data(),
+                         static_cast<int64_t>(per_rank_delta[r].size()),
+                         0, 0);
+    } else {
+      req->data.emplace_back(per_rank_delta[r].data(),
+                             per_rank_delta[r].size() * sizeof(float));
+    }
     reqs.push_back(std::move(req));
   }
   if (reqs.empty()) return true;
@@ -869,6 +1040,7 @@ void ScatterKVReply(void* arg, const Message& reply) {
 
 bool KVWorkerTable::Get(const std::vector<std::string>& keys, float* vals) {
   Monitor mon("KVWorker::Get");
+  FlushAdds();
   std::vector<std::vector<std::string>> per_rank(servers_);
   std::vector<std::vector<int64_t>> positions(servers_);
   for (size_t i = 0; i < keys.size(); ++i) {
@@ -883,7 +1055,8 @@ bool KVWorkerTable::Get(const std::vector<std::string>& keys, float* vals) {
   std::vector<MessagePtr> reqs;
   for (int r = 0; r < servers_; ++r) {
     if (per_rank[r].empty()) continue;
-    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r);
+    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
+                       accept_flags());
     req->data.push_back(PackKeys(per_rank[r]));
     reqs.push_back(std::move(req));
   }

@@ -5,6 +5,7 @@
 #include <cmath>
 #include <functional>
 
+#include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/log.h"
@@ -68,6 +69,14 @@ class WorkerActor : public Actor {
       Zoo::Get()->SendTo(actor::kServer, std::move(m));
     });
     RegisterHandler(MsgType::ReplyGet, [](MessagePtr& m) {
+      // Sparse-encoded reply payload (docs/wire_compression.md): decode
+      // before the table's consume sees it — a malformed payload is
+      // dropped here, never scattered into a caller's buffer.
+      if (m->codec != Codec::kRaw && !codec::DecodeInPlace(m.get())) {
+        Log::Error("ReplyGet for table %d: malformed %s payload dropped",
+                   m->table_id, codec::Name(m->codec));
+        return;
+      }
       Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
     });
     RegisterHandler(MsgType::ReplyAdd, [](MessagePtr& m) {
@@ -117,6 +126,9 @@ class ServerActor : public Actor {
       // correlates with the worker's Get across ranks.
       TraceScope scope(m->trace_id);
       table->ProcessGet(*m, reply.get());
+      // Reply-codec negotiation: a requester that advertised
+      // kAcceptSparse gets a lossless sparse payload when smaller.
+      codec::MaybeEncodeReply(reply.get(), m->flags);
       Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
     });
     RegisterHandler(MsgType::RequestVersion, [](MessagePtr& m) {
@@ -150,6 +162,15 @@ class ServerActor : public Actor {
       if (!table) {
         Log::Error("RequestAdd for table %d on non-server rank",
                    m->table_id);
+        return;
+      }
+      // Codec-encoded delta payload: decode to raw floats BEFORE
+      // ProcessAdd, so the table layer (and its updaters/version
+      // stamps) are codec-oblivious.  Malformed payloads are dropped —
+      // feeding garbage deltas to an updater would corrupt the shard.
+      if (m->codec != Codec::kRaw && !codec::DecodeInPlace(m.get())) {
+        Log::Error("RequestAdd for table %d: malformed %s payload "
+                   "dropped", m->table_id, codec::Name(m->codec));
         return;
       }
       TraceScope scope(m->trace_id);  // correlate apply with the Add
@@ -352,8 +373,11 @@ void Zoo::Stop() {
     if (!started_.exchange(false)) return;
   }
   // Cross-process: no rank may tear down while peers still need its
-  // server shard — rendezvous first (also flushes every pipeline).
+  // server shard — rendezvous first (also flushes every pipeline,
+  // aggregated adds included).  Single-process: drain the aggregation
+  // buffers directly so no absorbed add dies with the runtime.
   if (size_ > 1) Barrier();
+  else FlushWorkerAdds();
   // Lease loop dies before the transport it sends through.
   if (hb_running_.exchange(false)) {
     if (hb_thread_.joinable()) hb_thread_.join();
@@ -407,7 +431,26 @@ void Zoo::Stop() {
   Log::Info("%s", Dashboard::Report().c_str());
 }
 
+void Zoo::FlushWorkerAdds() {
+  // Drain every table's add-aggregation buffer onto the wire
+  // (docs/wire_compression.md).  Pointers copied out of tables_mu_
+  // before the flush runs: FlushAdds takes the table's own agg lock and
+  // enqueues sends — doing that under tables_mu_ could deadlock against
+  // a service path that needs the registry.
+  std::vector<WorkerTable*> snapshot;
+  {
+    MutexLock lk(tables_mu_);
+    for (auto& t : worker_tables_)
+      if (t) snapshot.push_back(t.get());
+  }
+  for (auto* t : snapshot) t->FlushAdds();
+}
+
 bool Zoo::FlushPipelines() {
+  // Aggregated adds first: the RequestFlush below must ride BEHIND them
+  // on every connection, so "flush acked" still means "adds applied" —
+  // the invariant Barrier's BSP guarantee stands on.
+  FlushWorkerAdds();
   if (!net_) return true;
   std::vector<int> targets;
   for (int s : server_ranks_)
@@ -645,6 +688,10 @@ std::vector<int> Zoo::DeadPeers() {
 
 void Zoo::Clock() {
   int64_t c = ++clock_;
+  // Aggregated adds belong to the clock being closed: flush them BEFORE
+  // the tick ships, so the per-connection FIFO keeps "min worker clock
+  // >= c implies clock-c adds applied" true under aggregation.
+  FlushWorkerAdds();
   // A tick is the SSP read boundary: cached rows fetched before it
   // would be served as hits FOREVER — never reaching the server where
   // MaybeHoldGet enforces `-staleness` — so the bound would silently
@@ -924,6 +971,17 @@ void Zoo::RouteInbound(Message&& m) {
   }
 }
 
+namespace {
+// Table-creation codec negotiation (docs/wire_compression.md): every
+// new worker stub starts on the `-wire_codec` default; MV_SetTableCodec
+// can retarget one table afterwards.
+Codec DefaultCodec() {
+  return configure::Has("wire_codec")
+             ? codec::FromName(configure::GetString("wire_codec"))
+             : Codec::kRaw;
+}
+}  // namespace
+
 int32_t Zoo::RegisterArrayTable(int64_t size) {
   MutexLock lk(tables_mu_);
   int32_t id = static_cast<int32_t>(server_tables_.size());
@@ -936,6 +994,7 @@ int32_t Zoo::RegisterArrayTable(int64_t size) {
                                                    sid, num_servers()));
   worker_tables_.push_back(
       std::make_unique<ArrayWorkerTable>(id, size, num_servers()));
+  worker_tables_.back()->set_codec(DefaultCodec());
   return id;
 }
 
@@ -954,6 +1013,7 @@ int32_t Zoo::RegisterMatrixTableImpl(int64_t rows, int64_t cols) {
                     rows, cols, updater_type_, sid, num_servers()));
   worker_tables_.push_back(
       std::make_unique<WorkerT>(id, rows, cols, num_servers()));
+  worker_tables_.back()->set_codec(DefaultCodec());
   return id;
 }
 
@@ -974,6 +1034,7 @@ int32_t Zoo::RegisterKVTable() {
               : std::make_unique<KVServerTable>(updater_type_));
   worker_tables_.push_back(
       std::make_unique<KVWorkerTable>(id, num_servers()));
+  worker_tables_.back()->set_codec(DefaultCodec());
   return id;
 }
 

@@ -11,17 +11,20 @@
 #include <cmath>
 #include <cstdio>
 #include <cstring>
+#include <limits>
 #include <string>
 #include <thread>
 #include <vector>
 
 #include "mvtpu/blob.h"
 #include "mvtpu/c_api.h"
+#include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/message.h"
 #include "mvtpu/mpi_net.h"
 #include "mvtpu/mt_queue.h"
+#include "mvtpu/net.h"
 #include "mvtpu/updater.h"
 #include "mvtpu/waiter.h"
 
@@ -111,6 +114,146 @@ static int TestMessage() {
   CHECK(back.data[0].count<float>() == 3);
   CHECK(back.data[0].As<float>()[2] == 3.0f);
   CHECK(back.data[1].As<int32_t>()[1] == 5);
+  return 0;
+}
+
+static int TestCodec() {
+  using mvtpu::Blob;
+  using mvtpu::codec::DecodeOneBit;
+  using mvtpu::codec::DecodeSparse;
+  using mvtpu::codec::EncodeOneBit;
+  using mvtpu::codec::EncodeSparse;
+
+  // ---- sparse: lossless round trips across the edge cases -----------
+  {
+    // Mostly-zero ODD-length payload with NaN/Inf nonzeros: bit-exact
+    // round trip (sparse pays off once nonzeros < n/2 - 2).
+    float d[33] = {0};
+    d[1] = 1.5f;
+    d[4] = -2.25f;
+    d[31] = std::numeric_limits<float>::quiet_NaN();
+    d[32] = std::numeric_limits<float>::infinity();
+    Blob enc = EncodeSparse(d, 33);
+    CHECK(enc.size() > 0 && enc.size() < 33 * sizeof(float));
+    std::vector<float> out;
+    CHECK(DecodeSparse(enc, &out));
+    CHECK(out.size() == 33);
+    CHECK(memcmp(out.data(), d, sizeof(d)) == 0);  // NaN survives memcmp
+  }
+  {
+    // Empty payload: the sparse form (16 bytes) is never smaller than
+    // 0 raw bytes — the encoder must fall back to raw.
+    Blob enc = EncodeSparse(nullptr, 0);
+    CHECK(enc.size() == 0);
+  }
+  {
+    // Dense payload: no benefit, raw fallback signalled by empty blob.
+    float d[4] = {1, 2, 3, 4};
+    CHECK(EncodeSparse(d, 4).size() == 0);
+  }
+  {
+    // Malformed payloads must decode false, not overread.
+    std::vector<float> out;
+    CHECK(!DecodeSparse(Blob("xy", 2), &out));
+    int64_t bad[2] = {8, 9};  // k > n
+    CHECK(!DecodeSparse(Blob(bad, sizeof(bad)), &out));
+  }
+
+  // ---- 1bit: shapes, signs, error-feedback drain --------------------
+  {
+    // Odd length, mixed signs, no residual.
+    float d[5] = {1.0f, -3.0f, 2.0f, -1.0f, 0.0f};
+    Blob enc = EncodeOneBit(d, 5, nullptr);
+    CHECK(enc.size() == 16 + 1);  // header + one bit byte
+    std::vector<float> out;
+    CHECK(DecodeOneBit(enc, &out));
+    CHECK(out.size() == 5);
+    CHECK(fabsf(out[0] - 1.0f) < 1e-6f);   // pos mean = (1+2+0)/3
+    CHECK(fabsf(out[1] + 2.0f) < 1e-6f);   // neg mean = (-3-1)/2
+    CHECK(out[0] == out[2] && out[1] == out[3] && out[0] == out[4]);
+  }
+  {
+    // All-negative payload: pos bucket empty -> pos_scale 0, decode ok.
+    float d[3] = {-1.0f, -2.0f, -3.0f};
+    std::vector<float> out;
+    CHECK(DecodeOneBit(EncodeOneBit(d, 3, nullptr), &out));
+    CHECK(fabsf(out[0] + 2.0f) < 1e-6f && out[0] == out[1]);
+  }
+  {
+    // Empty payload round-trips to an empty vector.
+    std::vector<float> out{1.0f};
+    CHECK(DecodeOneBit(EncodeOneBit(nullptr, 0, nullptr), &out));
+    CHECK(out.empty());
+  }
+  {
+    // Non-finite inputs are sanitized: finite scales, zeroed residual.
+    float d[4] = {std::numeric_limits<float>::quiet_NaN(),
+                  -std::numeric_limits<float>::infinity(), 2.0f, -2.0f};
+    float res[4] = {0, 0, 0, 0};
+    std::vector<float> out;
+    CHECK(DecodeOneBit(EncodeOneBit(d, 4, res), &out));
+    for (float v : out) CHECK(std::isfinite(v));
+    CHECK(res[0] == 0.0f && res[1] == 0.0f);
+    for (float v : res) CHECK(std::isfinite(v));
+  }
+  {
+    // Error feedback: repeated compress/apply with a ROTATING deviation
+    // pattern (real gradients fluctuate; a constant per-element
+    // deviation is the known two-global-scale pathology where the
+    // residual grows linearly).  Over full rotation cycles every
+    // element's true sum is kSteps * 0.7 exactly; the applied sum must
+    // track it with the residual bounded by one cycle's spread —
+    // i.e. the error DRAINS into later messages instead of
+    // accumulating.
+    const int kN = 16, kSteps = 60;  // 12 full cycles of 5
+    float delta[kN], res[kN] = {0};
+    std::vector<float> applied(kN, 0.0f);
+    for (int s = 0; s < kSteps; ++s) {
+      for (int i = 0; i < kN; ++i)
+        delta[i] = 0.5f + 0.1f * static_cast<float>((i + s) % 5);
+      std::vector<float> out;
+      CHECK(DecodeOneBit(EncodeOneBit(delta, kN, res), &out));
+      for (int i = 0; i < kN; ++i) applied[i] += out[i];
+    }
+    const float want = 0.7f * kSteps;
+    for (int i = 0; i < kN; ++i) {
+      CHECK(fabsf(applied[i] - want) < 1.0f);
+      CHECK(fabsf(applied[i] - want) / want < 0.02f);
+      CHECK(fabsf(res[i]) < 1.0f);  // drained, not accumulated
+    }
+  }
+
+  // ---- header stamp + in-place decode (the server's path) -----------
+  {
+    mvtpu::Message m;
+    m.type = mvtpu::MsgType::RequestAdd;
+    float d[16] = {0};
+    d[2] = 4.0f;
+    d[15] = -1.0f;
+    Blob enc = EncodeSparse(d, 16);
+    CHECK(enc.size() > 0);
+    m.codec = mvtpu::Codec::kSparse;
+    m.flags = mvtpu::msgflag::kAcceptRaw | mvtpu::msgflag::kAcceptSparse;
+    m.data.push_back(enc);
+    // Codec + flags survive the wire header round trip.
+    mvtpu::Message back = mvtpu::Message::Deserialize(m.Serialize());
+    CHECK(back.codec == mvtpu::Codec::kSparse);
+    CHECK(back.flags == m.flags);
+    CHECK(mvtpu::codec::DecodeInPlace(&back));
+    CHECK(back.codec == mvtpu::Codec::kRaw);
+    CHECK(back.data[0].count<float>() == 16);
+    CHECK(back.data[0].As<float>()[2] == 4.0f);
+    CHECK(back.data[0].As<float>()[15] == -1.0f);
+    // Reply encoding honors the accept list: raw-only stays raw.
+    mvtpu::Message reply;
+    reply.data.emplace_back(d, sizeof(d));
+    mvtpu::codec::MaybeEncodeReply(&reply, mvtpu::msgflag::kAcceptRaw);
+    CHECK(reply.codec == mvtpu::Codec::kRaw);
+    mvtpu::codec::MaybeEncodeReply(
+        &reply, mvtpu::msgflag::kAcceptRaw | mvtpu::msgflag::kAcceptSparse);
+    CHECK(reply.codec == mvtpu::Codec::kSparse);
+    CHECK(reply.data[0].size() < sizeof(d));
+  }
   return 0;
 }
 
@@ -1147,6 +1290,242 @@ static int WireBenchChild(const char* machine_file, const char* rank,
   return 0;
 }
 
+static int CodecWireChild(const char* machine_file, const char* rank) {
+  // Compressed data plane acceptance (docs/wire_compression.md): the
+  // SAME dense-add workload over the 2-process wire, once on the raw
+  // codec and once on 1bit, measured via the net.bytes.sent ledger
+  // (MV_WireStats).  1bit must ship >= 3x fewer bytes (it actually
+  // ships ~30x fewer; the bar leaves room for framing/control traffic)
+  // and the served values must stay within tolerance thanks to the
+  // worker-side error feedback.  Rank 0 prints one
+  //   CODEC <name> bytes=<b> msgs=<m> secs=<s>
+  // line per phase (bench.py's wire_{raw,1bit}_* keys) plus the
+  // headline ratio.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+                         "-log_level=error", "-rpc_timeout_ms=60000",
+                         "-barrier_timeout_ms=60000"};
+  CHECK(MV_Init(6, argv2) == 0);
+  int me = MV_WorkerId();
+  const int64_t kN = 1 << 16;  // 256 KiB of payload per full add
+  const int kAdds = 8;
+  std::vector<float> delta(kN), out(kN, -1.0f);
+  // Per-add rotation of the deviation pattern (delta depends on i + a):
+  // over kAdds (two full cycles of 4) every element's true sum is
+  // kAdds * 1.375 EXACTLY, and the 1-bit error-feedback residual stays
+  // bounded (a constant per-element deviation would instead grow it
+  // linearly — the known two-scale-quantizer pathology real gradients
+  // don't exhibit).
+  auto fill_delta = [&](int a) {
+    for (int64_t i = 0; i < kN; ++i)
+      delta[i] = 1.0f + 0.25f * static_cast<float>((i + a) % 4);
+  };
+  double mean = 1.0 + 0.25 * (0 + 1 + 2 + 3) / 4.0;  // 1.375
+
+  auto sent_bytes = []() -> long long {
+    long long sb = 0, rb = 0, sm = 0, rm = 0;
+    if (MV_WireStats(&sb, &rb, &sm, &rm) != 0) return -1;
+    return sb;
+  };
+  auto sent_msgs = []() -> long long {
+    long long sb = 0, rb = 0, sm = 0, rm = 0;
+    if (MV_WireStats(&sb, &rb, &sm, &rm) != 0) return -1;
+    return sm;
+  };
+  auto now = [] { return std::chrono::steady_clock::now(); };
+  auto secs = [](auto d) {
+    return std::chrono::duration<double>(d).count();
+  };
+
+  long long phase_bytes[2] = {0, 0}, phase_msgs[2] = {0, 0};
+  double phase_secs[2] = {0, 0};
+  const char* names[2] = {"raw", "1bit"};
+  for (int phase = 0; phase < 2; ++phase) {
+    int32_t h;
+    CHECK(MV_NewArrayTable(kN, &h) == 0);
+    if (phase == 1) CHECK(MV_SetTableCodec(h, "1bit") == 0);
+    CHECK(MV_Barrier() == 0);
+    long long b0 = sent_bytes(), m0 = sent_msgs();
+    auto t0 = now();
+    if (me == 0)
+      for (int a = 0; a < kAdds; ++a) {
+        fill_delta(a);
+        CHECK(MV_AddArrayTable(h, delta.data(), kN) == 0);
+      }
+    CHECK(MV_Barrier() == 0);
+    phase_secs[phase] = secs(now() - t0);
+    phase_bytes[phase] = sent_bytes() - b0;
+    phase_msgs[phase] = sent_msgs() - m0;
+    CHECK(MV_GetArrayTable(h, out.data(), kN) == 0);
+    const double want = kAdds * mean;  // exact per element (full cycles)
+    if (phase == 0) {
+      for (int64_t i = 0; i < kN; ++i)
+        CHECK(fabs(out[i] - want) < 1e-3);
+    } else {
+      // 1bit + error feedback: per-element error bounded by the
+      // un-flushed residual (~one deviation cycle's spread); the MEAN
+      // is preserved tightly — comfortably inside the 5% loss bar.
+      double sum = 0.0;
+      for (int64_t i = 0; i < kN; ++i) {
+        sum += out[i];
+        CHECK(fabs(out[i] - want) < 1.5);
+      }
+      double got_mean = sum / static_cast<double>(kN);
+      CHECK(fabs(got_mean - want) / want < 0.02);
+    }
+    CHECK(MV_Barrier() == 0);
+  }
+  if (me == 0) {
+    CHECK(phase_bytes[0] > 0 && phase_bytes[1] > 0);
+    double ratio = static_cast<double>(phase_bytes[0]) /
+                   static_cast<double>(phase_bytes[1]);
+    for (int p = 0; p < 2; ++p)
+      printf("CODEC %s bytes=%lld msgs=%lld secs=%.4f\n", names[p],
+             phase_bytes[p], phase_msgs[p], phase_secs[p]);
+    printf("CODEC_RATIO %.2f\n", ratio);
+    CHECK(ratio >= 3.0);  // acceptance bar (measured ~20-30x)
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("CODEC_WIRE_OK %d\n", me);
+  return 0;
+}
+
+static int AggChild(const char* machine_file, const char* rank) {
+  // Worker-side add aggregation (docs/wire_compression.md): async dense
+  // adds sum into a local buffer and ship as ONE wire message per flush
+  // window; Get, Clock, and Barrier all force the flush, so read and
+  // BSP/SSP visibility semantics are unchanged.  Counters: agg.adds
+  // (absorbed adds), agg.flush (windows shipped).
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+                         "-log_level=error", "-rpc_timeout_ms=60000",
+                         "-barrier_timeout_ms=60000",
+                         "-add_agg_bytes=16777216"};
+  CHECK(MV_Init(7, argv2) == 0);
+  int me = MV_WorkerId();
+  int32_t h;
+  CHECK(MV_NewArrayTable(16, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+  std::vector<float> ones(16, 1.0f), out(16, -1.0f);
+  long long adds = 0, flushes = 0;
+
+  // Phase 1 — flush-on-Get: 6 tiny async adds collapse into one wire
+  // message; the Get that follows must still read its own writes.
+  if (me == 0) {
+    for (int i = 0; i < 6; ++i)
+      CHECK(MV_AddAsyncArrayTable(h, ones.data(), 16) == 0);
+    CHECK(MV_QueryMonitor("agg.flush", &flushes) == 0);
+    CHECK(flushes == 0);  // still buffered — nothing on the wire yet
+    CHECK(MV_GetArrayTable(h, out.data(), 16) == 0);
+    for (float v : out) CHECK(v == 6.0f);  // read-your-writes held
+    CHECK(MV_QueryMonitor("agg.adds", &adds) == 0);
+    CHECK(MV_QueryMonitor("agg.flush", &flushes) == 0);
+    CHECK(adds == 6);
+    CHECK(flushes == 1);  // >= 4 adds collapsed into ONE message
+  }
+  CHECK(MV_Barrier() == 0);
+
+  // Phase 2 — flush-on-Clock: the SSP tick must ride BEHIND the
+  // aggregated adds it announces.
+  if (me == 0) {
+    for (int i = 0; i < 4; ++i)
+      CHECK(MV_AddAsyncArrayTable(h, ones.data(), 16) == 0);
+    CHECK(MV_Clock() == 0);
+    CHECK(MV_QueryMonitor("agg.flush", &flushes) == 0);
+    CHECK(flushes == 2);
+  } else {
+    CHECK(MV_Clock() == 0);  // keep the worker clocks aligned
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 16) == 0);
+  for (float v : out) CHECK(v == 10.0f);  // both ranks see 6 + 4
+
+  // Phase 3 — flush-on-Barrier: BSP visibility for aggregated adds.
+  if (me == 0) {
+    for (int i = 0; i < 5; ++i)
+      CHECK(MV_AddAsyncArrayTable(h, ones.data(), 16) == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 16) == 0);
+  for (float v : out) CHECK(v == 15.0f);
+  if (me == 0) {
+    CHECK(MV_QueryMonitor("agg.adds", &adds) == 0);
+    CHECK(MV_QueryMonitor("agg.flush", &flushes) == 0);
+    CHECK(adds == 15);
+    CHECK(flushes == 3);
+  }
+
+  // Phase 4 — explicit flush (MV_FlushAdds) + blocking-add ordering:
+  // a blocking add flushes the buffer first, so its ack covers both.
+  if (me == 0) {
+    CHECK(MV_AddAsyncArrayTable(h, ones.data(), 16) == 0);
+    CHECK(MV_FlushAdds(h) == 0);
+    CHECK(MV_QueryMonitor("agg.flush", &flushes) == 0);
+    CHECK(flushes == 4);
+    CHECK(MV_AddAsyncArrayTable(h, ones.data(), 16) == 0);
+    CHECK(MV_AddArrayTable(h, ones.data(), 16) == 0);  // blocking
+    CHECK(MV_GetArrayTable(h, out.data(), 16) == 0);
+    for (float v : out) CHECK(v == 18.0f);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("AGG_OK %d\n", me);
+  return 0;
+}
+
+static int AggBenchChild(const char* machine_file, const char* rank) {
+  // Aggregation throughput probe (bench.py add_agg keys): rank 0 fires
+  // bursts of small async adds under an armed aggregation window and
+  // reports the adds-per-wire-message collapse ratio from the
+  // agg.adds/agg.flush counters.  Correctness is asserted (the final
+  // read must equal the add count) so the numbers can't be "fast but
+  // wrong".
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+                         "-log_level=error", "-rpc_timeout_ms=60000",
+                         "-barrier_timeout_ms=60000",
+                         "-add_agg_bytes=262144"};
+  CHECK(MV_Init(7, argv2) == 0);
+  int me = MV_WorkerId();
+  const int64_t kN = 1024;     // 4 KiB per add
+  const int kBursts = 16, kPerBurst = 16;
+  int32_t h;
+  CHECK(MV_NewArrayTable(kN, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+  std::vector<float> ones(kN, 1.0f), out(kN, -1.0f);
+  auto t0 = std::chrono::steady_clock::now();
+  if (me == 0) {
+    for (int b = 0; b < kBursts; ++b) {
+      for (int i = 0; i < kPerBurst; ++i)
+        CHECK(MV_AddAsyncArrayTable(h, ones.data(), kN) == 0);
+      CHECK(MV_FlushAdds(h) == 0);
+    }
+  }
+  CHECK(MV_Barrier() == 0);
+  double secs = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0)
+                    .count();
+  CHECK(MV_GetArrayTable(h, out.data(), kN) == 0);
+  for (float v : out) CHECK(v == (float)(kBursts * kPerBurst));
+  if (me == 0) {
+    long long adds = 0, flushes = 0;
+    CHECK(MV_QueryMonitor("agg.adds", &adds) == 0);
+    CHECK(MV_QueryMonitor("agg.flush", &flushes) == 0);
+    CHECK(adds == (long long)kBursts * kPerBurst);
+    CHECK(flushes >= 1 && adds / flushes >= 4);
+    printf("AGG_BENCH adds=%lld flushes=%lld secs=%.4f\n", adds, flushes,
+           secs);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("AGG_BENCH_OK %d\n", me);
+  return 0;
+}
+
 static int AsyncOverlapChild(const char* machine_file, const char* rank) {
   // Async Get overlap scenario (reference WorkerTable::GetAsync + Wait,
   // SURVEY.md §2.10 / the AsyncBuffer idiom §2.24): the pull must make
@@ -1443,6 +1822,12 @@ int main(int argc, char** argv) {
     return ScenarioExit(WireBenchChild(argv[2], argv[3], argv[4]));
   if (argc == 4 && std::string(argv[1]) == "async_overlap")
     return ScenarioExit(AsyncOverlapChild(argv[2], argv[3]));
+  if (argc == 4 && std::string(argv[1]) == "codec_wire")
+    return ScenarioExit(CodecWireChild(argv[2], argv[3]));
+  if (argc == 4 && std::string(argv[1]) == "agg_child")
+    return ScenarioExit(AggChild(argv[2], argv[3]));
+  if (argc == 4 && std::string(argv[1]) == "agg_bench")
+    return ScenarioExit(AggBenchChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "chaos_retry")
     return ScenarioExit(ChaosRetryChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "chaos_dropdup")
@@ -1469,6 +1854,7 @@ int main(int argc, char** argv) {
   Case cases[] = {
       {"blob", TestBlob},         {"queue", TestQueue},
       {"configure", TestConfigure}, {"message", TestMessage},
+      {"codec", TestCodec},
       {"dashboard", TestDashboard},
       {"updater", TestUpdater},   {"array", TestArray},
       {"matrix", TestMatrix},     {"sparse", TestSparseMatrix},

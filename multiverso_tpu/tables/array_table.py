@@ -91,6 +91,10 @@ class ArrayTable(Table):
             if compress is None and self._try_device_add(
                     delta, (self.size,), option, sync):
                 return
+            if compress is None:
+                # -wire_codec=1bit: host dense adds default to the 1-bit
+                # wire format (docs/wire_compression.md).
+                compress = self._wire_compress_default()
             delta = np.asarray(delta, dtype=self.dtype)
             if delta.ndim == 2:
                 delta = delta.sum(axis=0)

@@ -268,6 +268,19 @@ class Table:
             self._data, self._state = fn(self._data, self._state, d)
         self._serve_bump()
 
+    def _wire_compress_default(self):
+        """Resolve the ``-wire_codec`` flag into a default ``compress=``
+        for host dense adds (docs/wire_compression.md): ``"1bit"`` when
+        the flag says so AND this table can carry it (float dtype, not
+        BSP — the residual is per wire message), else ``None``.  An
+        explicit ``compress=`` kwarg always wins; the device fast path
+        and the sparse codec stay native/wire concepts."""
+        import jax.numpy as jnp
+
+        if config.get("wire_codec") != "1bit" or self.sync:
+            return None
+        return "1bit" if jnp.issubdtype(self.dtype, jnp.floating) else None
+
     def _add_compressed(self, delta, option, compress: str,
                         blocking: bool) -> None:
         """Shared compress= dispatch for the dense table ``add`` paths:
@@ -276,6 +289,9 @@ class Table:
         import jax
         import jax.numpy as jnp
 
+        # Chaos seam (docs/fault_tolerance.md): a scripted encode
+        # failure surfaces here, exactly where a real codec error would.
+        fault.inject("codec.encode")
         if compress != "1bit":
             raise ValueError(
                 f"unknown compress '{compress}' (expected '1bit')")

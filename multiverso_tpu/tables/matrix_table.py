@@ -147,6 +147,10 @@ class MatrixTable(Table):
             if compress is None and self._try_device_add(
                     delta, (self.num_rows, self.num_cols), option, sync):
                 return
+            if compress is None:
+                # -wire_codec=1bit: host dense adds default to the 1-bit
+                # wire format (docs/wire_compression.md).
+                compress = self._wire_compress_default()
             delta = np.asarray(delta, dtype=self.dtype)
             if delta.shape != (self.num_rows, self.num_cols):
                 raise ValueError(

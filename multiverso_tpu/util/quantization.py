@@ -39,12 +39,22 @@ def quantize_1bit(delta: np.ndarray,
     d = np.asarray(delta, np.float32).ravel()
     if residual is not None:
         d = d + residual.ravel()
+    # Sanitize non-finite inputs (matches the native codec,
+    # native/src/codec.cc): a NaN/Inf element is treated as 0 for this
+    # message AND gets a zeroed residual — otherwise one bad element
+    # poisons both scales (NaN mean) or rides the feedback loop forever.
+    finite = np.isfinite(d)
+    if not finite.all():
+        d = np.where(finite, d, np.float32(0.0))
     pos = d >= 0
     pos_scale = float(d[pos].mean()) if pos.any() else 0.0
     neg_scale = float(d[~pos].mean()) if (~pos).any() else 0.0
     packed = np.packbits(pos)
     recon = np.where(pos, np.float32(pos_scale), np.float32(neg_scale))
-    return packed, pos_scale, neg_scale, (d - recon).astype(np.float32)
+    new_residual = (d - recon).astype(np.float32)
+    if not finite.all():
+        new_residual[~finite] = 0.0
+    return packed, pos_scale, neg_scale, new_residual
 
 
 def dequantize_1bit(packed: np.ndarray, pos_scale: float, neg_scale: float,

@@ -234,6 +234,41 @@ def test_mv007_fires_on_unbounded_client_cache(tmp_path):
     assert _lint_src(apps, src) == []
 
 
+def test_mv008_fires_on_noncontiguous_ctypes(tmp_path):
+    """A strided view handed to a ctypes pointer fires; arrays with a
+    provably C-contiguous producer (ascontiguousarray, fresh
+    constructors, ravel, _f32) in the same function do not."""
+    rules = _lint_src(tmp_path, """\
+        import numpy as np
+
+        def bad(lib, h, a):
+            view = a[::2]                       # possibly strided: BAD
+            lib.MV_Get(h, _fp(view), view.size)
+            col = a.T                           # transpose view: BAD
+            lib.MV_Put(h, col.ctypes.data_as(P))
+
+        def good(lib, h, a):
+            ids = np.ascontiguousarray(a, dtype=np.int32)
+            lib.MV_Get(h, _ip(ids), ids.size)
+            out = np.zeros(8, np.float32)
+            lib.MV_Get(h, _fp(out), 8)
+            flat = a.ravel()
+            lib.MV_Put(h, _fp(flat), flat.size)
+            lens = np.asarray([1, 2, 3], np.int32)  # fresh from literal
+            lib.MV_Put(h, _ip(lens), 3)
+        """)
+    assert [r for r, _ in rules] == ["MV008", "MV008"], rules
+
+
+def test_mv008_parameter_without_coercion_fires(tmp_path):
+    """A bare parameter (unknown provenance) needs the coercion."""
+    rules = _lint_src(tmp_path, """\
+        def push(lib, h, delta):
+            lib.MV_Add(h, _fp(delta), delta.size)
+        """)
+    assert [r for r, _ in rules] == ["MV008"], rules
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

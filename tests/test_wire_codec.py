@@ -1,0 +1,370 @@
+"""Compressed, copy-light wire data plane (docs/wire_compression.md).
+
+Four layers of coverage:
+
+1. Python 1-bit quantization property tests — empty payloads, NaN/Inf
+   sanitization, odd lengths, all-negative buckets, and the
+   error-feedback residual draining (not accumulating) over repeated
+   compress/apply cycles.
+2. The native codec unit suite (``mvtpu_test codec``): sparse/1-bit
+   round trips, malformed-payload rejection, header stamps, reply
+   accept-list negotiation.
+3. Multi-process wire scenarios: ``codec_wire`` (1bit ships >= 3x fewer
+   payload bytes than raw for the same dense adds, measured via the
+   ``net.bytes`` counters, with served values inside tolerance) and
+   ``agg_child`` (>= 4 consecutive small adds collapse into ONE wire
+   message; Get/Clock/Barrier/explicit-flush all drain the buffer, so
+   BSP/SSP visibility holds).
+4. The binding/bridge surface: MV_SetTableCodec / MV_FlushAdds /
+   MV_WireStats through ctypes, the ``net.bytes{dir=...}`` metrics
+   bridge, the ``codec.encode`` / ``agg.flush`` fault seams, and a
+   2-proc raw-vs-1bit LR convergence check (final loss within 5% at
+   equal steps — the acceptance bar bench_lr_native8 reports).
+"""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "multiverso_tpu", "native")
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+# ---------------------------------------------------------------------------
+# 1. Python quantization property tests (no runtime needed)
+# ---------------------------------------------------------------------------
+
+def test_quantize_empty_payload():
+    from multiverso_tpu.util.quantization import (dequantize_1bit,
+                                                  quantize_1bit)
+
+    packed, p, m, res = quantize_1bit(np.zeros(0, np.float32))
+    assert packed.size == 0 and res.size == 0
+    assert p == 0.0 and m == 0.0
+    assert dequantize_1bit(packed, p, m, 0).size == 0
+
+
+@pytest.mark.parametrize("n", [1, 5, 7, 9, 31, 33])
+def test_quantize_odd_lengths_roundtrip(n):
+    from multiverso_tpu.util.quantization import (dequantize_1bit,
+                                                  quantize_1bit)
+
+    rng = np.random.RandomState(n)
+    d = rng.randn(n).astype(np.float32)
+    packed, p, m, res = quantize_1bit(d)
+    out = dequantize_1bit(packed, p, m, n)
+    assert out.shape == (n,)
+    # Reconstruction + residual telescopes back to the input exactly.
+    np.testing.assert_allclose(out + res, d, atol=1e-5)
+
+
+def test_quantize_all_negative():
+    from multiverso_tpu.util.quantization import (dequantize_1bit,
+                                                  quantize_1bit)
+
+    d = np.asarray([-1.0, -2.0, -3.0], np.float32)
+    packed, p, m, _ = quantize_1bit(d)
+    assert p == 0.0 and m == pytest.approx(-2.0)
+    np.testing.assert_allclose(dequantize_1bit(packed, p, m, 3), -2.0)
+
+
+def test_quantize_sanitizes_nonfinite():
+    """NaN/Inf inputs must not poison the scales or ride the feedback
+    loop: they quantize as 0 and their residual resets to 0 (matches the
+    native codec)."""
+    from multiverso_tpu.util.quantization import quantize_1bit
+
+    d = np.asarray([np.nan, np.inf, -np.inf, 2.0, -2.0], np.float32)
+    packed, p, m, res = quantize_1bit(d)
+    assert np.isfinite(p) and np.isfinite(m)
+    assert np.isfinite(res).all()
+    assert res[0] == 0.0 and res[1] == 0.0 and res[2] == 0.0
+    assert packed.size == 1
+
+
+def test_error_feedback_residual_drains():
+    """Repeated compress/apply cycles with fluctuating deltas: the
+    applied sum tracks the true sum (relative error -> ~0) and the
+    carried residual stays bounded — the error DRAINS into later
+    messages instead of accumulating."""
+    from multiverso_tpu.util.quantization import OneBitCompressor
+
+    comp = OneBitCompressor()
+    rng = np.random.RandomState(0)
+    n, steps = 64, 80
+    applied = np.zeros(n, np.float32)
+    true_sum = np.zeros(n, np.float64)
+    for _ in range(steps):
+        d = rng.randn(n).astype(np.float32)
+        true_sum += d
+        packed, p, m = comp.compress(d)
+        applied += comp.decompress(packed, p, m, (n,))
+    # |applied - true| == |final residual|; with ~N(0,1) deltas the
+    # residual stays O(1) while the sums walk O(sqrt(steps)).
+    err = np.abs(applied - true_sum)
+    assert float(err.max()) < 4.0
+    assert np.abs(comp._residual).max() < 4.0
+    rel = float(err.mean()) / max(1.0, float(np.abs(true_sum).mean()))
+    assert rel < 0.5
+
+
+# ---------------------------------------------------------------------------
+# 2 + 3. Native codec unit suite and multi-process wire scenarios
+# ---------------------------------------------------------------------------
+
+def _binary():
+    b = os.path.join(NATIVE_DIR, "build", "mvtpu_test")
+    subprocess.run(["make", "-C", NATIVE_DIR, "-j4", "build/mvtpu_test"],
+                   check=True, capture_output=True, timeout=600)
+    return b
+
+
+def _machine_file(tmp_path, n=2):
+    import socket
+
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = tmp_path / "machines.txt"
+    mf.write_text("".join(e + "\n" for e in eps))
+    return str(mf)
+
+
+def _run_ranks(binary, scenario, mf, n, extra=()):
+    procs = [subprocess.Popen([binary, scenario, mf, str(r), *extra],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(n)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs, procs
+
+
+@needs_gxx
+def test_native_codec_unit_suite():
+    out = subprocess.run([_binary(), "codec"], capture_output=True,
+                         text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "codec        OK" in out.stdout
+
+
+@needs_gxx
+def test_codec_wire_1bit_ships_3x_fewer_bytes(tmp_path):
+    """Acceptance: the 2-proc wire bench's 1bit phase ships >= 3x fewer
+    payload bytes than raw for dense adds (net.bytes counters), with
+    served values inside tolerance (asserted inside the scenario)."""
+    mf = _machine_file(tmp_path, 2)
+    outs, procs = _run_ranks(_binary(), "codec_wire", mf, 2)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"CODEC_WIRE_OK {r}" in out, out[-2000:]
+    m = re.search(r"CODEC_RATIO ([0-9.]+)", outs[0])
+    assert m, outs[0][-2000:]
+    assert float(m.group(1)) >= 3.0, outs[0][-2000:]
+    # Both phases reported bytes/msgs for the bench keys.
+    assert re.search(r"CODEC raw bytes=\d+ msgs=\d+", outs[0])
+    assert re.search(r"CODEC 1bit bytes=\d+ msgs=\d+", outs[0])
+
+
+@needs_gxx
+def test_add_aggregation_collapses_and_flushes(tmp_path):
+    """Acceptance: >= 4 consecutive small async adds collapse into ONE
+    wire message, and Get/Clock/Barrier/MV_FlushAdds all flush the
+    buffer with no semantic change (values asserted in the scenario)."""
+    mf = _machine_file(tmp_path, 2)
+    outs, procs = _run_ranks(_binary(), "agg_child", mf, 2)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"AGG_OK {r}" in out, out[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# 4. Binding surface, metrics bridge, fault seams, LR convergence
+# ---------------------------------------------------------------------------
+
+@needs_gxx
+def test_binding_codec_surface_and_single_proc_1bit():
+    """MV_SetTableCodec / MV_FlushAdds / MV_WireStats through ctypes in
+    a fresh subprocess (its own runtime singleton): a 1bit table's adds
+    decode correctly even in-process, aggregation honors the explicit
+    flush, the agg.flush fault seam fires, and wire_stats stays zero
+    without a wire."""
+    code = """
+import numpy as np
+from multiverso_tpu import fault, native as nat
+
+rt = nat.NativeRuntime(args=["-updater_type=default", "-log_level=error",
+                             "-add_agg_bytes=1048576"])
+h = rt.new_array_table(32)
+rt.set_table_codec(h, "1bit")
+delta = (1.0 + 0.25 * (np.arange(32) % 4)).astype(np.float32)
+for a in range(4):
+    rt.array_add(h, np.roll(delta, a), sync=True)
+out = rt.array_get(h, 32)
+want = 4 * 1.375
+assert abs(out.mean() - want) / want < 0.02, out.mean()
+assert np.abs(out - want).max() < 1.5, out
+
+# Unknown codec name -> rc -1.
+try:
+    rt.set_table_codec(h, "zstd")
+    raise SystemExit("expected failure")
+except RuntimeError:
+    pass
+
+# Aggregation: async adds absorb until the explicit flush.
+h2 = rt.new_array_table(8)
+for _ in range(5):
+    rt.array_add(h2, np.ones(8, np.float32), sync=False)
+assert rt.query_monitor("agg.flush") == 0
+rt.flush_adds(h2)
+assert rt.query_monitor("agg.flush") == 1
+np.testing.assert_allclose(rt.array_get(h2, 8), 5.0)
+
+# agg.flush fault seam (docs/fault_tolerance.md).
+fault.configure(seed=1, sites={"agg.flush": 1.0})
+try:
+    rt.flush_adds(h2)
+    raise SystemExit("expected injected fault")
+except fault.FaultError:
+    pass
+fault.reset()
+
+# Single process: no transport, so the wire ledger stays empty.
+ws = rt.wire_stats()
+assert ws == {"sent_bytes": 0, "recv_bytes": 0,
+              "sent_msgs": 0, "recv_msgs": 0}, ws
+rt.shutdown()
+print("CODEC_BINDING_OK")
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "JAX_PLATFORMS": "cpu",
+                          "PYTHONPATH": REPO})
+    assert "CODEC_BINDING_OK" in out.stdout, out.stdout + out.stderr
+
+
+def test_bridge_maps_net_bytes_counters():
+    """bridge_native turns the native net.bytes.{sent,recv} ledgers
+    (count = frames, total = bytes) into the labelled net.bytes/net.msgs
+    counters — wire observability parity with the Python io.bytes."""
+    from multiverso_tpu import metrics
+
+    metrics.reset()
+
+    class StubRuntime:
+        def dump_monitors(self):
+            buckets = tuple([0] * 28)
+            return {"net.bytes.sent": (7, 4096.0, 1024.0, buckets),
+                    "net.bytes.recv": (3, 512.0, 256.0, buckets),
+                    "Net::Send": (7, 0.004, 0.001, buckets)}
+
+    n = metrics.bridge_native(StubRuntime())
+    assert n == 3
+    assert metrics.counter("net.bytes", {"dir": "sent"}).value == 4096.0
+    assert metrics.counter("net.bytes", {"dir": "recv"}).value == 512.0
+    assert metrics.counter("net.msgs", {"dir": "sent"}).value == 7
+    assert metrics.counter("net.msgs", {"dir": "recv"}).value == 3
+    # Re-bridging refreshes absolute state instead of double-counting.
+    metrics.bridge_native(StubRuntime())
+    assert metrics.counter("net.bytes", {"dir": "sent"}).value == 4096.0
+    metrics.reset()
+
+
+def test_codec_encode_fault_seam(mv):
+    """The codec.encode chaos seam fires inside the JAX-plane compress
+    path, where a real encode failure would surface."""
+    from multiverso_tpu import fault
+
+    mv.init(updater_type="sgd")
+    import multiverso_tpu as m
+
+    t = m.ArrayTable(16)
+    fault.configure(seed=7, sites={"codec.encode": 1.0})
+    try:
+        with pytest.raises(fault.FaultError):
+            t.add(np.ones(16, np.float32), compress="1bit")
+        assert fault.count("fault.codec.encode") == 1
+    finally:
+        fault.reset()
+    # Disarmed: the compressed add goes through (sgd, lr=0.1 -> -0.1).
+    t.add(np.ones(16, np.float32), compress="1bit")
+    np.testing.assert_allclose(t.get(), -0.1, atol=1e-5)
+
+
+def test_wire_codec_flag_defaults_compress(mv):
+    """-wire_codec=1bit makes 1-bit the default for host dense adds on
+    float ASP tables (explicit compress= still wins; BSP tables are
+    exempt — the residual is per wire message)."""
+    mv.init(updater_type="sgd")
+    import multiverso_tpu as m
+
+    m.config.set_flag("wire_codec", "1bit")
+    try:
+        t = m.ArrayTable(8, name="wc_default")
+        t.add(np.full(8, 2.0, np.float32))  # all-equal: 1bit is exact
+        np.testing.assert_allclose(t.get(), -0.2, atol=1e-5)  # sgd lr=.1
+        assert t._compressor is not None  # the 1bit path actually ran
+        tb = m.ArrayTable(8, name="wc_bsp", sync=True)
+        tb.add(np.ones(8, np.float32))    # BSP: buffered, not compressed
+        assert tb._compressor is None
+    finally:
+        m.config.set_flag("wire_codec", "raw")
+
+
+@needs_gxx
+def test_lr_native_1bit_loss_within_5pct(tmp_path):
+    """Acceptance: equal-steps LR over the native wire, raw vs 1bit +
+    error feedback — final loss within 5%."""
+    worker = os.path.join(REPO, "multiverso_tpu", "apps",
+                          "lr_native_worker.py")
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+
+    def run(codec):
+        mf = _machine_file(tmp_path, 2)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        procs = [subprocess.Popen(
+            [sys.executable, worker, mf, str(r), "40", "256", codec],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for r in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                outs.append(p.communicate(timeout=300)[0])
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        losses = []
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0 and "NATIVE_LR_OK" in out, \
+                f"rank {r} ({codec}):\n{out[-2000:]}"
+            losses.append(float(re.search(r"loss=([0-9.]+)", out).group(1)))
+        return float(np.mean(losses))
+
+    loss_raw = run("raw")
+    loss_1bit = run("1bit")
+    assert abs(loss_1bit - loss_raw) / loss_raw < 0.05, \
+        (loss_raw, loss_1bit)

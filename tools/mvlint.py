@@ -57,6 +57,15 @@ Rules (docs/static_analysis.md has the full rationale):
   layer's ``VersionedLRUCache`` is the house pattern) or annotate WHY
   the growth is bounded with a suppression comment.
 
+- **MV008 noncontiguous-ctypes** — a numpy array handed to a ctypes
+  float/int pointer (``_fp(x)`` / ``_ip(x)`` / ``x.ctypes.data_as``)
+  must have a *provably C-contiguous* producer in the same function
+  (``np.ascontiguousarray``, a fresh constructor like ``np.zeros``,
+  ``.ravel()``, ``_f32``...).  ``.ctypes`` on a possibly-strided view
+  (slices, transposes, parameters of unknown provenance) silently hands
+  the native side a pointer whose memory layout does not match the
+  declared flat buffer — reads scramble, writes corrupt.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -379,6 +388,78 @@ def check_unbounded_client_cache(tree, path):
     return out
 
 
+# Producers whose result is guaranteed C-contiguous for MV008: explicit
+# contiguity coercions, fresh-allocation constructors, and the binding's
+# own `_f32` (which wraps ascontiguousarray).  `ravel()` always returns
+# a contiguous array (copying when needed) — unlike `reshape`/`.T`.
+CONTIG_PRODUCERS = {"ascontiguousarray", "_f32", "ravel", "copy",
+                    "zeros", "ones", "full", "empty", "arange",
+                    "zeros_like", "ones_like", "full_like", "empty_like",
+                    "frombuffer", "fromiter"}
+
+
+def check_noncontiguous_ctypes(tree, path):
+    """MV008: numpy array → ctypes pointer without a provable
+    C-contiguous producer in the same function scope."""
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # The sanctioned pointer helpers themselves wrap a bare
+        # parameter — call SITES are what this rule polices.
+        if fn.name in PTR_HELPERS:
+            continue
+        # name -> provably-contiguous? (last assignment wins; walking in
+        # source order is close enough for straight-line binding code).
+        proven = {}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            v = node.value
+            if isinstance(v, ast.Call):
+                tail = _call_name(v.func)
+                if tail in CONTIG_PRODUCERS:
+                    proven[name] = True
+                elif tail == "asarray" and v.args and not isinstance(
+                        v.args[0], ast.Name):
+                    # np.asarray over a literal/comprehension constructs
+                    # a fresh (contiguous) array; over a Name it may
+                    # pass a strided view through unchanged.
+                    proven[name] = True
+                else:
+                    proven[name] = False
+            else:
+                proven[name] = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            arg = None
+            how = None
+            if (_call_name(node.func) in PTR_HELPERS and node.args
+                    and isinstance(node.args[0], ast.Name)):
+                arg = node.args[0].id
+                how = f"{_call_name(node.func)}({arg})"
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "data_as"
+                    and isinstance(f.value, ast.Attribute)
+                    and f.value.attr == "ctypes"
+                    and isinstance(f.value.value, ast.Name)):
+                arg = f.value.value.id
+                how = f"{arg}.ctypes.data_as(...)"
+            if arg is None or proven.get(arg) is True:
+                continue
+            out.append(Finding(
+                path, node.lineno, "MV008",
+                f"{how}: no guaranteed C-contiguous path for '{arg}' in "
+                f"this function — a strided view here hands native code "
+                f"a mismatched memory layout; route it through "
+                f"np.ascontiguousarray (or a fresh constructor) first"))
+    return out
+
+
 def lint_file(path):
     try:
         with open(path, "r", encoding="utf-8") as fh:
@@ -390,6 +471,7 @@ def lint_file(path):
     findings = []
     findings += check_ctypes_temporary(tree, path)
     findings += check_dangling_async(tree, path)
+    findings += check_noncontiguous_ctypes(tree, path)
     if f"{os.sep}tables{os.sep}" in path or "/tables/" in path:
         findings += check_host_sync_in_jit(tree, path)
     if os.path.basename(path).startswith("bench"):
