@@ -75,8 +75,24 @@ wire-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/wire_demo.py
 
+# Event-driven serve-tier smoke (docs/transport.md): 256 anonymous
+# raw-socket clients against a 2-rank epoll fleet — all accepted and
+# served over pseudo-rank reply routing, shed-rate > 0 under
+# -server_inflight_max=1 overload, and zero lost adds while rank 0's
+# blocking adds eat injected fail_send faults (the PR 2 harness).
+fanin-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/fanin_demo.py
+
+# Continuous perf gate (docs/PERF.md): diff the newest bench JSON line
+# against the committed BENCH_BASELINE.json with per-key noise bands;
+# exits nonzero on an out-of-band regression (serve p50, wire RTT,
+# codec byte ratio, MFU +/-1.5, lr/w2v ratios).
+bench-gate:
+	$(PYTHON) tools/bench_compare.py
+
 clean:
 	$(MAKE) -C $(NATIVE) clean
 
 .PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
-        serve-demo wire-demo clean
+        serve-demo wire-demo fanin-demo bench-gate clean

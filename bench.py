@@ -363,6 +363,16 @@ def bench_wire_micro():
     outs = _run_test_ranks("wire_bench", 2, ("tcp",))
     parse(outs[0], "wire_tcp", res)
 
+    # Epoll engine sweep (docs/transport.md): the same protocol through
+    # the reactor — wire_epoll_{put,get}_gbps_* + wire_epoll_rtt_ms, so
+    # a readiness-model regression is visible next to the blocking
+    # engine's numbers.
+    try:
+        outs = _run_test_ranks("wire_bench", 2, ("epoll",))
+        parse(outs[0], "wire_epoll", res)
+    except Exception:
+        traceback.print_exc()
+
     # --- payload-codec sweep (docs/wire_compression.md) ----------------
     # The same dense-add workload raw vs 1bit through the FULL runtime
     # (tables + actors + wire), bytes measured at the transport ledger
@@ -538,6 +548,30 @@ def bench_serve():
     if "serve_cold_p50_ms" in res and res.get("serve_cached_p50_ms"):
         res["serve_cached_vs_cold_p50"] = (res["serve_cold_p50_ms"]
                                            / res["serve_cached_p50_ms"])
+    return res
+
+
+def bench_serve_fanin():
+    """Serve-tier fan-in (docs/transport.md): 1000 concurrent ANONYMOUS
+    client sockets against ONE server rank's epoll reactor — raw-socket
+    clients speaking the serve protocol, no rank identity.  Latency
+    phase (8-outstanding version probes) gives ``fanin_p50_ms`` /
+    ``fanin_p99_ms``; the overload phase (all 1000 fire a Get at once
+    under ``-server_inflight_max=8``) gives ``fanin_shed_rate`` — the
+    busy fraction the backpressure gate sheds instead of queueing.
+    ``fanin_qps`` covers both phases.  Clients and fleet live in
+    ``apps/fanin_bench_worker.py``."""
+    import re
+
+    outs = _spawn_native_workers("fanin_bench_worker.py", 2,
+                                 "FANIN_BENCH_OK", (1000, 8, 0))
+    res = {}
+    for out in outs:
+        for m in re.finditer(r"(\w+)=([0-9.]+)", out):
+            if m.group(1) != "rank":
+                res[f"fanin_{m.group(1)}"] = float(m.group(2))
+                if m.group(1).endswith("_ms"):
+                    _observe_iter(float(m.group(2)) * 1e-3)
     return res
 
 
@@ -1224,7 +1258,8 @@ def bench_lightlda_mh(num_docs: int = 2048, vocab: int = 10000,
 # headline, the dim-512 toy config is overhead-bound by construction
 # (VERDICT r4 weak #1).
 _SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_w2v_native8,
-             bench_wire_micro, bench_ssp, bench_serve, bench_add_get,
+             bench_wire_micro, bench_ssp, bench_serve, bench_serve_fanin,
+             bench_add_get,
              bench_transformer_large, bench_transformer, bench_moe,
              bench_lightlda, bench_lightlda_mh, bench_long_context]
 
@@ -1250,7 +1285,7 @@ def main() -> None:
     # Schema/partial line FIRST — before any JAX-touching import — so
     # even a backend-init hang killed by `timeout` leaves one parseable
     # line on stdout.
-    results = {"bench_schema": 9}
+    results = {"bench_schema": 10}
     errors = []
     _emit(results, errors)
 
@@ -1284,7 +1319,13 @@ def main() -> None:
     # fix), wire_{raw,1bit}_{bytes,msgs}_per_s + wire_1bit_bytes_ratio
     # (codec sweep via net.bytes counters), add_agg_ratio/_adds_per_s
     # (aggregation collapse), and lr_native_loss_{raw,1bit} +
-    # lr_native_1bit_loss_ratio (equal-steps codec convergence).
+    # lr_native_1bit_loss_ratio (equal-steps codec convergence);
+    # 10 = event-driven transport (docs/transport.md): every native
+    # fleet now defaults to -net_engine=epoll (so all lr/w2v/serve
+    # native keys measure the reactor), wire_epoll_* joins wire_tcp_*
+    # in the micro sweep, and bench_serve_fanin adds fanin_{p50,p99}_ms
+    # / fanin_qps / fanin_shed_rate / fanin_accepted — 1000 anonymous
+    # client sockets against one server rank.
 
     # A budget SIGTERM lands mid-section: convert it to an exception so
     # the JSON accumulated so far still prints (the whole point of the

@@ -1,0 +1,195 @@
+"""Serve-tier fan-in benchmark worker (bench.py ``bench_serve_fanin``;
+``make fanin-demo`` drives it too).
+
+Run as ``python fanin_bench_worker.py <machine_file> <rank> [nclients]
+[inflight_max] [chaos]``: two of these form a native epoll-engine fleet;
+rank 1 then drives ``nclients`` ANONYMOUS raw sockets (the serve wire
+protocol, ``serve/wire.py``) against rank 0's reactor:
+
+- **latency phase** — every client sends one header-only version probe,
+  paced 8-outstanding so the p50/p99 measure the service path, not the
+  self-inflicted queue;
+- **overload phase** — every client fires a shard Get simultaneously;
+  with ``-server_inflight_max=<inflight_max>`` the backlog trips the
+  shed gate and the busy fraction is the measured shed rate.
+
+``chaos=1`` (the demo mode) additionally has rank 0 run blocking adds
+under injected send faults WHILE the herd hammers it — the PR 2 retry
+harness must land every add exactly once (zero lost adds), asserted
+against the final table value.
+
+Rank 1 prints the measured keys; both ranks print ``FANIN_BENCH_OK``.
+"""
+
+import os
+import selectors
+import socket
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from multiverso_tpu import native as nat  # noqa: E402
+from multiverso_tpu.serve.wire import (FrameDecoder, MSG,  # noqa: E402
+                                       pack_frame, unpack_frame)
+
+SIZE = 1024
+CHAOS_ADDS = 5
+
+
+def _raise_fd_limit(need: int) -> None:
+    import resource
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < need:
+        resource.setrlimit(resource.RLIMIT_NOFILE,
+                           (min(max(need, soft), hard), hard))
+
+
+def _herd(endpoint: str, nclients: int) -> dict:
+    host, port = endpoint.rsplit(":", 1)
+    _raise_fd_limit(nclients + 256)
+    sel = selectors.DefaultSelector()
+    socks = []
+    for i in range(nclients):
+        s = socket.socket()
+        s.connect((host, int(port)))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.setblocking(False)
+        sel.register(s, selectors.EVENT_READ,
+                     {"dec": FrameDecoder(), "id": i, "t0": 0.0})
+        socks.append(s)
+
+    def collect(expected, deadline_s, on_reply):
+        got = 0
+        deadline = time.time() + deadline_s
+        while got < expected and time.time() < deadline:
+            for key, _ in sel.select(timeout=1.0):
+                data = key.data
+                try:
+                    chunk = key.fileobj.recv(65536)
+                except BlockingIOError:
+                    continue
+                if not chunk:
+                    raise RuntimeError(f"conn {data['id']} died")
+                data["dec"].feed(chunk)
+                while True:
+                    body = data["dec"].next_frame()
+                    if body is None:
+                        break
+                    on_reply(data, unpack_frame(body))
+                    got += 1
+        if got < expected:
+            raise RuntimeError(f"only {got}/{expected} replies before "
+                               f"the {deadline_s:.0f}s deadline")
+        return got
+
+    out = {"clients": float(nclients)}
+    wall0 = time.perf_counter()
+
+    # --- latency phase: 8-outstanding version probes --------------------
+    lat = []
+    window = 8
+    for base in range(0, nclients, window):
+        batch = socks[base:base + window]
+        for j, s in enumerate(batch):
+            sel.get_key(s).data["t0"] = time.perf_counter()
+            s.sendall(pack_frame(MSG["RequestVersion"], 0, base + j))
+
+        def note(data, reply):
+            lat.append(time.perf_counter() - data["t0"])
+        collect(len(batch), 60, note)
+    lat_ms = np.asarray(lat) * 1e3
+    out["p50_ms"] = float(np.percentile(lat_ms, 50))
+    out["p99_ms"] = float(np.percentile(lat_ms, 99))
+
+    # --- overload phase: every client fires a Get at once ---------------
+    counts = {"ReplyGet": 0, "ReplyBusy": 0}
+    for i, s in enumerate(socks):
+        s.sendall(pack_frame(MSG["RequestGet"], 0, 10000 + i))
+
+    def tally(_data, reply):
+        counts[reply["type_name"]] = counts.get(reply["type_name"], 0) + 1
+    replies = collect(nclients, 120, tally)
+    wall = time.perf_counter() - wall0
+    out["qps"] = (len(lat) + replies) / wall
+    out["shed_rate"] = counts.get("ReplyBusy", 0) / float(replies)
+    out["busy"] = float(counts.get("ReplyBusy", 0))
+    for s in socks:
+        sel.unregister(s)
+        s.close()
+    return out
+
+
+def main() -> int:
+    mf, rank = sys.argv[1], int(sys.argv[2])
+    nclients = int(sys.argv[3]) if len(sys.argv) > 3 else 1000
+    inflight_max = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    chaos = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+    rt = nat.NativeRuntime(args=[
+        f"-machine_file={mf}", f"-rank={rank}", "-log_level=error",
+        "-rpc_timeout_ms=60000", "-barrier_timeout_ms=120000",
+        f"-server_inflight_max={inflight_max}",
+        "-net_arena_bytes=8192", "-send_retries=3", "-send_backoff_ms=20"])
+    assert rt.net_engine() == "epoll", rt.net_engine()
+    h = rt.new_array_table(SIZE)
+    hk = rt.new_kv_table()
+    rt.barrier()
+    if rank == 0:
+        rt.array_add(h, np.ones(SIZE, np.float32))
+    rt.barrier()
+
+    out = {}
+    if rank == 0:
+        rt.set_fault_seed(1234)
+        if chaos:
+            # PR 2 harness under live fan-in: every blocking add eats an
+            # injected send failure and must still land EXACTLY once.
+            for _ in range(CHAOS_ADDS):
+                rt.set_fault_n("fail_send", 1)
+                rt.array_add(h, np.ones(SIZE, np.float32))
+            rt.clear_faults()
+            assert rt.query_monitor("net.retries") >= CHAOS_ADDS
+        # Hold the serve tier up until the herd reports done.
+        deadline = time.time() + 600
+        while rt.kv_get(hk, "herd_done") < 1.0:
+            if time.time() > deadline:
+                raise RuntimeError("herd never finished")
+            time.sleep(0.05)
+    else:
+        eps = [ln.strip() for ln in open(mf) if ln.strip()]
+        out = _herd(eps[0], nclients)
+        rt.kv_add(hk, "herd_done", 1.0)
+    rt.barrier()
+
+    # Zero lost adds: the exact final value, read through the fleet
+    # (busy-shed retries until admitted — sheds are retryable by
+    # contract, rc -6 means the server did no work).
+    want = 1.0 + (CHAOS_ADDS if chaos else 0)
+    for attempt in range(60):
+        try:
+            got = rt.array_get(h, SIZE)
+            break
+        except nat.BusyError:
+            time.sleep(0.05)
+    else:
+        raise RuntimeError("get shed 60 times in a row")
+    np.testing.assert_allclose(got, want)
+
+    if rank == 0:
+        st = rt.fanin_stats()
+        out["accepted"] = float(st["accepted_total"])
+        out["client_shed"] = float(st["client_shed"])
+        out["adds_ok"] = 1.0
+    rt.barrier()
+    rt.shutdown()
+    kv = " ".join(f"{k}={v:.6f}" for k, v in sorted(out.items()))
+    print(f"FANIN_BENCH_OK rank={rank} {kv}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,10 @@ int MV_SetTableCodec(int32_t handle, const char* codec);
 int MV_FlushAdds(int32_t handle);
 int MV_WireStats(long long* sent_bytes, long long* recv_bytes,
                  long long* sent_msgs, long long* recv_msgs);
+char* MV_NetEngine(void);
+void MV_FreeString(char* s);
+int MV_FanInStats(long long* accepted_total, long long* active_clients,
+                  long long* client_shed);
 ]]
 
 -- libmvtpu.so sits two directories up from this file (native/build/).
@@ -237,6 +241,27 @@ function mv.wire_stats()
   local rm = ffi.new("long long[1]")
   check(C.MV_WireStats(sb, rb, sm, rm), "MV_WireStats")
   return tonumber(sb[0]), tonumber(rb[0]), tonumber(sm[0]), tonumber(rm[0])
+end
+
+--- Active wire engine (docs/transport.md): "tcp" | "epoll" | "mpi",
+--- or "local" for a single process with no transport.
+function mv.net_engine()
+  local p = C.MV_NetEngine()
+  local name = ffi.string(p)
+  C.MV_FreeString(p)
+  return name
+end
+
+--- Anonymous serve-tier fan-in counters (epoll engine only): returns
+--- accepted_total, active_clients, client_shed — non-rank client
+--- connections accepted, currently connected, and requests shed by
+--- the per-client admission gate (-client_inflight_max).
+function mv.fanin_stats()
+  local a = ffi.new("long long[1]")
+  local c = ffi.new("long long[1]")
+  local s = ffi.new("long long[1]")
+  check(C.MV_FanInStats(a, c, s), "MV_FanInStats")
+  return tonumber(a[0]), tonumber(c[0]), tonumber(s[0])
 end
 
 -- Shared async-get handle (MV_GetAsync* wait tickets): wait() joins the

@@ -166,6 +166,10 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_ClearFaults.restype = ctypes.c_int
     lib.MV_DeadPeerCount.argtypes = []
     lib.MV_DeadPeerCount.restype = ctypes.c_int
+    lib.MV_NetEngine.argtypes = []
+    lib.MV_NetEngine.restype = ctypes.c_void_p
+    lib.MV_FanInStats.argtypes = [ctypes.POINTER(ctypes.c_longlong)] * 3
+    lib.MV_FanInStats.restype = ctypes.c_int
     lib.MV_SetTableCodec.argtypes = [ctypes.c_int32, ctypes.c_char_p]
     lib.MV_SetTableCodec.restype = ctypes.c_int
     lib.MV_FlushAdds.argtypes = [ctypes.c_int32]
@@ -496,6 +500,26 @@ class NativeRuntime:
     def dead_peer_count(self) -> int:
         """Peers with expired heartbeat leases (rank 0, -heartbeat_ms)."""
         return self.lib.MV_DeadPeerCount()
+
+    # ------------------------------------------------- transport
+    def net_engine(self) -> str:
+        """Active wire engine (docs/transport.md): ``tcp`` | ``epoll``
+        | ``mpi``, or ``local`` for a single process with no wire."""
+        return self._dump_string(self.lib.MV_NetEngine, "MV_NetEngine")
+
+    def fanin_stats(self) -> dict:
+        """Anonymous serve-tier fan-in counters (epoll engine only):
+        ``{"accepted_total", "active_clients", "client_shed"}`` —
+        non-rank client connections accepted, currently connected, and
+        requests shed by the per-client admission gate
+        (``-client_inflight_max``)."""
+        vals = [ctypes.c_longlong(0) for _ in range(3)]
+        self._check(
+            self.lib.MV_FanInStats(*(ctypes.byref(v) for v in vals)),
+            "MV_FanInStats")
+        return {"accepted_total": vals[0].value,
+                "active_clients": vals[1].value,
+                "client_shed": vals[2].value}
 
     # ------------------------------------------------- wire data plane
     def set_table_codec(self, handle: int, codec: str) -> None:

@@ -20,9 +20,30 @@ class Blob {
     std::memcpy(data_->data(), src, size);
   }
 
-  size_t size() const { return data_ ? data_->size() : 0; }
-  char* data() { return data_ ? data_->data() : nullptr; }
-  const char* data() const { return data_ ? data_->data() : nullptr; }
+  // Zero-copy view into a shared slab (the receive-arena path,
+  // docs/transport.md): shares ownership of `owner` but exposes only
+  // [off, off+len).  The slab cannot be freed or overwritten while any
+  // view is alive — the arena checks use_count() before reusing it —
+  // so a view is as safe as an owning Blob, without the copy.
+  static Blob View(std::shared_ptr<std::vector<char>> owner, size_t off,
+                   size_t len) {
+    Blob b;
+    b.data_ = std::move(owner);
+    b.off_ = off;
+    b.len_ = len;
+    b.is_view_ = true;
+    return b;
+  }
+
+  size_t size() const {
+    return is_view_ ? len_ : (data_ ? data_->size() : 0);
+  }
+  char* data() {
+    return data_ ? data_->data() + (is_view_ ? off_ : 0) : nullptr;
+  }
+  const char* data() const {
+    return data_ ? data_->data() + (is_view_ ? off_ : 0) : nullptr;
+  }
 
   template <typename T>
   T* As() { return reinterpret_cast<T*>(data()); }
@@ -32,14 +53,20 @@ class Blob {
   size_t count() const { return size() / sizeof(T); }
 
   // Shallow copy shares the buffer (the reference Blob's refcount
-  // semantics); CopyFrom deep-copies.
+  // semantics); CopyFrom deep-copies (views flatten to owning blobs).
   void CopyFrom(const Blob& other) {
     data_ = std::make_shared<std::vector<char>>(
         other.data(), other.data() + other.size());
+    off_ = 0;
+    len_ = 0;
+    is_view_ = false;
   }
 
  private:
   std::shared_ptr<std::vector<char>> data_;
+  size_t off_ = 0;   // view window (is_view_ only)
+  size_t len_ = 0;
+  bool is_view_ = false;
 };
 
 }  // namespace mvtpu

@@ -171,6 +171,19 @@ int MV_ClearFaults(void);
 // peers whose liveness lease is currently expired.  0 elsewhere.
 int MV_DeadPeerCount(void);
 
+// ---- transport (docs/transport.md) -----------------------------------
+// Active wire engine name: "tcp" | "epoll" | "mpi", or "local" for a
+// single process with no transport.  malloc'd; caller frees with
+// MV_FreeString.
+char* MV_NetEngine(void);
+// Anonymous serve-tier fan-in counters: connections accepted without a
+// rank identity (external serve clients), how many are currently
+// connected, and how many of their requests the per-client admission
+// gate (`-client_inflight_max`) answered ReplyBusy.  Nonzero only on
+// the epoll engine; any output pointer may be NULL.
+int MV_FanInStats(long long* accepted_total, long long* active_clients,
+                  long long* client_shed);
+
 // ---- wire data plane (docs/wire_compression.md) ----------------------
 // Retarget one table's wire codec: "raw" | "1bit" (sign bits + two
 // scales per message, worker-side error feedback so the quantization

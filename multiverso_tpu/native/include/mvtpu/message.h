@@ -136,6 +136,16 @@ struct Message {
   // (net.cc SendFramed) — no full-payload copy on the hot path.
   Blob Serialize() const;
   static Message Deserialize(const Blob& buf);
+  // Zero-copy deserialize (the epoll receive path, docs/transport.md):
+  // the frame at [off, off+len) of `slab` is parsed in place, each data
+  // blob becoming a Blob::View sharing the slab's ownership — no payload
+  // copy.  `off` must be 8-aligned (the reactor's arena packs frames
+  // that way); blobs landing at unaligned offsets inside the frame are
+  // flattened to owning copies instead of views, so consumers may
+  // always As<T>() the payload.  False on a malformed frame (blob
+  // lengths overrunning `len`); the caller drops the connection.
+  static bool DeserializeView(std::shared_ptr<std::vector<char>> slab,
+                              size_t off, size_t len, Message* out);
 };
 
 using MessagePtr = std::unique_ptr<Message>;

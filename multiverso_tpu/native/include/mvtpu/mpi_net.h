@@ -54,6 +54,7 @@ class MpiNet : public Net {
 
   int rank() const override { return rank_; }
   int size() const override { return size_; }
+  const char* engine() const override { return "mpi"; }
 
  private:
   void ProbeLoop();

@@ -17,28 +17,15 @@
 
 #include "mvtpu/message.h"
 #include "mvtpu/mutex.h"
+#include "mvtpu/transport.h"
 
 namespace mvtpu {
 
-// Wire-transport interface — what the Zoo needs from a transport.  The
-// reference selects its transport (MPI vs ZMQ) behind one NetInterface
-// (include/multiverso/net.h, SURVEY.md §2.17-2.18); this is that seam:
-// TcpNet is the machine-file/registration transport, MpiNet (mpi_net.h)
-// the literal MPI wire, chosen by `-net_type`.
-class Net {
- public:
-  using InboundFn = std::function<void(Message&&)>;
-
-  virtual ~Net() = default;
-
-  // Serialize + ship to the peer; false on a dead/unreachable rank.
-  virtual bool Send(int dst_rank, const Message& msg) = 0;
-  virtual void Stop() = 0;
-  virtual int rank() const = 0;
-  virtual int size() const = 0;
-};
-
-class TcpNet : public Net {
+// The wire-transport interface itself (class Net + RankTransport) lives
+// in mvtpu/transport.h — the `-net_engine` seam.  TcpNet here is the
+// blocking thread-per-connection engine; EpollNet (epoll_net.h) the
+// event-driven reactor; MpiNet (mpi_net.h) the literal MPI wire.
+class TcpNet : public RankTransport {
  public:
   using InboundFn = Net::InboundFn;
 
@@ -93,7 +80,7 @@ class TcpNet : public Net {
   // deliver every inbound message to `fn` (called from reader threads).
   // `connect_retry_ms` bounds each lazy-connect's retry budget.
   bool Init(const std::vector<std::string>& endpoints, int rank,
-            InboundFn fn, int64_t connect_retry_ms = 15000);
+            InboundFn fn, int64_t connect_retry_ms = 15000) override;
 
   // Frame + write to the peer (lazy connect with retries — peers start
   // in any order; scatter-gather, so the payload is never copied into a
@@ -111,6 +98,7 @@ class TcpNet : public Net {
 
   int rank() const override { return rank_; }
   int size() const override { return static_cast<int>(endpoints_.size()); }
+  const char* engine() const override { return "tcp"; }
 
  private:
   void AcceptLoop();

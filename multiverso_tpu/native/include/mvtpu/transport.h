@@ -1,0 +1,83 @@
+// Transport — the pluggable wire seam (docs/transport.md).
+//
+// The reference selects its transport (MPI vs ZMQ) behind one
+// NetInterface (include/multiverso/net.h, SURVEY.md §2.17-2.18); this
+// header is that seam grown one axis further: besides the WIRE (TCP vs
+// MPI) the runtime now also picks the READINESS MODEL.  `-net_engine`
+// chooses between
+//
+//   tcp    — TcpNet (net.h): blocking sockets, one reader thread per
+//            accepted connection.  Simple, fine for a fixed rank fleet.
+//   epoll  — EpollNet (epoll_net.h): an event-driven reactor (one epoll
+//            loop, optionally `-net_threads` shards) driving
+//            non-blocking sockets through per-connection read/write
+//            state machines.  Scales to thousands of connections and is
+//            the only engine that accepts ANONYMOUS (non-rank) serve
+//            clients.  The default for TCP fleets.
+//   mpi    — MpiNet (mpi_net.h): the literal MPI wire; rank/size come
+//            from MPI itself, so it keeps its own Init shape.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "mvtpu/message.h"
+
+namespace mvtpu {
+
+// What the Zoo needs from a transport.
+class Net {
+ public:
+  using InboundFn = std::function<void(Message&&)>;
+
+  virtual ~Net() = default;
+
+  // Serialize + ship to the peer; false on a dead/unreachable rank.
+  virtual bool Send(int dst_rank, const Message& msg) = 0;
+  virtual void Stop() = 0;
+  virtual int rank() const = 0;
+  virtual int size() const = 0;
+  virtual const char* engine() const = 0;
+
+  // Anonymous serve-tier fan-in counters (docs/transport.md): clients
+  // are connections that carry no rank identity — only the epoll engine
+  // accepts them; every other engine reports zeros.
+  struct FanInStats {
+    long long accepted_total = 0;  // anonymous connections ever accepted
+    long long active_clients = 0;  // currently connected
+    long long client_shed = 0;     // requests answered ReplyBusy by the
+                                   // per-client admission gate
+  };
+  virtual FanInStats FanIn() const { return {}; }
+};
+
+namespace transport {
+
+// Anonymous clients have no endpoint to connect back to, so the reactor
+// assigns each accepted non-rank connection a PSEUDO-RANK at/above this
+// base and routes Send(pseudo_rank) back over the accepted socket.
+// Real ranks are always far below it, so routing stays a range check.
+inline constexpr int kClientRankBase = 1 << 20;
+
+inline bool IsClientRank(int r) { return r >= kClientRankBase; }
+
+}  // namespace transport
+
+// Machine-file/registration transports share one Init shape: endpoints
+// are rank-indexed "host:port" strings, `rank` is this process's index,
+// and every decoded inbound message is handed to `fn` (from reader or
+// reactor threads).  MpiNet is NOT one of these — it derives rank/size
+// from MPI itself.
+class RankTransport : public Net {
+ public:
+  virtual bool Init(const std::vector<std::string>& endpoints, int rank,
+                    InboundFn fn, int64_t connect_retry_ms = 15000) = 0;
+};
+
+// `-net_engine` factory ("tcp" | "epoll"); nullptr on an unknown name.
+std::unique_ptr<RankTransport> MakeRankTransport(const std::string& engine);
+
+}  // namespace mvtpu

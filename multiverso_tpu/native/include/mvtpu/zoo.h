@@ -46,6 +46,12 @@ class Zoo {
 
   int rank() const { return rank_; }
   int size() const { return size_; }
+  // Active wire engine name ("tcp" | "epoll" | "mpi"), or "local" when
+  // this is a single process with no transport (docs/transport.md).
+  const char* net_engine() const;
+  // Anonymous serve-tier fan-in counters — nonzero only on the epoll
+  // engine, the one that accepts non-rank client connections.
+  Net::FanInStats FanIn() const;
   // Role bitmasks (reference Role enum): 1 = worker, 2 = server.
   // Static (machine-file) mode gives every rank both roles; dynamic
   // registration (-controller_endpoint/-role) can create worker-only or

@@ -407,6 +407,21 @@ int MV_ClearFaults(void) {
 
 int MV_DeadPeerCount(void) { return Zoo::Get()->DeadPeerCount(); }
 
+// ---- transport (docs/transport.md) -----------------------------------
+
+char* MV_NetEngine(void) {
+  return MallocString(Zoo::Get()->net_engine());
+}
+
+int MV_FanInStats(long long* accepted_total, long long* active_clients,
+                  long long* client_shed) {
+  auto st = Zoo::Get()->FanIn();
+  if (accepted_total) *accepted_total = st.accepted_total;
+  if (active_clients) *active_clients = st.active_clients;
+  if (client_shed) *client_shed = st.client_shed;
+  return 0;
+}
+
 // ---- wire data plane (docs/wire_compression.md) ----------------------
 
 int MV_SetTableCodec(int32_t handle, const char* codec) {

@@ -147,6 +147,33 @@ void RegisterDefaults() {
                  "dlopen's libmpi: rank/size come from MPI (mpirun for "
                  ">1 node; isolated singleton otherwise), no machine "
                  "file needed");
+    DefineString("net_engine", "epoll",
+                 "tcp|epoll|mpi — readiness model of the wire transport "
+                 "(docs/transport.md).  epoll (default): one event-loop "
+                 "reactor (plus -net_threads shards) drives nonblocking "
+                 "sockets and accepts ANONYMOUS serve clients; tcp: the "
+                 "blocking thread-per-connection engine; mpi: the "
+                 "literal MPI wire (same as -net_type=mpi)");
+    DefineInt("net_threads", 1,
+              "epoll engine: number of reactor shards (event-loop "
+              "threads); connections round-robin across them.  1 "
+              "(default) is right below ~10k connections");
+    DefineInt("net_arena_bytes", 262144,
+              "epoll engine: receive-arena slab size per connection; "
+              "frames assemble in the slab and decode zero-copy "
+              "(Blob views).  Larger frames allocate exactly; smaller "
+              "ones pack and the slab recycles once no view is alive");
+    DefineInt("net_writeq_bytes", 67108864,
+              "epoll engine: per-connection write-queue bound.  A slow "
+              "reader fills it; senders then wait for drain up to "
+              "-io_timeout_ms (the readiness-model twin of SO_SNDTIMEO) "
+              "instead of ballooning memory.  <=0 unbounded");
+    DefineInt("client_inflight_max", 64,
+              "epoll engine: per-anonymous-client admission on top of "
+              "-server_inflight_max — a client with this many "
+              "unanswered Gets/probes is shed with ReplyBusy at the "
+              "reactor, before the actor mailbox.  Adds are never "
+              "shed.  <=0 disables");
     DefineInt("rank", 0, "this process's line index in machine_file");
     DefineString("controller_endpoint", "",
                  "dynamic registration: rank 0's host:port (no machine "

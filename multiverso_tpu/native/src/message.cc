@@ -54,6 +54,41 @@ Blob Message::Serialize() const {
   return out;
 }
 
+bool Message::DeserializeView(std::shared_ptr<std::vector<char>> slab,
+                              size_t off, size_t len, Message* out) {
+  if (len < sizeof(WireHeader) || off + len > slab->size()) return false;
+  const char* base = slab->data() + off;
+  WireHeader h;
+  std::memcpy(&h, base, sizeof(h));
+  out->AdoptWireHeader(h);
+  out->data.clear();
+  if (h.num_blobs < 0) return false;
+  size_t pos = sizeof(h);
+  out->data.reserve(static_cast<size_t>(h.num_blobs));
+  for (int32_t i = 0; i < h.num_blobs; ++i) {
+    if (pos + sizeof(int64_t) > len) return false;
+    int64_t blen;
+    std::memcpy(&blen, base + pos, sizeof(blen));
+    pos += sizeof(blen);
+    if (blen < 0 || pos + static_cast<size_t>(blen) > len) return false;
+    // Zero-copy only at 8-aligned payload offsets: consumers read
+    // blobs as typed float/int32/int64 arrays (As<T>), and a view
+    // following an odd-length blob would hand them a misaligned
+    // pointer (UB, and a real fault on strict architectures).  The
+    // hot path — one large payload right after the 8-aligned header —
+    // always qualifies; small trailing blobs behind odd-length keys
+    // pay a copy instead.
+    if ((off + pos) % 8 == 0) {
+      out->data.push_back(
+          Blob::View(slab, off + pos, static_cast<size_t>(blen)));
+    } else {
+      out->data.emplace_back(base + pos, static_cast<size_t>(blen));
+    }
+    pos += static_cast<size_t>(blen);
+  }
+  return pos == len;
+}
+
 Message Message::Deserialize(const Blob& buf) {
   Message m;
   const char* p = buf.data();

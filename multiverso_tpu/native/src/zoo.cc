@@ -260,7 +260,17 @@ bool Zoo::Start(int argc, const char* const* argv) {
                net_type.c_str());
     return false;
   }
-  if (net_type == "mpi") {
+  // Readiness-model seam (docs/transport.md): -net_engine picks the
+  // transport engine.  `epoll` (the default) and `tcp` are the two TCP
+  // engines behind MakeRankTransport; `mpi` forces the MPI wire (the
+  // legacy -net_type=mpi spelling still works and wins).
+  std::string engine = configure::GetString("net_engine");
+  if (engine != "tcp" && engine != "epoll" && engine != "mpi") {
+    Log::Error("unknown -net_engine '%s' (expected tcp|epoll|mpi)",
+               engine.c_str());
+    return false;
+  }
+  if (net_type == "mpi" || engine == "mpi") {
     // Literal MPI wire (reference net/mpi_net.h, SURVEY §2.17): rank and
     // size come from MPI itself — machine_file / -rank / registration
     // are TCP-mode concepts and are ignored.  Every rank is
@@ -315,12 +325,13 @@ bool Zoo::Start(int argc, const char* const* argv) {
     size_ = static_cast<int>(endpoints.size());
     SetRoles(roles);
     if (size_ > 1) {
-      auto tcp = std::make_unique<TcpNet>();
-      if (!tcp->Init(endpoints, rank_,
-                     [this](Message&& m) { RouteInbound(std::move(m)); },
-                     configure::GetInt("connect_retry_ms")))
+      auto wire = MakeRankTransport(engine);
+      if (!wire ||
+          !wire->Init(endpoints, rank_,
+                      [this](Message&& m) { RouteInbound(std::move(m)); },
+                      configure::GetInt("connect_retry_ms")))
         return false;
-      net_ = std::move(tcp);
+      net_ = std::move(wire);
     }
   } else if (!machine_file.empty()) {
     auto endpoints = TcpNet::ParseMachineFile(machine_file);
@@ -329,12 +340,13 @@ bool Zoo::Start(int argc, const char* const* argv) {
       size_ = static_cast<int>(endpoints.size());
       // Static mode: every rank is worker + server (reference Role::All).
       SetRoles(std::vector<int>(size_, kRoleWorker | kRoleServer));
-      auto tcp = std::make_unique<TcpNet>();
-      if (!tcp->Init(endpoints, rank_,
-                     [this](Message&& m) { RouteInbound(std::move(m)); },
-                     configure::GetInt("connect_retry_ms")))
+      auto wire = MakeRankTransport(engine);
+      if (!wire ||
+          !wire->Init(endpoints, rank_,
+                      [this](Message&& m) { RouteInbound(std::move(m)); },
+                      configure::GetInt("connect_retry_ms")))
         return false;
-      net_ = std::move(tcp);
+      net_ = std::move(wire);
     }
   }
 
@@ -358,9 +370,19 @@ bool Zoo::Start(int argc, const char* const* argv) {
   Dashboard::SetTraceRank(rank_);
   if (configure::GetBool("trace")) Dashboard::SetTraceEnabled(true);
   started_ = true;
-  Log::Info("mvtpu native runtime started (rank %d/%d, updater=%s)", rank_,
-            size_, upd.c_str());
+  Log::Info("mvtpu native runtime started (rank %d/%d, updater=%s, "
+            "engine=%s)", rank_, size_, upd.c_str(), net_engine());
   return true;
+}
+
+const char* Zoo::net_engine() const {
+  // Phase-stable like net_ itself (set by Start, cleared by the Stop
+  // latch winner); "local" = single process, no wire at all.
+  return net_ ? net_->engine() : "local";
+}
+
+Net::FanInStats Zoo::FanIn() const {
+  return net_ ? net_->FanIn() : Net::FanInStats{};
 }
 
 void Zoo::Stop() {

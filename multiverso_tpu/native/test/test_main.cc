@@ -508,19 +508,23 @@ static int TestThreads() {
   return 0;
 }
 
-static int NetChild(const char* machine_file, const char* rank) {
+static int NetChild(const char* machine_file, const char* rank,
+                    const char* engine) {
   // N-process scenario (spawned N times by tests/test_native.py): sharded
   // tables over the TCP transport — Add/Get round-trips cross the process
   // boundary, MV_Barrier rendezvouses through rank 0's controller.
-  // N comes from the machine file (2 and 4 in CI); N <= 4.
+  // N comes from the machine file (2 and 4 in CI); N <= 4.  `engine`
+  // picks the readiness model (tcp|epoll; tests run both).
   std::string mf = std::string("-machine_file=") + machine_file;
   std::string rk = std::string("-rank=") + rank;
+  std::string eng = std::string("-net_engine=") + engine;
   // Bounded deadlines: an infra failure (stolen port, dead sibling)
   // must fail a CHECK quickly, not hang the rank past pytest's timeout.
-  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+  const char* argv2[] = {mf.c_str(), rk.c_str(), eng.c_str(),
+                         "-updater_type=default",
                          "-log_level=error", "-rpc_timeout_ms=60000",
                          "-barrier_timeout_ms=60000"};
-  CHECK(MV_Init(6, argv2) == 0);
+  CHECK(MV_Init(7, argv2) == 0);
   int me = MV_WorkerId();
   int n = MV_NumWorkers();
   CHECK(n >= 2 && n <= 4);
@@ -1151,6 +1155,8 @@ static int WireBenchChild(const char* machine_file, const char* rank,
   using mvtpu::Blob;
   using mvtpu::Message;
   using mvtpu::MsgType;
+  // net_type: "tcp" | "epoll" (rank transports via the -net_engine
+  // factory seam) | "mpi" (the literal MPI wire).
   const bool mpi = std::string(net_type) == "mpi";
   int me = atoi(rank);
 
@@ -1169,7 +1175,7 @@ static int WireBenchChild(const char* machine_file, const char* rank,
   std::atomic<int> pings{0}, payloads{0}, get_reqs{0}, echoes{0},
       burst_acks{0}, done{0};
 
-  mvtpu::TcpNet tcp;
+  std::unique_ptr<mvtpu::RankTransport> rank_net;
   mvtpu::MpiNet mpin;
   mvtpu::Net* net = nullptr;
   auto inbound = [&](Message&& m) {
@@ -1201,8 +1207,10 @@ static int WireBenchChild(const char* machine_file, const char* rank,
   } else {
     auto eps = mvtpu::TcpNet::ParseMachineFile(machine_file);
     CHECK(eps.size() == 2);
-    CHECK(tcp.Init(eps, me, inbound, 15000));
-    net = &tcp;
+    rank_net = mvtpu::MakeRankTransport(net_type);
+    CHECK(rank_net != nullptr);
+    CHECK(rank_net->Init(eps, me, inbound, 15000));
+    net = rank_net.get();
   }
 
   auto mk = [&](MsgType t, size_t bytes) {
@@ -1442,6 +1450,11 @@ static int AggChild(const char* machine_file, const char* rank) {
   CHECK(MV_Barrier() == 0);
   CHECK(MV_GetArrayTable(h, out.data(), 16) == 0);
   for (float v : out) CHECK(v == 10.0f);  // both ranks see 6 + 4
+  // Rendezvous between rounds (the NetChild race note): without this,
+  // a slow rank's verify-Get races the fast rank's next-phase async
+  // adds — the blocking engine's synchronous Send masked the window,
+  // the reactor's enqueue-and-return Send opens it.
+  CHECK(MV_Barrier() == 0);
 
   // Phase 3 — flush-on-Barrier: BSP visibility for aggregated adds.
   if (me == 0) {
@@ -1457,6 +1470,7 @@ static int AggChild(const char* machine_file, const char* rank) {
     CHECK(adds == 15);
     CHECK(flushes == 3);
   }
+  CHECK(MV_Barrier() == 0);  // same verify-vs-next-round fence as above
 
   // Phase 4 — explicit flush (MV_FlushAdds) + blocking-add ordering:
   // a blocking add flushes the buffer first, so its ack covers both.
@@ -1599,18 +1613,22 @@ static int AsyncOverlapChild(const char* machine_file, const char* rank) {
 // scenarios can only approximate with real process death.  All run with
 // a fixed seed so CI is deterministic.
 
-static int ChaosRetryChild(const char* machine_file, const char* rank) {
+static int ChaosRetryChild(const char* machine_file, const char* rank,
+                           const char* engine) {
   // Send retry-then-succeed: the first two write attempts of rank 0's
   // blocking Add are injected failures; the bounded-backoff retry loop
   // reconnects and lands the delta.  Proves retries are counted and the
-  // payload survives the faulty wire.
+  // payload survives the faulty wire — on EITHER engine (the fault seam
+  // consumes an attempt the same way on the reactor path).
   std::string mf = std::string("-machine_file=") + machine_file;
   std::string rk = std::string("-rank=") + rank;
-  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+  std::string eng = std::string("-net_engine=") + engine;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), eng.c_str(),
+                         "-updater_type=default",
                          "-log_level=error", "-rpc_timeout_ms=30000",
                          "-barrier_timeout_ms=30000", "-send_retries=3",
                          "-send_backoff_ms=20", "-connect_retry_ms=2000"};
-  CHECK(MV_Init(9, argv2) == 0);
+  CHECK(MV_Init(10, argv2) == 0);
   CHECK(MV_SetFaultSeed(1234) == 0);
   int me = MV_WorkerId();
   int32_t h;
@@ -1803,8 +1821,9 @@ static int ScenarioExit(int rc) {
 }
 
 int main(int argc, char** argv) {
-  if (argc == 4 && std::string(argv[1]) == "net_child")
-    return ScenarioExit(NetChild(argv[2], argv[3]));
+  if ((argc == 4 || argc == 5) && std::string(argv[1]) == "net_child")
+    return ScenarioExit(
+        NetChild(argv[2], argv[3], argc == 5 ? argv[4] : "epoll"));
   if (argc == 5 && std::string(argv[1]) == "net_updater")
     return ScenarioExit(NetUpdaterChild(argv[2], argv[3], argv[4]));
   if (argc == 7 && std::string(argv[1]) == "register")
@@ -1828,8 +1847,9 @@ int main(int argc, char** argv) {
     return ScenarioExit(AggChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "agg_bench")
     return ScenarioExit(AggBenchChild(argv[2], argv[3]));
-  if (argc == 4 && std::string(argv[1]) == "chaos_retry")
-    return ScenarioExit(ChaosRetryChild(argv[2], argv[3]));
+  if ((argc == 4 || argc == 5) && std::string(argv[1]) == "chaos_retry")
+    return ScenarioExit(
+        ChaosRetryChild(argv[2], argv[3], argc == 5 ? argv[4] : "epoll"));
   if (argc == 4 && std::string(argv[1]) == "chaos_dropdup")
     return ScenarioExit(ChaosDropDupChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "chaos_barrier")

@@ -19,6 +19,11 @@ parts in the tradition of parameter-server client caches:
   both over a :class:`~multiverso_tpu.native.NativeRuntime`, plus
   busy-retry against ``-server_inflight_max`` backpressure sheds
   (``BusyError`` → ``fault.RetryPolicy`` backoff).
+- :class:`~multiverso_tpu.serve.wire.AnonServeClient` — a pure-socket
+  ANONYMOUS client speaking the serve protocol (RequestVersion /
+  RequestGet / ReplyBusy) straight to a server rank's epoll reactor:
+  no rank, no native library — the external-read-tier entry point
+  (docs/transport.md).
 
 The JAX-plane tables wear the same cache/coalescer directly (see
 ``tables/base.py``: ``-serve_cache_entries`` arms it); there the
@@ -31,5 +36,7 @@ from __future__ import annotations
 from .cache import VersionedLRUCache
 from .client import ServeClient
 from .coalescer import Coalescer
+from .wire import AnonServeClient, FrameDecoder, ServeBusy
 
-__all__ = ["Coalescer", "ServeClient", "VersionedLRUCache"]
+__all__ = ["AnonServeClient", "Coalescer", "FrameDecoder", "ServeBusy",
+           "ServeClient", "VersionedLRUCache"]

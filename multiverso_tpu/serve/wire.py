@@ -1,0 +1,193 @@
+"""Anonymous serve-tier wire client (docs/transport.md).
+
+Speaks the native frame protocol directly over a TCP socket — no rank,
+no machine file, no native library.  The epoll engine (`-net_engine=
+epoll`, the default) accepts such connections on any server rank's
+listen port: the first frame carries an invalid ``src`` (< 0), the
+reactor assigns the connection a pseudo-rank, and replies route back
+over the same socket.  The blocking ``tcp`` engine does NOT serve
+anonymous clients (its readers deliver inbound frames, but replies to a
+non-rank ``src`` have no route back).
+
+Frame layout (one ``Message``, little-endian, matching
+``mvtpu/message.h``)::
+
+    int64  frame_len                  # bytes after this field
+    WireHeader {                      # 56 bytes
+        int32 src, dst, type, table_id
+        int64 msg_id, trace_id, version
+        int32 codec, flags, num_blobs, pad
+    }
+    num_blobs x { int64 len; bytes payload }
+
+Supported requests are the serve protocol: ``RequestVersion`` (header
+only, ``version=-1`` for the whole table), ``RequestGet`` (the server
+replies with ITS SHARD of the table — an anonymous client reading a
+sharded table contacts each server rank it cares about), and the
+server-side shed path answers either with ``ReplyBusy``.
+
+This module is pure stdlib + numpy so external tooling can vendor it.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["AnonServeClient", "MSG", "pack_frame", "unpack_frame",
+           "HEADER"]
+
+# WireHeader (mvtpu/message.h): 4 x int32, 3 x int64, 4 x int32.
+HEADER = struct.Struct("<4i3q4i")
+_LEN = struct.Struct("<q")
+
+# MsgType values used by the serve protocol (mvtpu/message.h).
+MSG = {
+    "RequestGet": 1,
+    "ReplyGet": 3,
+    "ReplyError": 5,
+    "RequestVersion": 8,
+    "ReplyVersion": 9,
+    "ReplyBusy": 10,
+}
+_TYPE_NAME = {v: k for k, v in MSG.items()}
+
+_ACCEPT_RAW = 1  # msgflag::kAcceptRaw
+
+
+def pack_frame(msg_type: int, table_id: int, msg_id: int, *,
+               version: int = -1, blobs=()) -> bytes:
+    """One wire frame.  ``src=-1`` is what makes the connection
+    anonymous: the reactor sees no valid rank in the first frame and
+    assigns a pseudo-rank instead."""
+    body = HEADER.pack(-1, -1, msg_type, table_id, msg_id, 0, version,
+                       0, _ACCEPT_RAW, len(blobs), 0)
+    for b in blobs:
+        body += _LEN.pack(len(b)) + bytes(b)
+    return _LEN.pack(len(body)) + body
+
+
+def unpack_frame(body: bytes) -> dict:
+    """Decode one frame body (the bytes after the length prefix)."""
+    (src, dst, mtype, table_id, msg_id, trace_id, version, codec, flags,
+     num_blobs, _pad) = HEADER.unpack_from(body, 0)
+    blobs = []
+    pos = HEADER.size
+    for _ in range(num_blobs):
+        (blen,) = _LEN.unpack_from(body, pos)
+        pos += _LEN.size
+        blobs.append(body[pos:pos + blen])
+        pos += blen
+    return {"src": src, "dst": dst, "type": mtype,
+            "type_name": _TYPE_NAME.get(mtype, str(mtype)),
+            "table_id": table_id, "msg_id": msg_id, "trace_id": trace_id,
+            "version": version, "codec": codec, "flags": flags,
+            "blobs": blobs}
+
+
+class AnonServeClient:
+    """One anonymous connection to a server rank's listen endpoint.
+
+    Blocking convenience wrapper; the fan-in bench/demo drive hundreds
+    of these sockets through ``selectors`` instead (send ``request()``
+    bytes, feed received bytes to a :class:`FrameDecoder`).
+    """
+
+    def __init__(self, endpoint: str, timeout: Optional[float] = 30.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)),
+                                             timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._decoder = FrameDecoder()
+        self._msg_id = 0
+
+    # ------------------------------------------------------------- low level
+    def send_raw(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def recv_reply(self) -> dict:
+        """Block until one full reply frame arrives."""
+        while True:
+            frame = self._decoder.next_frame()
+            if frame is not None:
+                return unpack_frame(frame)
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed the connection")
+            self._decoder.feed(chunk)
+
+    # ------------------------------------------------------------ serve ops
+    def table_version(self, table_id: int) -> int:
+        """Header-only version probe (RequestVersion): returns the
+        contacted shard's current table version; a shed raises
+        :class:`ServeBusy`."""
+        mid = self._next_id()
+        self.send_raw(pack_frame(MSG["RequestVersion"], table_id, mid))
+        reply = self.recv_reply()
+        _check(reply, mid, "ReplyVersion")
+        return reply["version"]
+
+    def get_shard(self, table_id: int) -> np.ndarray:
+        """Fetch the contacted rank's shard of an array table as
+        float32 (RequestGet; the payload is the shard, not the whole
+        table — shards partition contiguously across server ranks)."""
+        mid = self._next_id()
+        self.send_raw(pack_frame(MSG["RequestGet"], table_id, mid))
+        reply = self.recv_reply()
+        _check(reply, mid, "ReplyGet")
+        return np.frombuffer(reply["blobs"][0], dtype=np.float32).copy()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _next_id(self) -> int:
+        self._msg_id += 1
+        return self._msg_id
+
+
+class ServeBusy(RuntimeError):
+    """The server (or the reactor's per-client admission gate) shed the
+    request with ReplyBusy — retryable after backoff."""
+
+
+def _check(reply: dict, msg_id: int, want: str) -> None:
+    if reply["type"] == MSG["ReplyBusy"]:
+        raise ServeBusy(f"request {msg_id} shed (ReplyBusy)")
+    if reply["type_name"] != want or reply["msg_id"] != msg_id:
+        raise ConnectionError(
+            f"unexpected reply {reply['type_name']} (msg_id "
+            f"{reply['msg_id']}, wanted {want}/{msg_id})")
+
+
+class FrameDecoder:
+    """Incremental frame reassembly for nonblocking herds: ``feed()``
+    received bytes, ``next_frame()`` yields complete frame bodies."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> None:
+        self._buf += data
+
+    def next_frame(self) -> Optional[bytes]:
+        if len(self._buf) < _LEN.size:
+            return None
+        (flen,) = _LEN.unpack_from(self._buf, 0)
+        end = _LEN.size + flen
+        if flen <= 0 or len(self._buf) < end:
+            return None
+        frame = bytes(self._buf[_LEN.size:end])
+        del self._buf[:end]
+        return frame

@@ -1,0 +1,55 @@
+"""Serve-fleet holder for the epoll transport tests (not a pytest module).
+
+Run as ``python epoll_serve_worker.py <machine_file> <rank> [extra flags
+...]``: joins a native fleet on the epoll engine, registers one
+64-element ArrayTable (id 0), rank 0 blocking-adds ones so every shard
+holds 1.0, rendezvouses, prints ``SERVE_READY`` — and then HOLDS the
+fleet up for anonymous wire clients until a line arrives on stdin.  On
+release it prints the fan-in counters (``FANIN accepted=N active=N
+shed=N``), rendezvouses again, and exits with ``SERVE_WORKER_OK <rank>``.
+
+The pytest side (tests/test_epoll_net.py) talks to rank 0's listen port
+with raw sockets while the fleet is held.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from multiverso_tpu import native as nat  # noqa: E402
+
+SIZE = 64
+
+
+def main() -> int:
+    mf, rank = sys.argv[1], int(sys.argv[2])
+    extra = sys.argv[3:]
+    rt = nat.NativeRuntime(args=[f"-machine_file={mf}", f"-rank={rank}",
+                                 "-log_level=error",
+                                 "-rpc_timeout_ms=30000",
+                                 "-barrier_timeout_ms=60000", *extra])
+    assert rt.net_engine() == "epoll", rt.net_engine()
+    h = rt.new_array_table(SIZE)
+    assert h == 0, h
+    rt.barrier()
+    if rank == 0:
+        rt.array_add(h, np.ones(SIZE, np.float32))
+    rt.barrier()
+    print("SERVE_READY", flush=True)
+    sys.stdin.readline()          # held until the test releases us
+    st = rt.fanin_stats()
+    print(f"FANIN accepted={st['accepted_total']} "
+          f"active={st['active_clients']} shed={st['client_shed']}",
+          flush=True)
+    rt.barrier()
+    rt.shutdown()
+    print(f"SERVE_WORKER_OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

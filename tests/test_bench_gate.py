@@ -1,0 +1,104 @@
+"""Tier-1 gate for the bench regression gate itself (ROADMAP item 5;
+``make bench-gate`` / tools/bench_compare.py).
+
+Three jobs: the committed BENCH_BASELINE.json must parse and run green
+against the newest committed bench line; a seeded regression must fail
+loudly (the gate demonstrably fires); and the line-extraction must
+survive the messy real formats (driver wrappers, partial lines, the
+r05-style unparseable file)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_compare  # noqa: E402
+
+
+def _gate(*args):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_compare.py"),
+         *args],
+        capture_output=True, text=True, timeout=60)
+    return out.returncode, out.stdout + out.stderr
+
+
+def test_baseline_parses_and_names_real_keys():
+    with open(os.path.join(REPO, "BENCH_BASELINE.json")) as fh:
+        baseline = json.load(fh)
+    assert baseline["keys"], baseline
+    for key, spec in baseline["keys"].items():
+        assert spec.get("direction") in ("higher", "lower"), key
+        assert "value" in spec, key
+        assert "band_rel" in spec or "band_abs" in spec, key
+
+
+def test_gate_green_against_committed_bench_line():
+    """`make bench-gate` with no arguments: the newest parseable
+    BENCH_r*.json must sit inside every band it measures (missing keys
+    skip — sections are individually best-effort)."""
+    rc, out = _gate()
+    assert rc == 0, out
+    assert "0 regression(s)" in out, out
+
+
+def test_gate_fails_on_seeded_regression(tmp_path):
+    """A line regressing a gated key out of band must exit nonzero and
+    name the key — the 'fails on a seeded regression' acceptance bar."""
+    line = {"metric": "x", "value": 1, "unit": "u",
+            "extras": {"transformer_large_mfu_pct": 40.0,   # -17 points
+                       "wire_tcp_rtt_ms": 95.0}}            # Nagle is back
+    p = tmp_path / "seeded.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "transformer_large_mfu_pct" in out and "FAIL" in out, out
+    assert "wire_tcp_rtt_ms" in out, out
+
+
+def test_gate_passes_in_band_line(tmp_path):
+    line = {"extras": {"transformer_large_mfu_pct": 57.0,
+                       "wire_tcp_rtt_ms": 0.4,
+                       "fanin_shed_rate": 0.8,
+                       "fanin_accepted": 1000.0}}
+    p = tmp_path / "ok.json"
+    p.write_text("some log noise\n" + json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
+def test_last_parseable_line_wins(tmp_path):
+    """Schema-7 cumulative emission: the LAST line is the freshest
+    cumulative state and must shadow earlier partials."""
+    stale = {"extras": {"transformer_large_mfu_pct": 10.0}}
+    fresh = {"extras": {"transformer_large_mfu_pct": 57.0}}
+    p = tmp_path / "cumulative.json"
+    p.write_text(json.dumps(stale) + "\n" + json.dumps(fresh) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
+def test_driver_wrapper_and_null_parse_forms(tmp_path):
+    """BENCH_r*.json driver wrappers resolve through `parsed` (or the
+    raw `tail`); a parsed=null rc=124 file yields nothing."""
+    wrapped = {"n": 9, "rc": 0,
+               "parsed": {"extras": {"fanin_accepted": 1000.0}}}
+    p = tmp_path / "wrap.json"
+    p.write_text(json.dumps(wrapped))
+    assert bench_compare.load_line(str(p)) == {"fanin_accepted": 1000.0}
+    dead = tmp_path / "dead.json"
+    dead.write_text(json.dumps({"n": 5, "rc": 124, "parsed": None,
+                                "tail": "WARNING: nothing\n"}))
+    assert bench_compare.load_line(str(dead)) is None
+
+
+def test_strict_mode_fails_on_missing_keys(tmp_path):
+    p = tmp_path / "thin.json"
+    p.write_text(json.dumps({"extras": {"fanin_accepted": 1000.0}}))
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out                      # default: skip
+    rc, out = _gate("--line", str(p), "--strict")
+    assert rc == 1, out                      # strict: miss = fail

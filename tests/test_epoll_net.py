@@ -1,0 +1,428 @@
+"""Epoll transport tests (docs/transport.md).
+
+The reactor engine (`-net_engine=epoll`, the default for TCP fleets)
+drives nonblocking sockets through per-connection read/write state
+machines.  These tests cover what the blocking engine's suite cannot:
+
+- the anonymous serve protocol (non-rank clients over raw sockets);
+- partial-frame reassembly (1-byte dribble delivery);
+- mid-frame peer disconnect (the partial dies, the server stays up);
+- hostile frame lengths (connection dropped, no huge allocation);
+- write-queue backpressure against a slow reader (EPOLLOUT drain, no
+  deadlock, no lost replies);
+- a 1k-connection fan-in smoke (`-m slow`).
+
+The rank-fleet semantics themselves (barriers, shards, chaos seams) run
+on the epoll engine everywhere else in the suite, since it is the
+default — plus the explicit both-engine scenario below.
+"""
+
+import os
+import shutil
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "multiverso_tpu", "native")
+
+sys.path.insert(0, REPO)
+
+from multiverso_tpu.serve.wire import (AnonServeClient, FrameDecoder,  # noqa: E402
+                                       MSG, ServeBusy, pack_frame,
+                                       unpack_frame)
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+# ------------------------------------------------------------ fleet fixture
+
+def _machine_file(tmp_path, n=2):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = tmp_path / "machines.txt"
+    mf.write_text("".join(e + "\n" for e in eps))
+    return str(mf), eps
+
+
+class Fleet:
+    """Two epoll-engine server ranks holding table 0 (= 64 ones) up for
+    anonymous clients; release() tears them down and returns outputs."""
+
+    def __init__(self, tmp_path, extra=()):
+        from multiverso_tpu import native as nat
+
+        nat.ensure_built()
+        self.mf, self.endpoints = _machine_file(tmp_path, 2)
+        worker = os.path.join(REPO, "tests", "epoll_serve_worker.py")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO
+        self.procs = [
+            subprocess.Popen(
+                [sys.executable, worker, self.mf, str(r), *extra],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, env=env)
+            for r in range(2)
+        ]
+        for p in self.procs:
+            line = p.stdout.readline()
+            assert "SERVE_READY" in line, line
+
+    def release(self):
+        outs = []
+        for p in self.procs:
+            try:
+                p.stdin.write("done\n")
+                p.stdin.flush()
+            except OSError:
+                pass
+        for p in self.procs:
+            outs.append(p.communicate(timeout=120)[0])
+        return outs
+
+    def kill(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    f = Fleet(tmp_path)
+    try:
+        yield f
+    finally:
+        f.kill()
+
+
+def _assert_clean_exit(outs, procs):
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"SERVE_WORKER_OK {r}" in out, out[-2000:]
+
+
+# ------------------------------------------------------- anonymous protocol
+
+def test_anonymous_client_version_and_get(fleet):
+    """A raw-socket client (no rank identity) probes the version and
+    pulls rank 0's shard; the reactor counts it in the fan-in stats."""
+    with AnonServeClient(fleet.endpoints[0]) as c:
+        assert c.table_version(0) == 1      # rank 0's blocking add
+        shard = c.get_shard(0)
+        assert shard.shape == (32,)         # 64 split over 2 server ranks
+        np.testing.assert_allclose(shard, 1.0)
+        # Several round trips over ONE connection (the pseudo-rank route
+        # back must survive reuse).
+        for _ in range(5):
+            assert c.table_version(0) == 1
+    outs = fleet.release()
+    _assert_clean_exit(outs, fleet.procs)
+    assert "FANIN accepted=1" in outs[0], outs[0]
+
+
+def test_partial_frame_dribble(fleet):
+    """A peer may deliver one byte per readiness event: the reactor must
+    reassemble the frame across reads, not assume atomic delivery."""
+    host, port = fleet.endpoints[0].rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=30)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    frame = pack_frame(MSG["RequestGet"], 0, 7)
+    for i in range(len(frame)):             # 1-byte dribble
+        s.sendall(frame[i:i + 1])
+        if i < 16:
+            time.sleep(0.002)               # force separate wakeups early
+    dec = FrameDecoder()
+    reply = None
+    while reply is None:
+        chunk = s.recv(65536)
+        assert chunk, "server closed on a dribbled frame"
+        dec.feed(chunk)
+        body = dec.next_frame()
+        if body is not None:
+            reply = unpack_frame(body)
+    assert reply["type_name"] == "ReplyGet" and reply["msg_id"] == 7
+    np.testing.assert_allclose(
+        np.frombuffer(reply["blobs"][0], np.float32), 1.0)
+    s.close()
+    _assert_clean_exit(fleet.release(), fleet.procs)
+
+
+def test_midframe_disconnect_leaves_server_healthy(fleet):
+    """A client dying mid-frame discards the partial: nothing reaches
+    the actors, and the NEXT client gets full service."""
+    host, port = fleet.endpoints[0].rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=30)
+    frame = pack_frame(MSG["RequestGet"], 0, 9)
+    s.sendall(frame[:len(frame) // 2])      # half a frame...
+    time.sleep(0.05)
+    s.close()                               # ...then the peer vanishes
+    with AnonServeClient(fleet.endpoints[0]) as c:
+        np.testing.assert_allclose(c.get_shard(0), 1.0)
+    _assert_clean_exit(fleet.release(), fleet.procs)
+
+
+def test_hostile_frame_length_drops_connection(fleet):
+    """An anonymous connection claiming a larger-than-allowed frame is
+    dropped at the length prefix — no arena allocation, no parse."""
+    host, port = fleet.endpoints[0].rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=30)
+    s.sendall(struct.pack("<q", 1 << 40))   # over the client frame cap
+    s.settimeout(10)
+    assert s.recv(16) == b""                # server hung up on us
+    s.close()
+    with AnonServeClient(fleet.endpoints[0]) as c:  # server still fine
+        assert c.table_version(0) == 1
+    _assert_clean_exit(fleet.release(), fleet.procs)
+
+
+def test_write_backpressure_slow_reader(tmp_path):
+    """A slow reader fills the bounded per-connection write queue; the
+    reactor parks the frames and drains them under EPOLLOUT when the
+    reader catches up — every reply arrives, nothing deadlocks."""
+    f = Fleet(tmp_path, extra=("-net_writeq_bytes=4096",))
+    try:
+        host, port = f.endpoints[0].rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=60)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        k = 24                               # ~24 x 200B replies > cap
+        for i in range(k):
+            s.sendall(pack_frame(MSG["RequestGet"], 0, 100 + i))
+        time.sleep(1.0)                      # let the queue actually fill
+        dec = FrameDecoder()
+        got = []
+        s.settimeout(60)
+        while len(got) < k:
+            chunk = s.recv(4096)
+            assert chunk, f"connection died after {len(got)}/{k} replies"
+            dec.feed(chunk)
+            while True:
+                body = dec.next_frame()
+                if body is None:
+                    break
+                got.append(unpack_frame(body))
+            time.sleep(0.01)                 # stay slow: EPOLLOUT drains
+        assert [g["msg_id"] for g in got] == list(range(100, 100 + k))
+        for g in got:
+            assert g["type_name"] == "ReplyGet"
+        s.close()
+        _assert_clean_exit(f.release(), f.procs)
+    finally:
+        f.kill()
+
+
+def test_per_client_admission_sheds_busy(tmp_path):
+    """`-client_inflight_max=1`: a client firing N gets back-to-back on
+    one connection gets at most 1 admitted before replies return — the
+    reactor answers the excess with ReplyBusy, never touching the actor
+    mailbox."""
+    f = Fleet(tmp_path, extra=("-client_inflight_max=1",))
+    try:
+        host, port = f.endpoints[0].rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=60)
+        k = 8
+        burst = b"".join(pack_frame(MSG["RequestGet"], 0, 200 + i)
+                         for i in range(k))
+        s.sendall(burst)
+        dec = FrameDecoder()
+        replies = []
+        s.settimeout(60)
+        while len(replies) < k:
+            chunk = s.recv(65536)
+            assert chunk
+            dec.feed(chunk)
+            while True:
+                body = dec.next_frame()
+                if body is None:
+                    break
+                replies.append(unpack_frame(body))
+        kinds = {r["type_name"] for r in replies}
+        assert "ReplyBusy" in kinds, kinds   # the gate fired
+        assert "ReplyGet" in kinds, kinds    # but service continued
+        s.close()
+        outs = f.release()
+        _assert_clean_exit(outs, f.procs)
+        assert "shed=0" not in outs[0].split("FANIN", 1)[1].split()[-1], \
+            outs[0]
+    finally:
+        f.kill()
+
+
+def test_anon_client_blocked_on_tcp_engine(tmp_path):
+    """Control: the blocking tcp engine has no reply route for non-rank
+    connections — an anonymous probe must NOT be answered (the fleet
+    itself stays healthy).  This is what makes epoll the serve tier."""
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    mf, eps = _machine_file(tmp_path, 2)
+    code = (
+        "import sys, numpy as np\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from multiverso_tpu import native as nat\n"
+        f"rt = nat.NativeRuntime(args=['-machine_file={mf}', "
+        "'-rank=' + sys.argv[1], '-log_level=error', "
+        "'-net_engine=tcp', '-barrier_timeout_ms=60000'])\n"
+        "assert rt.net_engine() == 'tcp'\n"
+        "h = rt.new_array_table(64)\n"
+        "rt.barrier()\n"
+        "print('READY', flush=True)\n"
+        "sys.stdin.readline()\n"
+        "rt.barrier(); rt.shutdown(); print('TCP_OK', flush=True)\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(r)],
+                              stdin=subprocess.PIPE,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for r in range(2)]
+    try:
+        for p in procs:
+            assert "READY" in p.stdout.readline()
+        host, port = eps[0].rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=10)
+        s.sendall(pack_frame(MSG["RequestVersion"], 0, 1))
+        s.settimeout(2)
+        with pytest.raises((socket.timeout, ConnectionError)):
+            data = s.recv(16)
+            if not data:
+                raise ConnectionError("closed")
+        s.close()
+        for p in procs:
+            p.stdin.write("done\n")
+            p.stdin.flush()
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0 and "TCP_OK" in out, out[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+# --------------------------------------------------------- both-engine fleet
+
+def _binary():
+    b = os.path.join(NATIVE_DIR, "build", "mvtpu_test")
+    subprocess.run(["make", "-C", NATIVE_DIR, "-j4", "build/mvtpu_test"],
+                   check=True, capture_output=True, timeout=600)
+    return b
+
+
+@pytest.mark.parametrize("engine", ["tcp", "epoll"])
+def test_net_child_scenario_on_both_engines(tmp_path, engine):
+    """The full sharded-table scenario (adds, barriers, SSP cache, KV)
+    must hold on BOTH readiness models — `-net_engine` switches the
+    transport without changing semantics."""
+    mf, _ = _machine_file(tmp_path, 2)
+    b = _binary()
+    procs = [subprocess.Popen([b, "net_child", mf, str(r), engine],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} ({engine}):\n{out[-3000:]}"
+        assert f"NET_CHILD_OK {r}" in out
+
+
+def test_chaos_retry_on_epoll_engine(tmp_path):
+    """PR 2 fault seam on the reactor path: two injected send failures
+    consume retry attempts, the payload still lands (the epoll twin of
+    the chaos suite's tcp scenario)."""
+    mf, _ = _machine_file(tmp_path, 2)
+    b = _binary()
+    procs = [subprocess.Popen([b, "chaos_retry", mf, str(r), "epoll"],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"CHAOS_RETRY_OK {r}" in out
+
+
+# ------------------------------------------------------------- 1k fan-in
+
+@pytest.mark.slow
+def test_1k_connection_smoke(tmp_path):
+    """1000 concurrent anonymous sockets against one server rank: every
+    connection gets its version probe answered and the fan-in counter
+    records them all (`-net_arena_bytes=8192` bounds the per-connection
+    arena so the smoke stays small)."""
+    import resource
+    import selectors
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if hard < 2200:
+        pytest.skip(f"fd hard limit {hard} too low for 1k sockets")
+    resource.setrlimit(resource.RLIMIT_NOFILE,
+                       (min(hard, 16384), hard))
+
+    f = Fleet(tmp_path, extra=("-net_arena_bytes=8192",))
+    try:
+        host, port = f.endpoints[0].rsplit(":", 1)
+        n = 1000
+        sel = selectors.DefaultSelector()
+        socks = []
+        for i in range(n):
+            s = socket.socket()
+            s.connect((host, int(port)))
+            s.setblocking(False)
+            sel.register(s, selectors.EVENT_READ,
+                         {"dec": FrameDecoder(), "id": i})
+            socks.append(s)
+            s.send(pack_frame(MSG["RequestVersion"], 0, i))
+        answered = set()
+        deadline = time.time() + 120
+        while len(answered) < n and time.time() < deadline:
+            for key, _ in sel.select(timeout=1.0):
+                data = key.data
+                try:
+                    chunk = key.fileobj.recv(65536)
+                except BlockingIOError:
+                    continue
+                assert chunk, f"conn {data['id']} closed unanswered"
+                data["dec"].feed(chunk)
+                body = data["dec"].next_frame()
+                if body is not None:
+                    reply = unpack_frame(body)
+                    assert reply["type_name"] in ("ReplyVersion",
+                                                  "ReplyBusy")
+                    answered.add(data["id"])
+        assert len(answered) == n, f"only {len(answered)}/{n} answered"
+        for s in socks:
+            sel.unregister(s)
+            s.close()
+        outs = f.release()
+        _assert_clean_exit(outs, f.procs)
+        assert f"FANIN accepted={n}" in outs[0], outs[0][-500:]
+    finally:
+        f.kill()

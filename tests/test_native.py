@@ -289,21 +289,31 @@ def test_native_ssp_bounded_staleness(native, tmp_path, staleness):
         assert f"SSP_OK {r}" in out, out[-2000:]
 
 
-def test_native_wire_bench_scenario(native, tmp_path):
-    """The direct transport microbench (bench.py wire_tcp_* keys) must
-    produce a full 4-size sweep of positive rates from a real 2-process
-    loopback run."""
+@pytest.mark.parametrize("engine", ["tcp", "epoll"])
+def test_native_wire_bench_scenario(native, tmp_path, engine):
+    """The direct transport microbench (bench.py wire_{tcp,epoll}_*
+    keys) must produce a full 4-size sweep of positive rates from a
+    real 2-process loopback run — ON BOTH ENGINES — and the loopback
+    RTT must stay in the low single-digit milliseconds.  The RTT bound
+    is the TCP_NODELAY regression guard: with Nagle + delayed ACK on
+    the frame path the same probe reads ~40–200 ms (the r04
+    `wire_rtt_ms ≈ 98` pathology), so a silent loss of the socket
+    option cannot pass this sweep.  20 ms leaves room for a loaded CI
+    host; the pathology is an order of magnitude above it."""
     mf = _machine_file(tmp_path, 2)
     b = _binary()
-    outs, procs = _run_ranks(b, "wire_bench", mf, 2, extra=("tcp",))
+    outs, procs = _run_ranks(b, "wire_bench", mf, 2, extra=(engine,))
     for r, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert p.returncode == 0, f"rank {r} ({engine}):\n{out[-3000:]}"
         assert f"WIRE_BENCH_OK {r}" in out, out[-2000:]
     lines = [l for l in outs[0].splitlines() if l.startswith("WIRE ")]
     assert len(lines) == 4, outs[0][-2000:]
     for line in lines:
         _, size, put, get, rtt = line.split()
         assert float(put) > 0 and float(get) > 0 and float(rtt) > 0, line
+        assert float(rtt) < 20.0, \
+            f"loopback RTT {rtt} ms on {engine} — Nagle/delayed-ACK " \
+            f"shaped; TCP_NODELAY lost? ({line})"
 
 
 def test_native_wire_bench_mpi_singleton(native):

@@ -269,6 +269,50 @@ def test_mv008_parameter_without_coercion_fires(tmp_path):
     assert [r for r, _ in rules] == ["MV008"], rules
 
 
+def test_mv009_fires_on_blocking_socket_in_reactor(tmp_path):
+    """A native file marked reactor-context may not issue blocking
+    socket calls: recv/send without MSG_DONTWAIT fires; guarded calls,
+    continuation-line flags, and unmarked files stay quiet."""
+    src = """\
+        // mvlint: reactor-context — event-loop source
+        void Loop(int fd) {
+          char buf[64];
+          ::recv(fd, buf, sizeof(buf), 0);               // BAD
+          ::send(fd, buf, sizeof(buf), MSG_NOSIGNAL);    // BAD
+          ::recv(fd, buf, sizeof(buf), MSG_DONTWAIT);    // guarded: fine
+          ::recv(fd, buf,
+                 sizeof(buf), MSG_DONTWAIT);   // flags on next line: fine
+          int c = ::accept4(fd, nullptr, nullptr, SOCK_NONBLOCK);  // fine
+          SendAttempt(fd);    // method name containing 'send': fine
+        }
+        """
+    rules = _lint_src(tmp_path, src, name="reactor.cc")
+    assert [r for r, _ in rules] == ["MV009", "MV009"], rules
+    # The identical calls WITHOUT the marker are out of scope — plain
+    # blocking transports (net.cc) legitimately block their own threads.
+    unmarked = src.replace("// mvlint: reactor-context", "// plain")
+    assert _lint_src(tmp_path, unmarked, name="plain.cc") == []
+
+
+def test_mv009_suppression_names_the_reason(tmp_path):
+    rules = _lint_src(tmp_path, """\
+        // mvlint: reactor-context
+        void Connect(int fd, const sockaddr* a, socklen_t l) {
+          ::connect(fd, a, l);  // mvlint: disable=MV009 (pre-reactor)
+        }
+        """, name="reactor2.cc")
+    assert rules == [], rules
+
+
+def test_mv009_repo_reactor_sources_are_marked():
+    """The epoll engine source itself carries the marker (so the rule
+    actually polices the real reactor, not just snippets)."""
+    p = os.path.join(NATIVE_DIR, "src", "epoll_net.cc")
+    with open(p) as fh:
+        assert mvlint.REACTOR_MARKER in fh.read()
+    assert mvlint.lint_file(p) == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

@@ -66,6 +66,16 @@ Rules (docs/static_analysis.md has the full rationale):
   the native side a pointer whose memory layout does not match the
   declared flat buffer — reads scramble, writes corrupt.
 
+- **MV009 blocking-socket-in-reactor** — native files marked
+  ``mvlint: reactor-context`` (the epoll event-loop sources,
+  docs/transport.md) may not issue blocking socket calls: every
+  ``recv``/``send``/``sendmsg``/``sendto`` must carry ``MSG_DONTWAIT``
+  (within the statement) and ``accept``/``accept4``/``connect`` must be
+  nonblocking (``SOCK_NONBLOCK``) or suppressed with an explanation — a
+  single blocking call inside a reactor parks EVERY connection on that
+  shard.  This is the one rule that lints C++ (line-level, not AST);
+  the marker comment opts a file in.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -73,6 +83,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 
 SKIP_DIRS = {".git", "build", "__pycache__", ".claude", "node_modules"}
@@ -460,7 +471,77 @@ def check_noncontiguous_ctypes(tree, path):
     return out
 
 
+# ---------------------------------------------------------------- MV009
+# Native reactor-context lint: the only non-Python rule.  A file opts in
+# with this marker (the epoll engine sources carry it); the rule then
+# requires every socket op in it to be nonblocking.
+REACTOR_MARKER = "mvlint: reactor-context"
+
+# Socket calls a reactor may only issue nonblocking.  recv/send family
+# must carry MSG_DONTWAIT in the statement; accept/accept4/connect must
+# show SOCK_NONBLOCK (or a same-line suppression with its why).
+_SOCKET_CALL = re.compile(
+    r"(?<![\w.>])(?:::)?(recv|send|sendmsg|sendto|recvfrom|recvmsg|"
+    r"accept4|accept|connect)\s*\(")
+_NONBLOCK_EVIDENCE = ("MSG_DONTWAIT", "SOCK_NONBLOCK")
+# A blocking call's flags may sit on a continuation line: a statement is
+# judged over this many lines starting at the call.
+_STMT_LOOKAHEAD = 4
+
+
+def lint_reactor_file(path, src):
+    """MV009 over a marked native source: blocking socket calls."""
+    out = []
+    lines = src.splitlines()
+    for i, line in enumerate(lines):
+        code = line.split("//", 1)[0]
+        m = _SOCKET_CALL.search(code)
+        if not m:
+            continue
+        # The statement = from the call to its terminating ';' (flags
+        # often sit on a continuation line), never past the lookahead —
+        # and never into the NEXT statement, whose guard must not vouch
+        # for this one.
+        stmt = code[m.start():]
+        for j in range(i + 1, min(i + _STMT_LOOKAHEAD, len(lines))):
+            if ";" in stmt:
+                break
+            stmt += "\n" + lines[j].split("//", 1)[0]
+        stmt = stmt.split(";", 1)[0]
+        if any(ev in stmt for ev in _NONBLOCK_EVIDENCE):
+            continue
+        out.append(Finding(
+            path, i + 1, "MV009",
+            f"{m.group(1)}() without a nonblocking guard in a "
+            f"reactor-context file — one blocking socket call parks "
+            f"every connection on this shard; pass MSG_DONTWAIT / use "
+            f"SOCK_NONBLOCK (or suppress with the reason if the call "
+            f"provably runs off-reactor)"))
+    return out
+
+
+def lint_native_file(path):
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+    except (OSError, UnicodeDecodeError) as exc:
+        return [Finding(path, 0, "MV000",
+                        f"unreadable: {exc.__class__.__name__}")]
+    if REACTOR_MARKER not in src:
+        return []
+    findings = lint_reactor_file(path, src)
+    lines = src.splitlines()
+    return [f for f in findings
+            if f"mvlint: disable={f.rule}" not in
+            (lines[f.line - 1] if 0 < f.line <= len(lines) else "")]
+
+
+NATIVE_EXTS = (".cc", ".cpp", ".cxx", ".h", ".hpp")
+
+
 def lint_file(path):
+    if path.endswith(NATIVE_EXTS):
+        return lint_native_file(path)
     try:
         with open(path, "r", encoding="utf-8") as fh:
             src = fh.read()
@@ -500,15 +581,18 @@ def lint_file(path):
 
 
 def iter_py_files(paths):
+    # Python sources plus the native C++ sources MV009 opts in (only
+    # marked files are actually linted — see lint_native_file).
+    exts = (".py",) + NATIVE_EXTS
     for p in paths:
         if os.path.isfile(p):
-            if p.endswith(".py"):
+            if p.endswith(exts):
                 yield p
             continue
         for root, dirs, files in os.walk(p):
             dirs[:] = [d for d in sorted(dirs) if d not in SKIP_DIRS]
             for name in sorted(files):
-                if name.endswith(".py"):
+                if name.endswith(exts):
                     yield os.path.join(root, name)
 
 
