@@ -14,8 +14,11 @@
 //    for the next readiness instead of tearing the connection down
 //    (TcpNet's retry-by-reconnect).  A full queue backpressures the
 //    sender (bounded by `-io_timeout_ms`).
-//  - ACCEPT: besides rank peers, the reactor accepts ANONYMOUS serve
-//    clients (connections whose messages carry no valid rank).  Each is
+//  - ACCEPT: rank peers identify themselves with a tiny Hello first
+//    frame (sent by ConnectToRank pre-reactor; only a valid Hello
+//    grants rank identity and the large rank frame bound).  Besides
+//    them, the reactor accepts ANONYMOUS serve clients (connections
+//    opening with anything other than a rank Hello).  Each is
 //    assigned a pseudo-rank >= transport::kClientRankBase; replies
 //    route back over the accepted socket, and a per-client admission
 //    gate (`-client_inflight_max`) sheds Gets/probes with ReplyBusy on

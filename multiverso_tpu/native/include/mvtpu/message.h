@@ -53,6 +53,14 @@ enum class MsgType : int32_t {
   // loop reports peers whose announcements stop (Dashboard hb.missed)
   // instead of letting the next barrier discover the corpse by hanging.
   Heartbeat = 21,
+  // Connection-identify frame (docs/transport.md): the FIRST frame a
+  // rank peer sends on a fresh outbound connection, carrying its rank
+  // in `src` and nothing else.  The epoll reactor caps UNIDENTIFIED
+  // accepted connections at the small anonymous-client frame bound, so
+  // a rank peer must announce itself with this tiny frame before its
+  // first (possibly shard-sized) payload frame; the reactor consumes it
+  // during identification — it is never forwarded upstream.
+  Hello = 22,
   Exit = 64,
 };
 

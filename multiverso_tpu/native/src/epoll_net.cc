@@ -359,11 +359,6 @@ void EpollNet::HandleAccept(Shard* s) {
 }
 
 void EpollNet::HandleReadable(Shard* s, const std::shared_ptr<Conn>& c) {
-  const int64_t max_frame =
-      (c->accepted && c->peer.load() < 0) ||
-              transport::IsClientRank(c->peer.load())
-          ? kMaxClientFrameBytes
-          : kMaxRankFrameBytes;
   const size_t slab_bytes = static_cast<size_t>(
       FlagOr("net_arena_bytes", static_cast<int64_t>(kDefaultSlabBytes)));
   while (true) {
@@ -380,6 +375,15 @@ void EpollNet::HandleReadable(Shard* s, const std::shared_ptr<Conn>& c) {
       if (c->len_got < sizeof(c->len_buf)) continue;
       int64_t len;
       std::memcpy(&len, c->len_buf, sizeof(len));
+      // PER FRAME, not per readable batch: a rank peer identifies
+      // itself with its tiny Hello first frame (FinishFrame sets
+      // c->peer mid-loop), and the very next frame — possibly a
+      // shard-sized payload — must already enjoy the rank bound.
+      const int64_t max_frame =
+          (c->accepted && c->peer.load() < 0) ||
+                  transport::IsClientRank(c->peer.load())
+              ? kMaxClientFrameBytes
+              : kMaxRankFrameBytes;
       if (len <= 0 || len > max_frame) {
         CloseConn(s, c, "bad frame length");
         return;
@@ -448,10 +452,16 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
 
   int peer = c->peer.load();
   if (c->accepted && peer < 0) {
-    // First frame identifies the connection: a valid rank in src means
-    // a fleet peer; anything else is an anonymous serve client, which
-    // gets a pseudo-rank so replies can route back over this socket.
-    if (m.src >= 0 && m.src < static_cast<int>(endpoints_.size())) {
+    // First frame identifies the connection: a fleet peer announces
+    // itself with a Hello carrying its rank in src (sent by
+    // ConnectToRank before any payload, so the identifying frame is
+    // always tiny and always first); ANY other opening frame — valid
+    // src or not — is an anonymous serve client, which gets a
+    // pseudo-rank so replies can route back over this socket.  A
+    // client forging a rank in src therefore neither impersonates a
+    // fleet member nor unlocks the rank frame bound.
+    if (m.type == MsgType::Hello && m.src >= 0 &&
+        m.src < static_cast<int>(endpoints_.size())) {
       peer = m.src;
       c->peer = peer;
     } else {
@@ -463,6 +473,10 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
       client_conns_[peer] = c;
     }
   }
+  // The identify frame is transport-internal: consumed here, never
+  // forwarded upstream (stray Hellos on an identified connection are
+  // dropped the same way).
+  if (m.type == MsgType::Hello) return true;
   if (transport::IsClientRank(peer)) {
     // Anonymous client: the pseudo-rank IS the reply address.
     m.src = peer;
@@ -604,6 +618,33 @@ std::shared_ptr<EpollNet::Conn> EpollNet::ConnectToRank(int dst_rank) {
   ::freeaddrinfo(res);
   if (fd < 0) return nullptr;
   SetNoDelay(fd);
+  // Identify before payload: the accept side caps UNIDENTIFIED
+  // connections at the small anonymous-client frame bound, so the first
+  // frame on a fresh rank connection must be this tiny Hello — after
+  // the reactor consumes it, subsequent frames get the rank bound.
+  // Still the sender's thread, still the blocking socket (it goes
+  // nonblocking into the reactor only below).
+  Message hello;
+  hello.type = MsgType::Hello;
+  hello.src = rank_;
+  hello.dst = dst_rank;
+  Blob hello_body = hello.Serialize();
+  int64_t hello_len = static_cast<int64_t>(hello_body.size());
+  std::vector<char> hello_wire(sizeof(hello_len) + hello_body.size());
+  std::memcpy(hello_wire.data(), &hello_len, sizeof(hello_len));
+  std::memcpy(hello_wire.data() + sizeof(hello_len), hello_body.data(),
+              hello_body.size());
+  size_t hello_sent = 0;
+  while (hello_sent < hello_wire.size()) {
+    ssize_t w = ::send(  // mvlint: disable=MV009 (pre-reactor handshake)
+        fd, hello_wire.data() + hello_sent, hello_wire.size() - hello_sent,
+        MSG_NOSIGNAL);
+    if (w <= 0) {
+      ::close(fd);
+      return nullptr;
+    }
+    hello_sent += static_cast<size_t>(w);
+  }
   if (!SetNonBlocking(fd)) {
     ::close(fd);
     return nullptr;
@@ -653,6 +694,20 @@ std::shared_ptr<EpollNet::Conn> EpollNet::ResolveConn(int dst_rank) {
 
 bool EpollNet::Enqueue(const std::shared_ptr<Conn>& c, const Message& msg,
                        bool may_block) {
+  // A reply headed back to an anonymous client settles one admission
+  // slot — BEFORE any failure exit below, so a reply dying on a full
+  // write queue or a just-closed connection still releases it (a leak
+  // here would permanently shed the client once leaks eat the whole
+  // cap).  Reactor-synthesized busy replies (may_block=false) answer
+  // requests that were never counted, so they settle nothing.
+  if (may_block && transport::IsClientRank(c->peer.load()) &&
+      (msg.type == MsgType::ReplyGet || msg.type == MsgType::ReplyAdd ||
+       msg.type == MsgType::ReplyVersion ||
+       msg.type == MsgType::ReplyBusy || msg.type == MsgType::ReplyFlush ||
+       msg.type == MsgType::ReplyError)) {
+    long long now = c->inflight.fetch_add(-1);
+    if (now <= 0) c->inflight.fetch_add(1);  // floor at zero
+  }
   const int64_t cap = FlagOr("net_writeq_bytes", 64 << 20);
   const int64_t timeout_ms = FlagOr("io_timeout_ms", 30000);
   {
@@ -686,15 +741,6 @@ bool EpollNet::Enqueue(const std::shared_ptr<Conn>& c, const Message& msg,
     }
     c->wq.emplace_back(msg);
     c->wq_bytes += c->wq.back().total;
-  }
-  // Reply going back to an anonymous client settles one admission slot.
-  if (transport::IsClientRank(c->peer.load()) &&
-      (msg.type == MsgType::ReplyGet || msg.type == MsgType::ReplyAdd ||
-       msg.type == MsgType::ReplyVersion ||
-       msg.type == MsgType::ReplyBusy || msg.type == MsgType::ReplyFlush ||
-       msg.type == MsgType::ReplyError)) {
-    long long now = c->inflight.fetch_add(-1);
-    if (now <= 0) c->inflight.fetch_add(1);  // floor at zero
   }
   Shard* target = shards_[static_cast<size_t>(c->shard)].get();
   {
@@ -782,8 +828,13 @@ Net::FanInStats EpollNet::FanIn() const {
 
 void EpollNet::Stop() {
   {
+    // `stopping_` is the Stop-vs-Stop latch (running_ stays true
+    // through the multi-second grace drain below, so testing it alone
+    // would let a second caller race the first into thread.join() —
+    // UB on the same std::thread — and double-close the epoll fds).
+    // `running_` remains the reactor-exit flag.
     MutexLock lk(stop_mu_);
-    if (!running_) return;
+    if (!running_ || stopping_) return;
     stopping_ = true;
   }
   // Graceful drain: give the reactor a bounded window to flush queued

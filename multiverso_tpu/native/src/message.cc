@@ -62,7 +62,16 @@ bool Message::DeserializeView(std::shared_ptr<std::vector<char>> slab,
   std::memcpy(&h, base, sizeof(h));
   out->AdoptWireHeader(h);
   out->data.clear();
-  if (h.num_blobs < 0) return false;
+  // num_blobs comes off the wire: bound it against the frame BEFORE the
+  // reserve — each blob costs at least its 8-byte length prefix, so a
+  // frame of `len` bytes cannot hold more than (len - header)/8 blobs.
+  // An unchecked reserve would let a 56-byte hostile frame claim
+  // INT32_MAX blobs and force a multi-GB allocation the frame caps
+  // exist to prevent.
+  if (h.num_blobs < 0 ||
+      static_cast<size_t>(h.num_blobs) >
+          (len - sizeof(WireHeader)) / sizeof(int64_t))
+    return false;
   size_t pos = sizeof(h);
   out->data.reserve(static_cast<size_t>(h.num_blobs));
   for (int32_t i = 0; i < h.num_blobs; ++i) {

@@ -3,9 +3,10 @@
 Speaks the native frame protocol directly over a TCP socket — no rank,
 no machine file, no native library.  The epoll engine (`-net_engine=
 epoll`, the default) accepts such connections on any server rank's
-listen port: the first frame carries an invalid ``src`` (< 0), the
-reactor assigns the connection a pseudo-rank, and replies route back
-over the same socket.  The blocking ``tcp`` engine does NOT serve
+listen port: fleet peers open with a ``Hello`` identify frame, so any
+connection whose first frame is an ordinary request (``src = -1``, as
+packed here) is treated as anonymous — the reactor assigns it a
+pseudo-rank, and replies route back over the same socket.  The blocking ``tcp`` engine does NOT serve
 anonymous clients (its readers deliver inbound frames, but replies to a
 non-rank ``src`` have no route back).
 
@@ -171,9 +172,20 @@ def _check(reply: dict, msg_id: int, want: str) -> None:
             f"{reply['msg_id']}, wanted {want}/{msg_id})")
 
 
+# A length prefix outside (0, _MAX_FRAME_BYTES] is stream desync or
+# corruption, never a legitimate reply — the bound mirrors the server's
+# own rank frame cap (mvtpu's bad-frame-length close), far above any
+# reply a serve client can receive.
+_MAX_FRAME_BYTES = 1 << 40
+
+
 class FrameDecoder:
     """Incremental frame reassembly for nonblocking herds: ``feed()``
-    received bytes, ``next_frame()`` yields complete frame bodies."""
+    received bytes, ``next_frame()`` yields complete frame bodies.
+
+    A corrupt length prefix raises :class:`ConnectionError` — treating
+    it as "need more bytes" would buffer a desynced stream forever and
+    hang the caller silently."""
 
     def __init__(self):
         self._buf = bytearray()
@@ -185,8 +197,11 @@ class FrameDecoder:
         if len(self._buf) < _LEN.size:
             return None
         (flen,) = _LEN.unpack_from(self._buf, 0)
+        if flen <= 0 or flen > _MAX_FRAME_BYTES:
+            raise ConnectionError(
+                f"bad frame length {flen}: stream desynced or corrupt")
         end = _LEN.size + flen
-        if flen <= 0 or len(self._buf) < end:
+        if len(self._buf) < end:
             return None
         frame = bytes(self._buf[_LEN.size:end])
         del self._buf[:end]

@@ -188,6 +188,63 @@ def test_hostile_frame_length_drops_connection(fleet):
     _assert_clean_exit(fleet.release(), fleet.procs)
 
 
+def test_hostile_num_blobs_drops_connection(fleet):
+    """A header-only frame claiming INT32_MAX blobs must fail the
+    deserialize bound check (each blob costs >= 8 bytes of frame), not
+    force a multi-GB vector reserve that would kill the reactor."""
+    from multiverso_tpu.serve.wire import HEADER, _LEN
+    host, port = fleet.endpoints[0].rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=30)
+    body = HEADER.pack(-1, -1, MSG["RequestGet"], 0, 1, 0, -1, 0, 1,
+                       2**31 - 1, 0)        # num_blobs = INT32_MAX
+    s.sendall(_LEN.pack(len(body)) + body)
+    s.settimeout(10)
+    assert s.recv(16) == b""                # dropped as malformed
+    s.close()
+    with AnonServeClient(fleet.endpoints[0]) as c:  # server still fine
+        assert c.table_version(0) == 1
+    _assert_clean_exit(fleet.release(), fleet.procs)
+
+
+def test_rank_src_forgery_stays_anonymous(fleet):
+    """Rank identity needs the Hello handshake: an anonymous client
+    forging a valid rank in src is still served as an anonymous client
+    (the reply routes back over ITS socket — it neither impersonates a
+    fleet member nor unlocks the rank frame bound)."""
+    from multiverso_tpu.serve.wire import HEADER, _LEN
+    host, port = fleet.endpoints[0].rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=30)
+    body = HEADER.pack(1, -1, MSG["RequestVersion"], 0, 5, 0, -1, 0, 1,
+                       0, 0)                # src = 1: a REAL rank
+    s.sendall(_LEN.pack(len(body)) + body)
+    dec = FrameDecoder()
+    s.settimeout(30)
+    reply = None
+    while reply is None:
+        chunk = s.recv(65536)
+        assert chunk, "forged-src client was dropped instead of served"
+        dec.feed(chunk)
+        body = dec.next_frame()
+        if body is not None:
+            reply = unpack_frame(body)
+    assert reply["type_name"] == "ReplyVersion" and reply["msg_id"] == 5
+    s.close()
+    outs = fleet.release()
+    _assert_clean_exit(outs, fleet.procs)
+    assert "FANIN accepted=1" in outs[0], outs[0]  # counted as a client
+
+
+def test_frame_decoder_rejects_corrupt_length():
+    """A desynced/garbled length prefix must raise, not buffer forever
+    (a silent None would hang selectors herds on a dead stream)."""
+    for bad in (struct.pack("<q", 0), struct.pack("<q", -7),
+                struct.pack("<q", 1 << 50)):
+        dec = FrameDecoder()
+        dec.feed(bad + b"garbage")
+        with pytest.raises(ConnectionError):
+            dec.next_frame()
+
+
 def test_write_backpressure_slow_reader(tmp_path):
     """A slow reader fills the bounded per-connection write queue; the
     reactor parks the frames and drains them under EPOLLOUT when the
