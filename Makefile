@@ -84,6 +84,15 @@ fanin-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/fanin_demo.py
 
+# Live introspection smoke (docs/observability.md): a 2-rank fleet +
+# anonymous scraper — fleet-scope Prometheus snapshot with per-rank
+# labels, an injected barrier timeout dumping blackbox_rank0.json whose
+# spans share trace ids with the merged Chrome trace, and a scraped
+# histogram-bucket exemplar trace id resolvable in that trace.
+ops-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/ops_demo.py
+
 # Continuous perf gate (docs/PERF.md): diff the newest bench JSON line
 # against the committed BENCH_BASELINE.json with per-key noise bands;
 # exits nonzero on an out-of-band regression (serve p50, wire RTT,
@@ -95,4 +104,4 @@ clean:
 	$(MAKE) -C $(NATIVE) clean
 
 .PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
-        serve-demo wire-demo fanin-demo bench-gate clean
+        serve-demo wire-demo fanin-demo ops-demo bench-gate clean

@@ -575,6 +575,32 @@ def bench_serve_fanin():
     return res
 
 
+def bench_ops():
+    """Live introspection plane (docs/observability.md): in-band
+    ``OpsQuery(metrics)`` scrapes measured UNDER the 1k-connection
+    fan-in load — ``ops_scrape_p50_ms``/``ops_scrape_p99_ms`` are the
+    scrape latencies while 1000 anonymous clients hammer the same
+    reactor (acceptance: p99 < 5 ms), and ``ops_overhead_pct`` is the
+    serve-probe QPS the live scrape path cost relative to an unscraped
+    A/B run of the same phase (acceptance: < 1%).  Fleet + scraper live
+    in ``apps/fanin_bench_worker.py`` (mode=ops)."""
+    import re
+
+    outs = _spawn_native_workers("fanin_bench_worker.py", 2,
+                                 "FANIN_BENCH_OK", (1000, 8, 0, "ops"))
+    res = {}
+    for out in outs:
+        for m in re.finditer(r"(\w+)=([0-9.]+)", out):
+            key = m.group(1)
+            if key == "rank":
+                continue
+            name = key if key.startswith("ops_") else f"ops_{key}"
+            res[name] = float(m.group(2))
+            if key.startswith("ops_") and key.endswith("_ms"):
+                _observe_iter(float(m.group(2)) * 1e-3)
+    return res
+
+
 def bench_w2v(batch: int = 8192, vocab: int = 100_000, dim: int = 128,
               negatives: int = 5):
     import jax
@@ -1259,6 +1285,7 @@ def bench_lightlda_mh(num_docs: int = 2048, vocab: int = 10000,
 # (VERDICT r4 weak #1).
 _SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_w2v_native8,
              bench_wire_micro, bench_ssp, bench_serve, bench_serve_fanin,
+             bench_ops,
              bench_add_get,
              bench_transformer_large, bench_transformer, bench_moe,
              bench_lightlda, bench_lightlda_mh, bench_long_context]
@@ -1285,7 +1312,7 @@ def main() -> None:
     # Schema/partial line FIRST — before any JAX-touching import — so
     # even a backend-init hang killed by `timeout` leaves one parseable
     # line on stdout.
-    results = {"bench_schema": 10}
+    results = {"bench_schema": 11}
     errors = []
     _emit(results, errors)
 
@@ -1325,7 +1352,12 @@ def main() -> None:
     # native keys measure the reactor), wire_epoll_* joins wire_tcp_*
     # in the micro sweep, and bench_serve_fanin adds fanin_{p50,p99}_ms
     # / fanin_qps / fanin_shed_rate / fanin_accepted — 1000 anonymous
-    # client sockets against one server rank.
+    # client sockets against one server rank;
+    # 11 = live introspection plane (docs/observability.md): bench_ops
+    # measures in-band OpsQuery scrapes under the 1k fan-in load —
+    # ops_scrape_{p50,p99}_ms (acceptance: p99 < 5 ms) and
+    # ops_overhead_pct (serve QPS cost of a live scraper vs an
+    # unscraped A/B run; acceptance < 1%), gated by make bench-gate.
 
     # A budget SIGTERM lands mid-section: convert it to an exception so
     # the JSON accumulated so far still prints (the whole point of the

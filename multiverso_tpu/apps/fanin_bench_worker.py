@@ -2,9 +2,9 @@
 ``make fanin-demo`` drives it too).
 
 Run as ``python fanin_bench_worker.py <machine_file> <rank> [nclients]
-[inflight_max] [chaos]``: two of these form a native epoll-engine fleet;
-rank 1 then drives ``nclients`` ANONYMOUS raw sockets (the serve wire
-protocol, ``serve/wire.py``) against rank 0's reactor:
+[inflight_max] [chaos] [mode]``: two of these form a native epoll-engine
+fleet; rank 1 then drives ``nclients`` ANONYMOUS raw sockets (the serve
+wire protocol, ``serve/wire.py``) against rank 0's reactor:
 
 - **latency phase** — every client sends one header-only version probe,
   paced 8-outstanding so the p50/p99 measure the service path, not the
@@ -17,6 +17,13 @@ protocol, ``serve/wire.py``) against rank 0's reactor:
 under injected send faults WHILE the herd hammers it — the PR 2 retry
 harness must land every add exactly once (zero lost adds), asserted
 against the final table value.
+
+``mode=ops`` (bench.py ``bench_ops``, docs/observability.md) runs the
+latency phase TWICE — plain, then with a concurrent anonymous scraper
+polling in-band ``OpsQuery(metrics)`` as fast as replies return — and
+reports ``ops_scrape_p50_ms``/``ops_scrape_p99_ms`` (scrape latency
+under the fan-in load) plus ``ops_overhead_pct``: the serve-probe QPS
+the live scrape path cost, proving introspection is effectively free.
 
 Rank 1 prints the measured keys; both ranks print ``FANIN_BENCH_OK``.
 """
@@ -33,11 +40,62 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 
 from multiverso_tpu import native as nat  # noqa: E402
-from multiverso_tpu.serve.wire import (FrameDecoder, MSG,  # noqa: E402
-                                       pack_frame, unpack_frame)
+from multiverso_tpu.serve.wire import (AnonServeClient,  # noqa: E402
+                                       FrameDecoder, MSG, pack_frame,
+                                       unpack_frame)
 
 SIZE = 1024
 CHAOS_ADDS = 5
+
+
+class _Scraper:
+    """Anonymous in-band metrics scraper hammering OpsQuery while the
+    herd runs — its reply latencies are the measured scrape p50/p99.
+
+    Runs as a child PROCESS (``fanin_bench_worker.py scrape <ep>``), not
+    a thread: the herd's selector loop owns this process's GIL, and a
+    threaded scraper would measure Python scheduling jitter on the
+    CLIENT, not the server's in-band service path."""
+
+    def __init__(self, endpoint: str):
+        import subprocess
+
+        self._proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "scrape",
+             endpoint],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        self.latencies = []
+        # Wait for the child to finish importing and CONNECT before the
+        # herd starts — otherwise a fast herd outruns the scraper and
+        # the "under load" latencies never get measured.
+        ready = self._proc.stdout.readline()
+        assert "SCRAPER_READY" in ready, ready
+
+    def stop(self) -> None:
+        self._proc.stdin.write("\n")
+        self._proc.stdin.flush()
+        out = self._proc.communicate(timeout=60)[0]
+        for tok in out.split():
+            self.latencies.append(float(tok))
+
+
+def _scrape_child(endpoint: str) -> int:
+    """Child body: scrape OpsQuery(metrics) continuously (1 ms pacing)
+    until a line arrives on stdin, then print the latencies (seconds)."""
+    import select
+
+    client = AnonServeClient(endpoint, timeout=30)
+    client.ops_report("health")       # connection warm before READY
+    print("SCRAPER_READY", flush=True)
+    lat = []
+    while not select.select([sys.stdin], [], [], 0.001)[0]:
+        t0 = time.perf_counter()
+        text = client.ops_report("metrics")
+        lat.append(time.perf_counter() - t0)
+        assert text, "empty ops reply"
+    client.close()
+    print(" ".join(f"{v:.9f}" for v in lat), flush=True)
+    return 0
 
 
 def _raise_fd_limit(need: int) -> None:
@@ -49,9 +107,10 @@ def _raise_fd_limit(need: int) -> None:
                            (min(max(need, soft), hard), hard))
 
 
-def _herd(endpoint: str, nclients: int) -> dict:
+def _herd(endpoint: str, nclients: int, scrape: bool = False) -> dict:
     host, port = endpoint.rsplit(":", 1)
     _raise_fd_limit(nclients + 256)
+    scraper = _Scraper(endpoint) if scrape else None
     sel = selectors.DefaultSelector()
     socks = []
     for i in range(nclients):
@@ -105,6 +164,21 @@ def _herd(endpoint: str, nclients: int) -> dict:
     lat_ms = np.asarray(lat) * 1e3
     out["p50_ms"] = float(np.percentile(lat_ms, 50))
     out["p99_ms"] = float(np.percentile(lat_ms, 99))
+    # Pure latency-phase probe rate: the ops_overhead_pct numerator —
+    # comparing it plain vs under a live scraper isolates what the
+    # in-band introspection path costs the serve tier.
+    out["probe_qps"] = len(lat) / (time.perf_counter() - wall0)
+    if scraper is not None:
+        # The scrape window is the FAN-IN load (1k-connection storm +
+        # paced probes), not the deliberately pathological all-at-once
+        # overload burst below — stop before it so ops_scrape_p99
+        # measures scraping a busy-but-live server, the acceptance bar.
+        scraper.stop()
+        if scraper.latencies:
+            sl = np.asarray(scraper.latencies) * 1e3
+            out["ops_scrape_p50_ms"] = float(np.percentile(sl, 50))
+            out["ops_scrape_p99_ms"] = float(np.percentile(sl, 99))
+            out["ops_scrapes"] = float(len(sl))
 
     # --- overload phase: every client fires a Get at once ---------------
     counts = {"ReplyGet": 0, "ReplyBusy": 0}
@@ -129,6 +203,7 @@ def main() -> int:
     nclients = int(sys.argv[3]) if len(sys.argv) > 3 else 1000
     inflight_max = int(sys.argv[4]) if len(sys.argv) > 4 else 8
     chaos = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+    mode = sys.argv[6] if len(sys.argv) > 6 else ""
     rt = nat.NativeRuntime(args=[
         f"-machine_file={mf}", f"-rank={rank}", "-log_level=error",
         "-rpc_timeout_ms=60000", "-barrier_timeout_ms=120000",
@@ -161,7 +236,17 @@ def main() -> int:
             time.sleep(0.05)
     else:
         eps = [ln.strip() for ln in open(mf) if ln.strip()]
-        out = _herd(eps[0], nclients)
+        if mode == "ops":
+            # A/B the latency phase: plain, then under a live in-band
+            # scraper — the delta is what introspection costs serving.
+            plain = _herd(eps[0], nclients)
+            out = _herd(eps[0], nclients, scrape=True)
+            base = plain.get("probe_qps", 0.0)
+            scraped = out.get("probe_qps", base)
+            out["ops_overhead_pct"] = (
+                max(0.0, (base - scraped) / base * 100.0) if base else 0.0)
+        else:
+            out = _herd(eps[0], nclients)
         rt.kv_add(hk, "herd_done", 1.0)
     rt.barrier()
 
@@ -192,4 +277,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "scrape":
+        sys.exit(_scrape_child(sys.argv[2]))
     sys.exit(main())

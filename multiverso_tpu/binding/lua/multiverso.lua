@@ -84,6 +84,10 @@ char* MV_NetEngine(void);
 void MV_FreeString(char* s);
 int MV_FanInStats(long long* accepted_total, long long* active_clients,
                   long long* client_shed);
+char* MV_OpsReport(const char* kind);
+int MV_SetOpsHostMetrics(const char* prom_text);
+int MV_BlackboxEvent(const char* kind, const char* detail);
+int MV_BlackboxTrigger(const char* reason);
 ]]
 
 -- libmvtpu.so sits two directories up from this file (native/build/).
@@ -262,6 +266,35 @@ function mv.fanin_stats()
   local s = ffi.new("long long[1]")
   check(C.MV_FanInStats(a, c, s), "MV_FanInStats")
   return tonumber(a[0]), tonumber(c[0]), tonumber(s[0])
+end
+
+--- Live introspection (docs/observability.md): this rank's ops report —
+--- "metrics" (Prometheus text with exemplar trace ids), "health"
+--- (JSON verdict) or "tables" (JSON per-table stats); the same payload
+--- the in-band wire scrape (MsgType::OpsQuery) serves.
+function mv.ops_report(kind)
+  local p = C.MV_OpsReport(kind or "health")
+  local text = ffi.string(p)
+  C.MV_FreeString(p)
+  return text
+end
+
+--- Push a host-rendered Prometheus document so in-band scrapes serve it
+--- instead of the native-only fallback (empty string clears).
+function mv.set_ops_host_metrics(text)
+  check(C.MV_SetOpsHostMetrics(text or ""), "MV_SetOpsHostMetrics")
+end
+
+--- Flight recorder ("black box"): record one lifecycle event into the
+--- bounded in-memory ring / dump ring + spans + monitor totals to
+--- <trace_dir>/blackbox_rank<r>.json (native failure triggers — barrier
+--- timeout, dead peer, shed storm — dump automatically).
+function mv.blackbox_event(kind, detail)
+  check(C.MV_BlackboxEvent(kind, detail or ""), "MV_BlackboxEvent")
+end
+
+function mv.blackbox_trigger(reason)
+  check(C.MV_BlackboxTrigger(reason), "MV_BlackboxTrigger")
 end
 
 -- Shared async-get handle (MV_GetAsync* wait tickets): wait() joins the

@@ -52,7 +52,22 @@ class CheckpointCorrupt(ValueError):
     """The snapshot file is damaged (truncated, bit-flipped, or not a
     checkpoint at all) — restore refuses to unpickle garbage.  Catchable
     separately so callers (``CheckpointManager.restore_latest``) can
-    fall back to the previous good snapshot."""
+    fall back to the previous good snapshot.
+
+    Constructing one is a flight-recorder trigger
+    (docs/observability.md): even when ``restore_latest`` tolerates the
+    corruption by falling back, the black box records that a snapshot
+    rotted — silent corruption is exactly what a post-mortem needs."""
+
+    def __init__(self, *args: Any) -> None:
+        super().__init__(*args)
+        try:
+            from .ops.flight_recorder import recorder
+
+            recorder.trigger(f"checkpoint_corrupt: "
+                             f"{args[0] if args else ''}")
+        except Exception:  # the trigger must never mask the corruption
+            pass
 
 
 def _write_snapshot(uri: str, magic: bytes, obj: Any) -> None:

@@ -168,6 +168,13 @@ class Context:
                              daemon=True)
         t.start()
         if not done.wait(timeout_s):
+            # Flight-recorder trigger (docs/observability.md): the
+            # moment the job becomes unrecoverable is the moment the
+            # black box must hit disk — before the raise unwinds.
+            from ..ops.flight_recorder import recorder
+
+            recorder.trigger(f"barrier_timeout: host_sync '{name}' "
+                             f"after {timeout_s:.3f}s")
             raise BarrierTimeout(
                 f"host_sync '{name}' timed out after {timeout_s:.3f}s "
                 f"waiting for {jax.process_count()} process(es) — an "
@@ -252,6 +259,14 @@ def init(args: Optional[List[str]] = None,
         trace_dir = str(config.get("trace_dir"))
         if trace_dir:
             tracing.enable(rank=node.rank)
+        # Flight recorder (docs/observability.md): always-on bounded
+        # ring; the rank pin names the blackbox_rank<r>.json dump a
+        # failure trigger (BarrierTimeout, CheckpointCorrupt) writes.
+        from ..ops.flight_recorder import recorder as _recorder
+
+        _recorder.attach(rank=node.rank)
+        _recorder.record("lifecycle",
+                         f"init rank {node.rank}/{node.size}")
         flush_ms = int(config.get("metrics_flush_ms"))
         if flush_ms > 0:
             import os
@@ -281,6 +296,10 @@ def shutdown(finalize: bool = True) -> None:
     with _LOCK:
         if _CONTEXT is None:
             return
+        from ..ops.flight_recorder import recorder as _recorder
+
+        _recorder.record("lifecycle",
+                         f"shutdown rank {_CONTEXT.node.rank}")
         _CONTEXT.barrier("mvtpu_shutdown")
         # Observability teardown: final metrics flush, then the span
         # export (-trace_dir), then the classic Dashboard dump — which

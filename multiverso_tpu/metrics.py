@@ -36,7 +36,7 @@ from .log import Log
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
     "counter", "gauge", "histogram", "snapshot", "render_prometheus",
-    "reset", "bridge_native", "start_flush", "stop_flush",
+    "reset", "bridge_native", "start_flush", "stop_flush", "set_ops_push",
     "NATIVE_TIME_BUCKETS", "DEFAULT_TIME_BUCKETS",
 ]
 
@@ -138,6 +138,12 @@ class Histogram:
     default log2 time buckets the p99 of a latency series is exact to
     within one bucket ratio (2x) — the right fidelity for "where did
     the time go" at zero allocation per observation.
+
+    Each bucket also keeps an **exemplar** — the last trace id whose
+    observation landed there (docs/observability.md): a p99 latency
+    sample links straight to the merged Chrome trace that explains it.
+    Captured from the thread's active ``tracing`` span id (or an
+    explicit ``trace_id=``); zero-cost when no span is active.
     """
 
     kind = "histogram"
@@ -151,14 +157,19 @@ class Histogram:
             raise ValueError(f"histogram bounds must ascend: {bounds}")
         self._lock = threading.Lock()
         self._counts = [0] * (len(self.bounds) + 1)
+        self._exemplars = [0] * (len(self.bounds) + 1)
         self._count = 0
         self._sum = 0.0
         self._max = 0.0
         self._min = math.inf
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[int] = None) -> None:
         v = float(v)
         i = self._bucket_of(v)
+        if trace_id is None:
+            from . import tracing
+
+            trace_id = tracing.current_trace_id()
         with self._lock:
             self._counts[i] += 1
             self._count += 1
@@ -167,6 +178,8 @@ class Histogram:
                 self._max = v
             if v < self._min:
                 self._min = v
+            if trace_id:
+                self._exemplars[i] = int(trace_id)
 
     def _bucket_of(self, v: float) -> int:
         lo, hi = 0, len(self.bounds)
@@ -179,15 +192,23 @@ class Histogram:
         return lo
 
     def _load(self, count: int, total: float, vmax: float,
-              bucket_counts: Iterable[int]) -> None:
+              bucket_counts: Iterable[int],
+              exemplars: Optional[Iterable[int]] = None) -> None:
         """Replace state wholesale (the native-bridge import path)."""
         counts = [int(c) for c in bucket_counts]
         if len(counts) != len(self.bounds) + 1:
             raise ValueError(
                 f"{self.name}: {len(counts)} bucket counts for "
                 f"{len(self.bounds)} bounds (+inf)")
+        ex = [int(e) for e in exemplars] if exemplars is not None else None
+        if ex is not None and len(ex) != len(counts):
+            raise ValueError(
+                f"{self.name}: {len(ex)} exemplars for {len(counts)} "
+                f"buckets")
         with self._lock:
             self._counts = counts
+            if ex is not None:
+                self._exemplars = ex
             self._count = int(count)
             self._sum = float(total)
             self._max = float(vmax)
@@ -232,10 +253,30 @@ class Histogram:
                 cum += c
             return vmax
 
+    def exemplar(self, q: float) -> int:
+        """Trace id of the last observation in the bucket holding the
+        q-quantile (0 = none recorded there) — the p99→trace link."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if self._count == 0:
+                return 0
+            target = q * self._count
+            cum = 0
+            for i, c in enumerate(self._counts):
+                if c and cum + c >= target:
+                    return self._exemplars[i]
+                cum += c
+            for i in range(len(self._counts) - 1, -1, -1):
+                if self._counts[i]:
+                    return self._exemplars[i]
+            return 0
+
     def to_dict(self) -> Dict[str, Any]:
         with self._lock:
             count, total, vmax = self._count, self._sum, self._max
-        return {
+            have_exemplars = any(self._exemplars)
+        out = {
             "type": "histogram",
             "count": count,
             "sum": total,
@@ -245,6 +286,9 @@ class Histogram:
             "p95": self.quantile(0.95),
             "p99": self.quantile(0.99),
         }
+        if have_exemplars:
+            out["exemplar_p99"] = f"{self.exemplar(0.99):#x}"
+        return out
 
 
 class Registry:
@@ -313,9 +357,12 @@ class Registry:
             out[_series_name(s.name, _label_key(s.labels))] = s.to_dict()
         return out
 
-    def render_prometheus(self) -> str:
+    def render_prometheus(self, exemplars: bool = False) -> str:
         """Prometheus text exposition (histograms with cumulative
-        ``_bucket{le=...}`` plus ``_sum``/``_count``)."""
+        ``_bucket{le=...}`` plus ``_sum``/``_count``).  With
+        ``exemplars=True``, bucket lines carry their last trace id in
+        OpenMetrics exemplar form (`` # {trace_id="0x..."} <le>``) —
+        off by default because plain-Prometheus parsers reject it."""
         lines = []
         by_name: Dict[str, list] = {}
         for s in self.series():
@@ -329,17 +376,27 @@ class Registry:
                 if isinstance(s, Histogram):
                     with s._lock:
                         counts = list(s._counts)
+                        exs = list(s._exemplars)
                         total, count = s._sum, s._count
+
+                    def _ex(i: int, le: float) -> str:
+                        if not exemplars or not exs[i]:
+                            return ""
+                        return (f' # {{trace_id="{exs[i]:#x}"}}'
+                                f' {_fmt(le)}')
+
                     cum = 0
-                    for bound, c in zip(s.bounds, counts):
+                    for i, (bound, c) in enumerate(zip(s.bounds, counts)):
                         cum += c
                         lines.append(
                             f"{pname}_bucket"
-                            f"{_prom_labels(key, le=_fmt(bound))} {cum}")
+                            f"{_prom_labels(key, le=_fmt(bound))} {cum}"
+                            f"{_ex(i, bound)}")
                     cum += counts[-1]
                     lines.append(
                         f"{pname}_bucket{_prom_labels(key, le='+Inf')} "
-                        f"{cum}")
+                        f"{cum}"
+                        f"{_ex(len(counts) - 1, s.bounds[-1] if s.bounds else 0.0)}")
                     lines.append(
                         f"{pname}_sum{_prom_labels(key)} {_fmt(total)}")
                     lines.append(
@@ -395,13 +452,14 @@ def snapshot() -> Dict[str, Dict[str, Any]]:
     return REGISTRY.snapshot()
 
 
-def render_prometheus() -> str:
-    return REGISTRY.render_prometheus()
+def render_prometheus(exemplars: bool = False) -> str:
+    return REGISTRY.render_prometheus(exemplars=exemplars)
 
 
 def reset() -> None:
     """Drop every series AND stop the flush thread (test isolation)."""
     stop_flush()
+    set_ops_push(None)
     REGISTRY.reset()
 
 
@@ -409,17 +467,22 @@ def reset() -> None:
 # Native bridge: ALL Dashboard monitors in one MV_DumpMonitors call.
 # ---------------------------------------------------------------------------
 
-def parse_native_dump(text: str) -> Dict[str, Tuple[int, float, float,
-                                                    Tuple[int, ...]]]:
+def parse_native_dump(text: str) -> Dict[str, tuple]:
     """Parse ``MV_DumpMonitors`` text → {name: (count, total, max,
-    bucket_counts)} (wire format documented in c_api.h)."""
+    bucket_counts[, exemplars])} (wire format documented in c_api.h).
+    The trailing per-bucket exemplar trace ids are optional — a
+    pre-exemplar dump yields 4-tuples, a current one 5-tuples."""
     out = {}
     for line in text.splitlines():
         if not line.strip():
             continue
-        name, count, total, vmax, buckets = line.split("\t")
-        out[name] = (int(count), float(total), float(vmax),
-                     tuple(int(b) for b in buckets.split(",")))
+        fields = line.split("\t")
+        name, count, total, vmax, buckets = fields[:5]
+        parsed = (int(count), float(total), float(vmax),
+                  tuple(int(b) for b in buckets.split(",")))
+        if len(fields) > 5:
+            parsed += (tuple(int(e) for e in fields[5].split(",")),)
+        out[name] = parsed
     return out
 
 
@@ -433,9 +496,11 @@ def bridge_native(runtime: Any, prefix: str = "native.") -> int:
     """
     dump = runtime.dump_monitors()
     n = 0
-    for name, (count, total, vmax, buckets) in dump.items():
+    for name, item in dump.items():
+        count, total, vmax, buckets = item[:4]
+        exemplars = item[4] if len(item) > 4 else None
         h = REGISTRY.histogram(prefix + name, bounds=NATIVE_TIME_BUCKETS)
-        h._load(count, total, vmax, buckets)
+        h._load(count, total, vmax, buckets, exemplars)
         n += 1
         # Wire-byte observability parity (docs/wire_compression.md):
         # the native transport ledgers record 1 unit = 1 byte with
@@ -457,6 +522,20 @@ def bridge_native(runtime: Any, prefix: str = "native.") -> int:
 
 _FLUSH_LOCK = threading.Lock()
 _FLUSHER: Optional["_Flusher"] = None
+# Optional per-flush push target (docs/observability.md): the native ops
+# plane's MV_SetOpsHostMetrics, so in-band wire scrapes serve THIS
+# registry's rendering (exemplars included) instead of the native-only
+# fallback.  Set via set_ops_push(rt.set_ops_host_metrics).
+_PUSH_FN = None
+
+
+def set_ops_push(fn) -> None:
+    """Register ``fn(prom_text)`` to receive the exemplar-annotated
+    Prometheus rendering on every flush (``None`` disarms).  Wire it to
+    ``NativeRuntime.set_ops_host_metrics`` so anonymous OpsQuery scrapes
+    serve the full registry."""
+    global _PUSH_FN
+    _PUSH_FN = fn
 
 
 class _Flusher(threading.Thread):
@@ -480,6 +559,9 @@ class _Flusher(threading.Thread):
             else:
                 snap = snapshot()
                 Log.debug("metrics flush: %d series", len(snap))
+            push = _PUSH_FN
+            if push is not None:
+                push(render_prometheus(exemplars=True))
         except Exception as exc:  # a flush must never kill training
             Log.error("metrics flush failed: %s", exc)
 
@@ -490,24 +572,40 @@ class _Flusher(threading.Thread):
 def start_flush(interval_ms: int, path: Optional[str] = None) -> None:
     """Start (or retarget) the periodic exporter: every ``interval_ms``
     the registry is rendered to ``path`` (Prometheus text, atomic
-    replace) or, with no path, summarized to the debug log."""
+    replace) or, with no path, summarized to the debug log.  The
+    previous flusher (if any) is stopped AND JOINED before the new one
+    starts — two live flushers would interleave writes to the same
+    ``metrics_rank<r>.prom``."""
     global _FLUSHER
     if interval_ms <= 0:
         return
     with _FLUSH_LOCK:
         if _FLUSHER is not None:
             _FLUSHER.stop()
+            _FLUSHER.join(timeout=5.0)
+            if _FLUSHER.is_alive():
+                Log.error("metrics flush: previous flusher still alive "
+                          "after 5s; retargeting anyway")
         _FLUSHER = _Flusher(interval_ms / 1e3, path)
         _FLUSHER.start()
 
 
 def stop_flush(final_flush: bool = True) -> None:
+    """Stop the exporter.  The thread is JOINED before the final flush
+    runs on the caller: shutdown's last ``snapshot()``/render must never
+    interleave with a flusher mid-write of ``metrics_rank<r>.prom`` (the
+    PR 3 teardown race) — if the join times out, the final flush is
+    SKIPPED and the error logged rather than racing the straggler."""
     global _FLUSHER
     with _FLUSH_LOCK:
         f, _FLUSHER = _FLUSHER, None
     if f is not None:
         f.stop()
         f.join(timeout=5.0)
+        if f.is_alive():
+            Log.error("metrics flush: flusher did not stop within 5s; "
+                      "skipping the final flush to avoid interleaving")
+            return
         if final_flush:
             f.flush_once()
 

@@ -156,6 +156,14 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_DumpSpans.restype = ctypes.c_void_p
     lib.MV_ClearSpans.argtypes = []
     lib.MV_ClearSpans.restype = ctypes.c_int
+    lib.MV_OpsReport.argtypes = [ctypes.c_char_p]
+    lib.MV_OpsReport.restype = ctypes.c_void_p
+    lib.MV_SetOpsHostMetrics.argtypes = [ctypes.c_char_p]
+    lib.MV_SetOpsHostMetrics.restype = ctypes.c_int
+    lib.MV_BlackboxEvent.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.MV_BlackboxEvent.restype = ctypes.c_int
+    lib.MV_BlackboxTrigger.argtypes = [ctypes.c_char_p]
+    lib.MV_BlackboxTrigger.restype = ctypes.c_int
     lib.MV_SetFault.argtypes = [ctypes.c_char_p, ctypes.c_double]
     lib.MV_SetFault.restype = ctypes.c_int
     lib.MV_SetFaultN.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
@@ -474,6 +482,38 @@ class NativeRuntime:
         """Raw MV_DumpSpans text (``tracing.parse_native_spans`` /
         ``tracing.add_native_spans`` turn it into events)."""
         return self._dump_string(self.lib.MV_DumpSpans, "MV_DumpSpans")
+
+    def ops_report(self, kind: str = "health") -> str:
+        """This rank's live introspection report — the same text the
+        in-band wire scrape (MsgType::OpsQuery) serves: ``metrics``
+        (Prometheus exposition with per-bucket exemplar trace ids),
+        ``health`` (JSON verdict), or ``tables`` (JSON per-table
+        version/spread/codec/agg stats).  docs/observability.md."""
+        return self._dump_string(lambda: self.lib.MV_OpsReport(
+            kind.encode()), "MV_OpsReport")
+
+    def set_ops_host_metrics(self, prom_text: str) -> None:
+        """Push this process's Python metrics-registry rendering so
+        in-band scrapes serve the full superset (the flush thread calls
+        this each interval via ``metrics.set_ops_push``)."""
+        self._check(self.lib.MV_SetOpsHostMetrics(prom_text.encode()),
+                    "MV_SetOpsHostMetrics")
+
+    def blackbox_event(self, kind: str, detail: str = "") -> None:
+        """Record one lifecycle event into the native flight-recorder
+        ring (bounded by ``-blackbox_events``)."""
+        self._check(self.lib.MV_BlackboxEvent(kind.encode(),
+                                              detail.encode()),
+                    "MV_BlackboxEvent")
+
+    def blackbox_trigger(self, reason: str) -> None:
+        """Dump the flight recorder (ring + recent spans + monitor
+        totals) to ``<trace_dir>/blackbox_rank<r>.json``.  Native
+        failure paths (barrier timeout, dead peer, shed storm) trigger
+        automatically; this is the host-side trigger (e.g.
+        CheckpointCorrupt)."""
+        self._check(self.lib.MV_BlackboxTrigger(reason.encode()),
+                    "MV_BlackboxTrigger")
 
     def clear_spans(self) -> None:
         self._check(self.lib.MV_ClearSpans(), "MV_ClearSpans")

@@ -135,6 +135,29 @@ int MV_SetTraceId(long long trace_id);
 char* MV_DumpSpans(void);
 int MV_ClearSpans(void);
 
+// ---- introspection plane (docs/observability.md; mvtpu/ops.h) --------
+// This rank's ops report text — the SAME payload the wire serves for an
+// in-band MsgType::OpsQuery.  kind: "metrics" (Prometheus exposition:
+// the host-pushed registry rendering when present, else the native
+// Dashboard with per-bucket exemplar trace ids) | "health" (JSON
+// verdict: queue depth vs -server_inflight_max, lease state, fan-in
+// counters) | "tables" (JSON per-table version / bucket-version spread /
+// codec / agg depth).  malloc'd; caller frees with MV_FreeString.
+char* MV_OpsReport(const char* kind);
+// Push the host (Python) metrics registry's Prometheus rendering so
+// in-band scrapes serve the full superset (the PR 3 registry already
+// bridges every native monitor).  The metrics flush thread calls this
+// each interval.  NULL or empty clears the push (native fallback).
+int MV_SetOpsHostMetrics(const char* prom_text);
+// Flight recorder ("black box"): record one lifecycle event into the
+// bounded in-memory ring (-blackbox_events), and/or trigger a dump of
+// ring + recent spans + monitor totals to
+// <trace_dir>/blackbox_rank<r>.json.  Native failure paths (barrier
+// timeout, dead peer, shed storm) trigger automatically; these let the
+// host layer add its own events/triggers (e.g. CheckpointCorrupt).
+int MV_BlackboxEvent(const char* kind, const char* detail);
+int MV_BlackboxTrigger(const char* reason);
+
 // ---- serve layer (docs/serving.md) -----------------------------------
 // Version probe: one header-only round trip filling *version with the
 // max CURRENT version over every server shard of the table — the cheap

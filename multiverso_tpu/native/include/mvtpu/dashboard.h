@@ -28,13 +28,19 @@ constexpr int kDashboardBuckets = 28;
 
 class Dashboard {
  public:
+  // Records into the monitor's bucket AND, when this thread carries a
+  // trace id, stamps that id as the bucket's EXEMPLAR — the last trace
+  // that landed there, so a p99 bucket links straight to the span
+  // timeline that explains it (docs/observability.md).
   static void Record(const std::string& name, double seconds);
   static std::string Report();
   static void Reset();
   // count/total for one monitor (testing/introspection).
   static bool Query(const std::string& name, long long* count, double* total);
   // Every monitor in one pass (MV_DumpMonitors): one line per stat,
-  //   name\tcount\ttotal\tmax\tb0,b1,...,b27\n
+  //   name\tcount\ttotal\tmax\tb0,b1,...,b27\te0,e1,...,e27\n
+  // The trailing exemplar field (last trace id per bucket, 0 = none) is
+  // OPTIONAL on the parse side — pre-exemplar consumers read 4 fields.
   static std::string Dump();
 
   // ---- tracing (spans) -------------------------------------------------

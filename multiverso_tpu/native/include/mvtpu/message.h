@@ -61,6 +61,18 @@ enum class MsgType : int32_t {
   // first (possibly shard-sized) payload frame; the reactor consumes it
   // during identification — it is never forwarded upstream.
   Hello = 22,
+  // Live introspection plane (docs/observability.md): an in-band scrape
+  // over the SAME wire the serve tier speaks.  The request's first blob
+  // names the report kind ("metrics" | "health" | "tables"); `version`
+  // carries the scope (0 = this rank, 1 = fleet: the receiving rank
+  // fans out to every peer with a bounded deadline and merges, marking
+  // silent ranks).  Local-scope queries are answered AT THE REACTOR
+  // (like ReplyBusy — never through the actor mailbox), so a wedged
+  // server still answers its health scrape.  The reply's single blob is
+  // the report text (Prometheus exposition for "metrics", JSON
+  // otherwise).
+  OpsQuery = 23,
+  OpsReply = 24,
   Exit = 64,
 };
 

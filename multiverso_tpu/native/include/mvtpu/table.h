@@ -256,6 +256,17 @@ class WorkerTable {
   // incompatible buffered aggregate (different length or AddOption) is
   // flushed first; a full/expired buffer is flushed right after.
   bool MaybeAggregate(const float* delta, int64_t n, const AddOption& opt);
+
+ public:
+  // Introspection (mvtpu/ops.h): async adds absorbed into the
+  // aggregation buffer but not yet shipped — the "agg buffer depth" of
+  // an ops table report.
+  int64_t agg_pending() {
+    MutexLock lk(agg_mu_);
+    return agg_count_;
+  }
+
+ protected:
   // Subclass hook: ship `sum` (n elements) as one async add.
   virtual void SendAggregate(const float* sum, int64_t n,
                              const AddOption& opt) {

@@ -98,6 +98,19 @@ class Zoo {
   bool MaybeHoldGet(MessagePtr& msg);
   void OnClockTick(int src_rank, int64_t clock);
 
+  // ---- introspection plane (docs/observability.md, mvtpu/ops.h) ------
+  // This rank's health verdict / per-table stats as JSON (the "health" /
+  // "tables" sections of an OpsQuery report).
+  std::string OpsHealthJson();
+  std::string OpsTablesJson();
+  // OpsQuery routing (transport reader / reactor threads — NEVER the
+  // actor mailbox, so a wedged server still answers its scrape).  Local
+  // scope replies inline; fleet scope (version == 1) fans out to every
+  // peer on a bounded detached thread (-ops_fleet_timeout_ms, capped by
+  // -ops_inflight_max) and merges, marking silent ranks.
+  void HandleOpsQuery(MessagePtr msg);
+  void OnOpsReply(MessagePtr msg);   // fleet fan-out responses
+
   // ---- serve backpressure (docs/serving.md) ---------------------------
   // Current server-actor mailbox backlog (the inflight gauge MV_Serve-
   // QueueDepth exposes); 0 when the runtime is down.
@@ -243,6 +256,19 @@ class Zoo {
   Mutex flush_mu_;
   std::unordered_map<int64_t, std::shared_ptr<Waiter>> flush_pending_
       GUARDED_BY(flush_mu_);
+
+  // Fleet-scope OpsQuery state: msg_id -> collected per-rank payloads.
+  // Fan-out threads are detached but counted (ops_inflight_); Stop
+  // drains the counter bounded before tearing the transport down.
+  struct OpsPending;
+  void FleetOpsThread(int64_t id, Message query);
+  Mutex ops_mu_;
+  std::unordered_map<int64_t, std::shared_ptr<OpsPending>> ops_pending_
+      GUARDED_BY(ops_mu_);
+  std::atomic<int> ops_inflight_{0};
+  // Shed-storm detector (-shed_storm_threshold): consecutive sheds.
+  std::atomic<long long> shed_streak_{0};
+  std::atomic<bool> shed_storm_latched_{false};
 
   // Heartbeat/lease state.  The loop thread is started by Start (when
   // enabled) and joined by the Stop latch winner before actors die.

@@ -10,6 +10,7 @@
 #include "mvtpu/dashboard.h"
 #include "mvtpu/fault.h"
 #include "mvtpu/mutex.h"
+#include "mvtpu/ops.h"
 #include "mvtpu/stream.h"
 #include "mvtpu/zoo.h"
 
@@ -457,6 +458,29 @@ int MV_WireStats(long long* sent_bytes, long long* recv_bytes,
   have = mvtpu::Dashboard::Query("net.bytes.recv", &c, &total);
   if (recv_bytes) *recv_bytes = have ? static_cast<long long>(total) : 0;
   if (recv_msgs) *recv_msgs = have ? c : 0;
+  return 0;
+}
+
+// ---- introspection plane (docs/observability.md) ---------------------
+
+char* MV_OpsReport(const char* kind) {
+  return MallocString(mvtpu::ops::LocalReport(kind ? kind : "health"));
+}
+
+int MV_SetOpsHostMetrics(const char* prom_text) {
+  mvtpu::ops::SetHostMetrics(prom_text ? prom_text : "");
+  return 0;
+}
+
+int MV_BlackboxEvent(const char* kind, const char* detail) {
+  if (!kind) return -1;
+  mvtpu::ops::BlackboxEvent(kind, detail ? detail : "");
+  return 0;
+}
+
+int MV_BlackboxTrigger(const char* reason) {
+  if (!reason) return -1;
+  mvtpu::ops::BlackboxTrigger(reason);
   return 0;
 }
 

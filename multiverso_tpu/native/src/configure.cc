@@ -249,6 +249,28 @@ void RegisterDefaults() {
                "record per-op spans (worker Get/Add, server apply, wire "
                "send) with cross-rank trace ids; dump via MV_DumpSpans "
                "(docs/observability.md)");
+    DefineString("trace_dir", "",
+                 "introspection output dir (docs/observability.md): the "
+                 "flight recorder dumps blackbox_rank<r>.json here on "
+                 "failure triggers (barrier timeout, dead peer, shed "
+                 "storm).  Empty (default) disables dumps; events still "
+                 "accumulate in the in-memory ring");
+    DefineInt("blackbox_events", 512,
+              "flight-recorder ring capacity (lifecycle events kept in "
+              "memory; dumped with recent spans + monitor totals on a "
+              "trigger)");
+    DefineInt("ops_fleet_timeout_ms", 2000,
+              "fleet-scope OpsQuery fan-out deadline: rank answers with "
+              "whatever peers replied by then, explicitly marking the "
+              "silent ranks instead of hanging the scraper");
+    DefineInt("ops_inflight_max", 4,
+              "concurrent fleet-scope OpsQuery aggregations; excess "
+              "queries are answered with a busy error document instead "
+              "of spawning unbounded fan-out threads");
+    DefineInt("shed_storm_threshold", 0,
+              "flight-recorder trigger: this many CONSECUTIVE busy-sheds "
+              "(-server_inflight_max) dump the black box once per storm "
+              "(an admit resets the streak).  0 (default) disables");
   });
 }
 

@@ -18,6 +18,9 @@ struct Stat {
   double total = 0.0;
   double max = 0.0;
   long long buckets[kDashboardBuckets] = {0};
+  // Per-bucket exemplar: the LAST trace id whose observation landed in
+  // the bucket (0 = none yet / tracing off) — the p99-to-trace link.
+  long long exemplars[kDashboardBuckets] = {0};
 };
 
 // First bucket whose upper bound (1e-6 * 2^i) holds `seconds`; the last
@@ -66,12 +69,15 @@ int64_t NowWallUs() {
 }  // namespace
 
 void Dashboard::Record(const std::string& name, double seconds) {
+  int bucket = BucketOf(seconds);
+  int64_t exemplar = t_trace_id;  // this thread's active span id (0 = none)
   MutexLock lk(g_mu);
   Stat& s = g_stats[name];
   ++s.count;
   s.total += seconds;
   s.max = std::max(s.max, seconds);
-  ++s.buckets[BucketOf(seconds)];
+  ++s.buckets[bucket];
+  if (exemplar != 0) s.exemplars[bucket] = exemplar;
 }
 
 std::string Dashboard::Report() {
@@ -117,6 +123,11 @@ std::string Dashboard::Dump() {
     for (int i = 0; i < kDashboardBuckets; ++i) {
       if (i) os << ',';
       os << s.buckets[i];
+    }
+    os << '\t';
+    for (int i = 0; i < kDashboardBuckets; ++i) {
+      if (i) os << ',';
+      os << s.exemplars[i];
     }
     os << '\n';
   }

@@ -16,6 +16,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <chrono>
 #include <cstring>
 
@@ -24,6 +25,7 @@
 #include "mvtpu/fault.h"
 #include "mvtpu/log.h"
 #include "mvtpu/net.h"
+#include "mvtpu/ops.h"
 
 namespace mvtpu {
 
@@ -63,6 +65,34 @@ void SetNoDelay(int fd) {
 constexpr int64_t kMaxRankFrameBytes = int64_t{1} << 40;
 constexpr int64_t kMaxClientFrameBytes = int64_t{1} << 26;  // 64 MiB
 constexpr size_t kDefaultSlabBytes = 256 << 10;
+
+#if defined(__SANITIZE_THREAD__)
+#define MVTPU_TSAN 1
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define MVTPU_TSAN 1
+#endif
+#endif
+
+// True when the reactor may REWIND the slab and overwrite it: no Blob
+// view is left alive.  The consumer's last read of a view is ordered
+// before our overwrite by (a) the view's shared_ptr release decrement
+// (acq_rel in libstdc++) and (b) the acquire FENCE below pairing with
+// it after the relaxed use_count() observation (atomics.fences) — the
+// bare use_count() == 1 check alone carries no happens-before edge
+// (TSan caught exactly that on the ssp_tput sweep).  TSan does not
+// model fences, so under it the fast path is compiled out (a fresh
+// slab is allocated instead of rewinding) rather than suppressed.
+bool SlabExclusive(const std::shared_ptr<std::vector<char>>& slab) {
+#ifdef MVTPU_TSAN
+  (void)slab;
+  return false;
+#else
+  if (slab.use_count() != 1) return false;
+  std::atomic_thread_fence(std::memory_order_acquire);
+  return true;
+#endif
+}
 
 }  // namespace
 
@@ -397,7 +427,7 @@ void EpollNet::HandleReadable(Shard* s, const std::shared_ptr<Conn>& c) {
       // false-sharing data race TSan rightly halts on).
       c->slab_used = (c->slab_used + 7) & ~size_t{7};
       size_t need = static_cast<size_t>(len);
-      if (c->slab && c->slab.use_count() == 1) {
+      if (c->slab && SlabExclusive(c->slab)) {
         if (c->slab->size() < need)
           c->slab->resize(std::max(need, slab_bytes));
         c->slab_used = 0;
@@ -477,6 +507,26 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
   // forwarded upstream (stray Hellos on an identified connection are
   // dropped the same way).
   if (m.type == MsgType::Hello) return true;
+  if (m.type == MsgType::OpsQuery) {
+    // Introspection scrape (docs/observability.md): answered AT THE
+    // REACTOR, exactly like a synthesized busy reply — it must never
+    // touch the actor mailbox (a wedged server still reports health),
+    // and reactor-originated sends never block (may_block=false: a
+    // full write queue drops the reply; the scraper's deadline covers
+    // it).  Uncounted by the per-client admission gate, like Hello.
+    if (transport::IsClientRank(peer)) m.src = peer;
+    if (m.version != 1) {
+      Message reply;
+      ops::BuildReply(m, &reply);
+      reply.src = rank_;
+      reply.dst = m.src;
+      return Enqueue(c, reply, /*may_block=*/false);
+    }
+    // Fleet scope: the zoo fans out on a bounded detached thread —
+    // the hand-off itself (thread spawn) is reactor-safe.
+    if (inbound_) inbound_(std::move(m));
+    return true;
+  }
   if (transport::IsClientRank(peer)) {
     // Anonymous client: the pseudo-rank IS the reply address.
     m.src = peer;

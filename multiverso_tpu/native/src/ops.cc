@@ -1,0 +1,303 @@
+#include "mvtpu/ops.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <deque>
+#include <sstream>
+#include <vector>
+
+#include "mvtpu/configure.h"
+#include "mvtpu/dashboard.h"
+#include "mvtpu/log.h"
+#include "mvtpu/mutex.h"
+#include "mvtpu/zoo.h"
+
+namespace mvtpu {
+namespace ops {
+
+namespace {
+
+Mutex g_mu;
+std::string g_host_metrics GUARDED_BY(g_mu);
+
+struct Event {
+  int64_t ts_us;
+  std::string kind;
+  std::string detail;
+};
+Mutex g_box_mu;
+std::deque<Event> g_events GUARDED_BY(g_box_mu);
+long long g_triggers GUARDED_BY(g_box_mu) = 0;
+
+int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// Minimal JSON string escape (names/details are runtime-controlled, but
+// a rogue flag value must not produce an unparseable black box).
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c & 0xff);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+std::vector<std::string> SplitTabs(const std::string& line) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  while (true) {
+    size_t tab = line.find('\t', start);
+    if (tab == std::string::npos) {
+      out.push_back(line.substr(start));
+      return out;
+    }
+    out.push_back(line.substr(start, tab - start));
+    start = tab + 1;
+  }
+}
+
+std::vector<long long> SplitCsv(const std::string& s) {
+  std::vector<long long> out;
+  size_t start = 0;
+  while (start <= s.size()) {
+    size_t comma = s.find(',', start);
+    std::string tok = s.substr(
+        start, comma == std::string::npos ? std::string::npos
+                                          : comma - start);
+    if (!tok.empty()) out.push_back(std::stoll(tok));
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  return out;
+}
+
+std::string FmtDouble(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%.9g", v);
+  return buf;
+}
+
+// Native Dashboard -> Prometheus exposition, with per-bucket exemplar
+// trace ids in OpenMetrics style:
+//   name_bucket{le="0.001024"} 17 # {trace_id="0x..."} 0.001024
+// Served only when the host has not pushed its own (superset)
+// rendering — the pushed text already bridges every native monitor.
+std::string RenderNativePrometheus() {
+  std::ostringstream os;
+  std::istringstream in(Dashboard::Dump());
+  std::string line;
+  while (std::getline(in, line)) {
+    if (line.empty()) continue;
+    auto fields = SplitTabs(line);
+    if (fields.size() < 5) continue;
+    const std::string pname = PromName(fields[0]);
+    long long count = std::stoll(fields[1]);
+    double total = std::stod(fields[2]);
+    auto buckets = SplitCsv(fields[4]);
+    std::vector<long long> exemplars;
+    if (fields.size() >= 6) exemplars = SplitCsv(fields[5]);
+    os << "# TYPE " << pname << " histogram\n";
+    long long cum = 0;
+    double bound = 1e-6;
+    for (size_t i = 0; i < buckets.size(); ++i) {
+      bool inf = i + 1 == buckets.size();
+      cum += buckets[i];
+      os << pname << "_bucket{le=\""
+         << (inf ? "+Inf" : FmtDouble(bound)) << "\"} " << cum;
+      if (i < exemplars.size() && exemplars[i] != 0) {
+        char hex[32];
+        std::snprintf(hex, sizeof(hex), "0x%llx",
+                      static_cast<unsigned long long>(exemplars[i]));
+        os << " # {trace_id=\"" << hex << "\"} "
+           << (inf ? FmtDouble(bound) : FmtDouble(bound));
+      }
+      os << '\n';
+      bound *= 2.0;
+    }
+    os << pname << "_sum " << FmtDouble(total) << '\n';
+    os << pname << "_count " << count << '\n';
+  }
+  return os.str();
+}
+
+}  // namespace
+
+std::string PromName(const std::string& name) {
+  std::string out;
+  out.reserve(name.size());
+  for (size_t i = 0; i < name.size(); ++i) {
+    char c = name[i];
+    bool ok = (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+              c == '_' || c == ':' || (c >= '0' && c <= '9' && i != 0);
+    out += ok ? c : '_';
+  }
+  return out;
+}
+
+void SetHostMetrics(const std::string& prom_text) {
+  MutexLock lk(g_mu);
+  g_host_metrics = prom_text;
+}
+
+std::string LocalReport(const std::string& kind) {
+  if (kind == "metrics") {
+    {
+      MutexLock lk(g_mu);
+      if (!g_host_metrics.empty()) return g_host_metrics;
+    }
+    return RenderNativePrometheus();
+  }
+  if (kind == "health") return Zoo::Get()->OpsHealthJson();
+  if (kind == "tables") return Zoo::Get()->OpsTablesJson();
+  return "{\"error\":\"unknown ops kind '" + JsonEscape(kind) + "'\"}";
+}
+
+void BuildReply(const Message& query, Message* reply) {
+  std::string kind = "health";
+  if (!query.data.empty() && query.data[0].size() > 0)
+    kind.assign(query.data[0].data(), query.data[0].size());
+  std::string text = LocalReport(kind);
+  reply->type = MsgType::OpsReply;
+  reply->table_id = query.table_id;
+  reply->msg_id = query.msg_id;
+  reply->trace_id = query.trace_id;
+  reply->version = query.version;  // echo the scope
+  reply->data.clear();
+  reply->data.emplace_back(text.data(), text.size());
+}
+
+// ---- flight recorder -------------------------------------------------
+
+void BlackboxEvent(const std::string& kind, const std::string& detail) {
+  size_t cap = static_cast<size_t>(
+      std::max<long long>(16, configure::Has("blackbox_events")
+                                  ? configure::GetInt("blackbox_events")
+                                  : 512));
+  Event ev{NowUs(), kind, detail};
+  MutexLock lk(g_box_mu);
+  g_events.push_back(std::move(ev));
+  while (g_events.size() > cap) g_events.pop_front();
+}
+
+std::string BlackboxTrigger(const std::string& reason) {
+  BlackboxEvent("trigger", reason);
+  Dashboard::Record("blackbox.trigger", 0.0);
+  std::string dir = configure::Has("trace_dir")
+                        ? configure::GetString("trace_dir")
+                        : "";
+  {
+    MutexLock lk(g_box_mu);
+    ++g_triggers;
+  }
+  if (dir.empty()) return "";
+
+  std::ostringstream os;
+  os << "{\"reason\":\"" << JsonEscape(reason) << "\",";
+  os << "\"rank\":" << Zoo::Get()->rank() << ",";
+  os << "\"ts_us\":" << NowUs() << ",";
+  os << "\"events\":[";
+  {
+    MutexLock lk(g_box_mu);
+    bool first = true;
+    for (const auto& ev : g_events) {
+      if (!first) os << ',';
+      first = false;
+      os << "{\"ts_us\":" << ev.ts_us << ",\"kind\":\""
+         << JsonEscape(ev.kind) << "\",\"detail\":\""
+         << JsonEscape(ev.detail) << "\"}";
+    }
+  }
+  os << "],\"spans\":[";
+  {
+    std::istringstream in(Dashboard::DumpSpans());
+    std::string line;
+    bool first = true;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      auto f = SplitTabs(line);
+      if (f.size() < 6) continue;
+      if (!first) os << ',';
+      first = false;
+      char hex[32];
+      std::snprintf(hex, sizeof(hex), "0x%llx",
+                    static_cast<unsigned long long>(std::stoll(f[1])));
+      os << "{\"name\":\"" << JsonEscape(f[0]) << "\",\"trace_id\":\""
+         << hex << "\",\"ts\":" << f[2] << ",\"dur\":" << f[3]
+         << ",\"pid\":" << f[4] << ",\"tid\":" << f[5] << "}";
+    }
+  }
+  os << "],\"monitors\":{";
+  {
+    std::istringstream in(Dashboard::Dump());
+    std::string line;
+    bool first = true;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      auto f = SplitTabs(line);
+      if (f.size() < 3) continue;
+      if (!first) os << ',';
+      first = false;
+      os << "\"" << JsonEscape(f[0]) << "\":{\"count\":" << f[1]
+         << ",\"total_s\":" << f[2] << "}";
+    }
+  }
+  os << "}}";
+
+  std::string path =
+      dir + "/blackbox_rank" + std::to_string(Zoo::Get()->rank()) + ".json";
+  std::string tmp = path + ".tmp";
+  std::FILE* fp = std::fopen(tmp.c_str(), "wb");
+  if (!fp) {
+    Log::Error("blackbox: cannot write %s", tmp.c_str());
+    return "";
+  }
+  std::string doc = os.str();
+  size_t wrote = std::fwrite(doc.data(), 1, doc.size(), fp);
+  std::fclose(fp);
+  if (wrote != doc.size() || std::rename(tmp.c_str(), path.c_str()) != 0) {
+    Log::Error("blackbox: short write / rename failed for %s",
+               path.c_str());
+    std::remove(tmp.c_str());
+    return "";
+  }
+  Log::Error("blackbox: dumped flight recorder to %s (reason: %s)",
+             path.c_str(), reason.c_str());
+  return path;
+}
+
+long long BlackboxTriggerCount() {
+  MutexLock lk(g_box_mu);
+  return g_triggers;
+}
+
+void BlackboxReset() {
+  {
+    MutexLock lk(g_box_mu);
+    g_events.clear();
+    g_triggers = 0;
+  }
+  MutexLock lk(g_mu);
+  g_host_metrics.clear();
+}
+
+}  // namespace ops
+}  // namespace mvtpu

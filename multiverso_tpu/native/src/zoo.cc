@@ -4,12 +4,15 @@
 #include <chrono>
 #include <cmath>
 #include <functional>
+#include <map>
+#include <sstream>
 
 #include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/log.h"
 #include "mvtpu/mpi_net.h"
+#include "mvtpu/ops.h"
 #include "mvtpu/waiter.h"
 
 namespace mvtpu {
@@ -370,6 +373,9 @@ bool Zoo::Start(int argc, const char* const* argv) {
   Dashboard::SetTraceRank(rank_);
   if (configure::GetBool("trace")) Dashboard::SetTraceEnabled(true);
   started_ = true;
+  ops::BlackboxEvent("lifecycle",
+                     "start rank " + std::to_string(rank_) + "/" +
+                         std::to_string(size_) + " engine=" + net_engine());
   Log::Info("mvtpu native runtime started (rank %d/%d, updater=%s, "
             "engine=%s)", rank_, size_, upd.c_str(), net_engine());
   return true;
@@ -400,10 +406,16 @@ void Zoo::Stop() {
   // buffers directly so no absorbed add dies with the runtime.
   if (size_ > 1) Barrier();
   else FlushWorkerAdds();
+  ops::BlackboxEvent("lifecycle", "stop rank " + std::to_string(rank_));
   // Lease loop dies before the transport it sends through.
   if (hb_running_.exchange(false)) {
     if (hb_thread_.joinable()) hb_thread_.join();
   }
+  // Detached fleet-ops aggregation threads send through net_ — give
+  // them a bounded window to finish before the transport dies (their
+  // deadline is -ops_fleet_timeout_ms, so this drain is bounded too).
+  for (int i = 0; i < 500 && ops_inflight_.load() > 0; ++i)
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
   // Un-waited async-get tickets hold pointers into the worker tables —
   // reclaim them before the registry dies (c_api.cc).
   CApiReclaimAsyncGets();
@@ -561,6 +573,10 @@ bool Zoo::Barrier() {
     for (int r : DeadPeers())
       Log::Error("Zoo::Barrier: rank %d's heartbeat lease is expired "
                  "(likely dead)", r);
+    // Flight-recorder trigger (docs/observability.md): a barrier that
+    // timed out is exactly the moment a post-mortem needs the recent
+    // spans/events — dump the black box naming the missing rank(s).
+    ops::BlackboxTrigger("barrier_timeout: waiting for rank(s) " + who);
   }
   bool failed;
   {
@@ -668,17 +684,26 @@ void Zoo::HeartbeatLoop() {
     // late heartbeat arrives — report-only, the reference's missing
     // failure detector; eviction/replacement stays the operator's call.
     int64_t now = NowMs();
-    MutexLock lk(hb_mu_);
-    for (int r = 1; r < size_; ++r) {
-      bool silent = now - hb_last_seen_[r] > timeout;
-      if (silent && !hb_dead_[r]) {
-        hb_dead_[r] = true;
-        Dashboard::Record("hb.missed", 0.0);
-        Log::Error("heartbeat: rank %d silent for over %lld ms — lease "
-                   "expired, reporting peer dead",
-                   r, static_cast<long long>(timeout));
+    std::vector<int> newly_dead;
+    {
+      MutexLock lk(hb_mu_);
+      for (int r = 1; r < size_; ++r) {
+        bool silent = now - hb_last_seen_[r] > timeout;
+        if (silent && !hb_dead_[r]) {
+          hb_dead_[r] = true;
+          Dashboard::Record("hb.missed", 0.0);
+          Log::Error("heartbeat: rank %d silent for over %lld ms — lease "
+                     "expired, reporting peer dead",
+                     r, static_cast<long long>(timeout));
+          newly_dead.push_back(r);
+        }
       }
     }
+    // Blackbox dump OUTSIDE hb_mu_ (it reads zoo state): a dead peer is
+    // a first-class failure trigger (docs/observability.md).
+    for (int r : newly_dead)
+      ops::BlackboxTrigger("dead_peer: rank " + std::to_string(r) +
+                           " silent past the heartbeat lease");
   }
 }
 
@@ -887,8 +912,21 @@ bool Zoo::ShedIfOverloaded(MessagePtr& msg) {
   // i ≈ depth 2^i, so the Dump shows the backlog distribution and
   // `serve.queue_depth`'s total/count is the mean depth per sample.
   Dashboard::Record("serve.queue_depth", depth * 1e-6);
-  if (depth < max_inflight) return false;
+  if (depth < max_inflight) {
+    // An admit ends the shed streak: the storm detector counts
+    // CONSECUTIVE sheds, re-arming once the server breathes again.
+    shed_streak_.store(0);
+    shed_storm_latched_.store(false);
+    return false;
+  }
   Dashboard::Record("serve.shed", 0.0);
+  int64_t storm = configure::GetInt("shed_storm_threshold");
+  long long streak = shed_streak_.fetch_add(1) + 1;
+  if (storm > 0 && streak >= storm &&
+      !shed_storm_latched_.exchange(true))
+    ops::BlackboxTrigger("shed_storm: " + std::to_string(streak) +
+                         " consecutive busy-sheds at queue depth " +
+                         std::to_string(depth));
   auto reply = std::make_unique<Message>();
   reply->type = MsgType::ReplyBusy;
   reply->table_id = msg->table_id;
@@ -898,6 +936,283 @@ bool Zoo::ShedIfOverloaded(MessagePtr& msg) {
   reply->dst = msg->src;
   Deliver(actor::kWorker, std::move(reply));
   return true;
+}
+
+// ---- introspection plane (docs/observability.md) ----------------------
+
+namespace {
+std::string JoinInts(const std::vector<int>& v) {
+  std::string out;
+  for (int x : v) {
+    if (!out.empty()) out += ',';
+    out += std::to_string(x);
+  }
+  return out;
+}
+}  // namespace
+
+std::string Zoo::OpsHealthJson() {
+  std::ostringstream os;
+  bool up = started_.load();
+  os << "{\"started\":" << (up ? "true" : "false");
+  if (!up) {
+    os << ",\"ready\":false,\"healthy\":false}";
+    return os.str();
+  }
+  int64_t inflight_max = configure::GetInt("server_inflight_max");
+  int depth = ServeQueueDepth();
+  bool overloaded = inflight_max > 0 && depth >= inflight_max;
+  auto dead = DeadPeers();
+  auto fanin = FanIn();
+  os << ",\"rank\":" << rank_ << ",\"size\":" << size_;
+  os << ",\"engine\":\"" << net_engine() << "\"";
+  os << ",\"workers\":" << num_workers() << ",\"servers\":"
+     << num_servers();
+  os << ",\"is_server\":" << (server_id() >= 0 ? "true" : "false");
+  os << ",\"clock\":" << clock_.load();
+  os << ",\"serve_queue_depth\":" << depth;
+  os << ",\"server_inflight_max\":" << inflight_max;
+  os << ",\"dead_peers\":[" << JoinInts(dead) << "]";
+  os << ",\"clients\":" << fanin.active_clients;
+  os << ",\"clients_accepted\":" << fanin.accepted_total;
+  os << ",\"client_shed\":" << fanin.client_shed;
+  os << ",\"blackbox_triggers\":" << ops::BlackboxTriggerCount();
+  // Readiness: the runtime answers requests at all; health: it is not
+  // drowning (queue within the shed bound) and, on the lease authority,
+  // the fleet has no expired peers.
+  os << ",\"ready\":true";
+  os << ",\"healthy\":" << (!overloaded && dead.empty() ? "true" : "false");
+  os << "}";
+  return os.str();
+}
+
+std::string Zoo::OpsTablesJson() {
+  // Snapshot pointers under tables_mu_, read stats OUTSIDE it: the
+  // accessors take per-table locks, and tables are never unregistered.
+  std::vector<std::pair<WorkerTable*, ServerTable*>> snapshot;
+  {
+    MutexLock lk(tables_mu_);
+    for (size_t i = 0; i < worker_tables_.size(); ++i)
+      snapshot.emplace_back(
+          worker_tables_[i].get(),
+          i < server_tables_.size() ? server_tables_[i].get() : nullptr);
+  }
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < snapshot.size(); ++i) {
+    auto [wt, st] = snapshot[i];
+    if (i) os << ',';
+    os << "{\"id\":" << i;
+    if (wt) {
+      os << ",\"codec\":\"" << codec::Name(wt->wire_codec()) << "\"";
+      os << ",\"last_version\":" << wt->last_version();
+      os << ",\"agg_pending\":" << wt->agg_pending();
+    }
+    if (st) {
+      int64_t v = st->version();
+      int64_t lo = v, hi = 0;
+      for (int b = 0; b < ServerTable::kVersionBuckets; ++b) {
+        int64_t bv = st->bucket_version(b);
+        lo = std::min(lo, bv);
+        hi = std::max(hi, bv);
+      }
+      os << ",\"version\":" << v;
+      os << ",\"bucket_version_min\":" << lo;
+      os << ",\"bucket_version_max\":" << hi;
+      os << ",\"bucket_version_spread\":" << (hi - lo);
+    } else {
+      os << ",\"shard\":null";
+    }
+    os << "}";
+  }
+  os << "]";
+  return os.str();
+}
+
+struct Zoo::OpsPending {
+  std::shared_ptr<Waiter> waiter;
+  Mutex mu;
+  std::map<int, std::string> replies GUARDED_BY(mu);  // rank -> payload
+};
+
+void Zoo::HandleOpsQuery(MessagePtr msg) {
+  if (msg->src < 0 || msg->src == rank_) return;  // no route back
+  if (msg->version != 1) {
+    // Local scope: build + answer right here (transport reader thread —
+    // the epoll engine answers even earlier, at the reactor).
+    auto reply = std::make_unique<Message>();
+    ops::BuildReply(*msg, reply.get());
+    reply->src = rank_;
+    reply->dst = msg->src;
+    Deliver(actor::kWorker, std::move(reply));
+    return;
+  }
+  // Fleet scope: bounded fan-out on a detached (but counted) thread —
+  // the deadline wait must never park a transport/reactor thread.
+  int cap = static_cast<int>(
+      std::max<int64_t>(1, configure::GetInt("ops_inflight_max")));
+  if (ops_inflight_.load() >= cap) {
+    auto reply = std::make_unique<Message>();
+    std::string busy = "{\"error\":\"ops busy: " + std::to_string(cap) +
+                       " fleet queries already in flight\"}";
+    reply->type = MsgType::OpsReply;
+    reply->msg_id = msg->msg_id;
+    reply->trace_id = msg->trace_id;
+    reply->version = 1;
+    reply->src = rank_;
+    reply->dst = msg->src;
+    reply->data.emplace_back(busy.data(), busy.size());
+    Deliver(actor::kWorker, std::move(reply));
+    return;
+  }
+  ops_inflight_.fetch_add(1);
+  // Deep-copy the query OUT of the receive arena before detaching (the
+  // kind blob may be a Blob::View into a reactor slab).
+  Message q;
+  q.src = msg->src;
+  q.msg_id = msg->msg_id;
+  q.trace_id = msg->trace_id;
+  q.version = msg->version;
+  if (!msg->data.empty()) {
+    Blob kind;
+    kind.CopyFrom(msg->data[0]);
+    q.data.push_back(kind);
+  }
+  int64_t id = NextMsgId();
+  std::thread([this, id, q]() mutable {
+    FleetOpsThread(id, std::move(q));
+    ops_inflight_.fetch_add(-1);
+  }).detach();
+}
+
+void Zoo::OnOpsReply(MessagePtr msg) {
+  std::shared_ptr<OpsPending> p;
+  {
+    MutexLock lk(ops_mu_);
+    auto it = ops_pending_.find(msg->msg_id);
+    if (it == ops_pending_.end()) return;  // past the deadline: dropped
+    p = it->second;
+  }
+  std::string text;
+  if (!msg->data.empty())
+    text.assign(msg->data[0].data(), msg->data[0].size());
+  {
+    MutexLock lk(p->mu);
+    p->replies[msg->src] = std::move(text);
+  }
+  p->waiter->Notify();
+}
+
+namespace {
+// Inject a rank label into one Prometheus exposition line:
+//   name{a="b"} v      ->  name{rank="0",a="b"} v
+//   name v # {...} e   ->  name{rank="0"} v # {...} e
+// Comment lines return "" (a fleet merge keeps data lines only — the
+// per-rank # TYPE duplicates would be invalid exposition).
+std::string InjectRankLabel(const std::string& line, int rank) {
+  if (line.empty() || line[0] == '#') return "";
+  std::string label = "rank=\"" + std::to_string(rank) + "\"";
+  size_t space = line.find(' ');
+  size_t brace = line.find('{');
+  if (brace != std::string::npos &&
+      (space == std::string::npos || brace < space))
+    return line.substr(0, brace + 1) + label + "," +
+           line.substr(brace + 1);
+  if (space == std::string::npos) return line;  // malformed: keep as-is
+  return line.substr(0, space) + "{" + label + "}" + line.substr(space);
+}
+}  // namespace
+
+void Zoo::FleetOpsThread(int64_t id, Message query) {
+  std::string kind = "health";
+  if (!query.data.empty() && query.data[0].size() > 0)
+    kind.assign(query.data[0].data(), query.data[0].size());
+
+  std::vector<int> targets;
+  for (int r = 0; r < size_; ++r)
+    if (r != rank_) targets.push_back(r);
+
+  auto pending = std::make_shared<OpsPending>();
+  pending->waiter =
+      std::make_shared<Waiter>(static_cast<int>(targets.size()));
+  if (!targets.empty()) {
+    {
+      MutexLock lk(ops_mu_);
+      ops_pending_[id] = pending;
+    }
+    for (int r : targets) {
+      auto sub = std::make_unique<Message>();
+      sub->type = MsgType::OpsQuery;
+      sub->msg_id = id;
+      sub->trace_id = query.trace_id;
+      sub->version = 0;  // local scope at the peer
+      sub->src = rank_;
+      sub->dst = r;
+      sub->data.emplace_back(kind.data(), kind.size());
+      if (net_) net_->Send(r, *sub);
+    }
+    pending->waiter->WaitFor(configure::GetInt("ops_fleet_timeout_ms"));
+    MutexLock lk(ops_mu_);
+    ops_pending_.erase(id);
+  }
+
+  std::map<int, std::string> replies;
+  {
+    MutexLock lk(pending->mu);
+    replies = pending->replies;
+  }
+  replies[rank_] = ops::LocalReport(kind);
+  std::vector<int> silent;
+  for (int r : targets)
+    if (!replies.count(r)) silent.push_back(r);
+  std::vector<int> dead = DeadPeers();
+
+  std::ostringstream os;
+  if (kind == "metrics") {
+    // Per-rank labels on every series; silent ranks are explicit
+    // zero-valued mv_ops_rank_up series, never just missing data.
+    os << "# fleet scrape from rank " << rank_ << " (" << replies.size()
+       << "/" << size_ << " ranks)\n";
+    for (auto& [r, text] : replies) {
+      std::istringstream in(text);
+      std::string line;
+      while (std::getline(in, line)) {
+        std::string labeled = InjectRankLabel(line, r);
+        if (!labeled.empty()) os << labeled << '\n';
+      }
+    }
+    for (int r = 0; r < size_; ++r)
+      os << "mv_ops_rank_up{rank=\"" << r << "\"} "
+         << (replies.count(r) ? 1 : 0) << '\n';
+    for (int r : dead)
+      os << "mv_ops_rank_dead{rank=\"" << r << "\"} 1\n";
+  } else {
+    os << "{\"scope\":\"fleet\",\"kind\":\"" << kind
+       << "\",\"aggregator\":" << rank_ << ",\"size\":" << size_;
+    os << ",\"silent\":[" << JoinInts(silent) << "]";
+    os << ",\"dead\":[" << JoinInts(dead) << "]";
+    os << ",\"ranks\":{";
+    bool first = true;
+    for (int r = 0; r < size_; ++r) {
+      if (!first) os << ',';
+      first = false;
+      os << "\"" << r << "\":";
+      auto it = replies.find(r);
+      os << (it == replies.end() ? std::string("null") : it->second);
+    }
+    os << "}}";
+  }
+  std::string merged = os.str();
+
+  auto reply = std::make_unique<Message>();
+  reply->type = MsgType::OpsReply;
+  reply->msg_id = query.msg_id;
+  reply->trace_id = query.trace_id;
+  reply->version = 1;
+  reply->src = rank_;
+  reply->dst = query.src;
+  reply->data.emplace_back(merged.data(), merged.size());
+  Deliver(actor::kWorker, std::move(reply));
 }
 
 void Zoo::SendTo(const std::string& actor_name, MessagePtr msg) {
@@ -986,6 +1301,16 @@ void Zoo::RouteInbound(Message&& m) {
     case MsgType::ControlBarrierReply:
     case MsgType::Heartbeat:
       SendTo(actor::kController, std::move(msg));
+      break;
+    // Introspection plane: NEVER through the actor mailbox — a wedged
+    // server must still answer its scrape.  (On the epoll engine the
+    // reactor already answered local-scope queries before inbound_;
+    // only fleet-scope queries and fan-out replies reach here.)
+    case MsgType::OpsQuery:
+      HandleOpsQuery(std::move(msg));
+      break;
+    case MsgType::OpsReply:
+      OnOpsReply(std::move(msg));
       break;
     default:
       Log::Error("RouteInbound: unhandled message type %d",

@@ -1,13 +1,21 @@
-"""Pallas TPU kernels for the hot ops.
+"""Hot-path kernels + the live ops/introspection plane.
 
-XLA's fusion covers most of this framework (the tables' gather/scatter
-paths, the updaters), but attention at long sequence length is the op
-worth hand-scheduling: the XLA path materializes the [B,H,T,T] score
-tensor in HBM, while the Pallas kernel streams K/V blocks through VMEM
-with float32 accumulators and never leaves on-chip memory — the flash
-attention recipe, tiled for the MXU.
+Two unrelated-but-cohabiting meanings of "ops", both hot paths:
+
+- **Kernel ops** — Pallas TPU kernels (:func:`flash_attention`): XLA's
+  fusion covers most of this framework, but attention at long sequence
+  length is worth hand-scheduling.
+- **Operations** — the live introspection plane
+  (docs/observability.md): :class:`OpsClient` scrapes any rank's
+  in-band ``/metrics`` + health + table stats over the anonymous serve
+  wire (``MsgType::OpsQuery``, answered at the reactor), and
+  :mod:`flight_recorder` keeps the bounded black-box ring that dumps
+  ``blackbox_rank<r>.json`` on failure triggers.
 """
 
 from .flash_attention import flash_attention
+from .flight_recorder import FlightRecorder, recorder
+from .introspect import OpsClient, parse_prometheus
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "OpsClient", "parse_prometheus",
+           "FlightRecorder", "recorder"]

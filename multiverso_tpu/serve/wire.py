@@ -24,8 +24,11 @@ Frame layout (one ``Message``, little-endian, matching
 Supported requests are the serve protocol: ``RequestVersion`` (header
 only, ``version=-1`` for the whole table), ``RequestGet`` (the server
 replies with ITS SHARD of the table — an anonymous client reading a
-sharded table contacts each server rank it cares about), and the
-server-side shed path answers either with ``ReplyBusy``.
+sharded table contacts each server rank it cares about), the
+server-side shed path answers either with ``ReplyBusy`` — plus the
+introspection scrape ``OpsQuery``/``OpsReply``
+(docs/observability.md): :meth:`AnonServeClient.ops_report` fetches
+Prometheus metrics / health / table stats, local- or fleet-scope.
 
 This module is pure stdlib + numpy so external tooling can vendor it.
 """
@@ -39,7 +42,7 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["AnonServeClient", "MSG", "pack_frame", "unpack_frame",
-           "HEADER"]
+           "HEADER", "OPS_SCOPE_LOCAL", "OPS_SCOPE_FLEET"]
 
 # WireHeader (mvtpu/message.h): 4 x int32, 3 x int64, 4 x int32.
 HEADER = struct.Struct("<4i3q4i")
@@ -53,7 +56,16 @@ MSG = {
     "RequestVersion": 8,
     "ReplyVersion": 9,
     "ReplyBusy": 10,
+    # Introspection plane (docs/observability.md): in-band scrape.  The
+    # request's first blob names the report kind; `version` carries the
+    # scope (OPS_SCOPE_LOCAL / OPS_SCOPE_FLEET).  Local-scope queries
+    # are answered AT THE REACTOR, never through the actor mailbox.
+    "OpsQuery": 23,
+    "OpsReply": 24,
 }
+
+OPS_SCOPE_LOCAL = 0
+OPS_SCOPE_FLEET = 1
 _TYPE_NAME = {v: k for k, v in MSG.items()}
 
 _ACCEPT_RAW = 1  # msgflag::kAcceptRaw
@@ -130,6 +142,20 @@ class AnonServeClient:
         reply = self.recv_reply()
         _check(reply, mid, "ReplyVersion")
         return reply["version"]
+
+    def ops_report(self, kind: str = "health", scope: int = 0) -> str:
+        """In-band introspection scrape (OpsQuery): returns the report
+        text — Prometheus exposition for ``kind="metrics"`` (exemplar
+        trace ids included), JSON for ``health``/``tables``.  With
+        ``scope=OPS_SCOPE_FLEET`` the contacted rank fans out to every
+        peer under a bounded deadline and merges, labeling series per
+        rank and explicitly marking silent ranks."""
+        mid = self._next_id()
+        self.send_raw(pack_frame(MSG["OpsQuery"], -1, mid, version=scope,
+                                 blobs=[kind.encode()]))
+        reply = self.recv_reply()
+        _check(reply, mid, "OpsReply")
+        return reply["blobs"][0].decode() if reply["blobs"] else ""
 
     def get_shard(self, table_id: int) -> np.ndarray:
         """Fetch the contacted rank's shard of an array table as

@@ -45,6 +45,7 @@ from .log import Log
 __all__ = [
     "SpanEvent", "enabled", "enable", "disable", "span", "record_span",
     "current_trace_id", "set_trace_id", "new_trace_id", "events",
+    "trace_ids",
     "clear", "to_chrome", "save", "merge_dir", "add_native_spans",
     "parse_native_spans", "default_trace_path",
 ]
@@ -161,6 +162,13 @@ def span(name: str, trace_id: Optional[int] = None,
 def events() -> List[SpanEvent]:
     with _LOCK:
         return list(_EVENTS)
+
+
+def trace_ids() -> set:
+    """Every distinct trace id in the buffer — the resolution set an
+    exemplar (docs/observability.md) must land in to be explainable."""
+    with _LOCK:
+        return {e.trace_id for e in _EVENTS if e.trace_id}
 
 
 # ---------------------------------------------------------------------------

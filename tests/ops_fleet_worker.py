@@ -1,0 +1,64 @@
+"""Fleet holder for the ops failure tests (not a pytest module).
+
+Run as ``python ops_fleet_worker.py <machine_file> <rank> <trace_dir>``:
+joins a 2-rank native epoll fleet with tracing, a FAST heartbeat lease
+(100 ms interval, 400 ms timeout) and fail-fast wire flags, does
+cross-rank table traffic (so spans + monitors exist), exports this
+rank's Chrome trace to ``<trace_dir>/trace_rank<r>.json``, prints
+``OPS_FLEET_READY`` — then HOLDS until a line arrives on stdin.
+
+The pytest side (tests/test_ops.py) SIGKILLs rank 1 while the fleet is
+held: rank 0's lease loop must mark the peer dead and the dead-peer
+flight-recorder trigger must dump ``blackbox_rank0.json`` — the test
+polls the file and scrapes rank 0's fleet view over an anonymous
+socket.  On release the worker exits via ``os._exit`` (a clean shutdown
+with a dead peer would just grind through every wire deadline — the
+state under test is already on disk).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from multiverso_tpu import native as nat  # noqa: E402
+from multiverso_tpu import tracing  # noqa: E402
+
+SIZE = 64
+
+
+def main() -> int:
+    mf, rank, trace_dir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    rt = nat.NativeRuntime(args=[
+        f"-machine_file={mf}", f"-rank={rank}", "-log_level=error",
+        "-trace=true", f"-trace_dir={trace_dir}",
+        "-heartbeat_ms=100", "-heartbeat_timeout_ms=400",
+        "-rpc_timeout_ms=5000", "-barrier_timeout_ms=10000",
+        "-connect_retry_ms=500", "-send_retries=0",
+        "-ops_fleet_timeout_ms=1000"])
+    assert rt.net_engine() == "epoll", rt.net_engine()
+    h = rt.new_array_table(SIZE)
+    rt.barrier()
+    for _ in range(3):
+        rt.array_add(h, np.ones(SIZE, np.float32))
+        rt.array_get(h, SIZE)
+    rt.barrier()
+    # Export the span ring NOW: the surviving rank's trace must exist
+    # before the chaos (a dead rank exports nothing — that is the point).
+    tracing.enable(rank=rank)
+    tracing.add_native_spans(rt)
+    tracing.save(os.path.join(trace_dir, f"trace_rank{rank}.json"))
+    print("OPS_FLEET_READY", flush=True)
+    sys.stdin.readline()          # held; the test may kill our sibling
+    print(f"OPS_FLEET_OK {rank}", flush=True)
+    sys.stdout.flush()
+    # Skip the native teardown: with a SIGKILLed peer, Zoo::Stop's
+    # barrier/flush legs would only burn their full deadlines.
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,11 +63,26 @@ def test_gate_passes_in_band_line(tmp_path):
     line = {"extras": {"transformer_large_mfu_pct": 57.0,
                        "wire_tcp_rtt_ms": 0.4,
                        "fanin_shed_rate": 0.8,
-                       "fanin_accepted": 1000.0}}
+                       "fanin_accepted": 1000.0,
+                       "ops_scrape_p99_ms": 2.5,
+                       "ops_overhead_pct": 0.3}}
     p = tmp_path / "ok.json"
     p.write_text("some log noise\n" + json.dumps(line) + "\n")
     rc, out = _gate("--line", str(p))
     assert rc == 0, out
+
+
+def test_gate_guards_ops_keys(tmp_path):
+    """bench_ops acceptance bars (docs/observability.md): scrape p99
+    past 5 ms or introspection overhead past 1% must fail the gate."""
+    line = {"extras": {"ops_scrape_p99_ms": 9.0,     # > 5 ms bar
+                       "ops_overhead_pct": 2.5}}     # > 1% bar
+    p = tmp_path / "ops_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "ops_scrape_p99_ms" in out and "FAIL" in out, out
+    assert "ops_overhead_pct" in out, out
 
 
 def test_last_parseable_line_wins(tmp_path):

@@ -243,6 +243,77 @@ def test_flush_thread_writes_prometheus_file(registry, tmp_path):
     assert "flush_me 3.0" in open(path).read()
 
 
+def test_flush_retarget_joins_previous_flusher(registry, tmp_path):
+    """start_flush must JOIN the previous flusher before starting a new
+    one: two live flushers interleave atomic-replace writes of the same
+    metrics_rank<r>.prom (the PR 3 teardown race, retarget flavor)."""
+    import threading
+    import time
+
+    from multiverso_tpu import metrics
+
+    registry.counter("retarget.me").inc()
+    slow_gate = threading.Event()
+    orig = metrics._Flusher.flush_once
+
+    def slow(self):
+        slow_gate.set()
+        time.sleep(0.25)
+        orig(self)
+
+    metrics._Flusher.flush_once = slow
+    try:
+        metrics.start_flush(5, path=str(tmp_path / "a.prom"))
+        first = metrics._FLUSHER
+        assert slow_gate.wait(5.0)           # first flusher is MID-FLUSH
+        metrics.start_flush(5, path=str(tmp_path / "b.prom"))
+        # The old thread must be dead BEFORE the retarget returned.
+        assert not first.is_alive()
+        assert metrics._FLUSHER is not first
+    finally:
+        metrics._Flusher.flush_once = orig
+        metrics.stop_flush()
+
+
+def test_stop_flush_final_flush_never_interleaves(registry, tmp_path):
+    """stop_flush joins the thread BEFORE running the final flush on the
+    caller — the shutdown-time file write can never overlap a flusher
+    mid-write (the `-metrics_flush_ms` teardown race)."""
+    import threading
+    import time
+
+    from multiverso_tpu import metrics
+
+    registry.counter("shutdown.me").inc(2)
+    path = str(tmp_path / "final.prom")
+    windows = []
+    orig = metrics._Flusher.flush_once
+
+    def traced(self):
+        t0 = time.monotonic()
+        time.sleep(0.2)                      # hold the write window open
+        orig(self)
+        windows.append((t0, time.monotonic(), threading.get_ident()))
+
+    metrics._Flusher.flush_once = traced
+    try:
+        metrics.start_flush(5, path=path)
+        deadline = time.monotonic() + 5.0
+        while not windows and time.monotonic() < deadline:
+            time.sleep(0.01)
+        metrics.stop_flush()                 # join THEN final flush
+    finally:
+        metrics._Flusher.flush_once = orig
+    assert windows, "flusher never ran"
+    # The final flush ran on the caller thread, and no two flush windows
+    # overlap — the interleaving the fix forbids.
+    assert windows[-1][2] == threading.get_ident()
+    ordered = sorted(windows)
+    for (_, end, _), (start, _, _) in zip(ordered, ordered[1:]):
+        assert start >= end, windows
+    assert "shutdown_me 2.0" in open(path).read()
+
+
 # ------------------------------------------------------------- chrome trace
 
 def test_chrome_trace_schema_and_merge(registry, tmp_path):
@@ -319,9 +390,12 @@ def test_native_bridge_one_call_enumeration(registry, native_rt):
     for op in ("ArrayWorker::Get", "ArrayWorker::Add",
                "ArrayServer::ProcessGet", "ArrayServer::ProcessAdd"):
         assert op in dump, sorted(dump)
-        count, total, vmax, buckets = dump[op]
+        count, total, vmax, buckets = dump[op][:4]
         assert count >= 1 and total >= 0.0 and vmax >= 0.0
         assert len(buckets) == 28 and sum(buckets) == count
+        # Trailing per-bucket exemplar field (docs/observability.md):
+        # present in current dumps, all-zero here (tracing off).
+        assert len(dump[op]) == 5 and len(dump[op][4]) == 28
     n = registry.bridge_native(native_rt)
     assert n >= len(dump) - 1            # dead_peers gauge not counted
     snap = registry.snapshot()

@@ -1,0 +1,383 @@
+"""Tier-1 gate for the live introspection plane (docs/observability.md):
+exemplar capture/parsing, the flight recorder (Python + native), the
+in-band OpsQuery wire protocol (local + fleet scope), and the
+fleet-scrape-under-failure contract — a SIGKILLed server rank must show
+up dead in the fleet snapshot within the lease window, the dead-peer
+trigger must dump a black box, and that dump's spans must correlate by
+trace id with the surviving rank's exported trace.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+# ------------------------------------------------------- prometheus parsing
+
+def test_parse_prometheus_values_and_exemplars():
+    from multiverso_tpu.ops.introspect import parse_prometheus
+
+    text = (
+        "# TYPE lat histogram\n"
+        'lat_bucket{le="0.001"} 3 # {trace_id="0x1002a"} 0.0009\n'
+        'lat_bucket{le="+Inf"} 4\n'
+        "lat_sum 0.005\n"
+        "lat_count 4\n"
+        'up{rank="1"} 1\n')
+    values, exemplars = parse_prometheus(text)
+    assert values['lat_bucket{le="0.001"}'] == 3.0
+    assert values['up{rank="1"}'] == 1.0
+    assert values["lat_count"] == 4.0
+    assert exemplars['lat_bucket{le="0.001"}']["trace_id"] == "0x1002a"
+    assert 'lat_bucket{le="+Inf"}' not in exemplars
+
+
+# ------------------------------------------------------- python exemplars
+
+@pytest.fixture()
+def registry():
+    from multiverso_tpu import metrics, tracing
+
+    metrics.reset()
+    tracing.disable()
+    tracing.clear()
+    yield metrics
+    metrics.reset()
+    tracing.disable()
+    tracing.clear()
+
+
+def test_histogram_exemplar_capture_and_quantile_link(registry):
+    """An observation inside a span stamps its trace id as the bucket's
+    exemplar; exemplar(q) returns the id of the quantile's bucket."""
+    from multiverso_tpu import tracing
+
+    tracing.enable(rank=0)
+    h = registry.histogram("t.lat", bounds=[1.0, 10.0, 100.0])
+    for _ in range(50):
+        h.observe(0.5)                       # no active span: no id
+    with tracing.span("slow.op") as tid:
+        h.observe(50.0)                      # the p99 bucket
+    assert tid != 0
+    assert h.exemplar(0.99) == tid
+    assert h.exemplar(0.50) == 0             # bulk bucket: never spanned
+    assert h.to_dict()["exemplar_p99"] == f"{tid:#x}"
+    # Explicit trace_id overrides the thread-local.
+    h.observe(500.0, trace_id=0xABC)
+    assert h.exemplar(1.0) == 0xABC
+
+
+def test_render_prometheus_exemplars_opt_in(registry):
+    """Exemplars render only on request (OpenMetrics suffix breaks
+    plain-Prometheus parsers, so the flush file stays vanilla)."""
+    h = registry.histogram("t.ex", bounds=[1.0])
+    h.observe(0.5, trace_id=0x77)
+    plain = registry.render_prometheus()
+    assert "trace_id" not in plain
+    rich = registry.render_prometheus(exemplars=True)
+    assert '# {trace_id="0x77"} 1.0' in rich
+    # Round-trips through the scrape parser.
+    from multiverso_tpu.ops.introspect import parse_prometheus
+
+    _, exemplars = parse_prometheus(rich)
+    assert exemplars['t_ex_bucket{le="1.0"}']["trace_id"] == "0x77"
+
+
+def test_parse_native_dump_exemplar_field(registry):
+    """The 5th tab field (per-bucket exemplars) is parsed when present
+    and optional when absent (pre-exemplar dumps)."""
+    buckets = ",".join(["1"] + ["0"] * 27)
+    exemplars = ",".join(["4242"] + ["0"] * 27)
+    new = f"op\t1\t0.5\t0.5\t{buckets}\t{exemplars}\n"
+    old = f"op\t1\t0.5\t0.5\t{buckets}\n"
+    got_new = registry.parse_native_dump(new)["op"]
+    got_old = registry.parse_native_dump(old)["op"]
+    assert len(got_new) == 5 and got_new[4][0] == 4242
+    assert len(got_old) == 4
+
+    class Stub:
+        def dump_monitors(self):
+            return registry.parse_native_dump(new)
+
+    registry.bridge_native(Stub())
+    h = registry.REGISTRY.histogram("native.op",
+                                    bounds=registry.NATIVE_TIME_BUCKETS)
+    assert h.exemplar(0.5) == 4242
+
+
+# ------------------------------------------------------- flight recorder
+
+def test_flight_recorder_dump_and_trace_correlation(registry, tmp_path):
+    from multiverso_tpu import config, tracing
+    from multiverso_tpu.ops.flight_recorder import FlightRecorder
+
+    tracing.enable(rank=3)
+    with tracing.span("doomed.op") as tid:
+        pass
+    config.set_flag("trace_dir", str(tmp_path))
+    try:
+        rec = FlightRecorder(max_events=4)
+        rec.attach(rank=3)
+        for i in range(10):                  # ring is bounded: newest win
+            rec.record("step", f"s{i}")
+        path = rec.trigger("unit_test_failure")
+        assert path == str(tmp_path / "blackbox_rank3.json")
+        doc = json.load(open(path))
+        assert doc["reason"] == "unit_test_failure"
+        assert doc["rank"] == 3
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds[-1] == "trigger" and len(doc["events"]) == 4
+        assert any(s["trace_id"] == f"{tid:#x}" for s in doc["spans"])
+        assert rec.triggers == 1
+    finally:
+        config.set_flag("trace_dir", "")
+
+
+def test_flight_recorder_no_trace_dir_records_only(registry):
+    from multiverso_tpu.ops.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder()
+    assert rec.trigger("nowhere-to-dump") is None
+    assert rec.events()[-1]["detail"] == "nowhere-to-dump"
+
+
+def test_checkpoint_corrupt_triggers_flight_recorder(registry, tmp_path):
+    """CheckpointCorrupt is a flight-recorder trigger: constructing one
+    (even on the tolerated restore-fallback path) dumps the box."""
+    from multiverso_tpu import config
+    from multiverso_tpu.checkpoint import CheckpointCorrupt
+    from multiverso_tpu.ops.flight_recorder import recorder
+
+    config.set_flag("trace_dir", str(tmp_path))
+    recorder.reset()
+    recorder.attach(rank=0)
+    try:
+        CheckpointCorrupt("ckpt.bin: CRC mismatch")
+        box = tmp_path / "blackbox_rank0.json"
+        assert box.exists()
+        doc = json.load(open(box))
+        assert doc["reason"].startswith("checkpoint_corrupt")
+    finally:
+        config.set_flag("trace_dir", "")
+        recorder.reset()
+
+
+# ------------------------------------------------------------ native plane
+
+@pytest.fixture()
+def native_rt(tmp_path):
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    rt = nat.NativeRuntime(args=["-log_level=error", "-trace=true",
+                                 f"-trace_dir={tmp_path}"])
+    yield rt
+    rt.shutdown()
+
+
+@needs_gxx
+def test_native_ops_report_kinds(native_rt):
+    import numpy as np
+
+    h = native_rt.new_array_table(16)
+    native_rt.array_add(h, np.ones(16, np.float32))
+    native_rt.array_get(h, 16)
+
+    health = json.loads(native_rt.ops_report("health"))
+    assert health["started"] and health["ready"] and health["healthy"]
+    assert health["engine"] == "local" and health["size"] == 1
+
+    tables = json.loads(native_rt.ops_report("tables"))
+    assert tables[0]["version"] >= 1
+    assert tables[0]["codec"] == "raw"
+    assert tables[0]["bucket_version_max"] >= \
+        tables[0]["bucket_version_min"]
+
+    metrics_text = native_rt.ops_report("metrics")
+    assert "ArrayServer::ProcessGet_bucket" in metrics_text
+    assert "trace_id=" in metrics_text      # exemplars (tracing armed)
+
+    err = json.loads(native_rt.ops_report("nonsense"))
+    assert "unknown ops kind" in err["error"]
+
+
+@needs_gxx
+def test_native_ops_host_metrics_push_wins(native_rt):
+    native_rt.set_ops_host_metrics("# TYPE pushed counter\npushed 7.0\n")
+    assert native_rt.ops_report("metrics").startswith("# TYPE pushed")
+    native_rt.set_ops_host_metrics("")
+    assert "pushed 7.0" not in native_rt.ops_report("metrics")
+
+
+@needs_gxx
+def test_native_blackbox_event_and_trigger(native_rt, tmp_path):
+    import numpy as np
+
+    h = native_rt.new_array_table(8)
+    native_rt.array_get(h, 8)
+    native_rt.blackbox_event("test", "before-the-crash")
+    native_rt.blackbox_trigger("unit-trigger")
+    doc = json.load(open(tmp_path / "blackbox_rank0.json"))
+    assert doc["reason"] == "unit-trigger"
+    assert any(e["kind"] == "test" and e["detail"] == "before-the-crash"
+               for e in doc["events"])
+    assert any(e["kind"] == "lifecycle" for e in doc["events"])
+    assert doc["spans"] and all("trace_id" in s for s in doc["spans"])
+    assert "ArrayWorker::Get" in doc["monitors"]
+
+
+# ------------------------------------------------------------- wire plane
+
+def _spawn_fleet(script, tmp_path, nranks=2, extra=()):
+    socks = [socket.socket() for _ in range(nranks)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = os.path.join(str(tmp_path), "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", script), mf,
+             str(r), *map(str, extra)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
+        for r in range(nranks)
+    ]
+    return eps, procs
+
+
+def _release(procs, marker, timeout=120):
+    outs = []
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.stdin.write("\n")
+                p.stdin.flush()
+            except (BrokenPipeError, OSError):
+                pass
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=timeout)[0])
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs.append(p.communicate()[0])
+    return outs
+
+
+@needs_gxx
+def test_wire_scrape_local_and_fleet(tmp_path):
+    """An anonymous socket scrapes one rank (local scope) and the whole
+    fleet (fleet scope: per-rank labels + explicit up markers)."""
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    from multiverso_tpu.ops.introspect import OpsClient
+
+    eps, procs = _spawn_fleet("epoll_serve_worker.py", tmp_path,
+                              extra=("-trace=true",))
+    try:
+        for p in procs:
+            assert "SERVE_READY" in p.stdout.readline()
+        with OpsClient(eps[0], timeout=15) as c:
+            health = c.health()
+            assert health["rank"] == 0 and health["size"] == 2
+            assert health["engine"] == "epoll"
+            values, _ = c.metrics(fleet=True)
+            assert values['mv_ops_rank_up{rank="0"}'] == 1.0
+            assert values['mv_ops_rank_up{rank="1"}'] == 1.0
+            assert any('rank="1"' in k and "_bucket" in k
+                       for k in values)
+            fleet = c.health(fleet=True)
+            assert fleet["silent"] == [] and fleet["dead"] == []
+            assert fleet["ranks"]["1"]["rank"] == 1
+            ft = c.fleet_tables()
+            assert ft["ranks"]["0"][0]["id"] == 0
+    finally:
+        outs = _release(procs, "SERVE_WORKER_OK")
+    for out in outs:
+        assert "SERVE_WORKER_OK" in out, out[-2000:]
+
+
+@needs_gxx
+def test_fleet_scrape_marks_killed_rank_dead_and_dumps_blackbox(tmp_path):
+    """The acceptance chaos path: SIGKILL a server rank mid-run — the
+    fleet snapshot marks it dead within the lease window, the dead-peer
+    trigger dumps blackbox_rank0.json, and the dump's spans correlate
+    by trace id with the surviving rank's exported trace."""
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    from multiverso_tpu.ops.introspect import OpsClient
+
+    eps, procs = _spawn_fleet("ops_fleet_worker.py", tmp_path,
+                              extra=(str(tmp_path),))
+    try:
+        for p in procs:
+            assert "OPS_FLEET_READY" in p.stdout.readline()
+
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait(timeout=30)
+
+        # Dead-peer trigger: the black box must land within the lease
+        # window (400 ms timeout + scan cadence; 15 s is generous).
+        box_path = os.path.join(str(tmp_path), "blackbox_rank0.json")
+        deadline = time.time() + 15
+        doc = None
+        while time.time() < deadline:
+            if os.path.exists(box_path):
+                try:
+                    doc = json.load(open(box_path))
+                    break
+                except ValueError:
+                    pass                      # mid-rename: retry
+            time.sleep(0.1)
+        assert doc is not None, "blackbox_rank0.json never appeared"
+        assert doc["reason"].startswith("dead_peer: rank 1"), doc["reason"]
+
+        # Fleet snapshot from the SURVIVOR: rank 1 dead + silent.
+        with OpsClient(eps[0], timeout=15) as c:
+            fleet = c.health(fleet=True)
+            assert fleet["dead"] == [1], fleet
+            assert fleet["silent"] == [1], fleet
+            assert fleet["ranks"]["1"] is None
+            assert fleet["ranks"]["0"]["healthy"] is False  # dead peer
+            values, _ = c.metrics(fleet=True)
+            assert values['mv_ops_rank_up{rank="1"}'] == 0.0
+            assert values['mv_ops_rank_dead{rank="1"}'] == 1.0
+
+        # mvtop's fleet table renders the corpse as an explicit row.
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import mvtop
+
+        rows = mvtop.collect([eps[0]], fleet=True, timeout=15)
+        by_rank = {r["rank"]: r for r in rows}
+        assert by_rank["1"]["up"] == "NO"
+        assert by_rank["0"]["up"] == "yes"
+
+        # Blackbox spans correlate with the surviving rank's trace.
+        trace = json.load(
+            open(os.path.join(str(tmp_path), "trace_rank0.json")))
+        trace_ids = {e["args"].get("trace_id")
+                     for e in trace["traceEvents"]} - {None}
+        box_ids = {s["trace_id"] for s in doc["spans"]} - {"0x0"}
+        assert box_ids & trace_ids, (sorted(box_ids)[:4],
+                                     sorted(trace_ids)[:4])
+    finally:
+        outs = _release(procs, "OPS_FLEET_OK")
+    assert any("OPS_FLEET_OK 0" in out for out in outs), outs[0][-2000:]

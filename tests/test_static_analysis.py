@@ -313,6 +313,73 @@ def test_mv009_repo_reactor_sources_are_marked():
     assert mvlint.lint_file(p) == []
 
 
+def test_mv010_fires_on_registry_bypass(tmp_path):
+    """Library code minting metric series outside the unified registry
+    (direct Counter/Gauge/Histogram construction) fires; the registry
+    accessors — and collections.Counter in unrelated code — do not."""
+    d = tmp_path / "multiverso_tpu"
+    d.mkdir()
+    src = """\
+        from collections import Counter
+        from multiverso_tpu import metrics
+        from multiverso_tpu.metrics import Histogram
+
+        def bad():
+            h = Histogram("rogue.latency")        # bypass: BAD
+            c = metrics.Counter("rogue.count")    # bypass: BAD
+            return h, c
+
+        def good(tokens):
+            h = metrics.histogram("app.latency")  # registry accessor
+            c = metrics.counter("app.count")
+            tally = Counter(tokens)               # collections.Counter
+            return h, c, tally
+        """
+    rules = _lint_src(d, src)
+    assert [r for r, _ in rules] == ["MV010", "MV010"], rules
+    # Outside library scope (tests, apps) the identical code is exempt.
+    assert _lint_src(d, src, name="test_snippet.py") == []
+    apps = d / "apps"
+    apps.mkdir()
+    assert _lint_src(apps, src) == []
+
+
+def test_mv010_fires_on_dropped_span_id(tmp_path):
+    """A span id captured with `as` but never propagated is an
+    observability bypass; using the id (native set_trace_id, a wire
+    stamp) or dropping the `as` clause silences the rule."""
+    d = tmp_path / "multiverso_tpu"
+    d.mkdir()
+    rules = _lint_src(d, """\
+        from multiverso_tpu import tracing
+
+        def bad(rt, h):
+            with tracing.span("op") as tid:       # id dropped: BAD
+                rt.get(h)
+
+        def good(rt, h):
+            with tracing.span("op") as tid:       # propagated: fine
+                rt.set_trace_id(tid)
+                rt.get(h)
+            with tracing.span("op2"):             # no binding: fine
+                rt.get(h)
+        """)
+    assert [r for r, _ in rules] == ["MV010"], rules
+
+
+def test_mv010_registry_itself_is_exempt(tmp_path):
+    """metrics.py constructs the classes it registers — exempt."""
+    d = tmp_path / "multiverso_tpu"
+    d.mkdir()
+    rules = _lint_src(d, """\
+        from multiverso_tpu.metrics import Histogram
+
+        def mint(name):
+            return Histogram(name)
+        """, name="metrics.py")
+    assert rules == [], rules
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

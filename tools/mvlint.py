@@ -76,6 +76,18 @@ Rules (docs/static_analysis.md has the full rationale):
   shard.  This is the one rule that lints C++ (line-level, not AST);
   the marker comment opts a file in.
 
+- **MV010 observability-bypass** — library code must feed the unified
+  observability plane (docs/observability.md), not route around it:
+  (a) instantiating ``metrics.Counter``/``Gauge``/``Histogram``
+  directly mints a series OUTSIDE the process registry — it never
+  reaches ``snapshot()``, the Prometheus flush, or the in-band
+  ``OpsQuery`` scrape; use ``metrics.counter()/gauge()/histogram()``.
+  (b) a ``with tracing.span(...) as tid:`` that never USES the bound id
+  captured a trace id only to drop it — the id exists to be propagated
+  (``NativeRuntime.set_trace_id``, a wire message header, a log line);
+  either propagate it or drop the ``as`` clause (nested spans inherit
+  the thread-local id without it).
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -471,6 +483,67 @@ def check_noncontiguous_ctypes(tree, path):
     return out
 
 
+# Registry-bypassing metric classes for MV010: direct instantiation
+# skips the process-global Registry, so the series is invisible to
+# snapshot()/Prometheus/the in-band ops scrape.
+METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+
+
+def check_observability_bypass(tree, path):
+    """MV010: metric series minted outside the registry, and span ids
+    captured but never propagated (library code only)."""
+    out = []
+    # (a) direct Counter/Gauge/Histogram construction.  Only names
+    # provably from the metrics module fire — collections.Counter in
+    # unrelated code must not.
+    imported = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom) and node.module
+                and node.module.split(".")[-1] == "metrics"):
+            for a in node.names:
+                if a.name in METRIC_CLASSES:
+                    imported.add(a.asname or a.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        direct = (isinstance(f, ast.Name) and f.id in imported)
+        attr = (isinstance(f, ast.Attribute) and f.attr in METRIC_CLASSES
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "metrics")
+        if direct or attr:
+            name = f.id if direct else f"metrics.{f.attr}"
+            out.append(Finding(
+                path, node.lineno, "MV010",
+                f"{name}(...) mints a series OUTSIDE the unified "
+                f"registry — it never reaches snapshot(), the "
+                f"Prometheus flush, or the in-band ops scrape; use "
+                f"metrics.{(f.attr if attr else f.id).lower()}() "
+                f"instead"))
+    # (b) `with span(...) as tid:` whose id is never used in the body.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            ce = item.context_expr
+            if not (isinstance(ce, ast.Call)
+                    and _call_name(ce.func) == "span"
+                    and isinstance(item.optional_vars, ast.Name)):
+                continue
+            var = item.optional_vars.id
+            used = any(isinstance(n, ast.Name) and n.id == var
+                       for stmt in node.body for n in ast.walk(stmt))
+            if not used:
+                out.append(Finding(
+                    path, item.context_expr.lineno, "MV010",
+                    f"span() binds its trace id to '{var}' but never "
+                    f"uses it — the id exists to be PROPAGATED (native "
+                    f"set_trace_id, a wire header, a log line); "
+                    f"propagate it or drop the `as` clause (nested "
+                    f"spans inherit the thread-local id)"))
+    return out
+
+
 # ---------------------------------------------------------------- MV009
 # Native reactor-context lint: the only non-Python rule.  A file opts in
 # with this marker (the epoll engine sources carry it); the rule then
@@ -570,6 +643,10 @@ def lint_file(path):
     if in_library:
         findings += check_print_in_library(tree, path)
         findings += check_unbounded_client_cache(tree, path)
+        # metrics.py IS the registry — it legitimately constructs the
+        # series classes it registers.
+        if os.path.basename(path) != "metrics.py":
+            findings += check_observability_bypass(tree, path)
     # Per-line suppressions.
     lines = src.splitlines()
     kept = []

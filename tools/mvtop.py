@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""mvtop — live fleet introspection CLI (docs/observability.md).
+
+Polls every rank of a running fleet over the ANONYMOUS serve wire
+(``MsgType::OpsQuery`` — answered at the epoll reactor, so even a rank
+whose server actor is drowning still reports) and renders one table row
+per rank: health verdict, serve queue depth vs the shed bound, live
+anonymous clients/sheds, heartbeat-lease dead peers, table versions, and
+blackbox trigger count.
+
+Usage::
+
+    python tools/mvtop.py HOST:PORT [HOST:PORT ...]       # one snapshot
+    python tools/mvtop.py HOST:PORT --fleet               # rank fans out
+    python tools/mvtop.py HOST:PORT ... --watch 2         # refresh loop
+    python tools/mvtop.py HOST:PORT --metrics [--fleet]   # raw Prometheus
+
+``--fleet`` asks the FIRST endpoint to aggregate the whole fleet
+server-side (bounded deadline; silent ranks are explicit rows), so a
+monitoring box needs reachability to one rank only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from multiverso_tpu.ops.introspect import OpsClient  # noqa: E402
+
+_COLS = ("rank", "up", "healthy", "engine", "queue", "max", "clients",
+         "shed", "dead", "tables", "vmax", "agg", "boxes")
+
+
+def _row_from_health(rank: str, h: dict, tables: list) -> dict:
+    vmax = max((t.get("version", 0) or 0 for t in tables), default=0)
+    agg = sum(t.get("agg_pending", 0) or 0 for t in tables)
+    return {
+        "rank": rank,
+        "up": "yes",
+        "healthy": "yes" if h.get("healthy") else "NO",
+        "engine": h.get("engine", "?"),
+        "queue": h.get("serve_queue_depth", 0),
+        "max": h.get("server_inflight_max", 0),
+        "clients": h.get("clients", 0),
+        "shed": h.get("client_shed", 0),
+        "dead": ",".join(map(str, h.get("dead_peers", []))) or "-",
+        "tables": len(tables),
+        "vmax": vmax,
+        "agg": agg,
+        "boxes": h.get("blackbox_triggers", 0),
+    }
+
+
+def _dead_row(rank: str) -> dict:
+    row = {c: "-" for c in _COLS}
+    row.update({"rank": rank, "up": "NO", "healthy": "NO"})
+    return row
+
+
+def collect(endpoints: list, fleet: bool, timeout: float) -> list:
+    rows = []
+    if fleet:
+        with OpsClient(endpoints[0], timeout=timeout) as c:
+            fh = c.health(fleet=True)
+            ft = c.fleet_tables()
+        silent = set(map(str, fh.get("silent", [])))
+        for rank in sorted(fh.get("ranks", {}), key=int):
+            h = fh["ranks"][rank]
+            if rank in silent or h is None:
+                rows.append(_dead_row(rank))
+                continue
+            tables = (ft.get("ranks", {}) or {}).get(rank) or []
+            rows.append(_row_from_health(rank, h, tables))
+        for rank in map(str, fh.get("dead", [])):
+            for row in rows:
+                if row["rank"] == rank and row["up"] == "yes":
+                    row["healthy"] = "NO(lease)"
+        return rows
+    for ep in endpoints:
+        try:
+            with OpsClient(ep, timeout=timeout) as c:
+                h = c.health()
+                tables = c.tables()
+            rows.append(_row_from_health(h.get("rank", ep), h, tables))
+        except (ConnectionError, OSError, TimeoutError):
+            rows.append(_dead_row(ep))
+    return rows
+
+
+def render(rows: list) -> str:
+    widths = {c: max(len(c), *(len(str(r[c])) for r in rows))
+              if rows else len(c) for c in _COLS}
+    out = ["  ".join(c.rjust(widths[c]) for c in _COLS)]
+    for r in rows:
+        out.append("  ".join(str(r[c]).rjust(widths[c]) for c in _COLS))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("endpoints", nargs="+", metavar="HOST:PORT")
+    ap.add_argument("--fleet", action="store_true",
+                    help="ask the first endpoint to aggregate the fleet "
+                         "server-side (silent ranks become explicit rows)")
+    ap.add_argument("--metrics", action="store_true",
+                    help="print the raw Prometheus exposition instead of "
+                         "the table")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SEC",
+                    help="refresh every SEC seconds until interrupted")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    while True:
+        if args.metrics:
+            with OpsClient(args.endpoints[0], timeout=args.timeout) as c:
+                print(c.metrics_text(fleet=args.fleet))
+        else:
+            rows = collect(args.endpoints, args.fleet, args.timeout)
+            stamp = time.strftime("%H:%M:%S")
+            print(f"mvtop @ {stamp} — {len(rows)} rank(s)")
+            print(render(rows))
+        if args.watch <= 0:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""ops-demo — acceptance smoke for the live introspection plane
+(docs/observability.md; ``make ops-demo``).
+
+Spawns a TWO-RANK native fleet (epoll engine, tracing armed) and drives
+an ANONYMOUS scraper against rank 0's listen port — the introspection
+plane is served in-band over the same wire the serve tier speaks:
+
+(a) **Fleet scrape** — one ``OpsQuery(scope=fleet)`` to rank 0 returns a
+    Prometheus snapshot whose every series carries a per-rank label
+    (``rank="0"`` AND ``rank="1"``) plus explicit
+    ``mv_ops_rank_up`` markers; fleet health JSON reports both ranks.
+(b) **Flight recorder** — an injected barrier timeout on rank 0 dumps
+    ``blackbox_rank0.json`` whose spans share trace ids with the merged
+    Chrome trace (the black box is EXPLAINABLE, not just a log).
+(c) **Exemplars** — a scraped p99-bucket exemplar trace id resolves in
+    that same merged trace.
+
+Prints ``OPS_DEMO_OK`` and exits 0 on success.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    from multiverso_tpu import native as nat
+    from multiverso_tpu.ops.introspect import OpsClient
+
+    nat.ensure_built()
+    socks = [socket.socket() for _ in range(2)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    tmp = tempfile.mkdtemp(prefix="mvtpu_ops_")
+    mf = os.path.join(tmp, "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+    trace_dir = os.path.join(tmp, "traces")
+    os.makedirs(trace_dir)
+
+    worker = os.path.join(REPO, "multiverso_tpu", "apps",
+                          "ops_demo_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, mf, str(r), trace_dir],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
+        for r in range(2)
+    ]
+    try:
+        for p in procs:
+            line = p.stdout.readline()
+            assert "OPS_READY" in line, line
+
+        # ---- (a) fleet scrape with per-rank labels -------------------
+        with OpsClient(eps[0], timeout=15) as c:
+            fleet_health = c.health(fleet=True)
+            values, exemplars = c.metrics(fleet=True)
+        assert fleet_health["silent"] == [], fleet_health
+        assert set(fleet_health["ranks"]) == {"0", "1"}, fleet_health
+        r0 = [k for k in values if 'rank="0"' in k]
+        r1 = [k for k in values if 'rank="1"' in k]
+        assert r0 and r1, (len(r0), len(r1))
+        assert values.get('mv_ops_rank_up{rank="0"}') == 1.0, values
+        assert values.get('mv_ops_rank_up{rank="1"}') == 1.0, values
+        print(f"fleet scrape: {len(values)} series, "
+              f"{len(r0)}/{len(r1)} labeled rank 0/1, no silent ranks")
+
+        # ---- (c) an exemplar on a served-latency histogram bucket ----
+        assert exemplars, "no exemplar trace ids in the fleet scrape"
+        exemplar_ids = {ex["trace_id"] for ex in exemplars.values()
+                        if "trace_id" in ex}
+        assert exemplar_ids, exemplars
+        print(f"exemplars: {len(exemplars)} bucket(s) carry trace ids "
+              f"({len(exemplar_ids)} distinct)")
+
+        # ---- (b) injected barrier timeout -> black box ---------------
+        for p in procs:
+            p.stdin.write("\n")
+            p.stdin.flush()
+        outs = []
+        for p in procs:
+            outs.append(p.communicate(timeout=300)[0])
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            if p.returncode != 0 or f"OPS_WORKER_OK {r}" not in out:
+                print(out[-3000:])
+                print(f"OPS_DEMO_FAIL: rank {r} rc={p.returncode}")
+                return 1
+        assert "BLACKBOX_DUMPED" in outs[0]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    box_path = os.path.join(trace_dir, "blackbox_rank0.json")
+    box = json.load(open(box_path))
+    assert box["reason"].startswith("barrier_timeout"), box["reason"]
+    assert box["spans"], "black box carries no spans"
+
+    from multiverso_tpu import tracing
+
+    merged = tracing.merge_dir(trace_dir)
+    mdoc = json.load(open(merged))
+    trace_ids = {e["args"].get("trace_id")
+                 for e in mdoc["traceEvents"]} - {None}
+    assert trace_ids, "merged trace carries no trace ids"
+
+    box_ids = {s["trace_id"] for s in box["spans"]} - {"0x0"}
+    shared = box_ids & trace_ids
+    assert shared, (sorted(box_ids)[:4], sorted(trace_ids)[:4])
+    print(f"black box: {box['reason'].split(':')[0]} dump with "
+          f"{len(box['spans'])} span(s); {len(shared)} trace id(s) "
+          f"shared with the merged Chrome trace")
+
+    resolved = exemplar_ids & trace_ids
+    assert resolved, (sorted(exemplar_ids)[:4], sorted(trace_ids)[:4])
+    print(f"exemplar resolution: {len(resolved)}/{len(exemplar_ids)} "
+          f"scraped exemplar id(s) resolve in the merged trace")
+
+    print("OPS_DEMO_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
