@@ -93,6 +93,20 @@ ops-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/ops_demo.py
 
+# Workload observability smoke (docs/observability.md, workload plane):
+# a 2-rank fleet + anonymous herd — zipf(1.0) row stream surfaces every
+# planted hot key in the top-K sketch with a bucket-load skew ratio
+# > 3x the uniform control's, a NaN-poisoned add dumps
+# blackbox_rank0.json naming the table, and stamped worker gets leave
+# an observed-staleness histogram.
+skew-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/skew_demo.py
+
+# Demo umbrella: every acceptance smoke in sequence (each target builds
+# the native runtime once; later builds are no-ops).
+demos: metrics-demo serve-demo wire-demo fanin-demo ops-demo skew-demo
+
 # Continuous perf gate (docs/PERF.md): diff the newest bench JSON line
 # against the committed BENCH_BASELINE.json with per-key noise bands;
 # exits nonzero on an out-of-band regression (serve p50, wire RTT,
@@ -104,4 +118,5 @@ clean:
 	$(MAKE) -C $(NATIVE) clean
 
 .PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
-        serve-demo wire-demo fanin-demo ops-demo bench-gate clean
+        serve-demo wire-demo fanin-demo ops-demo skew-demo demos \
+        bench-gate clean

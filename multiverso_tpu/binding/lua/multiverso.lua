@@ -88,6 +88,13 @@ char* MV_OpsReport(const char* kind);
 int MV_SetOpsHostMetrics(const char* prom_text);
 int MV_BlackboxEvent(const char* kind, const char* detail);
 int MV_BlackboxTrigger(const char* reason);
+char* MV_HotKeys(int32_t handle);
+int MV_TableLoadStats(int32_t handle, long long* gets, long long* adds,
+                      double* skew_ratio, double* add_l2,
+                      double* add_linf, long long* nan_count,
+                      long long* inf_count);
+int MV_SetHotKeyTracking(int on);
+char* MV_OpsFleetReport(const char* kind);
 ]]
 
 -- libmvtpu.so sits two directories up from this file (native/build/).
@@ -295,6 +302,47 @@ end
 
 function mv.blackbox_trigger(reason)
   check(C.MV_BlackboxTrigger(reason), "MV_BlackboxTrigger")
+end
+
+--- Workload plane (docs/observability.md): per-table hot-key / load
+--- report as a JSON string (the in-band "hotkeys" OpsQuery payload).
+--- handle >= 0 restricts to one table; nil/-1 reports every table.
+function mv.hot_keys(handle)
+  local p = C.MV_HotKeys(handle or -1)
+  local text = ffi.string(p)
+  C.MV_FreeString(p)
+  return text
+end
+
+--- Numeric workload slice for one table: gets, adds, skew_ratio,
+--- add_l2, add_linf, nan_count, inf_count.
+function mv.table_load_stats(handle)
+  local g = ffi.new("long long[1]")
+  local a = ffi.new("long long[1]")
+  local sk = ffi.new("double[1]")
+  local l2 = ffi.new("double[1]")
+  local li = ffi.new("double[1]")
+  local nn = ffi.new("long long[1]")
+  local inf = ffi.new("long long[1]")
+  check(C.MV_TableLoadStats(handle, g, a, sk, l2, li, nn, inf),
+        "MV_TableLoadStats")
+  return tonumber(g[0]), tonumber(a[0]), tonumber(sk[0]),
+         tonumber(l2[0]), tonumber(li[0]), tonumber(nn[0]),
+         tonumber(inf[0])
+end
+
+--- Toggle the workload accounting live (boot value: -hotkey_enabled).
+function mv.set_hotkey_tracking(on)
+  check(C.MV_SetHotKeyTracking(on and 1 or 0), "MV_SetHotKeyTracking")
+end
+
+--- Fleet-scope ops report assembled by THIS rank over the rank wire
+--- (works on every engine, anonymous ingress or not).
+function mv.ops_fleet_report(kind)
+  local p = C.MV_OpsFleetReport(kind or "health")
+  local text = ffi.string(p)
+  C.MV_FreeString(p)
+  return text
 end
 
 -- Shared async-get handle (MV_GetAsync* wait tickets): wait() joins the

@@ -208,6 +208,18 @@ define_double("coalesce_window_us", 200.0,
 define_int("serve_max_batch", 64,
            "size cap per coalescing window — a full batch seals (and "
            "executes) early")
+# --- workload observability (docs/observability.md) ------------------------
+define_bool("hotkey_enabled", True,
+            "per-table workload accounting: hot-key sketches "
+            "(space-saving top-K + count-min), per-bucket get/add load "
+            "counters and the skew ratio they expose.  Native-flag "
+            "parity: the server hot path carries the same switch; False "
+            "reduces every hook to one boolean check")
+define_int("hotkey_topk", 16,
+           "capacity of the space-saving top-K hot-key sketch per table "
+           "(memory bound; every key with frequency > total/K is "
+           "guaranteed monitored)")
+
 define_double("version_lease_ms", 50.0,
               "how long a learned server version stays trusted before "
               "a cached read pays a header-only version probe; 0 = "

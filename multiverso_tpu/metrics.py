@@ -37,7 +37,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
     "counter", "gauge", "histogram", "snapshot", "render_prometheus",
     "reset", "bridge_native", "start_flush", "stop_flush", "set_ops_push",
-    "NATIVE_TIME_BUCKETS", "DEFAULT_TIME_BUCKETS",
+    "record_history", "rate", "delta", "history",
+    "NATIVE_TIME_BUCKETS", "DEFAULT_TIME_BUCKETS", "HISTORY_SNAPSHOTS",
 ]
 
 # Mirror of the native Dashboard's fixed log2 latency buckets
@@ -51,8 +52,17 @@ DEFAULT_TIME_BUCKETS = NATIVE_TIME_BUCKETS
 # A labeled metric name may not explode into unbounded series (a bug
 # that labels by value — row id, msg id — would OOM the registry);
 # beyond the cap new label sets collapse into one overflow series.
+# Per-key/per-row accounting belongs in a bounded sketch
+# (multiverso_tpu/sketch.py), never in registry labels — mvlint MV011
+# polices the call sites.
 MAX_SERIES_PER_NAME = 256
 _OVERFLOW_LABELS = (("overflow", "true"),)
+
+# Bounded per-series time-series ring: the last N history snapshots
+# (one per record_history() call — the flush thread takes one each
+# interval), enabling rate()/delta() queries so QPS / shed-rate /
+# bytes-per-second are first-class instead of eyeball-the-counter.
+HISTORY_SNAPSHOTS = 64
 
 
 def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
@@ -298,10 +308,15 @@ class Registry:
         self._lock = threading.Lock()
         self._series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Any] = {}
         self._per_name: Dict[str, int] = {}
+        # Time-series ring: series key -> deque[(ts, value)], capped at
+        # HISTORY_SNAPSHOTS — bounded by construction (one deque per
+        # live series, N points each).
+        self._history: Dict[str, Any] = {}
 
     def _get(self, cls, name: str, labels: Optional[Dict[str, str]],
              **kwargs: Any):
         key = _label_key(labels)
+        overflowed = False
         with self._lock:
             s = self._series.get((name, key))
             if s is not None:
@@ -311,14 +326,33 @@ class Registry:
                 return s
             if key and self._per_name.get(name, 0) >= MAX_SERIES_PER_NAME:
                 # Cardinality guard: collapse, don't grow without bound.
+                overflowed = True
+                dropped = key
                 key = _OVERFLOW_LABELS
                 s = self._series.get((name, key))
-                if s is not None:
-                    return s
-            s = cls(name, key, **kwargs)
-            self._series[(name, key)] = s
-            self._per_name[name] = self._per_name.get(name, 0) + 1
-            return s
+            if s is None:
+                s = cls(name, key, **kwargs)
+                self._series[(name, key)] = s
+                self._per_name[name] = self._per_name.get(name, 0) + 1
+        if overflowed:
+            # The overflow series alone is a memoryless snapshot — a
+            # post-mortem of a cardinality explosion needs the EVENT,
+            # so it also lands in the flight-recorder ring (and dumps
+            # with the next black box).
+            self._note_overflow(name, dropped)
+        return s
+
+    @staticmethod
+    def _note_overflow(name: str, dropped_key) -> None:
+        try:
+            from .ops.flight_recorder import recorder
+
+            recorder.record(
+                "metric_overflow", name,
+                dropped_labels=_series_name("", dropped_key) or "{}",
+                cap=MAX_SERIES_PER_NAME)
+        except Exception as exc:  # recording must never break a metric
+            Log.error("metrics: overflow flight-record failed: %s", exc)
 
     def counter(self, name: str,
                 labels: Optional[Dict[str, str]] = None) -> Counter:
@@ -349,6 +383,77 @@ class Registry:
         with self._lock:
             self._series.clear()
             self._per_name.clear()
+            self._history.clear()
+
+    # -- time-series ring (docs/observability.md, workload plane) --------
+    def record_history(self, now: Optional[float] = None) -> int:
+        """Append one ``(ts, value)`` point per series to the bounded
+        ring (counters/gauges record their value; histograms record
+        ``<name>_count`` and ``<name>_sum`` series so both event rates
+        and e.g. bytes/s are queryable).  The flush thread calls this
+        each interval; tests/tools may call it directly.  Returns the
+        number of points recorded."""
+        import collections
+
+        ts = time.monotonic() if now is None else float(now)
+        points = []
+        for s in self.series():
+            key = _series_name(s.name, _label_key(s.labels))
+            if isinstance(s, Histogram):
+                points.append((key + "_count", float(s.count)))
+                points.append((key + "_sum", float(s.sum)))
+            else:
+                points.append((key, float(s.value)))
+        with self._lock:
+            for key, v in points:
+                ring = self._history.get(key)
+                if ring is None:
+                    ring = collections.deque(maxlen=HISTORY_SNAPSHOTS)
+                    self._history[key] = ring
+                ring.append((ts, v))
+        return len(points)
+
+    def history(self, name: str,
+                labels: Optional[Dict[str, str]] = None) -> list:
+        """The recorded ``[(ts, value)]`` ring for one series (the
+        ``<name>_count`` / ``<name>_sum`` histogram-derived names work
+        too — an unlabeled name passes through unchanged)."""
+        key = _series_name(name, _label_key(labels))
+        with self._lock:
+            ring = self._history.get(key)
+            return list(ring) if ring else []
+
+    def delta(self, name: str, labels: Optional[Dict[str, str]] = None,
+              n: int = 1) -> float:
+        """Value change over the last ``n`` recorded intervals (0.0
+        with fewer than two points)."""
+        pts = self.history(name, labels)
+        if len(pts) < 2:
+            return 0.0
+        lo = max(0, len(pts) - 1 - max(1, int(n)))
+        return pts[-1][1] - pts[lo][1]
+
+    def rate(self, name: str, labels: Optional[Dict[str, str]] = None,
+             window_s: Optional[float] = None) -> float:
+        """Per-second rate over the recorded window: (last - first)
+        / elapsed, where "first" is the oldest point inside
+        ``window_s`` (or the whole ring).  0.0 with fewer than two
+        points or zero elapsed — a counter that never moved is a zero
+        rate, not a NaN."""
+        pts = self.history(name, labels)
+        if len(pts) < 2:
+            return 0.0
+        t_last, v_last = pts[-1]
+        first = pts[0]
+        if window_s is not None:
+            for p in pts:
+                if t_last - p[0] <= window_s:
+                    first = p
+                    break
+        t_first, v_first = first
+        if t_last <= t_first:
+            return 0.0
+        return (v_last - v_first) / (t_last - t_first)
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         """Every series as plain data, keyed ``name`` or ``name{k="v"}``."""
@@ -456,6 +561,30 @@ def render_prometheus(exemplars: bool = False) -> str:
     return REGISTRY.render_prometheus(exemplars=exemplars)
 
 
+def record_history(now: Optional[float] = None) -> int:
+    """Take one time-series snapshot of every series (see
+    :meth:`Registry.record_history`); the flush thread does this each
+    interval automatically."""
+    return REGISTRY.record_history(now)
+
+
+def rate(name: str, labels: Optional[Dict[str, str]] = None,
+         window_s: Optional[float] = None) -> float:
+    """Per-second rate of a series over the recorded history window."""
+    return REGISTRY.rate(name, labels, window_s)
+
+
+def delta(name: str, labels: Optional[Dict[str, str]] = None,
+          n: int = 1) -> float:
+    """Value change over the last ``n`` recorded intervals."""
+    return REGISTRY.delta(name, labels, n)
+
+
+def history(name: str, labels: Optional[Dict[str, str]] = None) -> list:
+    """The recorded ``[(ts, value)]`` ring for one series."""
+    return REGISTRY.history(name, labels)
+
+
 def reset() -> None:
     """Drop every series AND stop the flush thread (test isolation)."""
     stop_flush()
@@ -551,6 +680,10 @@ class _Flusher(threading.Thread):
 
     def flush_once(self) -> None:
         try:
+            # One time-series point per flush: the ring holds the last
+            # HISTORY_SNAPSHOTS flush snapshots, so rate()/delta() span
+            # roughly interval_s * HISTORY_SNAPSHOTS of history.
+            record_history()
             if self.path:
                 from .io.stream import LocalStream
 

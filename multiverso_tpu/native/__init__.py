@@ -164,6 +164,22 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_BlackboxEvent.restype = ctypes.c_int
     lib.MV_BlackboxTrigger.argtypes = [ctypes.c_char_p]
     lib.MV_BlackboxTrigger.restype = ctypes.c_int
+    lib.MV_HotKeys.argtypes = [ctypes.c_int32]
+    lib.MV_HotKeys.restype = ctypes.c_void_p
+    lib.MV_TableLoadStats.argtypes = [
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong)]
+    lib.MV_TableLoadStats.restype = ctypes.c_int
+    lib.MV_SetHotKeyTracking.argtypes = [ctypes.c_int]
+    lib.MV_SetHotKeyTracking.restype = ctypes.c_int
+    lib.MV_OpsFleetReport.argtypes = [ctypes.c_char_p]
+    lib.MV_OpsFleetReport.restype = ctypes.c_void_p
     lib.MV_SetFault.argtypes = [ctypes.c_char_p, ctypes.c_double]
     lib.MV_SetFault.restype = ctypes.c_int
     lib.MV_SetFaultN.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
@@ -517,6 +533,56 @@ class NativeRuntime:
 
     def clear_spans(self) -> None:
         self._check(self.lib.MV_ClearSpans(), "MV_ClearSpans")
+
+    # --------------------------------------------- workload observability
+    def hot_keys(self, handle: int = -1) -> list:
+        """Per-table hot-key / shard-load report (docs/observability.md,
+        the ``"hotkeys"`` OpsQuery kind): for each server table, get/add
+        totals, bucket-load skew ratio, space-saving top-K hot keys with
+        count-min estimates, observed-staleness stats, and the add
+        L2/Linf + NaN/Inf health sentinels.  ``handle >= 0`` restricts
+        to one table."""
+        import json
+
+        return json.loads(self._dump_string(
+            lambda: self.lib.MV_HotKeys(handle), "MV_HotKeys"))
+
+    def table_load_stats(self, handle: int) -> dict:
+        """Numeric workload slice for one table: ``{"gets", "adds",
+        "skew_ratio", "add_l2", "add_linf", "nan_count", "inf_count"}``
+        (MV_TableLoadStats)."""
+        gets = ctypes.c_longlong(0)
+        adds = ctypes.c_longlong(0)
+        skew = ctypes.c_double(0.0)
+        l2 = ctypes.c_double(0.0)
+        linf = ctypes.c_double(0.0)
+        nans = ctypes.c_longlong(0)
+        infs = ctypes.c_longlong(0)
+        self._check(self.lib.MV_TableLoadStats(
+            handle, ctypes.byref(gets), ctypes.byref(adds),
+            ctypes.byref(skew), ctypes.byref(l2), ctypes.byref(linf),
+            ctypes.byref(nans), ctypes.byref(infs)), "MV_TableLoadStats")
+        return {"gets": gets.value, "adds": adds.value,
+                "skew_ratio": skew.value, "add_l2": l2.value,
+                "add_linf": linf.value, "nan_count": nans.value,
+                "inf_count": infs.value}
+
+    def set_hotkey_tracking(self, on: bool = True) -> None:
+        """Toggle the workload accounting live (boot value: the
+        ``-hotkey_enabled`` flag).  Disarmed, every server hot-path hook
+        is a single relaxed atomic check — the A/B behind the
+        ``hotkey_track_overhead_pct`` bench bar."""
+        self._check(self.lib.MV_SetHotKeyTracking(1 if on else 0),
+                    "MV_SetHotKeyTracking")
+
+    def ops_fleet_report(self, kind: str = "health") -> str:
+        """Fleet-scope ops report assembled BY THIS RANK over the rank
+        wire (bounded fan-out + merge) — works on every engine,
+        including the blocking tcp engine that refuses anonymous
+        scraper connections."""
+        return self._dump_string(
+            lambda: self.lib.MV_OpsFleetReport(kind.encode()),
+            "MV_OpsFleetReport")
 
     # ------------------------------------------------- fault injection
     def set_fault(self, kind: str, rate: float) -> None:

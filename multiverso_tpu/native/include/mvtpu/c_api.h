@@ -158,6 +158,35 @@ int MV_SetOpsHostMetrics(const char* prom_text);
 int MV_BlackboxEvent(const char* kind, const char* detail);
 int MV_BlackboxTrigger(const char* reason);
 
+// ---- workload observability (docs/observability.md) ------------------
+// Per-table hot-key / shard-load report as JSON — the same payload the
+// in-band `"hotkeys"` OpsQuery kind serves: for each server table,
+// get/add totals, per-bucket load skew (max bucket / mean bucket),
+// space-saving top-K hot keys with count-min estimates, observed-
+// staleness stats, and the add L2/Linf + NaN/Inf health sentinels.
+// handle >= 0 restricts to one table; < 0 reports every table.
+// malloc'd; caller frees with MV_FreeString.
+char* MV_HotKeys(int32_t handle);
+// Numeric slice of the same accounting for one table (any output
+// pointer may be NULL): served gets/adds, bucket-load skew ratio, the
+// accumulated add L2 norm / max |element|, and NaN/Inf counts.  rc 0,
+// -1 not started, -2 bad handle or no local shard on this rank.
+int MV_TableLoadStats(int32_t handle, long long* gets, long long* adds,
+                      double* skew_ratio, double* add_l2,
+                      double* add_linf, long long* nan_count,
+                      long long* inf_count);
+// Toggle the workload accounting live (the `-hotkey_enabled` flag is
+// the boot-time value): disarmed, every hot-path hook is one relaxed
+// atomic check — the armed-vs-disarmed A/B behind the bench_skew
+// overhead bar.
+int MV_SetHotKeyTracking(int on);
+// Fleet-scope ops report assembled BY THIS RANK over the rank wire
+// (the same bounded fan-out + merge an inbound fleet OpsQuery runs) —
+// works on every engine, including the blocking tcp engine that
+// refuses anonymous scraper connections.  kind: "metrics" | "health" |
+// "tables" | "hotkeys".  malloc'd; caller frees with MV_FreeString.
+char* MV_OpsFleetReport(const char* kind);
+
 // ---- serve layer (docs/serving.md) -----------------------------------
 // Version probe: one header-only round trip filling *version with the
 // max CURRENT version over every server shard of the table — the cheap

@@ -11,7 +11,9 @@
 //    flusher, which already bridges every native monitor), in which
 //    case the pushed superset is served.  "health" and "tables" are
 //    JSON built by the Zoo (queue depth vs -server_inflight_max, lease
-//    state, per-table version/spread/codec/agg depth).
+//    state, per-table version/spread/codec/agg depth); "hotkeys" is the
+//    workload plane (hot-key top-K + count-min estimates, bucket-load
+//    skew, observed staleness, add-health sentinels).
 //  - BuildReply(query, reply): wraps LocalReport into an OpsReply
 //    message (local scope only — fleet scope is Zoo::HandleOpsQuery's
 //    bounded fan-out).
@@ -37,7 +39,8 @@ namespace ops {
 // Python metrics flusher pushes via MV_SetOpsHostMetrics.
 void SetHostMetrics(const std::string& prom_text);
 
-// This rank's report for `kind` ("metrics" | "health" | "tables").
+// This rank's report for `kind` ("metrics" | "health" | "tables" |
+// "hotkeys").
 // Unknown kinds return a one-line JSON error instead of failing — a
 // scraper probing a newer protocol must not kill the connection.
 std::string LocalReport(const std::string& kind);

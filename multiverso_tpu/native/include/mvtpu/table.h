@@ -21,6 +21,7 @@
 #include "mvtpu/codec.h"
 #include "mvtpu/message.h"
 #include "mvtpu/mutex.h"
+#include "mvtpu/sketch.h"
 #include "mvtpu/stream.h"
 #include "mvtpu/updater.h"
 #include "mvtpu/waiter.h"
@@ -57,6 +58,8 @@ class ServerTable {
  public:
   ServerTable() {
     for (auto& b : bucket_versions_) b.store(0, std::memory_order_relaxed);
+    for (auto& b : bucket_gets_) b.store(0, std::memory_order_relaxed);
+    for (auto& b : bucket_adds_) b.store(0, std::memory_order_relaxed);
   }
   virtual ~ServerTable() = default;
   // Fill reply blobs for a get request.
@@ -81,6 +84,75 @@ class ServerTable {
     return bucket_versions_[b].load(std::memory_order_acquire);
   }
 
+  // ---- workload observability (docs/observability.md) ----------------
+  // Data-plane accounting beside the version plumbing: per-bucket
+  // get/add load counters (skew = max bucket / mean bucket), a top-K /
+  // count-min hot-key tracker, an observed-staleness histogram, and
+  // update-health sentinels (add L2/Linf accumulators, NaN/Inf counts
+  // with a flight-recorder trigger on the first NaN).  Every hook
+  // no-ops on one relaxed atomic load when `-hotkey_enabled=false`.
+  void set_table_id(int32_t id) { obs_table_id_ = id; }
+  int32_t observed_table_id() const { return obs_table_id_; }
+
+  struct LoadStats {
+    int64_t gets = 0;        // ProcessGet calls served
+    int64_t adds = 0;        // ProcessAdd calls applied
+    double skew_ratio = 0;   // max bucket load / mean bucket load
+    int64_t bucket_load_max = 0;
+    double bucket_load_mean = 0;
+    double add_l2 = 0;       // sqrt of accumulated delta L2^2
+    double add_linf = 0;     // max |delta element| ever applied
+    long long nan_count = 0;
+    long long inf_count = 0;
+    long long staleness_count = 0;  // stamped reads observed
+    double staleness_mean = 0;      // mean version distance at serve time
+  };
+  LoadStats Load() const;
+  std::string HotKeysJson() const { return tracker_.Json(); }
+  std::vector<workload::HotKeyTracker::Item> HotTopK() const {
+    return tracker_.TopK();
+  }
+
+ protected:
+  // One call per ProcessGet/ProcessAdd; bucket < 0 = whole-table op
+  // (counts toward totals only — charging all 64 buckets would fake a
+  // flat profile over the skew the per-key ops reveal).
+  void NoteGet(int bucket) {
+    if (!workload::Armed()) return;
+    total_gets_.fetch_add(1, std::memory_order_relaxed);
+    if (bucket >= 0)
+      bucket_gets_[bucket % kVersionBuckets].fetch_add(
+          1, std::memory_order_relaxed);
+  }
+  void NoteAdd(int bucket) {
+    if (!workload::Armed()) return;
+    total_adds_.fetch_add(1, std::memory_order_relaxed);
+    if (bucket >= 0)
+      bucket_adds_[bucket % kVersionBuckets].fetch_add(
+          1, std::memory_order_relaxed);
+  }
+  // One touched key (matrix row / KV key): sketch offer + bucket load.
+  void NoteKey(uint64_t hash, const std::string& label, int bucket,
+               bool is_add) {
+    if (!workload::Armed()) return;
+    tracker_.Note(hash, label);
+    auto& loads = is_add ? bucket_adds_ : bucket_gets_;
+    if (bucket >= 0)
+      loads[bucket % kVersionBuckets].fetch_add(
+          1, std::memory_order_relaxed);
+  }
+  // Observed staleness at serve time: server version minus the version
+  // the requester stamped into the Get (its last-seen stamp).  Recorded
+  // into the per-table Dashboard histogram `workload.staleness.t<id>`
+  // (1 unit = 1 version, via the µs-bucket ladder) — the measured
+  // distribution to hold against `-max_staleness`.
+  void NoteStaleness(int64_t request_version);
+  // Update-health scan over a decoded add payload: L2^2 / Linf
+  // accumulators + NaN/Inf counts; the FIRST NaN trips a flight-
+  // recorder dump naming this table (a diverging model is a failure
+  // whose post-mortem needs the recent ring, not a silent poisoning).
+  void NoteAddHealth(const float* delta, size_t n);
+
  protected:
   // bucket < 0 stamps EVERY bucket (whole-table adds).
   void BumpVersion(int64_t bucket = -1) {
@@ -100,6 +172,20 @@ class ServerTable {
  private:
   std::atomic<int64_t> version_{0};
   std::atomic<int64_t> bucket_versions_[kVersionBuckets];
+
+  // ---- workload accounting state (docs/observability.md) -------------
+  int32_t obs_table_id_ = -1;
+  std::atomic<int64_t> bucket_gets_[kVersionBuckets];
+  std::atomic<int64_t> bucket_adds_[kVersionBuckets];
+  std::atomic<int64_t> total_gets_{0};
+  std::atomic<int64_t> total_adds_{0};
+  workload::HotKeyTracker tracker_;
+  mutable Mutex health_mu_;
+  double add_l2sq_ GUARDED_BY(health_mu_) = 0.0;
+  double add_linf_ GUARDED_BY(health_mu_) = 0.0;
+  long long nan_count_ GUARDED_BY(health_mu_) = 0;
+  long long inf_count_ GUARDED_BY(health_mu_) = 0;
+  std::atomic<bool> nan_triggered_{false};
 };
 
 class ArrayServerTable : public ServerTable {

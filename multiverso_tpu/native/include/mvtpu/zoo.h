@@ -103,6 +103,17 @@ class Zoo {
   // "tables" sections of an OpsQuery report).
   std::string OpsHealthJson();
   std::string OpsTablesJson();
+  // Workload plane (docs/observability.md): per-table hot-key top-K,
+  // bucket-load skew ratio, observed staleness, and update-health
+  // sentinels — the "hotkeys" OpsQuery kind / MV_HotKeys payload.
+  // id >= 0 restricts to one table.
+  std::string OpsHotKeysJson(int32_t id = -1);
+  // Run a fleet-scope aggregation SYNCHRONOUSLY from this rank (the
+  // same bounded fan-out an inbound fleet OpsQuery triggers) — the
+  // engine-agnostic entry point: on the blocking tcp engine, where no
+  // anonymous scraper can connect, a rank can still assemble the fleet
+  // view itself.  Single-process fleets report just this rank.
+  std::string FleetReport(const std::string& kind);
   // OpsQuery routing (transport reader / reactor threads — NEVER the
   // actor mailbox, so a wedged server still answers its scrape).  Local
   // scope replies inline; fleet scope (version == 1) fans out to every
@@ -262,6 +273,12 @@ class Zoo {
   // drains the counter bounded before tearing the transport down.
   struct OpsPending;
   void FleetOpsThread(int64_t id, Message query);
+  // The shared fan-out+merge body of FleetOpsThread and FleetReport:
+  // sends local-scope sub-queries under `id`, waits out the bounded
+  // deadline, merges (rank labels / JSON ranks map, silent + dead
+  // ranks explicit) and returns the report text.
+  std::string FleetCollect(const std::string& kind, int64_t trace_id,
+                           int64_t id);
   Mutex ops_mu_;
   std::unordered_map<int64_t, std::shared_ptr<OpsPending>> ops_pending_
       GUARDED_BY(ops_mu_);

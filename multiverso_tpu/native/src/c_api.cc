@@ -11,6 +11,7 @@
 #include "mvtpu/fault.h"
 #include "mvtpu/mutex.h"
 #include "mvtpu/ops.h"
+#include "mvtpu/sketch.h"
 #include "mvtpu/stream.h"
 #include "mvtpu/zoo.h"
 
@@ -482,6 +483,40 @@ int MV_BlackboxTrigger(const char* reason) {
   if (!reason) return -1;
   mvtpu::ops::BlackboxTrigger(reason);
   return 0;
+}
+
+// ---- workload observability (docs/observability.md) ------------------
+
+char* MV_HotKeys(int32_t handle) {
+  return MallocString(Zoo::Get()->OpsHotKeysJson(handle));
+}
+
+int MV_TableLoadStats(int32_t handle, long long* gets, long long* adds,
+                      double* skew_ratio, double* add_l2,
+                      double* add_linf, long long* nan_count,
+                      long long* inf_count) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->server_table(handle);
+  if (!t) return -2;  // bad handle, or no local shard on this rank
+  auto load = t->Load();
+  if (gets) *gets = load.gets;
+  if (adds) *adds = load.adds;
+  if (skew_ratio) *skew_ratio = load.skew_ratio;
+  if (add_l2) *add_l2 = load.add_l2;
+  if (add_linf) *add_linf = load.add_linf;
+  if (nan_count) *nan_count = load.nan_count;
+  if (inf_count) *inf_count = load.inf_count;
+  return 0;
+}
+
+int MV_SetHotKeyTracking(int on) {
+  mvtpu::workload::Arm(on != 0);
+  return 0;
+}
+
+char* MV_OpsFleetReport(const char* kind) {
+  return MallocString(
+      Zoo::Get()->FleetReport(kind ? kind : "health"));
 }
 
 // ---- serve layer (docs/serving.md) -----------------------------------

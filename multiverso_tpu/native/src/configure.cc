@@ -267,6 +267,19 @@ void RegisterDefaults() {
               "concurrent fleet-scope OpsQuery aggregations; excess "
               "queries are answered with a busy error document instead "
               "of spawning unbounded fan-out threads");
+    DefineBool("hotkey_enabled", true,
+               "workload observability (docs/observability.md): per-table "
+               "hot-key sketches (space-saving top-K + count-min), "
+               "per-bucket get/add load counters, observed-staleness "
+               "histogram, and add L2/Linf + NaN/Inf health sentinels in "
+               "the server hot path.  false compiles every hook down to "
+               "one relaxed atomic check (MV_SetHotKeyTracking toggles "
+               "live for A/B overhead measurement)");
+    DefineInt("hotkey_topk", 16,
+              "capacity of the space-saving top-K hot-key sketch per "
+              "server table (memory bound: this many monitored keys; "
+              "every true heavy hitter with frequency > total/K is "
+              "guaranteed monitored)");
     DefineInt("shed_storm_threshold", 0,
               "flight-recorder trigger: this many CONSECUTIVE busy-sheds "
               "(-server_inflight_max) dump the black box once per storm "

@@ -168,6 +168,9 @@ std::string LocalReport(const std::string& kind) {
   }
   if (kind == "health") return Zoo::Get()->OpsHealthJson();
   if (kind == "tables") return Zoo::Get()->OpsTablesJson();
+  // Workload plane (docs/observability.md): per-table hot-key top-K +
+  // count-min estimates, bucket-load skew, staleness, health sentinels.
+  if (kind == "hotkeys") return Zoo::Get()->OpsHotKeysJson();
   return "{\"error\":\"unknown ops kind '" + JsonEscape(kind) + "'\"}";
 }
 

@@ -12,12 +12,14 @@
 #include "mvtpu/table.h"
 
 #include <chrono>
+#include <cmath>
 #include <cstring>
 
 #include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/log.h"
+#include "mvtpu/ops.h"
 #include "mvtpu/zoo.h"
 
 namespace mvtpu {
@@ -37,6 +39,97 @@ int64_t SteadyNowMs() {
 
 }  // namespace
 
+// ---------------- workload observability (docs/observability.md) -------
+
+void ServerTable::NoteStaleness(int64_t request_version) {
+  if (!workload::Armed() || request_version < 0) return;
+  int64_t stale = version() - request_version;
+  if (stale < 0) stale = 0;  // a racing reply can out-stamp us; clamp
+  // Ride the µs-bucket Dashboard ladder at 1 unit = 1 version (the
+  // serve.queue_depth trick): bucket i ≈ staleness 2^i, and the
+  // bridged histogram reconstructs the distribution host-side.
+  Dashboard::Record(
+      "workload.staleness.t" + std::to_string(obs_table_id_),
+      static_cast<double>(stale) * 1e-6);
+}
+
+void ServerTable::NoteAddHealth(const float* delta, size_t n) {
+  if (!workload::Armed() || !delta || n == 0) return;
+  double l2sq = 0.0, linf = 0.0;
+  long long nans = 0, infs = 0;
+  for (size_t i = 0; i < n; ++i) {
+    float v = delta[i];
+    if (std::isnan(v)) {
+      ++nans;
+      continue;
+    }
+    if (std::isinf(v)) {
+      ++infs;
+      continue;
+    }
+    double d = static_cast<double>(v);
+    l2sq += d * d;
+    if (std::fabs(d) > linf) linf = std::fabs(d);
+  }
+  {
+    MutexLock lk(health_mu_);
+    add_l2sq_ += l2sq;
+    if (linf > add_linf_) add_linf_ = linf;
+    nan_count_ += nans;
+    inf_count_ += infs;
+  }
+  if (nans > 0) {
+    Dashboard::Record("workload.nan.t" + std::to_string(obs_table_id_),
+                      0.0);
+    // First NaN per table trips the black box: a diverging update is a
+    // failure whose post-mortem needs the recent event/span ring NOW,
+    // not a silent shard poisoning discovered at eval time.
+    if (!nan_triggered_.exchange(true))
+      ops::BlackboxTrigger(
+          "nan_update: table " + std::to_string(obs_table_id_) + " (" +
+          std::to_string(nans) + " NaN element(s) in one add)");
+  }
+  if (infs > 0)
+    Dashboard::Record("workload.inf.t" + std::to_string(obs_table_id_),
+                      0.0);
+}
+
+ServerTable::LoadStats ServerTable::Load() const {
+  LoadStats out;
+  out.gets = total_gets_.load(std::memory_order_relaxed);
+  out.adds = total_adds_.load(std::memory_order_relaxed);
+  int64_t max_load = 0, sum = 0;
+  for (int b = 0; b < kVersionBuckets; ++b) {
+    int64_t load = bucket_gets_[b].load(std::memory_order_relaxed) +
+                   bucket_adds_[b].load(std::memory_order_relaxed);
+    sum += load;
+    if (load > max_load) max_load = load;
+  }
+  out.bucket_load_max = max_load;
+  out.bucket_load_mean =
+      static_cast<double>(sum) / static_cast<double>(kVersionBuckets);
+  out.skew_ratio = out.bucket_load_mean > 0
+                       ? static_cast<double>(max_load) / out.bucket_load_mean
+                       : 0.0;
+  {
+    MutexLock lk(health_mu_);
+    out.add_l2 = std::sqrt(add_l2sq_);
+    out.add_linf = add_linf_;
+    out.nan_count = nan_count_;
+    out.inf_count = inf_count_;
+  }
+  long long cnt = 0;
+  double total = 0.0;
+  if (Dashboard::Query(
+          "workload.staleness.t" + std::to_string(obs_table_id_), &cnt,
+          &total)) {
+    out.staleness_count = cnt;
+    // Recorded at 1e-6 per version (the µs ladder); undo the scale.
+    out.staleness_mean = cnt ? total * 1e6 / static_cast<double>(cnt) : 0.0;
+  }
+  return out;
+}
+
 // ---------------------------------------------------------------- server
 
 ArrayServerTable::ArrayServerTable(int64_t global_size, UpdaterType updater,
@@ -47,8 +140,9 @@ ArrayServerTable::ArrayServerTable(int64_t global_size, UpdaterType updater,
 }
 
 void ArrayServerTable::ProcessGet(const Message& req, Message* reply) {
-  (void)req;
   Monitor mon("ArrayServer::ProcessGet");
+  NoteGet(-1);                 // whole-array read: totals only
+  NoteStaleness(req.version);  // requester stamped its last-seen version
   reply->version = version();  // serve-layer staleness stamp
   MutexLock lk(mu_);
   reply->data.emplace_back(data_.data(), data_.size() * sizeof(float));
@@ -59,6 +153,8 @@ void ArrayServerTable::ProcessAdd(const Message& req) {
   const AddOption* opt = req.data[0].As<AddOption>();
   const float* delta = req.data[1].As<float>();
   size_t n = req.data[1].count<float>();
+  NoteAdd(-1);
+  NoteAddHealth(delta, n);
   MutexLock lk(mu_);
   if (n != data_.size()) {
     Log::Error("ArrayServerTable: delta size %zu != %zu", n, data_.size());
@@ -104,6 +200,8 @@ MatrixServerTable::MatrixServerTable(int64_t rows, int64_t cols,
 
 void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
   Monitor mon("MatrixServer::ProcessGet");
+  NoteGet(-1);  // totals; per-row bucket loads charge via NoteKey below
+  NoteStaleness(req.version);
   MutexLock lk(mu_);
   if (req.data.empty()) {  // GetAll: reply with the local row block
     reply->version = version();
@@ -119,6 +217,12 @@ void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
     if (ids[i] >= 0)
       stamp = std::max(stamp, bucket_version(RowBucket(ids[i])));
   reply->version = stamp;
+  if (workload::Armed())
+    for (size_t i = 0; i < k; ++i)
+      if (ids[i] >= 0 && ids[i] < global_rows_)
+        NoteKey(workload::KeyHash(static_cast<int64_t>(ids[i])),
+                std::to_string(ids[i]), RowBucket(ids[i]),
+                /*is_add=*/false);
   Blob out(k * cols_ * sizeof(float));
   float* dst = out.As<float>();
   for (size_t i = 0; i < k; ++i) {
@@ -137,6 +241,19 @@ void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
 void MatrixServerTable::ProcessAdd(const Message& req) {
   Monitor mon("MatrixServer::ProcessAdd");
   const AddOption* opt = req.data[0].As<AddOption>();
+  NoteAdd(-1);
+  if (!req.data.empty())
+    NoteAddHealth(req.data.back().As<float>(),
+                  req.data.back().count<float>());
+  if (workload::Armed() && req.data.size() == 3) {
+    const int32_t* note_ids = req.data[1].As<int32_t>();
+    size_t note_k = req.data[1].count<int32_t>();
+    for (size_t i = 0; i < note_k; ++i)
+      if (note_ids[i] >= 0 && note_ids[i] < global_rows_)
+        NoteKey(workload::KeyHash(static_cast<int64_t>(note_ids[i])),
+                std::to_string(note_ids[i]), RowBucket(note_ids[i]),
+                /*is_add=*/true);
+  }
   MutexLock lk(mu_);
   float* slots = slot0_.empty() ? nullptr : slot0_.data();
   if (req.data.size() == 2) {  // AddAll: the local row-block slice
@@ -249,13 +366,19 @@ void KVServerTable::ProcessGet(const Message& req, Message* reply) {
   Monitor mon("KVServer::ProcessGet");
   if (req.data.empty()) return;
   auto keys = UnpackKeys(req.data[0]);
+  NoteGet(-1);
+  NoteStaleness(req.version);
   Blob out(keys.size() * sizeof(float));
   float* vals = out.As<float>();
   // Bucket-granular stamp: max version over the touched key buckets.
   int64_t stamp = 0;
-  for (const auto& k : keys)
-    stamp = std::max(stamp, bucket_version(static_cast<int>(
-        KVHash(k.data(), k.size()) % kVersionBuckets)));
+  for (const auto& k : keys) {
+    uint64_t h = KVHash(k.data(), k.size());
+    stamp = std::max(stamp, bucket_version(
+        static_cast<int>(h % kVersionBuckets)));
+    NoteKey(h, k, static_cast<int>(h % kVersionBuckets),
+            /*is_add=*/false);
+  }
   reply->version = stamp;
   MutexLock lk(mu_);
   for (size_t i = 0; i < keys.size(); ++i) {
@@ -276,6 +399,14 @@ void KVServerTable::ProcessAdd(const Message& req) {
                req.data[2].count<float>());
     return;
   }
+  NoteAdd(-1);
+  NoteAddHealth(deltas, keys.size());
+  if (workload::Armed())
+    for (const auto& k : keys) {
+      uint64_t h = KVHash(k.data(), k.size());
+      NoteKey(h, k, static_cast<int>(h % kVersionBuckets),
+              /*is_add=*/true);
+    }
   bool stateful = NumSlots(updater_) > 0;
   auto bump_key = [this](const std::string& k) {
     BumpVersion(static_cast<int64_t>(KVHash(k.data(), k.size()) %
@@ -704,9 +835,12 @@ bool ArrayWorkerTable::Get(float* data, int64_t size) {
   FlushAdds();  // read-your-aggregated-writes: flush rides ahead (FIFO)
   int64_t msg_id = Zoo::Get()->NextMsgId();
   std::vector<MessagePtr> reqs;
-  for (int r = 0; r < servers_; ++r)
-    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
-                           accept_flags()));
+  for (int r = 0; r < servers_; ++r) {
+    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
+                       accept_flags());
+    req->version = last_version();  // observed-staleness stamp
+    reqs.push_back(std::move(req));
+  }
   GatherDest d{data, static_cast<size_t>(size), global_, servers_, 1};
   return RoundTrip(std::move(reqs), GatherReply, &d);
 }
@@ -716,9 +850,12 @@ AsyncGetPtr ArrayWorkerTable::GetAsync(float* data, int64_t size) {
   FlushAdds();
   int64_t msg_id = Zoo::Get()->NextMsgId();
   std::vector<MessagePtr> reqs;
-  for (int r = 0; r < servers_; ++r)
-    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
-                           accept_flags()));
+  for (int r = 0; r < servers_; ++r) {
+    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
+                       accept_flags());
+    req->version = last_version();  // observed-staleness stamp
+    reqs.push_back(std::move(req));
+  }
   auto d = std::make_shared<GatherDest>();
   *d = GatherDest{data, static_cast<size_t>(size), global_, servers_, 1};
   GatherDest* raw = d.get();
@@ -769,9 +906,12 @@ bool MatrixWorkerTable::GetAll(float* data) {
   FlushAdds();
   int64_t msg_id = Zoo::Get()->NextMsgId();
   std::vector<MessagePtr> reqs;
-  for (int r = 0; r < servers_; ++r)
-    reqs.push_back(MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
-                           accept_flags()));
+  for (int r = 0; r < servers_; ++r) {
+    auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
+                       accept_flags());
+    req->version = last_version();  // observed-staleness stamp
+    reqs.push_back(std::move(req));
+  }
   GatherDest d{data, static_cast<size_t>(rows_ * cols_), rows_, servers_,
                cols_};
   return RoundTrip(std::move(reqs), GatherReply, &d);
@@ -798,6 +938,7 @@ std::vector<MessagePtr> MatrixWorkerTable::PlanRowsGet(
     if (per_rank_ids[r].empty()) continue;
     auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
                        accept_flags());
+    req->version = last_version();  // observed-staleness stamp
     req->data.emplace_back(per_rank_ids[r].data(),
                            per_rank_ids[r].size() * sizeof(int32_t));
     reqs.push_back(std::move(req));
@@ -1057,6 +1198,7 @@ bool KVWorkerTable::Get(const std::vector<std::string>& keys, float* vals) {
     if (per_rank[r].empty()) continue;
     auto req = MakeReq(MsgType::RequestGet, table_id_, msg_id, r,
                        accept_flags());
+    req->version = last_version();  // observed-staleness stamp
     req->data.push_back(PackKeys(per_rank[r]));
     reqs.push_back(std::move(req));
   }

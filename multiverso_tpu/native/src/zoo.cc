@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <chrono>
 #include <cmath>
+#include <cstdio>
 #include <functional>
 #include <map>
 #include <sstream>
@@ -13,6 +14,7 @@
 #include "mvtpu/log.h"
 #include "mvtpu/mpi_net.h"
 #include "mvtpu/ops.h"
+#include "mvtpu/sketch.h"
 #include "mvtpu/waiter.h"
 
 namespace mvtpu {
@@ -371,6 +373,10 @@ bool Zoo::Start(int argc, const char* const* argv) {
   // Observability: rank-salt span ids (and the pid column of span
   // dumps); `-trace=true` arms span recording from the first op.
   Dashboard::SetTraceRank(rank_);
+  // Workload plane (docs/observability.md): latch the hot-key/load
+  // accounting arm switch from the flag (MV_SetHotKeyTracking toggles
+  // it live for armed-vs-disarmed overhead A/Bs).
+  workload::Arm(configure::GetBool("hotkey_enabled"));
   if (configure::GetBool("trace")) Dashboard::SetTraceEnabled(true);
   started_ = true;
   ops::BlackboxEvent("lifecycle",
@@ -1020,6 +1026,26 @@ std::string Zoo::OpsTablesJson() {
       os << ",\"bucket_version_min\":" << lo;
       os << ",\"bucket_version_max\":" << hi;
       os << ",\"bucket_version_spread\":" << (hi - lo);
+      // Workload plane (docs/observability.md): load totals, skew,
+      // observed staleness, and update-health sentinels ride the same
+      // report so mvtop's table view needs one scrape, not two.
+      auto load = st->Load();
+      char num[64];
+      os << ",\"gets\":" << load.gets << ",\"adds\":" << load.adds;
+      std::snprintf(num, sizeof(num), "%.6g", load.skew_ratio);
+      os << ",\"skew_ratio\":" << num;
+      os << ",\"bucket_load_max\":" << load.bucket_load_max;
+      std::snprintf(num, sizeof(num), "%.6g", load.bucket_load_mean);
+      os << ",\"bucket_load_mean\":" << num;
+      std::snprintf(num, sizeof(num), "%.6g", load.add_l2);
+      os << ",\"add_l2\":" << num;
+      std::snprintf(num, sizeof(num), "%.6g", load.add_linf);
+      os << ",\"add_linf\":" << num;
+      os << ",\"nan_count\":" << load.nan_count;
+      os << ",\"inf_count\":" << load.inf_count;
+      os << ",\"staleness_count\":" << load.staleness_count;
+      std::snprintf(num, sizeof(num), "%.6g", load.staleness_mean);
+      os << ",\"staleness_mean\":" << num;
     } else {
       os << ",\"shard\":null";
     }
@@ -1123,11 +1149,86 @@ std::string InjectRankLabel(const std::string& line, int rank) {
 }
 }  // namespace
 
+std::string Zoo::OpsHotKeysJson(int32_t id) {
+  // Snapshot pointers under tables_mu_, read stats OUTSIDE it (the
+  // accessors take per-table/tracker locks; tables never unregister).
+  std::vector<ServerTable*> snapshot;
+  {
+    MutexLock lk(tables_mu_);
+    for (auto& t : server_tables_)
+      snapshot.push_back(t.get());
+  }
+  std::ostringstream os;
+  os << "[";
+  bool first = true;
+  for (size_t i = 0; i < snapshot.size(); ++i) {
+    if (id >= 0 && static_cast<size_t>(id) != i) continue;
+    ServerTable* st = snapshot[i];
+    if (!first) os << ',';
+    first = false;
+    os << "{\"id\":" << i;
+    if (!st) {
+      os << ",\"shard\":null}";
+      continue;
+    }
+    auto load = st->Load();
+    char num[64];
+    os << ",\"gets\":" << load.gets << ",\"adds\":" << load.adds;
+    std::snprintf(num, sizeof(num), "%.6g", load.skew_ratio);
+    os << ",\"skew_ratio\":" << num;
+    os << ",\"bucket_load_max\":" << load.bucket_load_max;
+    std::snprintf(num, sizeof(num), "%.6g", load.bucket_load_mean);
+    os << ",\"bucket_load_mean\":" << num;
+    std::snprintf(num, sizeof(num), "%.6g", load.add_l2);
+    os << ",\"add_l2\":" << num;
+    std::snprintf(num, sizeof(num), "%.6g", load.add_linf);
+    os << ",\"add_linf\":" << num;
+    os << ",\"nan_count\":" << load.nan_count;
+    os << ",\"inf_count\":" << load.inf_count;
+    os << ",\"staleness_count\":" << load.staleness_count;
+    std::snprintf(num, sizeof(num), "%.6g", load.staleness_mean);
+    os << ",\"staleness_mean\":" << num;
+    os << ",\"armed\":" << (workload::Armed() ? "true" : "false");
+    os << ",\"hotkeys\":" << st->HotKeysJson();
+    os << "}";
+  }
+  os << "]";
+  return os.str();
+}
+
+std::string Zoo::FleetReport(const std::string& kind) {
+  // Synchronous fleet aggregation from THIS rank — the engine-agnostic
+  // twin of an inbound fleet-scope OpsQuery (on the blocking tcp
+  // engine no anonymous scraper can connect, but a rank can still
+  // assemble the fleet view itself over the rank wire).
+  if (!started_.load()) return "{\"error\":\"not started\"}";
+  ops_inflight_.fetch_add(1);  // Stop drains us before the wire dies
+  std::string out = FleetCollect(kind, Dashboard::ThreadTraceId(),
+                                 NextMsgId());
+  ops_inflight_.fetch_add(-1);
+  return out;
+}
+
 void Zoo::FleetOpsThread(int64_t id, Message query) {
   std::string kind = "health";
   if (!query.data.empty() && query.data[0].size() > 0)
     kind.assign(query.data[0].data(), query.data[0].size());
 
+  std::string merged = FleetCollect(kind, query.trace_id, id);
+
+  auto reply = std::make_unique<Message>();
+  reply->type = MsgType::OpsReply;
+  reply->msg_id = query.msg_id;
+  reply->trace_id = query.trace_id;
+  reply->version = 1;
+  reply->src = rank_;
+  reply->dst = query.src;
+  reply->data.emplace_back(merged.data(), merged.size());
+  Deliver(actor::kWorker, std::move(reply));
+}
+
+std::string Zoo::FleetCollect(const std::string& kind, int64_t trace_id,
+                              int64_t id) {
   std::vector<int> targets;
   for (int r = 0; r < size_; ++r)
     if (r != rank_) targets.push_back(r);
@@ -1144,7 +1245,7 @@ void Zoo::FleetOpsThread(int64_t id, Message query) {
       auto sub = std::make_unique<Message>();
       sub->type = MsgType::OpsQuery;
       sub->msg_id = id;
-      sub->trace_id = query.trace_id;
+      sub->trace_id = trace_id;
       sub->version = 0;  // local scope at the peer
       sub->src = rank_;
       sub->dst = r;
@@ -1202,17 +1303,7 @@ void Zoo::FleetOpsThread(int64_t id, Message query) {
     }
     os << "}}";
   }
-  std::string merged = os.str();
-
-  auto reply = std::make_unique<Message>();
-  reply->type = MsgType::OpsReply;
-  reply->msg_id = query.msg_id;
-  reply->trace_id = query.trace_id;
-  reply->version = 1;
-  reply->src = rank_;
-  reply->dst = query.src;
-  reply->data.emplace_back(merged.data(), merged.size());
-  Deliver(actor::kWorker, std::move(reply));
+  return os.str();
 }
 
 void Zoo::SendTo(const std::string& actor_name, MessagePtr msg) {
@@ -1339,6 +1430,7 @@ int32_t Zoo::RegisterArrayTable(int64_t size) {
       sid < 0 ? nullptr
               : std::make_unique<ArrayServerTable>(size, updater_type_,
                                                    sid, num_servers()));
+  if (server_tables_.back()) server_tables_.back()->set_table_id(id);
   worker_tables_.push_back(
       std::make_unique<ArrayWorkerTable>(id, size, num_servers()));
   worker_tables_.back()->set_codec(DefaultCodec());
@@ -1358,6 +1450,7 @@ int32_t Zoo::RegisterMatrixTableImpl(int64_t rows, int64_t cols) {
       sid < 0 ? nullptr
               : std::make_unique<MatrixServerTable>(
                     rows, cols, updater_type_, sid, num_servers()));
+  if (server_tables_.back()) server_tables_.back()->set_table_id(id);
   worker_tables_.push_back(
       std::make_unique<WorkerT>(id, rows, cols, num_servers()));
   worker_tables_.back()->set_codec(DefaultCodec());
@@ -1379,6 +1472,7 @@ int32_t Zoo::RegisterKVTable() {
   server_tables_.push_back(
       sid < 0 ? nullptr
               : std::make_unique<KVServerTable>(updater_type_));
+  if (server_tables_.back()) server_tables_.back()->set_table_id(id);
   worker_tables_.push_back(
       std::make_unique<KVWorkerTable>(id, num_servers()));
   worker_tables_.back()->set_codec(DefaultCodec());

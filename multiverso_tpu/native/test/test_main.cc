@@ -25,6 +25,7 @@
 #include "mvtpu/mpi_net.h"
 #include "mvtpu/mt_queue.h"
 #include "mvtpu/net.h"
+#include "mvtpu/sketch.h"
 #include "mvtpu/updater.h"
 #include "mvtpu/waiter.h"
 
@@ -486,6 +487,103 @@ static int TestServeVersions() {
   long long hits = -1, misses = -1;
   CHECK(MV_CacheStats(&hits, &misses) == 0);
   CHECK(hits >= 0 && misses >= 0);
+  return 0;
+}
+
+static int TestWorkload() {
+  using mvtpu::workload::CountMin;
+  using mvtpu::workload::KeyHash;
+  using mvtpu::workload::SpaceSaving;
+
+  // --- SpaceSaving: planted heavy hitters always surface -------------
+  SpaceSaving ss(4);
+  for (int round = 0; round < 200; ++round) {
+    ss.Offer(KeyHash((int64_t)1), "1", 1);        // 2 in 3 offers: hot
+    ss.Offer(KeyHash((int64_t)1), "1", 1);
+    ss.Offer(KeyHash((int64_t)(100 + round)), std::to_string(100 + round));
+  }
+  auto top = ss.TopK();
+  CHECK(!top.empty());
+  CHECK(top[0].label == "1");
+  CHECK(top[0].count - top[0].error <= 400);      // lower bound honest
+  CHECK(top[0].count >= 400);                     // upper bound covers
+  CHECK(ss.total() == 600);
+
+  // --- CountMin: never underestimates; eps-bounded overestimate ------
+  CountMin cm(1024, 4);
+  for (int i = 0; i < 5000; ++i) cm.Add(KeyHash((int64_t)(i % 50)));
+  for (int i = 0; i < 50; ++i) {
+    int64_t est = cm.Estimate(KeyHash((int64_t)i));
+    CHECK(est >= 100);                            // true count = 100
+    CHECK(est <= 100 + 2 * 5000 * 4 / 1024);      // ~eps*N slack
+  }
+  CHECK(cm.Estimate(KeyHash((int64_t)999999)) <= 2 * 5000 * 4 / 1024);
+
+  // --- merge across ranks: the fleet-scope fold -----------------------
+  SpaceSaving a(4), b(4);
+  for (int i = 0; i < 30; ++i) a.Offer(KeyHash((int64_t)7), "7");
+  for (int i = 0; i < 20; ++i) b.Offer(KeyHash((int64_t)7), "7");
+  b.Offer(KeyHash((int64_t)8), "8");
+  a.Merge(b);
+  CHECK(a.TopK()[0].label == "7");
+  CHECK(a.TopK()[0].count == 50);
+  CHECK(a.total() == 51);
+
+  // --- server hot path: skewed row gets -> top-K + skew ratio ---------
+  int32_t h;
+  CHECK(MV_NewMatrixTable(256, 4, &h) == 0);
+  std::vector<float> row(4, 0.5f), got(4);
+  std::vector<int32_t> hot_id = {3};
+  for (int i = 0; i < 64; ++i) {
+    CHECK(MV_AddMatrixTableByRows(h, row.data(), hot_id.data(), 1, 4) == 0);
+    CHECK(MV_GetMatrixTableByRows(h, got.data(), hot_id.data(), 1, 4) == 0);
+    int32_t cold = 10 + i;                        // one touch each
+    CHECK(MV_GetMatrixTableByRows(h, got.data(), &cold, 1, 4) == 0);
+  }
+  long long gets = 0, adds = 0, nans = 0, infs = 0;
+  double skew = 0, l2 = 0, linf = 0;
+  CHECK(MV_TableLoadStats(h, &gets, &adds, &skew, &l2, &linf, &nans,
+                          &infs) == 0);
+  CHECK(gets == 128 && adds == 64);
+  CHECK(skew > 2.0);                              // row 3's bucket is hot
+  CHECK(l2 > 0.0 && linf == 0.5);
+  CHECK(nans == 0 && infs == 0);
+  char* json = MV_HotKeys(h);
+  CHECK(json && strstr(json, "\"key\":\"3\"") != nullptr);
+  CHECK(strstr(json, "\"skew_ratio\"") != nullptr);
+  MV_FreeString(json);
+  json = MV_OpsReport("hotkeys");
+  CHECK(json && strstr(json, "\"topk\"") != nullptr);
+  MV_FreeString(json);
+
+  // --- NaN sentinel: first poisoned add trips the black box -----------
+  long long triggers0 = 0;
+  CHECK(MV_QueryMonitor("blackbox.trigger", &triggers0) == 0);
+  int32_t hn;
+  CHECK(MV_NewArrayTable(8, &hn) == 0);
+  std::vector<float> poison(8, 1.0f);
+  poison[3] = std::numeric_limits<float>::quiet_NaN();
+  poison[5] = std::numeric_limits<float>::infinity();
+  CHECK(MV_AddArrayTable(hn, poison.data(), 8) == 0);
+  CHECK(MV_TableLoadStats(hn, nullptr, nullptr, nullptr, nullptr,
+                          nullptr, &nans, &infs) == 0);
+  CHECK(nans == 1 && infs == 1);
+  long long triggers1 = 0;
+  CHECK(MV_QueryMonitor("blackbox.trigger", &triggers1) == 0);
+  CHECK(triggers1 == triggers0 + 1);
+  // Second poisoned add: counted, but the trigger fired once per table.
+  CHECK(MV_AddArrayTable(hn, poison.data(), 8) == 0);
+  CHECK(MV_QueryMonitor("blackbox.trigger", &triggers1) == 0);
+  CHECK(triggers1 == triggers0 + 1);
+
+  // --- disarmed: accounting freezes at one atomic check ---------------
+  CHECK(MV_SetHotKeyTracking(0) == 0);
+  CHECK(MV_GetMatrixTableByRows(h, got.data(), hot_id.data(), 1, 4) == 0);
+  long long gets2 = 0;
+  CHECK(MV_TableLoadStats(h, &gets2, nullptr, nullptr, nullptr, nullptr,
+                          nullptr, nullptr) == 0);
+  CHECK(gets2 == gets);
+  CHECK(MV_SetHotKeyTracking(1) == 0);
   return 0;
 }
 
@@ -1881,6 +1979,7 @@ int main(int argc, char** argv) {
       {"checkpoint", TestCheckpoint},
       {"kv", TestKV},             {"threads", TestThreads},
       {"serve", TestServeVersions},
+      {"workload", TestWorkload},
   };
   int failures = 0;
   std::string only = argc > 1 ? argv[1] : "";

@@ -106,6 +106,14 @@ class OpsClient:
     def fleet_tables(self) -> Dict[str, Any]:
         return json.loads(self.report("tables", fleet=True))
 
+    def hotkeys(self, fleet: bool = False):
+        """Workload-plane report (docs/observability.md): per-table
+        hot-key top-K with count-min estimates, bucket-load skew ratio,
+        observed-staleness stats and the add L2/Linf + NaN/Inf health
+        sentinels.  Local scope returns the table list; fleet scope the
+        usual ``{"ranks": {...}, "silent": [...]}`` wrapper."""
+        return json.loads(self.report("hotkeys", fleet=fleet))
+
     def metrics(self, fleet: bool = False) -> Tuple[
             Dict[str, float], Dict[str, Dict[str, str]]]:
         """(values, exemplars) of the scraped exposition text."""

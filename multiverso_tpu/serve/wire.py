@@ -28,7 +28,8 @@ sharded table contacts each server rank it cares about), the
 server-side shed path answers either with ``ReplyBusy`` — plus the
 introspection scrape ``OpsQuery``/``OpsReply``
 (docs/observability.md): :meth:`AnonServeClient.ops_report` fetches
-Prometheus metrics / health / table stats, local- or fleet-scope.
+Prometheus metrics / health / table stats / hot-key workload reports,
+local- or fleet-scope.
 
 This module is pure stdlib + numpy so external tooling can vendor it.
 """

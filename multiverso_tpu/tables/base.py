@@ -216,6 +216,19 @@ class Table:
         if self._serve_staleness < 0:
             raise ValueError(
                 f"max_staleness must be >= 0, got {self._serve_staleness}")
+        # --- workload plane (docs/observability.md) ---------------------
+        # Mirror of the native server's hot-key/load accounting: a
+        # space-saving top-K + count-min tracker fed by the eager
+        # get/add paths, so the pure-JAX plane reports the same shapes
+        # the native "hotkeys" OpsQuery kind serves.
+        if bool(config.get("hotkey_enabled")):
+            from ..sketch import WorkloadTracker
+
+            self._workload = WorkloadTracker(
+                topk=int(config.get("hotkey_topk")),
+                buckets=self.SERVE_BUCKETS)
+        else:
+            self._workload = None
         entries = int(config.get("serve_cache_entries")
                       if serve_cache is None else serve_cache)
         if entries > 0:
@@ -579,12 +592,17 @@ class Table:
 
         return zlib.crc32(repr(key).encode()) % Table.SERVE_BUCKETS
 
-    def _serve_bump(self, buckets=None) -> None:
+    def _serve_bump(self, buckets=None, keys=None) -> None:
         """Advance the table version after a local apply — the JAX-plane
         analog of the native server's per-apply version stamp.  Bumping
         IS the write-through invalidation: cached entries below the new
         version fail the staleness gate at lookup.  ``buckets`` (row ids
-        or key buckets) stamps only the touched buckets."""
+        or key buckets) stamps only the touched buckets.  ``keys`` (the
+        touched row ids / KV keys, when the apply is key-granular) feeds
+        the workload hot-key tracker — independent of the serve cache,
+        which may be disarmed while accounting stays on."""
+        if self._workload is not None:
+            self._workload.note_add(keys)
         if self._serve_cache is None:
             return
         import numpy as np
@@ -622,8 +640,19 @@ class Table:
                 return 0
             return int(self._serve_buckets[idx % self.SERVE_BUCKETS].max())
 
+    def workload_report(self) -> dict:
+        """Per-table workload report (docs/observability.md): the same
+        shape as one entry of the native ``"hotkeys"`` OpsQuery kind —
+        get/add totals, bucket-load skew ratio, top-K hot keys with
+        count-min estimates.  ``{"armed": False}`` when disabled."""
+        if self._workload is None:
+            return {"id": self.table_id, "armed": False}
+        out = {"id": self.table_id, "armed": True}
+        out.update(self._workload.report())
+        return out
+
     def _serve_read(self, key: tuple, fetch, buckets=None,
-                    collective_safe: bool = True, copy=None):
+                    collective_safe: bool = True, copy=None, keys=None):
         """Cache + coalesce an eager host read (docs/serving.md).
 
         ``fetch`` is the full existing read path (including any
@@ -633,8 +662,12 @@ class Table:
         there would break the lockstep fetch collective, so they bypass
         the cache under ``process_count() > 1``.  ``copy`` clones a
         value on the cache boundary (default: ndarray ``.copy()``) so
-        caller mutation cannot corrupt the cached copy.
+        caller mutation cannot corrupt the cached copy.  ``keys`` (the
+        touched row ids / KV keys) feeds the workload hot-key tracker
+        regardless of whether the cache is armed.
         """
+        if self._workload is not None:
+            self._workload.note_get(keys)
         cache = self._serve_cache
         if cache is None or (not collective_safe and is_multiprocess()):
             return fetch()

@@ -106,7 +106,8 @@ class KVTable(Table):
                 ("kv", tuple(keys)), fetch,
                 buckets=[self.serve_key_bucket(k) for k in keys],
                 collective_safe=False,
-                copy=lambda d: {k: v.copy() for k, v in d.items()})
+                copy=lambda d: {k: v.copy() for k, v in d.items()},
+                keys=[str(k) for k in keys])
             # raw() contract: the mirror holds every key the app Get()s
             # even when the serve cache short-circuits fetch() above.
             with self._lock:
@@ -248,7 +249,8 @@ class KVTable(Table):
         if ups:
             # Serve layer: one version bump per apply batch, stamping
             # only the touched key buckets.
-            self._serve_bump([self.serve_key_bucket(k) for k in ups])
+            self._serve_bump([self.serve_key_bucket(k) for k in ups],
+                             keys=[str(k) for k in ups])
 
     # ------------------------------------------------------------ checkpoint
     def store_state(self) -> Any:

@@ -117,7 +117,8 @@ class MatrixTable(Table):
             # request different ids, and a rank-local hit would break
             # the union collective, so multi-host bypasses the cache.
             return self._serve_read(("rows", tuple(rows.tolist())), fetch,
-                                    buckets=rows, collective_safe=False)
+                                    buckets=rows, collective_safe=False,
+                                    keys=rows.tolist())
 
     def _gather_host(self, rows: np.ndarray) -> np.ndarray:
         """Bucketed compiled gather + host fetch of ``rows`` (all ranks
@@ -274,8 +275,9 @@ class MatrixTable(Table):
                 self._data, self._state, jnp.asarray(prows),
                 jnp.asarray(pdelta))
         # Serve layer: bucket-granular bump — uniq is already the
-        # cross-rank union, so every rank stamps identical buckets.
-        self._serve_bump(uniq)
+        # cross-rank union, so every rank stamps identical buckets (and
+        # the workload tracker charges the touched rows).
+        self._serve_bump(uniq, keys=[int(r) for r in uniq])
 
     # ------------------------------------------------- fused (in-jit) path
     def raw_value(self) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:

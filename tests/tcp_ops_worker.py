@@ -1,0 +1,53 @@
+"""TCP-engine ops-fleet holder (not a pytest module).
+
+Run as ``python tcp_ops_worker.py <machine_file> <rank>``: joins a
+2-rank fleet on the BLOCKING tcp engine (which refuses anonymous
+scraper connections — the engine the in-band wire scrape can't reach),
+does a little skewed table traffic, and has rank 0 assemble the
+fleet-scope ``"hotkeys"`` report ITSELF over the rank wire
+(``MV_OpsFleetReport``) — proving the workload plane is reachable on
+every engine.  Rank 0 prints ``FLEET_HOTKEYS <json>``; both ranks print
+``TCP_OPS_OK <rank>``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from multiverso_tpu import native as nat  # noqa: E402
+
+ROWS = 64
+COLS = 4
+
+
+def main() -> int:
+    mf, rank = sys.argv[1], int(sys.argv[2])
+    rt = nat.NativeRuntime(args=[f"-machine_file={mf}", f"-rank={rank}",
+                                 "-net_engine=tcp", "-log_level=error",
+                                 "-rpc_timeout_ms=30000",
+                                 "-barrier_timeout_ms=60000"])
+    assert rt.net_engine() == "tcp", rt.net_engine()
+    h = rt.new_matrix_table(ROWS, COLS)
+    rt.barrier()
+    # Skewed traffic from BOTH ranks: row 5 (rank 0's shard) and row 45
+    # (rank 1's shard) are everyone's hot keys.
+    delta = np.ones((2, COLS), np.float32)
+    for i in range(10):
+        rt.matrix_add_rows(h, [5, 45], delta)
+        rt.matrix_get_rows(h, [5, 45, 10 + i], COLS)
+    rt.barrier()
+    if rank == 0:
+        print("FLEET_HOTKEYS " + rt.ops_fleet_report("hotkeys"),
+              flush=True)
+    rt.barrier()
+    rt.shutdown()
+    print(f"TCP_OPS_OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,34 @@ def test_gate_guards_ops_keys(tmp_path):
     assert "ops_overhead_pct" in out, out
 
 
+def test_gate_guards_skew_keys(tmp_path):
+    """bench_skew acceptance bars (docs/observability.md, workload
+    plane): a collapsed zipf skew ratio (the sketches stopped seeing the
+    imbalance), a planted hot key missing from the top-K, or sketch
+    overhead past the noise band must all fail the gate."""
+    line = {"extras": {"skew_ratio_zipf": 2.0,          # < 3.5 floor
+                       "skew_hot_recall": 0.6,          # missed hot keys
+                       "hotkey_track_overhead_pct": 25.0}}  # way past band
+    p = tmp_path / "skew_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "skew_ratio_zipf" in out and "FAIL" in out, out
+    assert "skew_hot_recall" in out, out
+    assert "hotkey_track_overhead_pct" in out, out
+
+
+def test_gate_passes_in_band_skew_line(tmp_path):
+    line = {"extras": {"skew_ratio_zipf": 8.5,
+                       "skew_ratio_uniform": 1.3,
+                       "skew_hot_recall": 1.0,
+                       "hotkey_track_overhead_pct": 1.1}}
+    p = tmp_path / "skew_ok.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
 def test_last_parseable_line_wins(tmp_path):
     """Schema-7 cumulative emission: the LAST line is the freshest
     cumulative state and must shadow earlier partials."""

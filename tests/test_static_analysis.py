@@ -380,6 +380,65 @@ def test_mv010_registry_itself_is_exempt(tmp_path):
     assert rules == [], rules
 
 
+def test_mv011_fires_on_per_key_labels(tmp_path):
+    """Registry labels derived from a table key / row id mint one
+    series per key — unbounded cardinality; per-key accounting must go
+    through a bounded sketch.  Bounded dimensions (table name, rank,
+    dir) stay legal."""
+    d = tmp_path / "multiverso_tpu"
+    d.mkdir()
+    rules = _lint_src(d, """\
+        from multiverso_tpu import metrics
+
+        def bad(key, row_id, hot_rows, i):
+            metrics.counter("t.reads", labels={"key": str(key)})   # BAD
+            metrics.counter("t.reads", labels={"r": f"{row_id}"})  # BAD
+            metrics.gauge("t.load", labels={"x": hot_rows[i]})     # BAD
+
+        def good(table_id, rank):
+            metrics.counter("t.reads", labels={"table": str(table_id)})
+            metrics.counter("t.reads", labels={"rank": str(rank)})
+            metrics.counter("io.bytes", labels={"dir": "read"})
+        """)
+    assert [r for r, _ in rules] == ["MV011", "MV011", "MV011"], rules
+
+
+def test_mv011_fires_on_keyish_label_name(tmp_path):
+    """A label literally NAMED "key"/"row" with a non-constant value is
+    per-key by its own admission, however the value is spelled."""
+    d = tmp_path / "multiverso_tpu"
+    d.mkdir()
+    rules = _lint_src(d, """\
+        from multiverso_tpu import metrics
+
+        def bad(k):
+            metrics.histogram("t.lat", labels={"row": str(k)})     # BAD
+
+        def good():
+            metrics.histogram("t.lat", labels={"row": "header"})   # const
+        """)
+    assert [r for r, _ in rules] == ["MV011"], rules
+
+
+def test_mv011_out_of_scope_and_suppressible(tmp_path):
+    """Tests/apps are exempt (same scope rule as MV010); an in-library
+    finding silences with the usual suppression comment."""
+    src = """\
+        from multiverso_tpu import metrics
+
+        def f(key):
+            metrics.counter("t.x", labels={"key": str(key)})
+        """
+    d = tmp_path / "multiverso_tpu"
+    d.mkdir()
+    assert [r for r, _ in _lint_src(d, src)] == ["MV011"]
+    assert _lint_src(d, src, name="test_snippet.py") == []
+    suppressed = src.replace(
+        "labels={\"key\": str(key)})",
+        "labels={\"key\": str(key)})  # mvlint: disable=MV011")
+    assert _lint_src(d, suppressed) == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

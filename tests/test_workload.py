@@ -1,0 +1,503 @@
+"""Tier-1 gate for the workload observability plane
+(docs/observability.md, "workload plane"): the hot-key sketches
+(property-tested: planted heavy hitters always surface, count-min never
+underestimates and stays inside its eps bound, per-rank merges fold),
+the JAX-plane table mirror, the metrics time-series ring
+(rate()/delta()), the label-cardinality-overflow flight-recorder hook,
+mvtop's two-scrape rate columns, and the native plane end to end —
+including the ``"hotkeys"`` OpsQuery round trip on both wire engines
+and the NaN update-health blackbox trigger.
+"""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+# ---------------------------------------------------------- sketch properties
+
+def test_key_hash_is_fnv1a_and_stable():
+    from multiverso_tpu.sketch import key_hash
+
+    # FNV-1a 64 reference values (the native KeyHash/KVHash function):
+    # hash("") is the offset basis; str/bytes agree; ints hash their
+    # little-endian int64 form.
+    assert key_hash(b"") == 1469598103934665603
+    assert key_hash("abc") == key_hash(b"abc")
+    assert key_hash(3) == key_hash((3).to_bytes(8, "little", signed=True))
+    assert key_hash("a") != key_hash("b")
+
+
+def test_space_saving_planted_heavy_hitters_always_surface():
+    """Any key with frequency > total/K is guaranteed monitored — the
+    space-saving invariant, checked over a zipf-ish stream with noise
+    keys churning the tail."""
+    from multiverso_tpu.sketch import SpaceSavingSketch
+
+    rng = np.random.RandomState(0)
+    ss = SpaceSavingSketch(k=16)
+    true = {}
+    for i in range(4000):
+        if i % 2 == 0:
+            key = f"hot{(i // 2) % 8}"       # 8 planted hitters, 6.25% each
+        else:
+            key = f"noise{rng.randint(100000)}"
+        ss.offer(key)
+        true[key] = true.get(key, 0) + 1
+    top = {label: (count, err) for label, count, err in ss.topk()}
+    for h in range(8):
+        key = f"hot{h}"
+        assert key in top, (key, sorted(top))
+        count, err = top[key]
+        assert count >= true[key]            # upper bound
+        assert count - err <= true[key]      # honest lower bound
+
+
+def test_count_min_never_underestimates_and_bounds_error():
+    from multiverso_tpu.sketch import CountMinSketch
+
+    cm = CountMinSketch(width=512, depth=4)
+    for i in range(3000):
+        cm.add(i % 30)                       # 30 keys, 100 each
+    eps_slack = 2 * cm.total * cm.depth // cm.width   # generous eps*N
+    for i in range(30):
+        est = cm.estimate(i)
+        assert est >= 100
+        assert est <= 100 + eps_slack
+    assert cm.estimate("never-seen") <= eps_slack
+
+
+def test_sketches_merge_across_ranks():
+    """The fleet-scope fold: merging per-rank sketches must preserve
+    the heavy hitters and sum counts/grids."""
+    from multiverso_tpu.sketch import (CountMinSketch, SpaceSavingSketch,
+                                       WorkloadTracker)
+
+    a, b = SpaceSavingSketch(8), SpaceSavingSketch(8)
+    for _ in range(40):
+        a.offer("shared")
+    for _ in range(25):
+        b.offer("shared")
+    b.offer("b-only")
+    a.merge(b)
+    top = dict((label, count) for label, count, _ in a.topk())
+    assert top["shared"] == 65
+    assert a.total == 66
+
+    ca, cb = CountMinSketch(64, 2), CountMinSketch(64, 2)
+    ca.add("x", 10)
+    cb.add("x", 5)
+    ca.merge(cb)
+    assert ca.estimate("x") >= 15
+    assert ca.total == 15
+    with pytest.raises(ValueError):
+        ca.merge(CountMinSketch(32, 2))
+
+    ta, tb = WorkloadTracker(topk=8), WorkloadTracker(topk=8)
+    ta.note_get([1, 1, 2])                   # ONE get touching 3 keys
+    tb.note_get([1])
+    tb.note_add([3])
+    ta.merge(tb)
+    rep = ta.report()
+    assert rep["gets"] == 2 and rep["adds"] == 1
+    assert rep["hotkeys"]["topk"][0]["key"] == "1"
+    assert rep["hotkeys"]["topk"][0]["count"] == 3
+
+
+def test_workload_tracker_report_shape_and_skew():
+    from multiverso_tpu.sketch import WorkloadTracker
+
+    t = WorkloadTracker(topk=8, buckets=64)
+    for _ in range(64):
+        t.note_get([7])                      # one hot bucket
+    t.note_add()                             # whole-table op: totals only
+    rep = t.report()
+    assert rep["gets"] == 64 and rep["adds"] == 1
+    assert rep["bucket_load_max"] == 64
+    assert rep["skew_ratio"] == 64.0         # all load in bucket 7
+    top = rep["hotkeys"]["topk"][0]
+    assert top["key"] == "7" and top["count"] == 64
+    assert top["estimate"] >= 64             # count-min never under
+
+
+# ------------------------------------------------------ JAX-plane table mirror
+
+def test_table_workload_report_mirrors_native_shape(mv):
+    mv.init()
+    t = mv.MatrixTable(32, 4)
+    hot = np.ones((1, 4), np.float32)
+    for _ in range(10):
+        t.add_rows([3], hot)
+        t.get_rows([3, 7])
+    rep = t.workload_report()
+    assert rep["armed"] and rep["id"] == t.table_id
+    assert rep["gets"] == 10 and rep["adds"] == 10
+    top = [e["key"] for e in rep["hotkeys"]["topk"]]
+    assert top[0] == "3"                     # the hot row leads
+    assert rep["skew_ratio"] > 1.0
+
+
+def test_table_workload_disarmed_by_flag(mv):
+    from multiverso_tpu import config
+
+    config.set_flag("hotkey_enabled", False)
+    try:
+        mv.init()
+        t = mv.ArrayTable(8)
+        t.get()
+        assert t.workload_report() == {"id": t.table_id, "armed": False}
+    finally:
+        config.set_flag("hotkey_enabled", True)
+
+
+# ----------------------------------------------------- metrics time-series
+
+@pytest.fixture()
+def registry():
+    from multiverso_tpu import metrics
+
+    metrics.reset()
+    yield metrics
+    metrics.reset()
+
+
+def test_metrics_history_rate_and_delta(registry):
+    c = registry.counter("req.count")
+    g = registry.gauge("q.depth")
+    c.inc(100)
+    g.set(5)
+    registry.record_history(now=10.0)
+    c.inc(50)
+    g.set(9)
+    registry.record_history(now=20.0)
+    assert registry.rate("req.count") == pytest.approx(5.0)   # 50 in 10s
+    assert registry.delta("req.count") == pytest.approx(50.0)
+    assert registry.rate("q.depth") == pytest.approx(0.4)
+    assert len(registry.history("req.count")) == 2
+    # Window narrows the baseline sample.
+    c.inc(10)
+    registry.record_history(now=30.0)
+    assert registry.rate("req.count", window_s=11.0) == pytest.approx(1.0)
+    assert registry.rate("req.count") == pytest.approx(3.0)   # full ring
+
+
+def test_metrics_history_histogram_and_bounds(registry):
+    h = registry.histogram("op.lat", bounds=[1.0, 10.0])
+    h.observe(0.5)
+    registry.record_history(now=1.0)
+    h.observe(0.5)
+    h.observe(2.0)
+    registry.record_history(now=2.0)
+    assert registry.rate("op.lat_count") == pytest.approx(2.0)
+    assert registry.delta("op.lat_sum") == pytest.approx(2.5)
+    # Ring is bounded: HISTORY_SNAPSHOTS points max.
+    for i in range(registry.HISTORY_SNAPSHOTS + 10):
+        registry.record_history(now=10.0 + i)
+    assert len(registry.history("op.lat_count")) == \
+        registry.HISTORY_SNAPSHOTS
+    # Fewer than two points / unknown series: 0.0, never a crash.
+    assert registry.rate("nope") == 0.0
+    assert registry.delta("nope") == 0.0
+
+
+def test_metrics_flush_records_history(registry, tmp_path):
+    c = registry.counter("flush.count")
+    c.inc(3)
+    registry.start_flush(10, str(tmp_path / "m.prom"))
+    try:
+        import time
+
+        deadline = time.time() + 5
+        while not registry.history("flush.count") and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        assert registry.history("flush.count"), \
+            "flush thread never recorded a history point"
+    finally:
+        registry.stop_flush()
+
+
+def test_label_overflow_lands_in_flight_recorder(registry):
+    """The cardinality-overflow series is snapshot-only; the EVENT must
+    also land in the flight-recorder ring so a post-mortem sees the
+    explosion (satellite fix + regression test)."""
+    from multiverso_tpu.ops.flight_recorder import recorder
+
+    recorder.reset()
+    for i in range(registry.MAX_SERIES_PER_NAME + 3):
+        registry.counter("burst", labels={"v": str(i)})
+    events = [e for e in recorder.events()
+              if e["kind"] == "metric_overflow"]
+    assert len(events) == 3, [e["kind"] for e in recorder.events()]
+    assert events[0]["detail"] == "burst"
+    assert "v=" in events[0]["dropped_labels"]
+    recorder.reset()
+
+
+# ----------------------------------------------------------- mvtop rates
+
+def test_mvtop_compute_rates_and_sparkline():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import mvtop
+
+    prev = {"vmax": 100.0, "gets": 50.0, "adds": 20.0, "shed": 0.0}
+    cur = {"vmax": 160.0, "gets": 250.0, "adds": 30.0, "shed": 4.0}
+    rates = mvtop.compute_rates(prev, cur, dt=2.0)
+    assert rates == {"vmax": 30.0, "gets": 100.0, "adds": 5.0,
+                     "shed": 2.0}
+    # A restarted rank's counter reset clamps to 0, not negative.
+    assert mvtop.compute_rates({"vmax": 500.0}, {"vmax": 10.0},
+                               1.0)["vmax"] == 0.0
+    assert mvtop.compute_rates({}, {"vmax": 10.0}, 0.0)["vmax"] == 0.0
+
+    assert mvtop.sparkline([]) == "-"
+    assert mvtop.sparkline([0, 0]) == "▁▁"
+    line = mvtop.sparkline([0, 5, 10])
+    assert len(line) == 3 and line[-1] == "█"
+
+
+def test_mvtop_watch_rates_from_two_canned_scrapes():
+    """The --watch refresh loop's rate columns, fed two canned scrape
+    samples: the second refresh must show the computed per-second
+    rates and a sparkline; the first shows placeholders."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import mvtop
+
+    health = {"healthy": True, "engine": "epoll", "serve_queue_depth": 0,
+              "server_inflight_max": 8, "clients": 3, "client_shed": 0,
+              "dead_peers": [], "blackbox_triggers": 0}
+    t0 = [{"id": 0, "version": 100, "gets": 50, "adds": 20,
+           "agg_pending": 0}]
+    t1 = [{"id": 0, "version": 160, "gets": 250, "adds": 30,
+           "agg_pending": 0}]
+    row0 = mvtop._row_from_health("0", health, t0)
+    row1 = mvtop._row_from_health("0", dict(health, client_shed=4), t1)
+
+    tracker = mvtop.RateTracker()
+    first = tracker.update("0", row0["_counters"], now=100.0)
+    assert first["v/s"] == "-"               # no baseline yet
+    second = tracker.update("0", row1["_counters"], now=102.0)
+    assert second["v/s"] == "30.0"
+    assert second["get/s"] == "100.0"
+    assert second["add/s"] == "5.0"
+    assert second["shed/s"] == "2.0"
+    assert second["trend"] != "-" and len(second["trend"]) >= 1
+    # The rendered watch table carries the rate columns.
+    row1.update(second)
+    table = mvtop.render([row1], mvtop._COLS + mvtop._RATE_COLS)
+    assert "v/s" in table and "30.0" in table
+
+
+def test_mvtop_hotkey_rows_rank_by_skew():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import mvtop
+
+    entry = {"id": 0, "gets": 100, "adds": 50, "skew_ratio": 7.5,
+             "staleness_mean": 1.2, "nan_count": 0, "inf_count": 0,
+             "hotkeys": {"total": 150, "topk": [
+                 {"key": "42", "count": 90, "error": 0, "estimate": 91}]}}
+    assert mvtop._fmt_topk(entry) == "42:90"
+    assert mvtop._fmt_topk({"hotkeys": {"topk": []}}) == "-"
+    table = mvtop.render(
+        [{"rank": "0", "table": 0, "gets": 100, "adds": 50,
+          "skew": "7.50", "stale~": "1.2", "nan": 0, "inf": 0,
+          "top keys": "42:90"}], mvtop._HOTKEY_COLS)
+    assert "42:90" in table and "7.50" in table
+
+
+# ------------------------------------------------------------- native plane
+
+@pytest.fixture()
+def native_rt(tmp_path):
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    rt = nat.NativeRuntime(args=["-log_level=error",
+                                 f"-trace_dir={tmp_path}"])
+    yield rt
+    rt.set_hotkey_tracking(True)
+    rt.shutdown()
+
+
+@needs_gxx
+def test_native_hotkeys_report_and_load_stats(native_rt):
+    h = native_rt.new_matrix_table(128, 4)
+    hot = np.ones((1, 4), np.float32)
+    for i in range(16):
+        native_rt.matrix_add_rows(h, [9], hot)
+        native_rt.matrix_get_rows(h, [9, 20 + i], 4)
+    report = native_rt.hot_keys()
+    entry = report[h]
+    assert entry["id"] == h and entry["armed"]
+    assert entry["gets"] == 16 and entry["adds"] == 16
+    assert entry["skew_ratio"] > 1.0
+    top = entry["hotkeys"]["topk"]
+    assert top[0]["key"] == "9"
+    assert top[0]["estimate"] >= top[0]["count"] - top[0]["error"]
+    # Observed staleness: worker gets stamp last_version, so the
+    # histogram has samples and the mean sits near 0 (read-your-writes).
+    assert entry["staleness_count"] >= 1
+    stats = native_rt.table_load_stats(h)
+    assert stats["gets"] == 16 and stats["adds"] == 16
+    assert stats["add_l2"] == pytest.approx(8.0)   # sqrt(16*4*1)
+    assert stats["add_linf"] == 1.0
+    # One-table restriction of MV_HotKeys.
+    only = native_rt.hot_keys(h)
+    assert len(only) == 1 and only[0]["id"] == h
+    # The ops plane serves the same payload as the "hotkeys" kind.
+    via_ops = json.loads(native_rt.ops_report("hotkeys"))
+    assert via_ops[h]["gets"] == 16
+    # The "tables" report carries the new workload fields too.
+    tables = json.loads(native_rt.ops_report("tables"))
+    assert tables[h]["gets"] == 16 and tables[h]["nan_count"] == 0
+
+
+@needs_gxx
+def test_native_hotkey_disarm_stops_accounting(native_rt):
+    h = native_rt.new_matrix_table(32, 2)
+    native_rt.matrix_get_rows(h, [1], 2)
+    before = native_rt.table_load_stats(h)["gets"]
+    native_rt.set_hotkey_tracking(False)
+    native_rt.matrix_get_rows(h, [1], 2)
+    assert native_rt.table_load_stats(h)["gets"] == before
+    native_rt.set_hotkey_tracking(True)
+    native_rt.matrix_get_rows(h, [1], 2)
+    assert native_rt.table_load_stats(h)["gets"] == before + 1
+
+
+@needs_gxx
+def test_native_nan_add_dumps_blackbox_naming_table(native_rt, tmp_path):
+    """The update-health sentinel acceptance path: the FIRST NaN-
+    poisoned add dumps blackbox_rank0.json naming the table; repeats
+    count but do not re-trigger."""
+    h = native_rt.new_array_table(8)
+    poison = np.ones(8, np.float32)
+    poison[2] = np.nan
+    poison[6] = np.inf
+    native_rt.array_add(h, poison)
+    stats = native_rt.table_load_stats(h)
+    assert stats["nan_count"] == 1 and stats["inf_count"] == 1
+    box = tmp_path / "blackbox_rank0.json"
+    assert box.exists(), "NaN add did not dump the black box"
+    doc = json.load(open(box))
+    assert doc["reason"].startswith(f"nan_update: table {h}"), \
+        doc["reason"]
+    # The hotkeys report carries the sentinel counters too.
+    entry = native_rt.hot_keys(h)[0]
+    assert entry["nan_count"] == 1 and entry["inf_count"] == 1
+
+
+# -------------------------------------------------------------- wire plane
+
+def _spawn_fleet(script, tmp_path, nranks=2, extra=()):
+    socks = [socket.socket() for _ in range(nranks)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = os.path.join(str(tmp_path), "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", script), mf,
+             str(r), *map(str, extra)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
+        for r in range(nranks)
+    ]
+    return eps, procs
+
+
+@needs_gxx
+def test_hotkeys_roundtrip_epoll_anonymous_scrape(tmp_path):
+    """The ``"hotkeys"`` kind over the anonymous serve wire (epoll
+    engine): local scope answers the table list, fleet scope wraps it
+    in the ranks{} merge with every rank present."""
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    from multiverso_tpu.ops.introspect import OpsClient
+    from multiverso_tpu.serve.wire import AnonServeClient
+
+    eps, procs = _spawn_fleet("epoll_serve_worker.py", tmp_path)
+    try:
+        for p in procs:
+            assert "SERVE_READY" in p.stdout.readline()
+        # Drive some shard reads so the accounting has data.
+        with AnonServeClient(eps[0], timeout=15) as ac:
+            for _ in range(5):
+                ac.get_shard(0)
+        with OpsClient(eps[0], timeout=15) as c:
+            local = c.hotkeys()
+            assert local[0]["id"] == 0 and local[0]["armed"]
+            assert local[0]["gets"] >= 5
+            fleet = c.hotkeys(fleet=True)
+            assert fleet["kind"] == "hotkeys"
+            assert fleet["silent"] == []
+            assert set(fleet["ranks"]) == {"0", "1"}
+            assert fleet["ranks"]["0"][0]["gets"] >= 5
+            assert fleet["ranks"]["1"][0]["armed"] is True
+    finally:
+        outs = []
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.stdin.write("\n")
+                    p.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    pass
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=120)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+    for out in outs:
+        assert "SERVE_WORKER_OK" in out, out[-2000:]
+
+
+@needs_gxx
+def test_hotkeys_roundtrip_tcp_fleet_report(tmp_path):
+    """The blocking tcp engine refuses anonymous scrapers, so the rank
+    assembles the fleet view itself (MV_OpsFleetReport) — the
+    ``"hotkeys"`` kind must round-trip over the rank wire with both
+    ranks' hot keys present."""
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    eps, procs = _spawn_fleet("tcp_ops_worker.py", tmp_path)
+    outs = []
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=120)[0])
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs.append(p.communicate()[0])
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0 and "TCP_OPS_OK" in out, out[-2000:]
+    line = next(ln for ln in outs[0].splitlines()
+                if ln.startswith("FLEET_HOTKEYS "))
+    fleet = json.loads(line[len("FLEET_HOTKEYS "):])
+    assert fleet["scope"] == "fleet" and fleet["kind"] == "hotkeys"
+    assert fleet["silent"] == []
+    # Rank 0's shard saw hot row 5; rank 1's shard hot row 45.
+    r0 = {e["key"]: e for e in
+          fleet["ranks"]["0"][0]["hotkeys"]["topk"]}
+    r1 = {e["key"]: e for e in
+          fleet["ranks"]["1"][0]["hotkeys"]["topk"]}
+    assert "5" in r0 and r0["5"]["count"] >= 20    # both ranks' traffic
+    assert "45" in r1 and r1["45"]["count"] >= 20

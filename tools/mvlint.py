@@ -88,6 +88,18 @@ Rules (docs/static_analysis.md has the full rationale):
   either propagate it or drop the ``as`` clause (nested spans inherit
   the thread-local id without it).
 
+- **MV011 per-key-label-cardinality** — a registry series may not be
+  labeled by a table key / row id: ``metrics.counter(...,
+  labels={"row": row_id})`` mints one series per key, and a sparse
+  table has millions — the registry's cardinality cap collapses them
+  into one useless overflow series (and before the cap, the registry
+  IS the leak).  Per-key accounting belongs in a bounded sketch
+  (``multiverso_tpu/sketch.py`` — space-saving top-K / count-min), not
+  in label sets; label by bounded dimensions (table name, rank, dir).
+  Fires when a ``labels=`` dict value's expression derives from an
+  identifier that names a key/row (``key``, ``row``, ``row_id``,
+  ``word``, ``token``...), including through ``str()`` / f-strings.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -544,6 +556,84 @@ def check_observability_bypass(tree, path):
     return out
 
 
+# Identifiers that mark a label value as key-derived for MV011.  The
+# match is per underscore-separated word, so `table_id`/`rank` stay
+# legal (bounded dimensions) while `key`, `row_id`, `hot_row`, `word`,
+# `token_id` fire.  "id"/"ids" alone intentionally do NOT fire — every
+# bounded handle is an id; the unbounded ones are keys/rows/tokens.
+KEYISH_WORDS = {"key", "keys", "row", "rows", "rowid", "word", "words",
+                "token", "tokens"}
+
+# Registry accessor names whose labels= MV011 inspects.
+REGISTRY_ACCESSORS = {"counter", "gauge", "histogram"}
+
+
+def _keyish_name(name: str) -> bool:
+    return any(w in KEYISH_WORDS for w in name.lower().split("_"))
+
+
+def _keyish_expr(node) -> "str | None":
+    """Terminal identifier of `node`'s expression that names a table
+    key/row id, or None.  Walks through str()/format calls, f-strings,
+    subscripts and attributes — `str(row_id)`, `f"{key}"`,
+    `self.hot_rows[i]` all derive from a key."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.arg):
+            name = sub.arg
+        if name and _keyish_name(name):
+            return name
+    return None
+
+
+def check_label_cardinality(tree, path):
+    """MV011: metrics labels= whose value derives from a table key/row
+    id — unbounded series; route per-key accounting through a sketch."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_registry = (
+            (isinstance(f, ast.Name) and f.id in REGISTRY_ACCESSORS)
+            or (isinstance(f, ast.Attribute)
+                and f.attr in REGISTRY_ACCESSORS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "metrics"))
+        if not is_registry:
+            continue
+        labels = next((k.value for k in node.keywords
+                       if k.arg == "labels"), None)
+        if not isinstance(labels, ast.Dict):
+            continue
+        for key_node, val in zip(labels.keys, labels.values):
+            derived = _keyish_expr(val)
+            label = (key_node.value
+                     if isinstance(key_node, ast.Constant) else "?")
+            if derived is None and isinstance(key_node, ast.Constant) \
+                    and isinstance(key_node.value, str) \
+                    and _keyish_name(key_node.value) \
+                    and not isinstance(val, ast.Constant):
+                # labels={"key": anything-non-literal}: the label NAME
+                # says it's per-key even when the value spelling hides it.
+                derived = key_node.value
+            if derived is not None:
+                out.append(Finding(
+                    path, val.lineno, "MV011",
+                    f"labels= value for '{label}' derives from "
+                    f"'{derived}' — a per-key/row label mints one "
+                    f"series per key (unbounded cardinality; the "
+                    f"registry cap collapses them into one overflow "
+                    f"series).  Per-key accounting goes through a "
+                    f"bounded sketch (multiverso_tpu/sketch.py), not "
+                    f"registry labels"))
+    return out
+
+
 # ---------------------------------------------------------------- MV009
 # Native reactor-context lint: the only non-Python rule.  A file opts in
 # with this marker (the epoll engine sources carry it); the rule then
@@ -647,6 +737,7 @@ def lint_file(path):
         # series classes it registers.
         if os.path.basename(path) != "metrics.py":
             findings += check_observability_bypass(tree, path)
+            findings += check_label_cardinality(tree, path)
     # Per-line suppressions.
     lines = src.splitlines()
     kept = []

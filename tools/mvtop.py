@@ -8,11 +8,23 @@ per rank: health verdict, serve queue depth vs the shed bound, live
 anonymous clients/sheds, heartbeat-lease dead peers, table versions, and
 blackbox trigger count.
 
+Under ``--watch`` every refresh also derives TIME-SERIES RATES from the
+two most recent scrapes — versions/s (the apply rate), served gets/adds
+per second, client sheds/s — plus a sparkline of the recent apply-rate
+history, so a hot shard reads as a moving number instead of a counter
+you eyeball twice.
+
+``--hotkeys`` switches to the workload view (the ``"hotkeys"`` OpsQuery
+kind): one row per table per rank ranked by bucket-load skew ratio,
+with the space-saving top-K hot keys, observed staleness, and NaN/Inf
+update-health sentinels.
+
 Usage::
 
     python tools/mvtop.py HOST:PORT [HOST:PORT ...]       # one snapshot
     python tools/mvtop.py HOST:PORT --fleet               # rank fans out
     python tools/mvtop.py HOST:PORT ... --watch 2         # refresh loop
+    python tools/mvtop.py HOST:PORT --hotkeys [--fleet]   # workload view
     python tools/mvtop.py HOST:PORT --metrics [--fleet]   # raw Prometheus
 
 ``--fleet`` asks the FIRST endpoint to aggregate the whole fleet
@@ -34,6 +46,78 @@ from multiverso_tpu.ops.introspect import OpsClient  # noqa: E402
 
 _COLS = ("rank", "up", "healthy", "engine", "queue", "max", "clients",
          "shed", "dead", "tables", "vmax", "agg", "boxes")
+# Rate columns appended by a RateTracker (watch mode): per-second deltas
+# between consecutive scrapes + a sparkline of recent apply rates.
+_RATE_COLS = ("v/s", "get/s", "add/s", "shed/s", "trend")
+
+_HOTKEY_COLS = ("rank", "table", "gets", "adds", "skew", "stale~",
+                "nan", "inf", "top keys")
+
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 8) -> str:
+    """Render the last ``width`` values as a unicode sparkline ("-"
+    when there is nothing to show)."""
+    vals = [float(v) for v in list(values)[-width:]]
+    if not vals:
+        return "-"
+    hi = max(vals)
+    if hi <= 0:
+        return _SPARK_GLYPHS[0] * len(vals)
+    return "".join(
+        _SPARK_GLYPHS[min(len(_SPARK_GLYPHS) - 1,
+                          int(v / hi * (len(_SPARK_GLYPHS) - 1)))]
+        for v in vals)
+
+
+def compute_rates(prev: dict, cur: dict, dt: float) -> dict:
+    """Per-second rates between two scrape samples of one rank.
+
+    ``prev``/``cur`` are ``{counter_name: value}`` dicts (the vmax /
+    gets / adds / shed counters a health+tables scrape yields); the
+    result maps each key to ``max(0, (cur - prev) / dt)`` — a restarted
+    rank's counter reset reads as 0, not a negative rate."""
+    out = {}
+    if dt <= 0:
+        return {k: 0.0 for k in cur}
+    for k, v in cur.items():
+        try:
+            d = float(v) - float(prev.get(k, v))
+        except (TypeError, ValueError):
+            continue
+        out[k] = max(0.0, d / dt)
+    return out
+
+
+class RateTracker:
+    """Two-scrape delta state per rank (watch mode): feed each refresh's
+    raw counters, get the rate columns + sparkline back."""
+
+    HISTORY = 32
+
+    def __init__(self):
+        self._prev = {}      # rank -> (ts, counters)
+        self._trend = {}     # rank -> [recent v/s]
+
+    def update(self, rank: str, counters: dict,
+               now: float = None) -> dict:
+        ts = time.monotonic() if now is None else float(now)
+        cols = {c: "-" for c in _RATE_COLS}
+        prev = self._prev.get(rank)
+        self._prev[rank] = (ts, dict(counters))
+        if prev is None:
+            return cols
+        rates = compute_rates(prev[1], counters, ts - prev[0])
+        trend = self._trend.setdefault(rank, [])
+        trend.append(rates.get("vmax", 0.0))
+        del trend[:-self.HISTORY]
+        cols["v/s"] = f"{rates.get('vmax', 0.0):.1f}"
+        cols["get/s"] = f"{rates.get('gets', 0.0):.1f}"
+        cols["add/s"] = f"{rates.get('adds', 0.0):.1f}"
+        cols["shed/s"] = f"{rates.get('shed', 0.0):.1f}"
+        cols["trend"] = sparkline(trend)
+        return cols
 
 
 def _row_from_health(rank: str, h: dict, tables: list) -> dict:
@@ -53,6 +137,13 @@ def _row_from_health(rank: str, h: dict, tables: list) -> dict:
         "vmax": vmax,
         "agg": agg,
         "boxes": h.get("blackbox_triggers", 0),
+        # Raw counters for the rate tracker (dropped before render).
+        "_counters": {
+            "vmax": vmax,
+            "gets": sum(t.get("gets", 0) or 0 for t in tables),
+            "adds": sum(t.get("adds", 0) or 0 for t in tables),
+            "shed": h.get("client_shed", 0) or 0,
+        },
     }
 
 
@@ -92,12 +183,59 @@ def collect(endpoints: list, fleet: bool, timeout: float) -> list:
     return rows
 
 
-def render(rows: list) -> str:
+def _fmt_topk(entry: dict, n: int = 4) -> str:
+    top = (entry.get("hotkeys") or {}).get("topk") or []
+    return " ".join(f"{t['key']}:{t['count']}" for t in top[:n]) or "-"
+
+
+def hotkey_rows(endpoints: list, fleet: bool, timeout: float) -> list:
+    """One row per (rank, table), ranked by skew ratio descending —
+    the hot-shard triage view."""
+    per_rank = {}
+    if fleet:
+        with OpsClient(endpoints[0], timeout=timeout) as c:
+            fh = c.hotkeys(fleet=True)
+        for rank, tables in (fh.get("ranks") or {}).items():
+            per_rank[str(rank)] = tables or []
+    else:
+        for ep in endpoints:
+            try:
+                with OpsClient(ep, timeout=timeout) as c:
+                    h = c.health()
+                    per_rank[str(h.get("rank", ep))] = c.hotkeys()
+            except (ConnectionError, OSError, TimeoutError):
+                per_rank[str(ep)] = None
+    rows = []
+    for rank in sorted(per_rank):
+        tables = per_rank[rank]
+        if tables is None:
+            rows.append({c: "-" for c in _HOTKEY_COLS} | {"rank": rank})
+            continue
+        for t in tables:
+            if "gets" not in t:     # no local shard on this rank
+                continue
+            rows.append({
+                "rank": rank,
+                "table": t.get("id", "?"),
+                "gets": t.get("gets", 0),
+                "adds": t.get("adds", 0),
+                "skew": f"{t.get('skew_ratio', 0.0):.2f}",
+                "stale~": f"{t.get('staleness_mean', 0.0):.1f}",
+                "nan": t.get("nan_count", 0),
+                "inf": t.get("inf_count", 0),
+                "top keys": _fmt_topk(t),
+            })
+    rows.sort(key=lambda r: -float(r.get("skew", 0) or 0))
+    return rows
+
+
+def render(rows: list, cols=_COLS) -> str:
+    rows = [{c: r.get(c, "-") for c in cols} for r in rows]
     widths = {c: max(len(c), *(len(str(r[c])) for r in rows))
-              if rows else len(c) for c in _COLS}
-    out = ["  ".join(c.rjust(widths[c]) for c in _COLS)]
+              if rows else len(c) for c in cols}
+    out = ["  ".join(c.rjust(widths[c]) for c in cols)]
     for r in rows:
-        out.append("  ".join(str(r[c]).rjust(widths[c]) for c in _COLS))
+        out.append("  ".join(str(r[c]).rjust(widths[c]) for c in cols))
     return "\n".join(out)
 
 
@@ -110,20 +248,37 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics", action="store_true",
                     help="print the raw Prometheus exposition instead of "
                          "the table")
+    ap.add_argument("--hotkeys", action="store_true",
+                    help="workload view: tables ranked by bucket-load "
+                         "skew ratio, with top-K hot keys and NaN/Inf "
+                         "health sentinels")
     ap.add_argument("--watch", type=float, default=0.0, metavar="SEC",
-                    help="refresh every SEC seconds until interrupted")
+                    help="refresh every SEC seconds until interrupted "
+                         "(adds two-scrape rate columns + sparklines)")
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
 
+    tracker = RateTracker()
     while True:
         if args.metrics:
             with OpsClient(args.endpoints[0], timeout=args.timeout) as c:
                 print(c.metrics_text(fleet=args.fleet))
+        elif args.hotkeys:
+            rows = hotkey_rows(args.endpoints, args.fleet, args.timeout)
+            stamp = time.strftime("%H:%M:%S")
+            print(f"mvtop --hotkeys @ {stamp} — {len(rows)} table row(s)")
+            print(render(rows, _HOTKEY_COLS))
         else:
             rows = collect(args.endpoints, args.fleet, args.timeout)
+            cols = _COLS
+            if args.watch > 0:
+                cols = _COLS + _RATE_COLS
+                for row in rows:
+                    row.update(tracker.update(
+                        str(row["rank"]), row.get("_counters", {})))
             stamp = time.strftime("%H:%M:%S")
             print(f"mvtop @ {stamp} — {len(rows)} rank(s)")
-            print(render(rows))
+            print(render(rows, cols))
         if args.watch <= 0:
             return 0
         try:
