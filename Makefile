@@ -103,9 +103,20 @@ skew-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/skew_demo.py
 
+# Host-bridge smoke (docs/host_bridge.md): borrowed arena adds land
+# exactly with mid-flight releases deferred (no use-after-recycle), the
+# zero-copy path beats the copying path outright, and a transformer
+# trainer whose optimizer state lives on a remote assign-updater table
+# via the double-buffered OffloadedState reproduces the in-memory
+# baseline's loss trajectory BIT FOR BIT at equal steps.
+bridge-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bridge_demo.py
+
 # Demo umbrella: every acceptance smoke in sequence (each target builds
 # the native runtime once; later builds are no-ops).
-demos: metrics-demo serve-demo wire-demo fanin-demo ops-demo skew-demo
+demos: metrics-demo serve-demo wire-demo fanin-demo ops-demo skew-demo \
+       bridge-demo
 
 # Continuous perf gate (docs/PERF.md): diff the newest bench JSON line
 # against the committed BENCH_BASELINE.json with per-key noise bands;
@@ -118,5 +129,5 @@ clean:
 	$(MAKE) -C $(NATIVE) clean
 
 .PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
-        serve-demo wire-demo fanin-demo ops-demo skew-demo demos \
-        bench-gate clean
+        serve-demo wire-demo fanin-demo ops-demo skew-demo bridge-demo \
+        demos bench-gate clean

@@ -52,6 +52,34 @@ int MV_GetAsyncMatrixTableByRows(int32_t handle, float* data,
                                  int64_t cols, int32_t* wait_handle);
 int MV_WaitGet(int32_t wait_handle);
 int MV_CancelGet(int32_t wait_handle);
+int MV_ArenaAcquire(int64_t bytes, void** ptr);
+int MV_ArenaRelease(void* ptr);
+int MV_ArenaStats(long long* buffers, long long* free_buffers,
+                  long long* bytes, long long* in_flight,
+                  long long* deferred, long long* recycled,
+                  long long* pinned);
+int MV_AddArrayTableBorrowed(int32_t handle, const float* delta,
+                             int64_t size);
+int MV_AddAsyncArrayTableBorrowed(int32_t handle, const float* delta,
+                                  int64_t size);
+int MV_GetArrayTableBorrowed(int32_t handle, float* data, int64_t size);
+int MV_GetAsyncArrayTableBorrowed(int32_t handle, float* data,
+                                  int64_t size, int32_t* wait_handle);
+int MV_AddMatrixTableAllBorrowed(int32_t handle, const float* delta,
+                                 int64_t size);
+int MV_AddAsyncMatrixTableAllBorrowed(int32_t handle, const float* delta,
+                                      int64_t size);
+int MV_AddMatrixTableByRowsBorrowed(int32_t handle, const float* delta,
+                                    const int32_t* row_ids,
+                                    int64_t num_rows, int64_t cols);
+int MV_AddAsyncMatrixTableByRowsBorrowed(int32_t handle,
+                                         const float* delta,
+                                         const int32_t* row_ids,
+                                         int64_t num_rows, int64_t cols);
+int MV_GetAsyncMatrixTableByRowsBorrowed(int32_t handle, float* data,
+                                         const int32_t* row_ids,
+                                         int64_t num_rows, int64_t cols,
+                                         int32_t* wait_handle);
 int MV_NewKVTable(int32_t* handle);
 int MV_GetKV(int32_t handle, const char* key, float* value);
 int MV_AddKV(int32_t handle, const char* key, float delta);
@@ -243,6 +271,79 @@ function mv.flush_adds(handle)
   check(C.MV_FlushAdds(handle or -1), "MV_FlushAdds")
 end
 
+--- Host-bridge arena (docs/host_bridge.md): acquire a recycled,
+--- 64-byte-aligned, best-effort-pinned host buffer of `bytes` bytes as
+--- an FFI void*.  Caller-held until mv.arena_release(ptr); borrowed
+--- sends started from it defer the recycle past their in-flight window.
+function mv.arena_acquire(bytes)
+  local p = ffi.new("void*[1]")
+  check(C.MV_ArenaAcquire(bytes, p), "MV_ArenaAcquire")
+  return p[0]
+end
+
+--- Release an arena buffer (safe mid-flight: recycling defers behind
+--- in-flight borrows; rc -2 on a double release raises).
+function mv.arena_release(ptr)
+  check(C.MV_ArenaRelease(ptr), "MV_ArenaRelease")
+end
+
+--- Arena counters: buffers, free_buffers, bytes, in_flight, deferred,
+--- recycled, pinned (see MV_ArenaStats).
+function mv.arena_stats()
+  local v = {}
+  for i = 1, 7 do v[i] = ffi.new("long long[1]") end
+  check(C.MV_ArenaStats(v[1], v[2], v[3], v[4], v[5], v[6], v[7]),
+        "MV_ArenaStats")
+  local out = {}
+  for i = 1, 7 do out[i] = tonumber(v[i][0]) end
+  return unpack(out)
+end
+
+--- Borrowed fast-path siblings (docs/host_bridge.md): `data` must lie
+--- inside a live arena buffer (rc -7 raises otherwise) — adds ship the
+--- bytes zero-copy into the scatter-gather send path; async gets hold
+--- the buffer until the ticket is consumed.
+function mv.add_array_borrowed(handle, data, size, async)
+  if async then
+    check(C.MV_AddAsyncArrayTableBorrowed(handle, data, size),
+          "MV_AddAsyncArrayTableBorrowed")
+  else
+    check(C.MV_AddArrayTableBorrowed(handle, data, size),
+          "MV_AddArrayTableBorrowed")
+  end
+end
+
+function mv.get_array_borrowed(handle, data, size)
+  check(C.MV_GetArrayTableBorrowed(handle, data, size),
+        "MV_GetArrayTableBorrowed")
+  return data
+end
+
+
+function mv.add_matrix_all_borrowed(handle, data, size, async)
+  if async then
+    check(C.MV_AddAsyncMatrixTableAllBorrowed(handle, data, size),
+          "MV_AddAsyncMatrixTableAllBorrowed")
+  else
+    check(C.MV_AddMatrixTableAllBorrowed(handle, data, size),
+          "MV_AddMatrixTableAllBorrowed")
+  end
+end
+
+function mv.add_matrix_rows_borrowed(handle, data, row_ids, k, cols,
+                                     async)
+  if async then
+    check(C.MV_AddAsyncMatrixTableByRowsBorrowed(handle, data, row_ids,
+                                                 k, cols),
+          "MV_AddAsyncMatrixTableByRowsBorrowed")
+  else
+    check(C.MV_AddMatrixTableByRowsBorrowed(handle, data, row_ids, k,
+                                            cols),
+          "MV_AddMatrixTableByRowsBorrowed")
+  end
+end
+
+
 --- Transport byte/frame ledger: returns sent_bytes, recv_bytes,
 --- sent_msgs, recv_msgs over the native wire (headers included).
 function mv.wire_stats()
@@ -379,6 +480,24 @@ local function make_async_get(ticket, buf)
 end
 
 -- ---------------------------------------------------------------- Array
+
+--- Async borrowed gets (docs/host_bridge.md): defined after
+--- make_async_get so the wrappers close over the local.
+function mv.get_array_async_borrowed(handle, data, size)
+  local t = ffi.new("int32_t[1]")
+  check(C.MV_GetAsyncArrayTableBorrowed(handle, data, size, t),
+        "MV_GetAsyncArrayTableBorrowed")
+  return make_async_get(t[0], data)
+end
+
+function mv.get_matrix_rows_async_borrowed(handle, data, row_ids, k,
+                                           cols)
+  local t = ffi.new("int32_t[1]")
+  check(C.MV_GetAsyncMatrixTableByRowsBorrowed(handle, data, row_ids, k,
+                                               cols, t),
+        "MV_GetAsyncMatrixTableByRowsBorrowed")
+  return make_async_get(t[0], data)
+end
 
 mv.ArrayTableHandler = {}
 mv.ArrayTableHandler.__index = mv.ArrayTableHandler

@@ -496,6 +496,7 @@ class TransformerTrainer:
             self.params)
         self._step = None
         self._eval = None
+        self._offload = None  # (bridge, leaf shapes/shardings) — see below
 
     def _apply_updates(self, params, state, grads):
         """One updater application over the whole param pytree."""
@@ -579,6 +580,11 @@ class TransformerTrainer:
         fixed.
         """
         from ..parallel.sharding import batch_placer
+        if self._offload is not None:
+            raise RuntimeError(
+                "train_steps_fused keeps the state on device across the "
+                "whole fused program — incompatible with offload_state "
+                "(use train_step_async)")
         fn = getattr(self, "_multi_step", None)
         if fn is None:
             raw = self._raw_step()
@@ -601,6 +607,77 @@ class TransformerTrainer:
                                            jnp.int32(n))
         return loss
 
+    # ------------------------------------------------------ state offload
+    def offload_state(self, bridge) -> None:
+        """Move the optimizer state to a remote store (ZeRO-style
+        offload over the host bridge, docs/host_bridge.md).
+
+        ``bridge`` is a :class:`~multiverso_tpu.parallel.OffloadedState`
+        sized to the flat state element count (``offload_size()``) whose
+        backing fleet runs ``-updater_type=assign`` — the bridge is a
+        bit-exact store, so the offloaded run's loss trajectory matches
+        the in-memory baseline bit for bit (``make bridge-demo``
+        asserts exactly that).  After this call, ``train_step_async``
+        round-trips the state each step: fetch the prefetched vector,
+        rebuild the device pytree, step, push the new state async and
+        prefetch the next — the wire rides behind the tail of the
+        step's device execution instead of serializing with it.  The
+        trade is host<->device traffic of one state set per step for
+        state that no longer occupies device memory between steps."""
+        leaves = jax.tree_util.tree_leaves(self.state)
+        if not leaves:
+            raise ValueError(
+                f"updater '{self.updater.name}' keeps no optimizer "
+                f"state — nothing to offload")
+        if bridge.size != self.offload_size():
+            raise ValueError(
+                f"bridge sized {bridge.size}, state needs "
+                f"{self.offload_size()} elements")
+        self._offload = bridge
+        bridge.init(self._state_to_flat())
+        # The device copies now live remotely; drop them so the memory
+        # relief is real (rebuilt from the bridge on the next step).
+        self.state = jax.tree_util.tree_map(
+            lambda p: tuple(None for _ in range(self.updater.num_slots)),
+            self.params)
+        bridge.prefetch()
+
+    def offload_size(self) -> int:
+        """Flat float32 element count of the optimizer state — the
+        ``OffloadedState`` size this trainer needs."""
+        return int(sum(np.prod(p.shape)
+                       for p in jax.tree_util.tree_leaves(self.params))
+                   ) * self.updater.num_slots
+
+    def _state_to_flat(self, state=None) -> np.ndarray:
+        leaves = jax.tree_util.tree_leaves(
+            self.state if state is None else state)
+        out = np.empty(self.offload_size(), np.float32)
+        pos = 0
+        for leaf in leaves:
+            n = int(np.prod(leaf.shape))
+            np.copyto(out[pos:pos + n],
+                      np.asarray(leaf, np.float32).ravel())
+            pos += n
+        return out
+
+    def _flat_to_state(self, flat: np.ndarray):
+        """Rebuild the sharded state pytree from the bridge's vector
+        (device_put per leaf with the matching param sharding)."""
+        flat_p, tree = jax.tree_util.tree_flatten(self.params)
+        pos = 0
+        slots_per = self.updater.num_slots
+        rebuilt = []
+        for p in flat_p:
+            n = int(np.prod(p.shape))
+            slots = []
+            for _ in range(slots_per):
+                host = flat[pos:pos + n].reshape(p.shape)
+                slots.append(jax.device_put(host, p.sharding))
+                pos += n
+            rebuilt.append(tuple(slots))
+        return jax.tree_util.tree_unflatten(tree, rebuilt)
+
     def train_step_async(self, tokens, accum: int = 1) -> jax.Array:
         """Enqueue one step; returns the device loss scalar (no host
         sync).  Back-to-back callers (the bench loop) pipeline dispatches
@@ -621,8 +698,23 @@ class TransformerTrainer:
             step = jax.jit(self._raw_step(accum), donate_argnums=(0, 1))
             self._step[accum] = (step, place)
         step, place = self._step[accum]
-        self.params, self.state, loss = step(self.params, self.state,
-                                             place(tokens))
+        if self._offload is None:
+            self.params, self.state, loss = step(self.params, self.state,
+                                                 place(tokens))
+            return loss
+        # Offloaded state (docs/host_bridge.md): the vector prefetched
+        # during the previous step's tail is ready (or fetched now on
+        # the first step), rebuilt on device, donated into the step;
+        # the new state ships back ASYNC and the next prefetch rides
+        # behind it (FIFO) while the caller moves on.
+        with dashboard.monitor("Transformer::offload_wait"):
+            state = self._flat_to_state(self._offload.wait())
+        self.params, new_state, loss = step(self.params, state,
+                                            place(tokens))
+        with dashboard.monitor("Transformer::offload_push"):
+            self._offload.push(self._state_to_flat(new_state))
+            self._offload.prefetch()
+        del new_state  # device copies die; the remote store owns them
         return loss
 
     def train_step(self, tokens) -> float:
@@ -640,11 +732,16 @@ class TransformerTrainer:
     # ------------------------------------------------------------ checkpoint
     def save(self, uri: str) -> None:
         """Snapshot params + updater state (collective; rank-0 atomic
-        write — same durability as the table checkpoints)."""
+        write — same durability as the table checkpoints).  With the
+        state offloaded, it is re-materialized from the bridge first
+        (the next step's wait simply pays one blocking fetch)."""
         from .. import checkpoint
 
+        state = self.state
+        if self._offload is not None:
+            state = self._flat_to_state(self._offload.wait())
         checkpoint.save_pytree(uri, {"params": self.params,
-                                     "state": self.state})
+                                     "state": state})
 
     def restore(self, uri: str) -> None:
         """Load a snapshot onto THIS trainer's mesh/shardings (the
@@ -652,6 +749,19 @@ class TransformerTrainer:
         params' shardings)."""
         from .. import checkpoint
 
+        like_state = self.state
+        if self._offload is not None:
+            # Offloaded runs keep no device state; restore against a
+            # zeros-like template, then re-seed the remote store.
+            like_state = jax.tree_util.tree_map(
+                lambda p: tuple(jnp.zeros_like(p)
+                                for _ in range(self.updater.num_slots)),
+                self.params)
         snap = checkpoint.restore_pytree(
-            uri, like={"params": self.params, "state": self.state})
-        self.params, self.state = snap["params"], snap["state"]
+            uri, like={"params": self.params, "state": like_state})
+        self.params = snap["params"]
+        if self._offload is not None:
+            self._offload.init(self._state_to_flat(snap["state"]))
+            self._offload.prefetch()
+        else:
+            self.state = snap["state"]

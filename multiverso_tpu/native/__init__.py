@@ -24,8 +24,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["ensure_built", "load", "NativeRuntime", "lib_path",
-           "BusyError"]
+__all__ = ["ensure_built", "load", "NativeRuntime", "HostArena",
+           "lib_path", "BusyError", "ArenaError"]
 
 
 class BusyError(RuntimeError):
@@ -36,6 +36,15 @@ class BusyError(RuntimeError):
     work, so a retry cannot double-apply.  ``fault.RetryPolicy`` with
     ``retry_on=(BusyError,)`` is the house backoff (the serve client
     wires this up by default)."""
+
+class ArenaError(RuntimeError):
+    """A ``*Borrowed`` call's buffer is not (entirely) inside a live
+    :class:`HostArena` buffer (C API rc -7; docs/host_bridge.md).
+
+    Borrowed calls fail loudly instead of silently copying — allocate
+    the buffer with ``NativeRuntime.arena().alloc(...)`` (or drop the
+    ``borrowed``/``arena`` argument to take the copying path)."""
+
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB = os.path.join(_DIR, "build", "libmvtpu.so")
@@ -109,6 +118,34 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_GetAsyncArrayTable.argtypes = [ctypes.c_int32, c_float_p,
                                           ctypes.c_int64, c_int32_p]
     lib.MV_GetAsyncArrayTable.restype = ctypes.c_int
+    # ---- host-bridge fast path (docs/host_bridge.md) -----------------
+    lib.MV_ArenaAcquire.argtypes = [ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_void_p)]
+    lib.MV_ArenaAcquire.restype = ctypes.c_int
+    lib.MV_ArenaRelease.argtypes = [ctypes.c_void_p]
+    lib.MV_ArenaRelease.restype = ctypes.c_int
+    lib.MV_ArenaStats.argtypes = [ctypes.POINTER(ctypes.c_longlong)] * 7
+    lib.MV_ArenaStats.restype = ctypes.c_int
+    for name in ("MV_AddArrayTableBorrowed", "MV_AddAsyncArrayTableBorrowed",
+                 "MV_GetArrayTableBorrowed",
+                 "MV_AddMatrixTableAllBorrowed",
+                 "MV_AddAsyncMatrixTableAllBorrowed"):
+        getattr(lib, name).argtypes = [ctypes.c_int32, c_float_p,
+                                       ctypes.c_int64]
+        getattr(lib, name).restype = ctypes.c_int
+    lib.MV_GetAsyncArrayTableBorrowed.argtypes = [
+        ctypes.c_int32, c_float_p, ctypes.c_int64, c_int32_p]
+    lib.MV_GetAsyncArrayTableBorrowed.restype = ctypes.c_int
+    for name in ("MV_AddMatrixTableByRowsBorrowed",
+                 "MV_AddAsyncMatrixTableByRowsBorrowed"):
+        getattr(lib, name).argtypes = [
+            ctypes.c_int32, c_float_p, c_int32_p, ctypes.c_int64,
+            ctypes.c_int64]
+        getattr(lib, name).restype = ctypes.c_int
+    lib.MV_GetAsyncMatrixTableByRowsBorrowed.argtypes = [
+        ctypes.c_int32, c_float_p, c_int32_p, ctypes.c_int64,
+        ctypes.c_int64, c_int32_p]
+    lib.MV_GetAsyncMatrixTableByRowsBorrowed.restype = ctypes.c_int
     lib.MV_GetAsyncMatrixTableByRows.argtypes = [
         ctypes.c_int32, c_float_p, c_int32_p, ctypes.c_int64,
         ctypes.c_int64, c_int32_p]
@@ -217,6 +254,25 @@ def _f32(a) -> np.ndarray:
     return np.ascontiguousarray(a, dtype=np.float32)
 
 
+def _contig_f32(a: np.ndarray, size: int, what: str) -> np.ndarray:
+    """Validate (never copy) a caller buffer for the borrow/out=
+    protocol (docs/host_bridge.md): float32, C-contiguous, exactly
+    ``size`` elements — raising beats a silent astype/copy, which is
+    the very churn the fast path exists to kill (mvlint MV012)."""
+    if not isinstance(a, np.ndarray):
+        raise TypeError(f"{what}: expected an ndarray, got {type(a)!r}")
+    if a.dtype != np.float32:
+        raise ValueError(f"{what}: dtype {a.dtype} != float32 — the "
+                         f"borrow/out= protocol never converts")
+    if not a.flags["C_CONTIGUOUS"]:
+        raise ValueError(f"{what}: buffer is not C-contiguous — the "
+                         f"borrow/out= protocol never copies")
+    if a.size != size:
+        raise ValueError(f"{what}: buffer has {a.size} elements, "
+                         f"expected {size}")
+    return a
+
+
 def _fp(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
@@ -269,6 +325,80 @@ class AsyncGet:
             pass  # interpreter teardown / already reclaimed at shutdown
 
 
+class HostArena:
+    """Numpy facade over the native pinned buffer arena
+    (docs/host_bridge.md, ``mvtpu/host_arena.h``).
+
+    ``alloc()`` hands out numpy arrays BACKED BY arena buffers —
+    recycled, 64-byte-aligned, best-effort mlock'd, and C-contiguous
+    float32 by construction (MV008 holds without an
+    ``ascontiguousarray`` in sight).  Arrays allocated here are what
+    the ``borrowed=``/``out=``/``arena=`` arguments of
+    :class:`NativeRuntime` accept: adds ship the bytes zero-copy into
+    the scatter-gather send path, async gets land replies straight
+    into them.
+
+    Ownership: an array is yours from ``alloc()`` until ``release()``.
+    Releasing while a borrowed send is still in flight is safe — the
+    native arena defers recycling until the wire is done — but the
+    ndarray must not be READ OR WRITTEN after ``release()`` returns
+    (a recycled buffer may be handed to the next ``alloc``).
+    """
+
+    def __init__(self, rt: "NativeRuntime"):
+        self._rt = rt
+        self._bases: dict = {}  # mvlint: disable=MV007 — one entry per live buffer, freed by release()
+
+    def alloc(self, shape, dtype=np.float32) -> np.ndarray:
+        shape = (int(shape),) if np.isscalar(shape) else tuple(shape)
+        dt = np.dtype(dtype)
+        nbytes = max(int(np.prod(shape)) * dt.itemsize, 1)
+        p = ctypes.c_void_p()
+        self._rt._check(
+            self._rt.lib.MV_ArenaAcquire(nbytes, ctypes.byref(p)),
+            "MV_ArenaAcquire")
+        raw = (ctypes.c_char * nbytes).from_address(p.value)
+        arr = np.frombuffer(raw, dtype=dt).reshape(shape)
+        self._bases[p.value] = True
+        return arr
+
+    def owns(self, arr: np.ndarray) -> bool:
+        """True when ``arr``'s base address is a live arena buffer this
+        facade handed out (offset-0 views included)."""
+        try:
+            addr = arr.__array_interface__["data"][0]
+        except (AttributeError, TypeError):
+            return False
+        return addr in self._bases
+
+    def release(self, arr: np.ndarray) -> None:
+        """Return ``arr``'s buffer to the arena.  The array (and every
+        view of it) is dead to the caller afterwards; in-flight
+        borrowed sends keep the memory alive natively until they
+        drain."""
+        addr = arr.__array_interface__["data"][0]
+        if addr not in self._bases:
+            raise ArenaError(
+                "release(): not an arena-allocated array (or already "
+                "released)")
+        del self._bases[addr]
+        self._rt._check(self._rt.lib.MV_ArenaRelease(
+            ctypes.c_void_p(addr)), "MV_ArenaRelease")
+
+    def stats(self) -> dict:
+        """Native arena counters: ``buffers``/``free_buffers``/``bytes``
+        /``in_flight``/``deferred``/``recycled``/``pinned`` —
+        ``deferred`` counts releases parked behind in-flight borrows,
+        the observable proof of the lifetime contract."""
+        vals = [ctypes.c_longlong(0) for _ in range(7)]
+        self._rt._check(
+            self._rt.lib.MV_ArenaStats(*(ctypes.byref(v) for v in vals)),
+            "MV_ArenaStats")
+        keys = ("buffers", "free_buffers", "bytes", "in_flight",
+                "deferred", "recycled", "pinned")
+        return dict(zip(keys, (v.value for v in vals)))
+
+
 class NativeRuntime:
     """Numpy-facing wrapper over the MV_* C API."""
 
@@ -303,6 +433,16 @@ class NativeRuntime:
                        eps=1e-8) -> None:
         self.lib.MV_SetAddOption(learning_rate, momentum, rho, eps)
 
+    # ------------------------------------------------- host bridge
+    def arena(self) -> HostArena:
+        """The process's pinned buffer arena (docs/host_bridge.md):
+        allocate numpy arrays here and pass them to the ``borrowed=``/
+        ``out=``/``arena=`` arguments below for the zero-copy path."""
+        a = getattr(self, "_arena", None)
+        if a is None:
+            a = self._arena = HostArena(self)
+        return a
+
     # ------------------------------------------------------------- arrays
     def new_array_table(self, size: int) -> int:
         h = ctypes.c_int32(-1)
@@ -310,23 +450,62 @@ class NativeRuntime:
                     "MV_NewArrayTable")
         return h.value
 
-    def array_get(self, handle: int, size: int) -> np.ndarray:
-        out = np.zeros(size, np.float32)
+    def array_get(self, handle: int, size: int,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Pull the array; ``out=`` fills a preallocated float32 buffer
+        (no per-call allocation+zeroing — the host-bridge out=
+        protocol, docs/host_bridge.md) and returns it."""
+        if out is None:
+            out = np.zeros(size, np.float32)
+        else:
+            out = _contig_f32(out, size, "array_get(out=)")
         self._check(self.lib.MV_GetArrayTable(handle, _fp(out), size),
                     "MV_GetArrayTable")
         return out
 
-    def array_get_async(self, handle: int, size: int) -> AsyncGet:
-        """Start a non-blocking Get; overlap compute, then ``wait()``."""
-        out = np.zeros(size, np.float32)
+    def array_get_async(self, handle: int, size: int,
+                        out: Optional[np.ndarray] = None,
+                        arena: Optional[HostArena] = None) -> AsyncGet:
+        """Start a non-blocking Get; overlap compute, then ``wait()``.
+
+        ``out=`` lands the reply in a preallocated buffer.  With
+        ``arena=`` (and ``out`` allocated from it) the native side
+        holds the buffer until the ticket is consumed, so an early
+        ``arena.release(out)`` cannot recycle memory a late shard
+        reply could still scatter into."""
+        if out is None:
+            out = np.zeros(size, np.float32)
+        else:
+            out = _contig_f32(out, size, "array_get_async(out=)")
         t = ctypes.c_int32(-1)
-        self._check(
-            self.lib.MV_GetAsyncArrayTable(handle, _fp(out), size,
-                                           ctypes.byref(t)),
-            "MV_GetAsyncArrayTable")
+        if arena is not None:
+            if not arena.owns(out):
+                raise ArenaError("array_get_async: out= is not an "
+                                 "arena-allocated buffer")
+            self._check(
+                self.lib.MV_GetAsyncArrayTableBorrowed(
+                    handle, _fp(out), size, ctypes.byref(t)),
+                "MV_GetAsyncArrayTableBorrowed")
+        else:
+            self._check(
+                self.lib.MV_GetAsyncArrayTable(handle, _fp(out), size,
+                                               ctypes.byref(t)),
+                "MV_GetAsyncArrayTable")
         return AsyncGet(self, t.value, out, (size,))
 
-    def array_add(self, handle: int, delta, sync: bool = True) -> None:
+    def array_add(self, handle: int, delta, sync: bool = True,
+                  borrowed: bool = False) -> None:
+        """Push a delta.  ``borrowed=True``: ``delta`` is an arena
+        array (``arena().alloc``) shipped ZERO-COPY into the send path
+        — do not mutate it until the add is known drained (a blocking
+        add returning, or any later get/barrier on the table)."""
+        if borrowed:
+            d = _contig_f32(delta, int(delta.size), "array_add(borrowed)")
+            fn = (self.lib.MV_AddArrayTableBorrowed if sync
+                  else self.lib.MV_AddAsyncArrayTableBorrowed)
+            self._check(fn(handle, _fp(d), d.size),
+                        "MV_AddArrayTableBorrowed")
+            return
         d = _f32(delta)
         fn = (self.lib.MV_AddArrayTable if sync
               else self.lib.MV_AddAsyncArrayTable)
@@ -348,46 +527,101 @@ class NativeRuntime:
             "MV_NewSparseMatrixTable")
         return h.value
 
-    def matrix_get_all(self, handle: int, rows: int, cols: int) -> np.ndarray:
-        out = np.zeros(rows * cols, np.float32)
+    def matrix_get_all(self, handle: int, rows: int, cols: int,
+                       out: Optional[np.ndarray] = None) -> np.ndarray:
+        if out is None:
+            out = np.zeros(rows * cols, np.float32)
+        else:
+            # Validate BEFORE reshaping: reshape(-1) of a strided array
+            # would copy and the caller's buffer would never fill.
+            out = _contig_f32(out, rows * cols,
+                              "matrix_get_all(out=)").ravel()
         self._check(
             self.lib.MV_GetMatrixTableAll(handle, _fp(out), out.size),
             "MV_GetMatrixTableAll")
         return out.reshape(rows, cols)
 
-    def matrix_add_all(self, handle: int, delta, sync: bool = True) -> None:
+    def matrix_add_all(self, handle: int, delta, sync: bool = True,
+                       borrowed: bool = False) -> None:
+        if borrowed:
+            d = _contig_f32(delta, int(delta.size),
+                            "matrix_add_all(borrowed)").ravel()
+            fn = (self.lib.MV_AddMatrixTableAllBorrowed if sync
+                  else self.lib.MV_AddAsyncMatrixTableAllBorrowed)
+            self._check(fn(handle, _fp(d), d.size),
+                        "MV_AddMatrixTableAllBorrowed")
+            return
         d = _f32(delta).ravel()
         fn = (self.lib.MV_AddMatrixTableAll if sync
               else self.lib.MV_AddAsyncMatrixTableAll)
         self._check(fn(handle, _fp(d), d.size), "MV_AddMatrixTableAll")
 
-    def matrix_get_rows(self, handle: int, row_ids, cols: int) -> np.ndarray:
+    def matrix_get_rows(self, handle: int, row_ids, cols: int,
+                        out: Optional[np.ndarray] = None) -> np.ndarray:
         ids = np.ascontiguousarray(row_ids, dtype=np.int32)
-        out = np.zeros(ids.size * cols, np.float32)
+        if out is None:
+            out = np.zeros(ids.size * cols, np.float32)
+        else:
+            out = _contig_f32(out, ids.size * cols,
+                              "matrix_get_rows(out=)").ravel()
         self._check(
             self.lib.MV_GetMatrixTableByRows(handle, _fp(out), _ip(ids),
                                              ids.size, cols),
             "MV_GetMatrixTableByRows")
         return out.reshape(ids.size, cols)
 
-    def matrix_get_rows_async(self, handle: int, row_ids,
-                              cols: int) -> AsyncGet:
+    def matrix_get_rows_async(self, handle: int, row_ids, cols: int,
+                              out: Optional[np.ndarray] = None,
+                              arena: Optional[HostArena] = None
+                              ) -> AsyncGet:
         """Start a non-blocking row pull (``MV_GetAsyncMatrixTableByRows``);
         the ids are consumed before this returns.  On a sparse table the
-        async path bypasses the worker row cache entirely."""
+        async path bypasses the worker row cache entirely.  ``out=``/
+        ``arena=`` follow :meth:`array_get_async`'s borrow protocol."""
         ids = np.ascontiguousarray(row_ids, dtype=np.int32)
-        out = np.zeros(ids.size * cols, np.float32)
+        if out is None:
+            out = np.zeros(ids.size * cols, np.float32)
+        else:
+            out = _contig_f32(out, ids.size * cols,
+                              "matrix_get_rows_async(out=)").ravel()
         t = ctypes.c_int32(-1)
-        self._check(
-            self.lib.MV_GetAsyncMatrixTableByRows(
-                handle, _fp(out), _ip(ids), ids.size, cols,
-                ctypes.byref(t)),
-            "MV_GetAsyncMatrixTableByRows")
+        if arena is not None:
+            if not arena.owns(out):
+                raise ArenaError("matrix_get_rows_async: out= is not an "
+                                 "arena-allocated buffer")
+            self._check(
+                self.lib.MV_GetAsyncMatrixTableByRowsBorrowed(
+                    handle, _fp(out), _ip(ids), ids.size, cols,
+                    ctypes.byref(t)),
+                "MV_GetAsyncMatrixTableByRowsBorrowed")
+        else:
+            self._check(
+                self.lib.MV_GetAsyncMatrixTableByRows(
+                    handle, _fp(out), _ip(ids), ids.size, cols,
+                    ctypes.byref(t)),
+                "MV_GetAsyncMatrixTableByRows")
         return AsyncGet(self, t.value, out, (ids.size, cols))
 
     def matrix_add_rows(self, handle: int, row_ids, delta,
-                        sync: bool = True) -> None:
+                        sync: bool = True,
+                        borrowed: bool = False) -> None:
         ids = np.ascontiguousarray(row_ids, dtype=np.int32)
+        if borrowed:
+            # Zero-copy row push (docs/host_bridge.md): with one server
+            # shard the packed delta ships straight from this buffer
+            # (no per-rank staging); multi-shard fleets stage per rank
+            # but still skip the binding-side astype/copy.
+            d = _contig_f32(delta, int(delta.size),
+                            "matrix_add_rows(borrowed)")
+            if d.ndim != 2 or d.shape[0] != ids.size:
+                raise ValueError("rows/delta shape mismatch")
+            flat = d.ravel()
+            fn = (self.lib.MV_AddMatrixTableByRowsBorrowed if sync
+                  else self.lib.MV_AddAsyncMatrixTableByRowsBorrowed)
+            self._check(fn(handle, _fp(flat), _ip(ids), ids.size,
+                           d.shape[1]),
+                        "MV_AddMatrixTableByRowsBorrowed")
+            return
         d = _f32(delta)
         if d.shape[0] != ids.size:
             raise ValueError("rows/delta shape mismatch")
@@ -700,5 +934,10 @@ class NativeRuntime:
             raise BusyError(
                 f"{what} shed by server backpressure "
                 f"(-server_inflight_max) — retry after backoff")
+        if rc == -7:
+            raise ArenaError(
+                f"{what}: buffer is not inside a live HostArena buffer "
+                f"— allocate it with NativeRuntime.arena().alloc(...) "
+                f"(docs/host_bridge.md)")
         if rc != 0:
             raise RuntimeError(f"{what} failed with rc={rc}")

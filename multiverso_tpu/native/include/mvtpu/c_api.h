@@ -9,7 +9,9 @@
 // failed, -5 local stream open failed (an IO problem, NOT peer death),
 // -6 a server SHED the request under `-server_inflight_max`
 // backpressure (docs/serving.md) — retryable after backoff, and unlike
-// -3 it is NOT indeterminate: the server did no work.
+// -3 it is NOT indeterminate: the server did no work, -7 a *Borrowed
+// call's buffer is not (entirely) inside a live HostArena buffer
+// (docs/host_bridge.md) — nothing was sent.
 // A -3 from a DEADLINE is indeterminate, not at-most-once: a slow
 // server may still apply the Add after the caller gave up (a blind
 // retry can double-apply), and a timed-out Get's output buffer may be
@@ -83,6 +85,63 @@ int MV_GetAsyncMatrixTableByRows(int32_t handle, float* data,
                                  int64_t cols, int32_t* wait_handle);
 int MV_WaitGet(int32_t wait_handle);
 int MV_CancelGet(int32_t wait_handle);  // 0, or -2 unknown/consumed
+
+// ---- host-bridge fast path (docs/host_bridge.md) ---------------------
+// Pinned buffer arena: recycled 64-byte-aligned host buffers whose
+// bytes the *Borrowed calls below ship ZERO-COPY into the scatter-
+// gather send path (Blob borrows instead of copies).  Ownership
+// contract: a buffer is caller-held from MV_ArenaAcquire until
+// MV_ArenaRelease; in-flight borrowed sends add native holds, and the
+// buffer is recycled only when BOTH are gone — releasing mid-flight is
+// always safe (the recycle defers), but MUTATING the bytes before the
+// in-flight send drains is the caller's bug.  rc: 0, -1 bad args /
+// allocation failure, -2 double release.
+int MV_ArenaAcquire(int64_t bytes, void** ptr);
+int MV_ArenaRelease(void* ptr);
+// Arena accounting (any pointer may be NULL): live buffers, recycled
+// free-list depth, total arena bytes, buffers with in-flight borrows,
+// releases that had to defer behind a borrow, Acquires served from the
+// free list, and buffers successfully mlock'd (-arena_pin).
+int MV_ArenaStats(long long* buffers, long long* free_buffers,
+                  long long* bytes, long long* in_flight,
+                  long long* deferred, long long* recycled,
+                  long long* pinned);
+
+// Borrowed siblings of the Add/Get calls above: `delta`/`data` MUST lie
+// inside a live arena buffer (rc -7 otherwise — the call does nothing;
+// Borrowed calls fail loudly rather than silently copying).  Adds ship
+// the caller's bytes straight into the sendmsg iovecs — no intermediate
+// Blob copy; the arena defers the buffer's recycle until the wire (or
+// the local server apply) is done with it.  Codec-encoded tables
+// (1bit/sparse) and the add-aggregation buffer take ownership by
+// copying exactly where they must mutate (copy-on-conflict).  Gets
+// land replies directly in `data` as always; the Borrowed variants
+// additionally validate the destination and — for the async forms —
+// hold the arena buffer until MV_WaitGet/MV_CancelGet consumes the
+// ticket, so an early MV_ArenaRelease cannot recycle a buffer a late
+// shard reply could still scatter into.
+int MV_AddArrayTableBorrowed(int32_t handle, const float* delta,
+                             int64_t size);
+int MV_AddAsyncArrayTableBorrowed(int32_t handle, const float* delta,
+                                  int64_t size);
+int MV_GetArrayTableBorrowed(int32_t handle, float* data, int64_t size);
+int MV_GetAsyncArrayTableBorrowed(int32_t handle, float* data,
+                                  int64_t size, int32_t* wait_handle);
+int MV_AddMatrixTableAllBorrowed(int32_t handle, const float* delta,
+                                 int64_t size);
+int MV_AddAsyncMatrixTableAllBorrowed(int32_t handle, const float* delta,
+                                      int64_t size);
+int MV_AddMatrixTableByRowsBorrowed(int32_t handle, const float* delta,
+                                    const int32_t* row_ids,
+                                    int64_t num_rows, int64_t cols);
+int MV_AddAsyncMatrixTableByRowsBorrowed(int32_t handle,
+                                         const float* delta,
+                                         const int32_t* row_ids,
+                                         int64_t num_rows, int64_t cols);
+int MV_GetAsyncMatrixTableByRowsBorrowed(int32_t handle, float* data,
+                                         const int32_t* row_ids,
+                                         int64_t num_rows, int64_t cols,
+                                         int32_t* wait_handle);
 
 // KV table (string key -> float value; SURVEY.md §2.14).  Batch calls
 // take keys as concatenated NUL-FREE bytes with per-key lengths.

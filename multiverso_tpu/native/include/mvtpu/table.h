@@ -53,6 +53,27 @@ inline int OwnerOf(int64_t index, int64_t n, int size) {
   return static_cast<int>(rem + (index - big) / base);
 }
 
+// ---- host-bridge borrow window (docs/host_bridge.md) -----------------
+// RAII thread-local borrow scope for the *Borrowed C API: while a scope
+// is active on this thread, raw float payloads whose bytes fall inside
+// [base, base+len) ship as Blob::Borrow sharing `hold` (the HostArena
+// keepalive) instead of being copied into owning blobs.  Encode paths
+// (1bit/sparse) and the aggregation buffer ignore the scope — they must
+// mutate or outlive the payload, so they take ownership by copying
+// (copy-on-conflict).  Scopes do not nest.
+class BorrowScope {
+ public:
+  BorrowScope(const void* base, size_t len, std::shared_ptr<void> hold);
+  ~BorrowScope();
+  BorrowScope(const BorrowScope&) = delete;
+  BorrowScope& operator=(const BorrowScope&) = delete;
+};
+
+// Payload blob for [p, p+bytes): borrowed when the active scope covers
+// the window, an owning copy otherwise — THE one spelling every raw
+// send-path payload goes through.
+Blob WrapPayload(const void* p, size_t bytes);
+
 // ---------------------------------------------------------------- server
 class ServerTable {
  public:

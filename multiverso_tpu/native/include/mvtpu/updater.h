@@ -22,10 +22,21 @@ struct AddOption {
 };
 
 enum class UpdaterType : int { kDefault = 0, kSGD, kAdaGrad, kMomentum,
-                               kSmoothGradient };
+                               kSmoothGradient,
+                               // assign: w = delta (last-write-wins) — the
+                               // "put" of the offload bridge
+                               // (docs/host_bridge.md): remotely stored
+                               // optimizer/embedding state round-trips
+                               // bit-exactly because the server stores the
+                               // pushed float32 bits verbatim instead of
+                               // accumulating into them.
+                               kAssign };
 
 inline int NumSlots(UpdaterType t) {
-  return (t == UpdaterType::kDefault || t == UpdaterType::kSGD) ? 0 : 1;
+  return (t == UpdaterType::kAdaGrad || t == UpdaterType::kMomentum ||
+          t == UpdaterType::kSmoothGradient)
+             ? 1
+             : 0;
 }
 
 // Returns kDefault for unknown names (caller validates via IsUpdaterName).

@@ -9,6 +9,7 @@
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/fault.h"
+#include "mvtpu/host_arena.h"
 #include "mvtpu/mutex.h"
 #include "mvtpu/ops.h"
 #include "mvtpu/sketch.h"
@@ -32,19 +33,41 @@ int FailRc() { return mvtpu::WorkerTable::last_call_busy() ? -6 : -3; }
 
 // Outstanding MV_GetAsync* tickets.  Tickets index AsyncGetHandles so
 // the FFI surface stays integer-only; MV_WaitGet consumes the entry.
+// Borrowed async gets (docs/host_bridge.md) additionally park an arena
+// hold with the ticket: the destination buffer cannot be recycled while
+// a late shard reply could still scatter into it — the hold drops when
+// Wait/Cancel consumes the ticket (or at shutdown reclaim).
+struct GetTicket {
+  mvtpu::AsyncGetPtr h;
+  std::shared_ptr<void> arena_hold;  // null on non-borrowed gets
+};
 Mutex g_gets_mu;
-std::unordered_map<int32_t, mvtpu::AsyncGetPtr>& Gets()
-    REQUIRES(g_gets_mu) {
-  static auto* m = new std::unordered_map<int32_t, mvtpu::AsyncGetPtr>();
+std::unordered_map<int32_t, GetTicket>& Gets() REQUIRES(g_gets_mu) {
+  static auto* m = new std::unordered_map<int32_t, GetTicket>();
   return *m;
 }
 int32_t g_next_get_ticket GUARDED_BY(g_gets_mu) = 1;
 
-int32_t StashGet(mvtpu::AsyncGetPtr h) {
+int32_t StashGet(mvtpu::AsyncGetPtr h,
+                 std::shared_ptr<void> arena_hold = nullptr) {
   MutexLock lk(g_gets_mu);
   int32_t t = g_next_get_ticket++;
-  Gets()[t] = std::move(h);
+  Gets()[t] = GetTicket{std::move(h), std::move(arena_hold)};
   return t;
+}
+
+// Validate a *Borrowed pointer window and mint its arena hold: fills
+// `hold` and returns 0, or returns -7 (not a live arena buffer / the
+// window overruns it) with nothing minted.
+int ArenaHoldFor(const void* p, size_t bytes, void** base,
+                 std::shared_ptr<void>* hold) {
+  if (!p) return -1;
+  void* b = mvtpu::HostArena::Get()->BufferOf(p, bytes);
+  if (!b) return -7;
+  *hold = mvtpu::HostArena::Get()->BorrowHold(b);
+  if (!*hold) return -7;
+  if (base) *base = b;
+  return 0;
 }
 }  // namespace
 
@@ -205,29 +228,178 @@ int MV_GetAsyncMatrixTableByRows(int32_t handle, float* data,
 }
 
 int MV_WaitGet(int32_t wait_handle) {
-  mvtpu::AsyncGetPtr h;
+  GetTicket t;
   {
     MutexLock lk(g_gets_mu);
     auto it = Gets().find(wait_handle);
     if (it == Gets().end()) return -2;
-    h = std::move(it->second);
+    t = std::move(it->second);
     Gets().erase(it);
   }
-  return h->Wait() ? 0 : FailRc();  // Wait outside the registry lock
+  // Wait outside the registry lock; the ticket's arena hold (borrowed
+  // gets) drops when `t` dies — AFTER every shard reply landed.
+  return t.h->Wait() ? 0 : FailRc();
 }
 
 int MV_CancelGet(int32_t wait_handle) {
-  mvtpu::AsyncGetPtr h;
+  GetTicket t;
   {
     MutexLock lk(g_gets_mu);
     auto it = Gets().find(wait_handle);
     if (it == Gets().end()) return -2;
-    h = std::move(it->second);
+    t = std::move(it->second);
     Gets().erase(it);
   }
   // ~AsyncGetHandle withdraws the pending entry (under the table's
   // lock), so a late reply is dropped at the door instead of scattering
-  // into an output buffer the caller is about to free.
+  // into an output buffer the caller is about to free; only then does
+  // the ticket's arena hold release the destination for recycling.
+  return 0;
+}
+
+// ---- host-bridge fast path (docs/host_bridge.md) ---------------------
+
+int MV_ArenaAcquire(int64_t bytes, void** ptr) {
+  if (bytes <= 0 || !ptr) return -1;
+  void* p = mvtpu::HostArena::Get()->Acquire(static_cast<size_t>(bytes));
+  if (!p) return -1;
+  *ptr = p;
+  return 0;
+}
+
+int MV_ArenaRelease(void* ptr) {
+  if (!ptr) return -1;
+  return mvtpu::HostArena::Get()->Release(ptr);
+}
+
+int MV_ArenaStats(long long* buffers, long long* free_buffers,
+                  long long* bytes, long long* in_flight,
+                  long long* deferred, long long* recycled,
+                  long long* pinned) {
+  auto st = mvtpu::HostArena::Get()->GetStats();
+  if (buffers) *buffers = st.buffers;
+  if (free_buffers) *free_buffers = st.free_buffers;
+  if (bytes) *bytes = st.bytes;
+  if (in_flight) *in_flight = st.in_flight;
+  if (deferred) *deferred = st.deferred;
+  if (recycled) *recycled = st.recycled;
+  if (pinned) *pinned = st.pinned;
+  return 0;
+}
+
+static int AddArrayBorrowed(int32_t handle, const float* delta,
+                            int64_t size, bool blocking) {
+  if (RequireStarted() || size <= 0) return -1;
+  auto* t = Zoo::Get()->array_worker(handle);
+  if (!t) return -2;
+  std::shared_ptr<void> hold;
+  size_t bytes = static_cast<size_t>(size) * sizeof(float);
+  int rc = ArenaHoldFor(delta, bytes, nullptr, &hold);
+  if (rc) return rc;
+  mvtpu::BorrowScope scope(delta, bytes, std::move(hold));
+  return t->Add(delta, size, g_add_option, blocking) ? 0 : FailRc();
+}
+
+int MV_AddArrayTableBorrowed(int32_t h, const float* d, int64_t n) {
+  return AddArrayBorrowed(h, d, n, true);
+}
+int MV_AddAsyncArrayTableBorrowed(int32_t h, const float* d, int64_t n) {
+  return AddArrayBorrowed(h, d, n, false);
+}
+
+int MV_GetArrayTableBorrowed(int32_t handle, float* data, int64_t size) {
+  if (RequireStarted() || size <= 0) return -1;
+  auto* t = Zoo::Get()->array_worker(handle);
+  if (!t) return -2;
+  // Destination validation + hold for the call's duration: the blocking
+  // Get returns only after every shard landed, so the hold's job is the
+  // -7 contract (an un-acquired / overrun destination fails loudly).
+  std::shared_ptr<void> hold;
+  int rc = ArenaHoldFor(data, static_cast<size_t>(size) * sizeof(float),
+                        nullptr, &hold);
+  if (rc) return rc;
+  return t->Get(data, size) ? 0 : FailRc();
+}
+
+int MV_GetAsyncArrayTableBorrowed(int32_t handle, float* data,
+                                  int64_t size, int32_t* wait_handle) {
+  if (RequireStarted() || !data || !wait_handle || size < 0) return -1;
+  auto* t = Zoo::Get()->array_worker(handle);
+  if (!t) return -2;
+  std::shared_ptr<void> hold;
+  int rc = ArenaHoldFor(data, static_cast<size_t>(size) * sizeof(float),
+                        nullptr, &hold);
+  if (rc) return rc;
+  *wait_handle = StashGet(t->GetAsync(data, size), std::move(hold));
+  return 0;
+}
+
+static int AddMatrixAllBorrowed(int32_t handle, const float* delta,
+                                int64_t size, bool blocking) {
+  if (RequireStarted() || size <= 0) return -1;
+  auto* t = Zoo::Get()->matrix_worker(handle);
+  if (!t) return -2;
+  std::shared_ptr<void> hold;
+  size_t bytes = static_cast<size_t>(size) * sizeof(float);
+  int rc = ArenaHoldFor(delta, bytes, nullptr, &hold);
+  if (rc) return rc;
+  mvtpu::BorrowScope scope(delta, bytes, std::move(hold));
+  return t->AddAll(delta, g_add_option, blocking) ? 0 : FailRc();
+}
+
+int MV_AddMatrixTableAllBorrowed(int32_t h, const float* d, int64_t n) {
+  return AddMatrixAllBorrowed(h, d, n, true);
+}
+int MV_AddAsyncMatrixTableAllBorrowed(int32_t h, const float* d,
+                                      int64_t n) {
+  return AddMatrixAllBorrowed(h, d, n, false);
+}
+
+static int AddMatrixRowsBorrowed(int32_t handle, const float* delta,
+                                 const int32_t* row_ids, int64_t num_rows,
+                                 int64_t cols, bool blocking) {
+  if (RequireStarted() || !row_ids || num_rows <= 0 || cols <= 0)
+    return -1;
+  auto* t = Zoo::Get()->matrix_worker(handle);
+  if (!t) return -2;
+  std::shared_ptr<void> hold;
+  size_t bytes = static_cast<size_t>(num_rows * cols) * sizeof(float);
+  int rc = ArenaHoldFor(delta, bytes, nullptr, &hold);
+  if (rc) return rc;
+  mvtpu::BorrowScope scope(delta, bytes, std::move(hold));
+  return t->AddRows(row_ids, num_rows, delta, g_add_option, blocking)
+             ? 0
+             : FailRc();
+}
+
+int MV_AddMatrixTableByRowsBorrowed(int32_t h, const float* d,
+                                    const int32_t* ids, int64_t k,
+                                    int64_t cols) {
+  return AddMatrixRowsBorrowed(h, d, ids, k, cols, true);
+}
+int MV_AddAsyncMatrixTableByRowsBorrowed(int32_t h, const float* d,
+                                         const int32_t* ids, int64_t k,
+                                         int64_t cols) {
+  return AddMatrixRowsBorrowed(h, d, ids, k, cols, false);
+}
+
+int MV_GetAsyncMatrixTableByRowsBorrowed(int32_t handle, float* data,
+                                         const int32_t* row_ids,
+                                         int64_t num_rows, int64_t cols,
+                                         int32_t* wait_handle) {
+  if (RequireStarted() || !data || !row_ids || !wait_handle ||
+      num_rows < 0 || cols <= 0)
+    return -1;
+  auto* t = Zoo::Get()->matrix_worker(handle);
+  if (!t) return -2;
+  std::shared_ptr<void> hold;
+  int rc = ArenaHoldFor(data,
+                        static_cast<size_t>(num_rows * cols) *
+                            sizeof(float),
+                        nullptr, &hold);
+  if (rc) return rc;
+  *wait_handle =
+      StashGet(t->GetRowsAsync(row_ids, num_rows, data), std::move(hold));
   return 0;
 }
 

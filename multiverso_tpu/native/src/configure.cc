@@ -139,7 +139,9 @@ void RegisterDefaults() {
   std::call_once(once, [] {
     DefineBool("sync", false, "BSP (true) vs ASP (false) training");
     DefineString("updater_type", "default",
-                 "default|sgd|adagrad|momentum|smooth_gradient");
+                 "default|sgd|adagrad|momentum|smooth_gradient|assign "
+                 "(assign: w = delta, last-write-wins — the offload "
+                 "bridge's bit-exact remote store, docs/host_bridge.md)");
     DefineString("machine_file", "",
                  "host:port per line; >1 line enables the TCP transport");
     DefineString("net_type", "tcp",
@@ -280,6 +282,11 @@ void RegisterDefaults() {
               "server table (memory bound: this many monitored keys; "
               "every true heavy hitter with frequency > total/K is "
               "guaranteed monitored)");
+    DefineBool("arena_pin", true,
+               "host bridge (docs/host_bridge.md): mlock(2) HostArena "
+               "buffers so the scatter-gather send path never page-"
+               "faults mid-write.  Best-effort — RLIMIT_MEMLOCK misses "
+               "are counted in MV_ArenaStats, not fatal");
     DefineInt("shed_storm_threshold", 0,
               "flight-recorder trigger: this many CONSECUTIVE busy-sheds "
               "(-server_inflight_max) dump the black box once per storm "

@@ -492,9 +492,41 @@ bool KVServerTable::Load(Stream* in) {
 // return every table op and binding already speaks.
 namespace {
 thread_local bool g_rt_busy = false;
+
+// Active host-bridge borrow window (docs/host_bridge.md) — thread-local
+// because the *Borrowed C API runs table ops on the caller's thread and
+// the window must never leak into unrelated ops on other threads.
+struct BorrowWindow {
+  const char* base = nullptr;
+  size_t len = 0;
+  std::shared_ptr<void> hold;
+};
+thread_local BorrowWindow g_borrow;
 }  // namespace
 
 bool WorkerTable::last_call_busy() { return g_rt_busy; }
+
+BorrowScope::BorrowScope(const void* base, size_t len,
+                         std::shared_ptr<void> hold) {
+  g_borrow.base = static_cast<const char*>(base);
+  g_borrow.len = len;
+  g_borrow.hold = std::move(hold);
+}
+
+BorrowScope::~BorrowScope() {
+  // Blobs minted inside the scope keep their own keepalive copies; only
+  // the thread-local template dies here.
+  g_borrow = BorrowWindow{};
+}
+
+Blob WrapPayload(const void* p, size_t bytes) {
+  const char* cp = static_cast<const char*>(p);
+  if (g_borrow.base != nullptr && cp >= g_borrow.base &&
+      cp + bytes <= g_borrow.base + g_borrow.len) {
+    return Blob::Borrow(p, bytes, g_borrow.hold);
+  }
+  return Blob(p, bytes);
+}
 
 // ---- wire codec + add aggregation (docs/wire_compression.md) ---------
 
@@ -518,13 +550,15 @@ void WorkerTable::AppendEncodedDelta(Message* req, const float* delta,
   } else if (c == Codec::kSparse) {
     Blob enc = codec::EncodeSparse(delta, static_cast<size_t>(n));
     if (enc.size() == 0) {  // denser than the sparse form: ship raw
-      req->data.emplace_back(delta, raw_bytes);
+      req->data.push_back(WrapPayload(delta, raw_bytes));
     } else {
       req->codec = Codec::kSparse;
       req->data.push_back(std::move(enc));
     }
   } else {
-    req->data.emplace_back(delta, raw_bytes);
+    // Raw payloads borrow the caller's bytes when a host-bridge borrow
+    // scope covers them (docs/host_bridge.md) — no copy into the blob.
+    req->data.push_back(WrapPayload(delta, raw_bytes));
     return;  // raw tables keep the encode path at zero cost — no ratio
   }
   // Per-table compression ledger: mean of (encoded / raw payload bytes)
@@ -1018,6 +1052,36 @@ bool MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
   // FIFO with any buffered whole-table aggregate: it ships first so the
   // server applies adds in submission order.
   FlushAdds();
+  // Single-shard fast path (the offload bridge's embedding case,
+  // docs/host_bridge.md): with one server and only in-range ids there
+  // is nothing to partition — ship the id list once and let the packed
+  // delta borrow the caller's bytes (WrapPayload) instead of staging
+  // per-rank copies.  The sparse codec keeps the staging path: its
+  // encode owns a fresh blob anyway.
+  if (servers_ == 1 && k > 0 && wire_codec() != Codec::kSparse) {
+    bool all_valid = true;
+    for (int64_t i = 0; i < k; ++i)
+      if (row_ids[i] < 0 || row_ids[i] >= rows_) {
+        all_valid = false;
+        break;
+      }
+    if (all_valid) {
+      int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
+      auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, 0);
+      req->data.emplace_back(&opt, sizeof(opt));
+      req->data.emplace_back(row_ids, static_cast<size_t>(k) *
+                                          sizeof(int32_t));
+      req->data.push_back(WrapPayload(
+          delta, static_cast<size_t>(k * cols_) * sizeof(float)));
+      std::vector<MessagePtr> reqs;
+      reqs.push_back(std::move(req));
+      if (blocking)
+        return RoundTrip(std::move(reqs), DiscardReply, nullptr);
+      for (auto& r : reqs)
+        Zoo::Get()->SendTo(actor::kWorker, std::move(r));
+      return true;
+    }
+  }
   std::vector<std::vector<int32_t>> per_rank_ids(servers_);
   std::vector<std::vector<float>> per_rank_delta(servers_);
   for (int64_t i = 0; i < k; ++i) {

@@ -21,6 +21,7 @@
 #include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
+#include "mvtpu/host_arena.h"
 #include "mvtpu/message.h"
 #include "mvtpu/mpi_net.h"
 #include "mvtpu/mt_queue.h"
@@ -50,6 +51,82 @@ static int TestBlob() {
   deep.As<float>()[0] = 0.0f;
   CHECK(b.As<float>()[0] == 42.0f);
   CHECK(b.count<float>() == 4);
+  return 0;
+}
+
+static int TestBlobBorrow() {
+  // Borrowed external memory (docs/host_bridge.md): Blob::Borrow wraps
+  // caller bytes without copying; the keepalive's deleter fires when
+  // the LAST shallow copy dies — the arena's "wire is done" signal.
+  float ext[4] = {1.0f, 2.0f, 3.0f, 4.0f};
+  int released = 0;
+  {
+    mvtpu::Blob outer;
+    {
+      auto keep = std::shared_ptr<void>(
+          static_cast<void*>(ext), [&released](void*) { ++released; });
+      mvtpu::Blob b = mvtpu::Blob::Borrow(ext, sizeof(ext), keep);
+      CHECK(b.borrowed());
+      CHECK(b.size() == sizeof(ext));
+      CHECK(b.As<float>() == ext);  // zero copy: the caller's bytes
+      outer = b;                    // shallow copy shares the keepalive
+    }
+    CHECK(released == 0);  // a live copy still pins the buffer
+    CHECK(outer.As<float>()[2] == 3.0f);
+    // CopyFrom flattens a borrow into an owning blob and drops the hook.
+    mvtpu::Blob deep;
+    deep.CopyFrom(outer);
+    CHECK(!deep.borrowed());
+    CHECK(deep.As<float>() != ext);
+    CHECK(deep.As<float>()[3] == 4.0f);
+  }
+  CHECK(released == 1);  // last copy died -> exactly one release
+  return 0;
+}
+
+static int TestArena() {
+  auto* arena = mvtpu::HostArena::Get();
+  // 64-byte alignment by construction (the MV008 contiguity guarantee).
+  void* a = arena->Acquire(6144);
+  void* b = arena->Acquire(6144);
+  CHECK(a && b && a != b);
+  CHECK(reinterpret_cast<uintptr_t>(a) % 64 == 0);
+  CHECK(reinterpret_cast<uintptr_t>(b) % 64 == 0);
+  // BufferOf: containment gate of the *Borrowed C API.
+  char* ca = static_cast<char*>(a);
+  CHECK(arena->BufferOf(ca, 6144) == a);
+  CHECK(arena->BufferOf(ca + 100, 6044) == a);
+  CHECK(arena->BufferOf(ca + 100, 6144) == nullptr);  // overruns
+  int unknown[1];
+  CHECK(arena->BufferOf(unknown, 4) == nullptr);
+  // Release/recycle: same capacity comes back off the free list.
+  CHECK(arena->Release(b) == 0);
+  CHECK(arena->Release(b) == -2);       // double release
+  CHECK(arena->Release(unknown) == -1);  // not arena memory
+  void* b2 = arena->Acquire(6144);
+  CHECK(b2 == b);  // recycled
+  // DEFERRED recycle (the borrowed-lifetime regression, red on a naive
+  // arena that recycles on caller release alone): while a native borrow
+  // is in flight, Release must NOT put the buffer back in rotation —
+  // an Acquire of the same size gets fresh memory, not the borrowed
+  // bytes a late wire write could still read.
+  void* c = nullptr;
+  {
+    auto hold = arena->BorrowHold(a);
+    CHECK(hold);
+    CHECK(arena->Release(a) == 0);          // safe mid-flight
+    c = arena->Acquire(6144);
+    CHECK(c != a);                          // NOT handed back while held
+    CHECK(arena->BufferOf(ca, 64) == nullptr);  // released: not borrowable
+  }                                         // hold drops -> recycle fires
+  void* a2 = arena->Acquire(6144);          // c is still caller-held, so
+  CHECK(a2 == a);                           // this must be the recycle
+  auto st = arena->GetStats();
+  CHECK(st.deferred >= 1);
+  CHECK(st.recycled >= 2);
+  CHECK(arena->Release(c) == 0);
+  CHECK(arena->Release(a2) == 0);
+  CHECK(arena->Release(b2) == 0);
   return 0;
 }
 
@@ -317,6 +394,16 @@ static int TestUpdater() {
   mvtpu::ApplyUpdate(UpdaterType::kAdaGrad, opt, w2, h, g, 1);
   float expect = -0.1f - 0.1f / sqrtf(2.0f);
   CHECK(fabsf(w2[0] - expect) < 1e-5f);
+  // assign: stored bits == pushed bits (the offload bridge's bit-exact
+  // remote store, docs/host_bridge.md); repeated assigns do not
+  // accumulate, and NumSlots is 0 (no optimizer state of its own).
+  CHECK(mvtpu::NumSlots(UpdaterType::kAssign) == 0);
+  CHECK(mvtpu::UpdaterFromName("assign") == UpdaterType::kAssign);
+  CHECK(mvtpu::IsUpdaterName("assign"));
+  float w3[2] = {7.0f, -7.0f}, d3[2] = {0.25f, -1.5f};
+  mvtpu::ApplyUpdate(UpdaterType::kAssign, opt, w3, nullptr, d3, 2);
+  mvtpu::ApplyUpdate(UpdaterType::kAssign, opt, w3, nullptr, d3, 2);
+  CHECK(w3[0] == 0.25f && w3[1] == -1.5f);
   return 0;
 }
 
@@ -351,6 +438,82 @@ static int TestMatrix() {
   }
   CHECK(MV_GetMatrixTableAll(h, out.data(), 32) == 0);
   CHECK(out[0] == 0.5f);
+  return 0;
+}
+
+static int TestBridge() {
+  // Host-bridge fast path over the C API (docs/host_bridge.md); runs
+  // after `array` armed the single-process runtime.  Every payload here
+  // lives in a HostArena buffer and ships borrowed — zero payload copy
+  // on the send side.
+  int32_t h;
+  CHECK(MV_NewArrayTable(48, &h) == 0);
+  void* p = nullptr;
+  CHECK(MV_ArenaAcquire(48 * sizeof(float), &p) == 0);
+  float* buf = static_cast<float*>(p);
+  for (int i = 0; i < 48; ++i) buf[i] = static_cast<float>(i);
+  // Borrowed calls FAIL LOUDLY on non-arena memory (rc -7, nothing
+  // sent) — the contract mvlint MV012 polices from the Python side.
+  std::vector<float> heap(48, 1.0f);
+  CHECK(MV_AddArrayTableBorrowed(h, heap.data(), 48) == -7);
+  CHECK(MV_GetArrayTableBorrowed(h, heap.data(), 48) == -7);
+  // Blocking borrowed add + borrowed get into a second arena buffer.
+  CHECK(MV_AddArrayTableBorrowed(h, buf, 48) == 0);
+  void* po = nullptr;
+  CHECK(MV_ArenaAcquire(48 * sizeof(float), &po) == 0);
+  float* out = static_cast<float*>(po);
+  CHECK(MV_GetArrayTableBorrowed(h, out, 48) == 0);
+  for (int i = 0; i < 48; ++i) CHECK(out[i] == static_cast<float>(i));
+  // Async borrowed add: the arena defers the buffer past the in-flight
+  // send; the barrier flushes, then values must read back doubled.
+  CHECK(MV_AddAsyncArrayTableBorrowed(h, buf, 48) == 0);
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTableBorrowed(h, out, 48) == 0);
+  for (int i = 0; i < 48; ++i) CHECK(out[i] == 2.0f * i);
+  // Async borrowed get + EARLY caller release: the ticket's arena hold
+  // keeps the destination un-recycled until MV_WaitGet consumes it — an
+  // Acquire of the same size mid-flight must get different memory.
+  int32_t ticket = -1;
+  CHECK(MV_GetAsyncArrayTableBorrowed(h, out, 48, &ticket) == 0);
+  CHECK(MV_ArenaRelease(po) == 0);  // safe: recycle deferred past Wait
+  void* other = nullptr;
+  CHECK(MV_ArenaAcquire(48 * sizeof(float), &other) == 0);
+  CHECK(other != po);
+  CHECK(MV_WaitGet(ticket) == 0);
+  for (int i = 0; i < 48; ++i) CHECK(out[i] == 2.0f * i);
+  CHECK(MV_ArenaRelease(other) == 0);
+  // Matrix plane: whole-table + by-rows borrowed (single shard -> the
+  // no-staging fast path) + async borrowed row get.
+  int32_t hm;
+  CHECK(MV_NewMatrixTable(6, 4, &hm) == 0);
+  void* pm = nullptr;
+  CHECK(MV_ArenaAcquire(24 * sizeof(float), &pm) == 0);
+  float* md = static_cast<float*>(pm);
+  for (int i = 0; i < 24; ++i) md[i] = 0.5f;
+  CHECK(MV_AddMatrixTableAllBorrowed(hm, md, 24) == 0);
+  int32_t rows[2] = {1, 4};
+  CHECK(MV_AddMatrixTableByRowsBorrowed(hm, md, rows, 2, 4) == 0);
+  int32_t bad_rows[2] = {1, 99};  // out of range: staging path handles
+  CHECK(MV_AddMatrixTableByRowsBorrowed(hm, md, bad_rows, 2, 4) == 0);
+  void* pr = nullptr;
+  CHECK(MV_ArenaAcquire(8 * sizeof(float), &pr) == 0);
+  float* rout = static_cast<float*>(pr);
+  int32_t t2 = -1;
+  CHECK(MV_GetAsyncMatrixTableByRowsBorrowed(hm, rout, rows, 2, 4, &t2)
+        == 0);
+  CHECK(MV_WaitGet(t2) == 0);
+  for (int c = 0; c < 4; ++c) {
+    CHECK(rout[c] == 1.5f);      // row 1: 0.5 + 0.5 + 0.5
+    CHECK(rout[4 + c] == 1.0f);  // row 4: 0.5 + 0.5
+  }
+  CHECK(MV_ArenaRelease(pr) == 0);
+  CHECK(MV_ArenaRelease(pm) == 0);
+  CHECK(MV_ArenaRelease(p) == 0);
+  long long buffers = 0, in_flight = 0, deferred = 0;
+  CHECK(MV_ArenaStats(&buffers, nullptr, nullptr, &in_flight, &deferred,
+                      nullptr, nullptr) == 0);
+  CHECK(in_flight == 0);   // every borrowed send drained
+  CHECK(deferred >= 1);    // the early release above was deferred
   return 0;
 }
 
@@ -1810,6 +1973,114 @@ static int ChaosDropDupChild(const char* machine_file, const char* rank) {
   return 0;
 }
 
+static int BridgeChild(const char* machine_file, const char* rank,
+                       const char* engine) {
+  // Borrowed sends UNDER CHAOS (docs/host_bridge.md): 2 ranks, arena
+  // buffers shipped zero-copy over the wire with drop/dup/delay faults
+  // armed on rank 0's sends.  The point is lifetime, not arithmetic:
+  // a dropped frame's message dies on the retry path, a duplicated one
+  // extends the borrow, a delayed one parks it — in every case the
+  // arena must defer recycling until the LAST in-flight borrow drops,
+  // and the sanitizer sweeps (tests/test_native.py) run this scenario
+  // under TSan and ASan to prove no borrowed byte is read after reuse.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  std::string eng = std::string("-net_engine=") + engine;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), eng.c_str(),
+                         "-updater_type=default", "-log_level=error",
+                         "-rpc_timeout_ms=60000",
+                         "-barrier_timeout_ms=60000"};
+  CHECK(MV_Init(7, argv2) == 0);
+  CHECK(MV_SetFaultSeed(4242) == 0);
+  int me = MV_WorkerId();
+  int32_t h;
+  CHECK(MV_NewArrayTable(10, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+
+  void* p = nullptr;
+  CHECK(MV_ArenaAcquire(10 * sizeof(float), &p) == 0);
+  float* buf = static_cast<float*>(p);
+  for (int i = 0; i < 10; ++i) buf[i] = 1.0f;
+
+  // Round 1: rank 0 drops exactly one borrowed async add's remote frame
+  // (same stagger discipline as ChaosDropDupChild so the budget
+  // deterministically hits the add, not rank 1's barrier flush).
+  if (me == 0) {
+    CHECK(MV_SetFaultN("drop", 1) == 0);
+    CHECK(MV_AddAsyncArrayTableBorrowed(h, buf, 10) == 0);
+  } else {
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+  }
+  CHECK(MV_Barrier() == 0);
+  std::vector<float> out(10, -1.0f);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
+  if (me == 0) {
+    for (int i = 0; i < 5; ++i) CHECK(out[i] == 1.0f);   // local applied
+    for (int i = 5; i < 10; ++i) CHECK(out[i] == 0.0f);  // dropped
+  }
+  CHECK(MV_Barrier() == 0);
+
+  // Round 2: duplicate a borrowed async add's remote frame — the dup's
+  // shallow message copy EXTENDS the borrow (two frames gather-read the
+  // same arena bytes).
+  if (me == 0) {
+    CHECK(MV_SetFaultN("dup", 1) == 0);
+    CHECK(MV_AddAsyncArrayTableBorrowed(h, buf, 10) == 0);
+  } else {
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
+  if (me == 0) {
+    for (int i = 0; i < 5; ++i) CHECK(out[i] == 2.0f);   // 2 local adds
+    for (int i = 5; i < 10; ++i) CHECK(out[i] == 2.0f);  // 0 + dup(2)
+  }
+  CHECK(MV_Barrier() == 0);
+
+  // Round 3: DELAY the remote frame and release the buffer mid-flight —
+  // the worker-actor send sleeps 50 ms while the caller's Release lands,
+  // so the recycle MUST defer behind the parked borrow (a naive arena
+  // frees here and the delayed sendmsg reads freed memory — ASan red).
+  if (me == 0) {
+    CHECK(MV_SetFault("delay_ms", 50) == 0);
+    CHECK(MV_SetFaultN("delay", 1) == 0);
+    CHECK(MV_AddAsyncArrayTableBorrowed(h, buf, 10) == 0);
+    CHECK(MV_ArenaRelease(p) == 0);  // mid-flight: defer, no use-after-free
+  } else {
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+    CHECK(MV_ArenaRelease(p) == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), 10) == 0);
+  if (me == 0) {
+    // Local shard: 3 clean applies; remote shard: drop(-1) + dup(+1)
+    // cancel — both read 3.
+    for (int i = 0; i < 10; ++i) CHECK(out[i] == 3.0f);
+    long long duped = 0, delayed = 0;
+    CHECK(MV_QueryMonitor("net.duplicated", &duped) == 0);
+    CHECK(MV_QueryMonitor("net.delayed", &delayed) == 0);
+    CHECK(duped == 1);
+    CHECK(delayed == 1);
+    CHECK(MV_ClearFaults() == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  // Every borrow must drain: no buffer may stay parked in flight once
+  // the fleet quiesced (spin briefly — the dup's extra frame finishes
+  // asynchronously of the barrier).
+  long long in_flight = 1, deferred = 0;
+  for (int spin = 0; spin < 100 && in_flight != 0; ++spin) {
+    CHECK(MV_ArenaStats(nullptr, nullptr, nullptr, &in_flight, &deferred,
+                        nullptr, nullptr) == 0);
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  CHECK(in_flight == 0);
+  if (me == 0) CHECK(deferred >= 1);  // the mid-flight release deferred
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("BRIDGE_CHAOS_OK %d\n", me);
+  return 0;
+}
+
 static int ChaosBarrierTimeoutChild(const char* machine_file,
                                     const char* rank) {
   // Deadline-bounded barrier: rank 1 simply never arrives (busy for 4 s)
@@ -1941,6 +2212,9 @@ int main(int argc, char** argv) {
     return ScenarioExit(AsyncOverlapChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "codec_wire")
     return ScenarioExit(CodecWireChild(argv[2], argv[3]));
+  if ((argc == 4 || argc == 5) && std::string(argv[1]) == "bridge_child")
+    return ScenarioExit(BridgeChild(argv[2], argv[3],
+                                    argc == 5 ? argv[4] : "epoll"));
   if (argc == 4 && std::string(argv[1]) == "agg_child")
     return ScenarioExit(AggChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "agg_bench")
@@ -1970,12 +2244,14 @@ int main(int argc, char** argv) {
   };
   // array must run before the other C-API scenarios (it calls MV_Init).
   Case cases[] = {
-      {"blob", TestBlob},         {"queue", TestQueue},
+      {"blob", TestBlob},         {"blob_borrow", TestBlobBorrow},
+      {"arena", TestArena},       {"queue", TestQueue},
       {"configure", TestConfigure}, {"message", TestMessage},
       {"codec", TestCodec},
       {"dashboard", TestDashboard},
       {"updater", TestUpdater},   {"array", TestArray},
-      {"matrix", TestMatrix},     {"sparse", TestSparseMatrix},
+      {"matrix", TestMatrix},     {"bridge", TestBridge},
+      {"sparse", TestSparseMatrix},
       {"checkpoint", TestCheckpoint},
       {"kv", TestKV},             {"threads", TestThreads},
       {"serve", TestServeVersions},

@@ -1,3 +1,4 @@
+from .offload import OffloadedState
 from .pipeline import gpipe, stage_pspec
 from .sharding import (
     make_mesh,
@@ -15,4 +16,5 @@ __all__ = [
     "host_to_global",
     "gpipe",
     "stage_pspec",
+    "OffloadedState",
 ]

@@ -153,7 +153,15 @@ class ServeClient:
             val = self.coalescer.submit(key, None, execute)
         self._note(handle)
         if v0 is not None:
-            self.cache.store(key, val.copy(), v0)
+            # Store the wire value ITSELF, read-only flagged: every
+            # consumer (coalesced waiters below, future hits above)
+            # copies exactly once at its own boundary, so the old
+            # store-a-copy pair cost one redundant full-payload copy
+            # per miss (docs/host_bridge.md).  The writeable=False flip
+            # turns any aliasing slip into a loud ValueError instead of
+            # silent cache corruption.
+            val.flags.writeable = False
+            self.cache.store(key, val, v0)
         # Per-caller copy: coalesced waiters all hold the SAME wire
         # ndarray — returned uncopied, one caller's in-place mutation
         # would corrupt every other waiter's result (the hit path above
@@ -231,8 +239,12 @@ class ServeClient:
             val = self.coalescer.submit(key, None, execute)
         self._note(handle)
         if v0 is not None:
-            stored = val if single else np.array(val, copy=True)
-            self.cache.store(key, stored, v0)
+            # Batch values are stored READ-ONLY and uncopied (the same
+            # one-copy-per-miss discipline as _cached above); the
+            # per-caller copy below is the single copy.
+            if not single:
+                val.flags.writeable = False
+            self.cache.store(key, val, v0)
         # Single-key reads are python floats (immutable); batch reads are
         # one ndarray SHARED by every coalesced waiter — copy per caller.
         return val if single else np.array(val, copy=True)
@@ -245,6 +257,11 @@ class ServeClient:
         contract every BSP flush in this repo already relies on), then
         every cached read of the table is invalidated (write-through).
         """
+        # Legitimate copy (MV012 exempt by hoisting): callers hand this
+        # façade arbitrary dtypes/layouts, and the coalescer may SUM the
+        # buffer with siblings — it must own a normalized copy.  Hot
+        # loops that control their buffers use the arena/borrowed path
+        # on NativeRuntime directly (docs/host_bridge.md).
         d = np.ascontiguousarray(delta, dtype=np.float32)
         if not coalesce:
             self.retry.run(self.rt.array_add, handle, d, sync=sync)

@@ -161,12 +161,19 @@ class AnonServeClient:
     def get_shard(self, table_id: int) -> np.ndarray:
         """Fetch the contacted rank's shard of an array table as
         float32 (RequestGet; the payload is the shard, not the whole
-        table — shards partition contiguously across server ranks)."""
+        table — shards partition contiguously across server ranks).
+
+        Returns a READ-ONLY zero-copy view over the reply bytes
+        (``frombuffer`` of immutable ``bytes`` is non-writeable by
+        construction) — the old trailing ``.copy()`` paid a full
+        payload copy per fetch that cache layers then re-copied
+        (docs/host_bridge.md).  Callers that need to mutate copy at
+        their own boundary."""
         mid = self._next_id()
         self.send_raw(pack_frame(MSG["RequestGet"], table_id, mid))
         reply = self.recv_reply()
         _check(reply, mid, "ReplyGet")
-        return np.frombuffer(reply["blobs"][0], dtype=np.float32).copy()
+        return np.frombuffer(reply["blobs"][0], dtype=np.float32)
 
     def close(self) -> None:
         try:

@@ -51,30 +51,39 @@ class ArrayTable(Table):
         # BSP clock buffers, bucketed per AddOption so a flush applies each
         # option's aggregate with the right hyper-parameters.
         self._pending: Dict[Optional[AddOption], np.ndarray] = {}
+        # Options whose buffered delta is a BORROWED caller array (no
+        # defensive copy, docs/host_bridge.md): a second add to the same
+        # option must not += into the caller's memory.
+        self._pending_borrowed: set = set()
 
     # ------------------------------------------------------------------ Get
-    def get(self, option=None, device: bool = False):
+    def get(self, option=None, device: bool = False, out=None):
         """Pull the whole array (reference ``ArrayWorker<T>::Get``; §3.2).
 
         ``device=True`` returns a fresh device ``jax.Array`` instead of a
         host copy — the TPU-native Get for callers whose next op runs on
         device (no wire hop; pairs with passing a device delta to ``add``).
+        ``out=`` fills a preallocated host buffer instead of allocating
+        one per call (the host-bridge out= protocol, docs/host_bridge.md).
         """
         with self._monitor("Get"):
             if device:
+                if out is not None:
+                    raise ValueError("out= is a host-path argument")
                 return self._slice_device((self.size,))
             # Serve layer (docs/serving.md): repeat host reads within the
             # version-staleness bound serve from the client cache;
             # concurrent misses coalesce into one fetch.  No-op unless
             # -serve_cache_entries armed the cache.
-            return self._serve_read(
+            return self._fill_out(out, self._serve_read(
                 ("get",),
                 lambda: self._locked_read(
-                    lambda d, s: host_fetch(d))[: self.size])
+                    lambda d, s: host_fetch(d))[: self.size]))
 
     # ------------------------------------------------------------------ Add
     def add(self, delta, option: Optional[AddOption] = None,
-            sync: bool = False, compress: Optional[str] = None) -> None:
+            sync: bool = False, compress: Optional[str] = None,
+            borrow: bool = False) -> None:
         """Push a delta/gradient (reference ``ArrayWorker<T>::Add``; §3.3).
 
         ``delta`` is [size] or [k, size] (stacked per-worker contributions,
@@ -82,7 +91,10 @@ class ArrayTable(Table):
         blocks until the device commit completes (the reference's blocking
         Add vs AddAsync).  ``compress="1bit"`` sends sign bits + scales
         with error feedback (1/32 the wire bytes; lossy per add, SGD-safe
-        — SURVEY.md §5 quantization lineage).
+        — SURVEY.md §5 quantization lineage).  ``borrow=True``: ``delta``
+        is already this table's dtype/C layout and will not be mutated
+        until applied — the path skips the defensive astype/copy churn
+        (docs/host_bridge.md; wrong layouts raise instead of copying).
         """
         with self._monitor("Add"):
             if compress is None and isinstance(delta, jax.Array) \
@@ -95,7 +107,7 @@ class ArrayTable(Table):
                 # -wire_codec=1bit: host dense adds default to the 1-bit
                 # wire format (docs/wire_compression.md).
                 compress = self._wire_compress_default()
-            delta = np.asarray(delta, dtype=self.dtype)
+            delta = self._coerce_delta(delta, borrow)
             if delta.ndim == 2:
                 delta = delta.sum(axis=0)
             if delta.shape != (self.size,):
@@ -106,9 +118,20 @@ class ArrayTable(Table):
                 return
             if self.sync:
                 # BSP: buffer until the clock boundary (barrier → flush).
+                # Borrowed deltas buffer WITHOUT the defensive copy; a
+                # second add to the same option must then allocate a
+                # fresh sum instead of += into the caller's memory.
                 with self._lock:
                     if option in self._pending:
-                        self._pending[option] += delta
+                        if option in self._pending_borrowed:
+                            self._pending[option] = (
+                                self._pending[option] + delta)
+                            self._pending_borrowed.discard(option)
+                        else:
+                            self._pending[option] += delta
+                    elif borrow:
+                        self._pending[option] = delta
+                        self._pending_borrowed.add(option)
                     else:
                         self._pending[option] = delta.astype(
                             self.dtype, copy=True)
@@ -120,6 +143,7 @@ class ArrayTable(Table):
     def flush(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, {}
+            self._pending_borrowed = set()
 
         def apply(pending=pending):
             for option, delta in pending.items():
@@ -130,6 +154,7 @@ class ArrayTable(Table):
     def discard_pending(self) -> None:
         with self._lock:
             self._pending = {}
+            self._pending_borrowed = set()
             self._stale_queue = []
 
     def _apply_now(self, delta: np.ndarray, option: Optional[AddOption]) -> None:

@@ -699,9 +699,55 @@ class Table:
                                                execute)
         # Stamp with the PRE-fetch version: the fetch ran after the
         # estimate, so the data is at least that new (a post-fetch stamp
-        # could mark pre-add data as post-add fresh).
-        cache.store(key, copy(val), cur)
+        # could mark pre-add data as post-add fresh).  Store the fetched
+        # value ITSELF and copy once on the way out — nothing else holds
+        # `val` mutably (every coalesced waiter runs this same tail and
+        # takes its own copy; hits copy at lookup), so the old
+        # store-a-copy-then-return-a-copy pair was one redundant
+        # full-payload copy per miss.
+        cache.store(key, val, cur)
         return copy(val)
+
+    # -- host-bridge borrow/out= protocol (docs/host_bridge.md) --------------
+    def _coerce_delta(self, delta, borrow: bool):
+        """THE one coercion gate of every eager add path.
+
+        ``borrow=False`` (default): the defensive ``np.asarray`` —
+        converts dtype/layout as needed (possibly copying).
+        ``borrow=True``: the caller guarantees ``delta`` is already
+        this table's dtype, C-contiguous, and will not be mutated while
+        buffered (BSP) or in flight — the path then stores/ships it
+        WITHOUT the astype/copy churn (mvlint MV012's arena protocol);
+        a wrong layout raises instead of silently copying, so the fast
+        path cannot quietly decay into the slow one."""
+        import numpy as np
+
+        if not borrow:
+            return np.asarray(delta, dtype=self.dtype)
+        if not isinstance(delta, np.ndarray):
+            raise TypeError(
+                f"borrow=True needs an ndarray delta, got {type(delta)!r}")
+        if delta.dtype != self.dtype:
+            raise ValueError(
+                f"borrow=True: delta dtype {delta.dtype} != table dtype "
+                f"{self.dtype} — the borrow protocol never converts")
+        if not delta.flags["C_CONTIGUOUS"]:
+            raise ValueError(
+                "borrow=True: delta is not C-contiguous — the borrow "
+                "protocol never copies")
+        return delta
+
+    @staticmethod
+    def _fill_out(out, val):
+        """``out=`` tail of the eager get paths: fill the caller's
+        preallocated buffer (killing the per-call allocation) or hand
+        back ``val`` unchanged."""
+        if out is None:
+            return val
+        import numpy as np
+
+        np.copyto(out, val)
+        return out
 
     def _monitor(self, op: str):
         # Every public eager op opens with this — it doubles as the

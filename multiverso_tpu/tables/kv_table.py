@@ -45,6 +45,8 @@ def _np_apply(name: str, w: np.ndarray, state: List[np.ndarray],
     elif name == "smooth_gradient":
         state[0][...] = opt.rho * state[0] + (1.0 - opt.rho) * d
         w -= opt.learning_rate * state[0]
+    elif name == "assign":
+        w[...] = d          # last-write-wins store (docs/host_bridge.md)
     else:
         raise ValueError(f"unknown updater {name}")
     return w
@@ -116,10 +118,25 @@ class KVTable(Table):
             return out
 
     def add(self, updates: Dict[Any, Any],
-            option: Optional[AddOption] = None, sync: bool = False) -> None:
+            option: Optional[AddOption] = None, sync: bool = False,
+            borrow: bool = False) -> None:
+        """``borrow=True``: every value is already a correctly-typed
+        ndarray the caller will not mutate while buffered — skips the
+        per-value asarray churn (docs/host_bridge.md); a wrong dtype
+        raises instead of silently converting."""
         with self._monitor("Add"):
-            ups = {k: np.asarray(v, dtype=self.dtype)
-                   for k, v in updates.items()}
+            if borrow:
+                for k, v in updates.items():
+                    if not isinstance(v, np.ndarray) \
+                            or v.dtype != self.dtype:
+                        raise ValueError(
+                            f"borrow=True: value for {k!r} is not a "
+                            f"{self.dtype} ndarray — the borrow "
+                            f"protocol never converts")
+                ups = dict(updates)
+            else:
+                ups = {k: np.asarray(v, dtype=self.dtype)
+                       for k, v in updates.items()}
             if self.sync or self.coalesce:
                 # BSP buffering, or coalesce=True batching eager adds
                 # into the per-barrier collective.
@@ -189,14 +206,21 @@ class KVTable(Table):
 
         Same semantic mapping as ``tables.base.multihost_sum``: every
         rank contributes its own payload, every rank sees the identical
-        rank-ordered list and merges deterministically.
+        rank-ordered list and merges deterministically.  Wire hygiene
+        (docs/host_bridge.md): HIGHEST_PROTOCOL (out-of-band-capable
+        framing, smaller ndarray pickles than the old pinned
+        protocol=4) and the gathered parts feed ``pickle.loads``
+        DIRECTLY via the buffer protocol — the old ``part.tobytes()``
+        detour copied every rank's payload once more per gather.
         """
         import pickle
 
         from .base import multihost_allgather_list
 
-        blob = np.frombuffer(pickle.dumps(payload, protocol=4), np.uint8)
-        return [pickle.loads(part.tobytes())
+        blob = np.frombuffer(
+            pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL),
+            np.uint8)
+        return [pickle.loads(part)
                 for part in multihost_allgather_list(blob)]
 
     def _multihost_merge_buckets(

@@ -58,6 +58,9 @@ class MatrixTable(Table):
         self._pending_dense: Dict[Optional[AddOption], np.ndarray] = {}
         self._pending_sparse: List[
             Tuple[np.ndarray, np.ndarray, Optional[AddOption]]] = []
+        # Options whose buffered dense delta is a BORROWED caller array
+        # (docs/host_bridge.md): never += into the caller's memory.
+        self._pending_borrowed: set = set()
         # Jitted-apply memo keyed per AddOption — bounded by call-site
         # diversity, not data (see base._dense_cache).
         self._rows_cache: Dict[AddOption, Any] = {}  # mvlint: disable=MV007
@@ -65,21 +68,24 @@ class MatrixTable(Table):
         self._gather_fn = jax.jit(lambda data, r: data[r])
 
     # ------------------------------------------------------------------ Get
-    def get(self, option=None, device: bool = False):
+    def get(self, option=None, device: bool = False, out=None):
         """Whole-matrix pull (reference ``MatrixWorkerTable::Get`` all-rows).
 
-        ``device=True`` returns a fresh device ``jax.Array`` (no wire hop)."""
+        ``device=True`` returns a fresh device ``jax.Array`` (no wire hop);
+        ``out=`` fills a preallocated host buffer (docs/host_bridge.md)."""
         with self._monitor("Get"):
             if device:
+                if out is not None:
+                    raise ValueError("out= is a host-path argument")
                 return self._slice_device((self.num_rows, self.num_cols))
             # Serve layer: cached + coalesced whole-matrix host read
             # (collective-safe — the key is identical on every rank).
-            return self._serve_read(
+            return self._fill_out(out, self._serve_read(
                 ("get",),
                 lambda: self._locked_read(
-                    lambda d, s: host_fetch(d))[: self.num_rows])
+                    lambda d, s: host_fetch(d))[: self.num_rows]))
 
-    def get_rows(self, row_ids, option=None) -> np.ndarray:
+    def get_rows(self, row_ids, option=None, out=None) -> np.ndarray:
         """Row-subset pull — the sparse hot read path.
 
         Reference: ``MatrixWorkerTable::Get(row_ids)`` partitions ids across
@@ -116,9 +122,10 @@ class MatrixTable(Table):
             # keep these hitting).  collective_safe=False — ranks may
             # request different ids, and a rank-local hit would break
             # the union collective, so multi-host bypasses the cache.
-            return self._serve_read(("rows", tuple(rows.tolist())), fetch,
-                                    buckets=rows, collective_safe=False,
-                                    keys=rows.tolist())
+            return self._fill_out(out, self._serve_read(
+                ("rows", tuple(rows.tolist())), fetch,
+                buckets=rows, collective_safe=False,
+                keys=rows.tolist()))
 
     def _gather_host(self, rows: np.ndarray) -> np.ndarray:
         """Bucketed compiled gather + host fetch of ``rows`` (all ranks
@@ -139,11 +146,14 @@ class MatrixTable(Table):
 
     # ------------------------------------------------------------------ Add
     def add(self, delta, option: Optional[AddOption] = None,
-            sync: bool = False, compress: Optional[str] = None) -> None:
+            sync: bool = False, compress: Optional[str] = None,
+            borrow: bool = False) -> None:
         """Whole-matrix add (reference ``Add`` all-rows path).
 
         ``compress="1bit"``: sign-bit wire format with error feedback
-        (see ``ArrayTable.add``)."""
+        (see ``ArrayTable.add``).  ``borrow=True``: skip the defensive
+        astype/copy — the caller guarantees dtype/layout and no
+        mutation until applied (docs/host_bridge.md)."""
         with self._monitor("Add"):
             if compress is None and self._try_device_add(
                     delta, (self.num_rows, self.num_cols), option, sync):
@@ -152,7 +162,7 @@ class MatrixTable(Table):
                 # -wire_codec=1bit: host dense adds default to the 1-bit
                 # wire format (docs/wire_compression.md).
                 compress = self._wire_compress_default()
-            delta = np.asarray(delta, dtype=self.dtype)
+            delta = self._coerce_delta(delta, borrow)
             if delta.shape != (self.num_rows, self.num_cols):
                 raise ValueError(
                     f"delta shape {delta.shape} != "
@@ -163,7 +173,17 @@ class MatrixTable(Table):
             if self.sync:
                 with self._lock:
                     if option in self._pending_dense:
-                        self._pending_dense[option] += delta
+                        if option in self._pending_borrowed:
+                            self._pending_dense[option] = (
+                                self._pending_dense[option] + delta)
+                            self._pending_borrowed.discard(option)
+                        else:
+                            self._pending_dense[option] += delta
+                    elif borrow:
+                        # Buffer the caller's array itself; a second add
+                        # to this option allocates a fresh sum above.
+                        self._pending_dense[option] = delta
+                        self._pending_borrowed.add(option)
                     else:
                         self._pending_dense[option] = delta.astype(
                             self.dtype, copy=True)
@@ -173,11 +193,14 @@ class MatrixTable(Table):
                 jax.block_until_ready(self._data)
 
     def add_rows(self, row_ids, delta, option: Optional[AddOption] = None,
-                 sync: bool = False) -> None:
-        """Row-subset push — the sparse hot write path (§3.3 with rows)."""
+                 sync: bool = False, borrow: bool = False) -> None:
+        """Row-subset push — the sparse hot write path (§3.3 with rows).
+
+        ``borrow=True`` skips the defensive delta copy/convert; the BSP
+        buffer then holds the caller's array until the barrier flush."""
         with self._monitor("AddRows"):
             rows = np.asarray(row_ids, dtype=np.int64)
-            delta = np.asarray(delta, dtype=self.dtype)
+            delta = self._coerce_delta(delta, borrow)
             if delta.shape != (rows.shape[0], self.num_cols):
                 raise ValueError("rows/delta shape mismatch")
             if self.sync:
@@ -192,6 +215,7 @@ class MatrixTable(Table):
         with self._lock:
             dense, self._pending_dense = self._pending_dense, {}
             sparse, self._pending_sparse = self._pending_sparse, []
+            self._pending_borrowed = set()
 
         def apply(dense=dense, sparse=sparse):
             by_opt: Dict[Optional[AddOption],
@@ -211,6 +235,7 @@ class MatrixTable(Table):
         with self._lock:
             self._pending_dense = {}
             self._pending_sparse = []
+            self._pending_borrowed = set()
             self._stale_queue = []
 
     # ----------------------------------------------------------- internals

@@ -26,6 +26,7 @@ from . import sgd as _sgd            # noqa: F401  (registration side effect)
 from . import adagrad as _adagrad    # noqa: F401
 from . import momentum as _momentum  # noqa: F401
 from . import smooth_gradient as _sg # noqa: F401
+from . import assign as _assign      # noqa: F401
 
 __all__ = [
     "AddOption",

@@ -1,0 +1,34 @@
+"""Assign updater — ``w = delta`` (last-write-wins).
+
+The "put" of the host-bridge offload protocol (docs/host_bridge.md):
+a table under this updater is a bit-exact remote STORE, not an
+accumulator — the server keeps the pushed float32 bits verbatim, so
+state that round-trips through it (``parallel/offload.py``) reads back
+bitwise identical.  Mirrors the native ``UpdaterType::kAssign``.
+
+Semantics notes: duplicates in one row batch resolve last-write-wins
+(order within the batch), and ``apply_rows`` is NOT linear — padding
+must go through the masked scatter so it cannot clobber real rows.
+"""
+
+from __future__ import annotations
+
+from .base import (AddOption, Updater, effective_rows, register_updater)
+
+__all__ = ["AssignUpdater"]
+
+
+@register_updater
+class AssignUpdater(Updater):
+    name = "assign"
+    num_slots = 0
+    # Not linear: assign(sum of duplicates) != last duplicate assigned.
+    linear = False
+
+    def apply_dense(self, w, state, delta, opt: AddOption):
+        return delta.astype(w.dtype), state
+
+    def apply_rows(self, w, state, rows, delta, opt: AddOption,
+                   mask=None):
+        rows = effective_rows(rows, mask, w.shape[0])
+        return w.at[rows].set(delta.astype(w.dtype), mode="drop"), state

@@ -113,6 +113,36 @@ def test_gate_passes_in_band_skew_line(tmp_path):
     assert rc == 0, out
 
 
+def test_gate_guards_bridge_keys(tmp_path):
+    """bench_bridge acceptance bars (docs/host_bridge.md): the borrowed
+    add/out= get bandwidth collapsing back toward the pre-arena rates,
+    the borrow-vs-copy speedup evaporating, or double buffering hiding
+    none of the round trip must all fail the gate."""
+    line = {"extras": {"bridge_add_host_gbps": 0.2,    # ~the old 0.12
+                       "bridge_get_host_gbps": 0.05,
+                       "bridge_borrow_speedup": 1.0,   # borrow buys nothing
+                       "offload_overlap_pct": 5.0}}    # overlap gone
+    p = tmp_path / "bridge_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "bridge_add_host_gbps" in out and "FAIL" in out, out
+    assert "bridge_get_host_gbps" in out, out
+    assert "bridge_borrow_speedup" in out, out
+    assert "offload_overlap_pct" in out, out
+
+
+def test_gate_passes_in_band_bridge_line(tmp_path):
+    line = {"extras": {"bridge_add_host_gbps": 2.8,
+                       "bridge_get_host_gbps": 0.9,
+                       "bridge_borrow_speedup": 3.1,
+                       "offload_overlap_pct": 55.0}}
+    p = tmp_path / "bridge_ok.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
 def test_last_parseable_line_wins(tmp_path):
     """Schema-7 cumulative emission: the LAST line is the freshest
     cumulative state and must shadow earlier partials."""

@@ -1,0 +1,368 @@
+"""Host-bridge fast path (docs/host_bridge.md): pinned arena buffers,
+zero-copy borrowed adds/gets, the borrow/out= table protocol, the
+assign updater, and the double-buffered OffloadedState bridge — plus
+the serve-layer copy-discipline satellites that rode the same PR.
+
+The borrowed-buffer LIFETIME coverage (mutate/free mid-flight under
+injected drop/dup/delay, ASan/TSan) lives in the native suite
+(test_main.cc `arena`/`bridge` units + the `bridge_child` scenario in
+tests/test_native.py's sanitizer sweeps); this file covers the Python
+surface and the bit-exactness contract end to end.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def rt():
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    r = nat.NativeRuntime(args=["-updater_type=assign",
+                                "-log_level=error"])
+    yield r
+    r.shutdown()
+
+
+@pytest.fixture()
+def arena(rt):
+    return rt.arena()
+
+
+# ---------------------------------------------------------------- arena
+
+@needs_gxx
+def test_arena_alloc_alignment_and_recycle(rt, arena):
+    a = arena.alloc(1000)
+    assert a.dtype == np.float32 and a.shape == (1000,)
+    assert a.ctypes.data % 64 == 0          # MV008 holds by construction
+    assert a.flags["C_CONTIGUOUS"]
+    addr = a.ctypes.data
+    assert arena.owns(a)
+    arena.release(a)
+    assert not arena.owns(a)
+    b = arena.alloc(1000)                   # same capacity: recycled
+    assert b.ctypes.data == addr
+    arena.release(b)
+
+
+@needs_gxx
+def test_arena_release_errors(rt, arena):
+    from multiverso_tpu.native import ArenaError
+
+    a = arena.alloc(64)
+    arena.release(a)
+    with pytest.raises(ArenaError):
+        arena.release(a)                    # double release
+    with pytest.raises(ArenaError):
+        arena.release(np.zeros(64, np.float32))  # not arena memory
+
+
+@needs_gxx
+def test_arena_stats_shape(rt, arena):
+    st = arena.stats()
+    for k in ("buffers", "free_buffers", "bytes", "in_flight",
+              "deferred", "recycled", "pinned"):
+        assert k in st and st[k] >= 0, st
+
+
+# ------------------------------------------------------- borrowed paths
+
+@needs_gxx
+def test_borrowed_array_roundtrip_and_out(rt, arena):
+    h = rt.new_array_table(512)
+    buf = arena.alloc(512)
+    buf[:] = np.arange(512, dtype=np.float32)
+    rt.array_add(h, buf, sync=True, borrowed=True)
+    out = arena.alloc(512)
+    got = rt.array_get(h, 512, out=out)
+    assert got is out                       # landed in the caller buffer
+    assert np.array_equal(got, buf)
+    # assign updater: a second borrowed push OVERWRITES (bit-exact
+    # store semantics, not accumulation).
+    buf[:] = -3.25
+    rt.array_add(h, buf, sync=True, borrowed=True)
+    assert np.all(rt.array_get(h, 512) == np.float32(-3.25))
+    arena.release(buf)
+    arena.release(out)
+
+
+@needs_gxx
+def test_borrowed_rejects_non_arena_and_bad_layout(rt, arena):
+    from multiverso_tpu.native import ArenaError
+
+    h = rt.new_array_table(64)
+    with pytest.raises(ArenaError):
+        rt.array_add(h, np.ones(64, np.float32), borrowed=True)
+    buf = arena.alloc(64)
+    with pytest.raises(ValueError):         # never converts
+        rt.array_add(h, buf.astype(np.float64), borrowed=True)
+    with pytest.raises(ValueError):         # never copies strided views
+        rt.array_add(h, buf[::2], borrowed=True)
+    with pytest.raises(ValueError):         # out= validates identically
+        rt.array_get(h, 64, out=np.zeros(64, np.float64))
+    arena.release(buf)
+
+
+@needs_gxx
+def test_async_borrowed_get_defers_release(rt, arena):
+    """An early arena.release of an async get's destination must DEFER
+    recycling until wait() consumes the ticket — the Python face of the
+    native regression (test_main.cc `arena`, red on a naive arena)."""
+    h = rt.new_array_table(4096)
+    buf = arena.alloc(4096)
+    buf[:] = 7.0
+    rt.array_add(h, buf, sync=True, borrowed=True)
+    out = arena.alloc(4096)
+    before = arena.stats()["deferred"]
+    ag = rt.array_get_async(h, 4096, out=out, arena=arena)
+    arena.release(out)                      # mid-flight: must defer
+    got = ag.wait()
+    assert np.all(got == 7.0)
+    assert arena.stats()["deferred"] - before >= 1
+    arena.release(buf)
+
+
+@needs_gxx
+def test_borrowed_matrix_paths(rt, arena):
+    h = rt.new_matrix_table(16, 8)
+    md = arena.alloc((16, 8))
+    md[:] = 1.0
+    rt.matrix_add_all(h, md, borrowed=True)
+    rows = arena.alloc((3, 8))
+    rows[:] = 9.0
+    rt.matrix_add_rows(h, [2, 5, 11], rows, borrowed=True)
+    out = arena.alloc((3, 8))
+    ag = rt.matrix_get_rows_async(h, [2, 5, 11], 8, out=out, arena=arena)
+    assert np.all(ag.wait() == 9.0)         # assign overwrote those rows
+    plain = rt.matrix_get_rows(h, [0, 1], 8)
+    assert np.all(plain == 1.0)
+    for b in (md, rows, out):
+        arena.release(b)
+
+
+# --------------------------------------------------- JAX-plane protocol
+
+def test_table_get_out_and_add_borrow(mv):
+    mv.init(args=["-log_level=error"])
+    t = mv.ArrayTable(64, name="hb_arr")
+    delta = np.arange(64, dtype=np.float32)
+    t.add(delta, borrow=True)
+    out = np.empty(64, np.float32)
+    got = t.get(out=out)
+    assert got is out and np.array_equal(out, delta)
+    # borrow never converts/copies: wrong dtype raises.
+    with pytest.raises(ValueError):
+        t.add(np.ones(64, np.float64), borrow=True)
+    with pytest.raises(TypeError):
+        t.add([1.0] * 64, borrow=True)
+
+
+def test_bsp_borrowed_buffer_not_mutated(mv):
+    """A second BSP add to the same option must NOT += into the first
+    (borrowed) caller array — the aliasing hazard the borrowed-pending
+    set exists to prevent."""
+    mv.init(args=["-log_level=error"], sync=True)
+    t = mv.ArrayTable(8, name="hb_bsp")
+    mine = np.ones(8, np.float32)
+    t.add(mine, borrow=True)
+    t.add(np.full(8, 2.0, np.float32))
+    assert np.all(mine == 1.0), "table mutated a borrowed caller buffer"
+    mv.barrier()
+    assert np.allclose(t.get(), 3.0)
+
+
+def test_matrix_get_rows_out_and_borrow(mv):
+    mv.init(args=["-log_level=error"])
+    t = mv.MatrixTable(8, 4, name="hb_mat")
+    d = np.full((2, 4), 5.0, np.float32)
+    t.add_rows([1, 3], d, borrow=True)
+    out = np.empty((2, 4), np.float32)
+    got = t.get_rows([1, 3], out=out)
+    assert got is out and np.all(out == 5.0)
+
+
+def test_kv_add_borrow_validates(mv):
+    mv.init(args=["-log_level=error"])
+    t = mv.KVTable(name="hb_kv")
+    v = np.float32(2.5).reshape(())
+    t.add({"a": np.asarray(v)}, borrow=True)
+    assert float(t.get(["a"])["a"]) == 2.5
+    with pytest.raises(ValueError):
+        t.add({"a": 1.0}, borrow=True)      # not an ndarray of the dtype
+
+
+# ------------------------------------------------------- assign updater
+
+def test_assign_updater_jax_parity(mv):
+    """Python/JAX assign parity with the native semantics: dense
+    overwrite, rows last-write-wins, masked padding can't clobber."""
+    mv.init(args=["-log_level=error"], updater_type="assign")
+    t = mv.ArrayTable(16, name="hb_assign")
+    t.add(np.full(16, 3.0, np.float32))
+    t.add(np.full(16, 1.5, np.float32))
+    assert np.all(t.get() == 1.5)           # overwrite, not 4.5
+    m = mv.MatrixTable(6, 2, name="hb_assign_m", updater_type="assign")
+    m.add_rows([1, 4], np.full((2, 2), 8.0, np.float32))
+    got = m.get_rows([0, 1, 4])
+    assert np.all(got[0] == 0.0) and np.all(got[1:] == 8.0)
+
+
+# ------------------------------------------------------ offload bridge
+
+@needs_gxx
+def test_offloaded_state_bit_exact_native(rt):
+    from multiverso_tpu.parallel.offload import OffloadedState
+
+    off = OffloadedState(rt, 333)
+    rng = np.random.RandomState(5)
+    v = rng.randn(333).astype(np.float32)
+    v[0] = np.float32(1e-38)                # subnormal-adjacent
+    v[1] = np.float32(-0.0)
+    off.init(v)
+    ref = v.copy()
+    for i in range(5):
+        s = off.wait()
+        new = (s * np.float32(0.99) + np.float32(i * 0.1)).astype(
+            np.float32)
+        off.push(new)
+        off.prefetch()
+        ref = (ref * np.float32(0.99) + np.float32(i * 0.1)).astype(
+            np.float32)
+    assert off.wait().tobytes() == ref.tobytes()
+    off.close()
+
+
+def test_offloaded_state_local_backend():
+    from multiverso_tpu.parallel.offload import OffloadedState
+
+    off = OffloadedState(None, 64, backend="local")
+    v = np.arange(64, dtype=np.float32)
+    off.init(v)
+    assert off.wait().tobytes() == v.tobytes()
+    off.push(v * 2)
+    assert np.array_equal(off.wait(), v * 2)
+
+
+@needs_gxx
+def test_trainer_offload_bit_exact(rt, mv):
+    """The acceptance contract: an offloaded TransformerTrainer's loss
+    trajectory matches the in-memory baseline BIT FOR BIT at equal
+    steps (the bridge is a store, not an approximation)."""
+    from multiverso_tpu.core import context as core_context
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerTrainer)
+    from multiverso_tpu.parallel.offload import OffloadedState
+
+    mv.init(args=["-log_level=error"])
+    mesh = core_context.get_context().mesh
+    cfg = TransformerConfig(vocab_size=64, dim=32, n_layers=2, n_heads=2,
+                            hidden=64, max_seq=32)
+    toks = np.random.RandomState(0).randint(
+        64, size=(4, 16)).astype(np.int32)
+
+    base = TransformerTrainer(cfg, mesh, updater_type="momentum", seed=1)
+    mem = [float(base.train_step_async(toks)) for _ in range(3)]
+
+    tr = TransformerTrainer(cfg, mesh, updater_type="momentum", seed=1)
+    bridge = OffloadedState(rt, tr.offload_size())
+    tr.offload_state(bridge)
+    off = [float(tr.train_step_async(toks)) for _ in range(3)]
+    assert [np.float32(x).tobytes() for x in mem] == \
+           [np.float32(x).tobytes() for x in off]
+    bridge.close()
+
+
+def test_trainer_offload_rejects_stateless_updater(mv):
+    from multiverso_tpu.core import context as core_context
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerTrainer)
+    from multiverso_tpu.parallel.offload import OffloadedState
+
+    mv.init(args=["-log_level=error"])
+    mesh = core_context.get_context().mesh
+    cfg = TransformerConfig(vocab_size=32, dim=16, n_layers=1, n_heads=2,
+                            hidden=32, max_seq=16)
+    tr = TransformerTrainer(cfg, mesh, updater_type="sgd", seed=0)
+    assert tr.offload_size() == 0
+    with pytest.raises(ValueError):
+        tr.offload_state(OffloadedState(None, 1, backend="local"))
+
+
+# --------------------------------------------- serve copy satellites
+
+def test_serve_read_single_copy_per_miss(mv):
+    """Satellite: the table serve cache stores the fetched value itself
+    and copies once on the way out — and caller mutation of the
+    returned array must not corrupt later hits."""
+    mv.init(args=["-log_level=error", "-serve_cache_entries=8"])
+    t = mv.ArrayTable(16, name="hb_serve", serve_cache=8)
+    t.add(np.ones(16, np.float32))
+    first = t.get()
+    first[:] = -99.0                        # caller scribbles its copy
+    again = t.get()                         # hit: pristine
+    assert np.all(again == 1.0), again
+
+
+def test_anon_wire_get_shard_is_readonly_view():
+    """Satellite: AnonServeClient.get_shard returns the frombuffer view
+    (read-only flagged), not a copy."""
+    from multiverso_tpu.serve.wire import pack_frame, unpack_frame
+
+    payload = np.arange(6, dtype=np.float32).tobytes()
+    frame = pack_frame(3, 0, 1, blobs=[payload])   # ReplyGet shape
+    body = unpack_frame(frame[8:])
+    arr = np.frombuffer(body["blobs"][0], dtype=np.float32)
+    assert not arr.flags.writeable          # bytes-backed view
+    assert np.array_equal(arr, np.arange(6, dtype=np.float32))
+
+
+def test_serve_client_cache_is_mutation_proof(mv):
+    """Satellite: ServeClient stores the wire value read-only and hands
+    every caller a writable copy — scribbling on a result can never
+    corrupt a later hit."""
+    from multiverso_tpu.serve.client import ServeClient
+
+    mv.init(args=["-log_level=error"])
+
+    class StubRT:
+        def __init__(self):
+            self.fetches = 0
+
+        def array_get(self, handle, size):
+            self.fetches += 1
+            return np.ones(size, np.float32)
+
+        def last_version(self, handle):
+            return 1
+
+        def table_version(self, handle):
+            return 1
+
+    stub = StubRT()
+    c = ServeClient(stub, cache_entries=8, max_staleness=0,
+                    window_us=0.0, lease_ms=1e6)
+    a = c.array_get(0, 8)
+    assert a.flags.writeable                # caller copy is writable
+    a[:] = -5.0
+    b = c.array_get(0, 8)                   # cache hit
+    assert np.all(b == 1.0)
+    assert stub.fetches == 1                # really was a hit
+
+
+def test_kv_allgather_payload_roundtrip(mv):
+    """Satellite: the HIGHEST_PROTOCOL + buffer-protocol loads path
+    still round-trips arbitrary payloads single-process."""
+    mv.init(args=["-log_level=error"])
+    t = mv.KVTable(name="hb_kv_pickle")
+    payload = {"x": np.arange(5, dtype=np.float32), "y": ("s", 3)}
+    out = t._allgather_payload(payload)
+    assert len(out) == 1
+    assert np.array_equal(out[0]["x"], payload["x"])
+    assert out[0]["y"] == ("s", 3)

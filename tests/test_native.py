@@ -353,7 +353,10 @@ def test_native_tsan_scenarios(native, tmp_path):
     for scenario, nprocs, extra in [("net_child", 2, ()),
                                     ("backup_child", 3, ("0.34",)),
                                     ("ssp_tput", 2, ("3",)),
-                                    ("async_overlap", 2, ())]:
+                                    ("async_overlap", 2, ()),
+                                    # Borrowed arena sends under
+                                    # drop/dup/delay (host_bridge.md).
+                                    ("bridge_child", 2, ("epoll",))]:
         mf = _machine_file(tmp_path, nprocs)  # rewritten per scenario
         procs = [subprocess.Popen([tsan_bin, scenario, mf, str(r), *extra],
                                   stdout=subprocess.PIPE,
@@ -399,7 +402,11 @@ def test_native_asan_scenarios(native, tmp_path):
     for scenario, nprocs, extra in [("net_child", 2, ()),
                                     ("backup_child", 3, ("0.34",)),
                                     ("ssp_child", 2, ("1",)),
-                                    ("async_overlap", 2, ())]:
+                                    ("async_overlap", 2, ()),
+                                    # Borrowed arena sends under
+                                    # drop/dup/delay: the use-after-
+                                    # recycle class lives here.
+                                    ("bridge_child", 2, ("epoll",))]:
         mf = _machine_file(tmp_path, nprocs)  # rewritten per scenario
         procs = [subprocess.Popen([asan_bin, scenario, mf, str(r), *extra],
                                   stdout=subprocess.PIPE,

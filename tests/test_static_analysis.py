@@ -439,6 +439,54 @@ def test_mv011_out_of_scope_and_suppressible(tmp_path):
     assert _lint_src(d, suppressed) == []
 
 
+def test_mv012_fires_on_bridge_copy_churn(tmp_path):
+    """astype/.copy()/ascontiguousarray minted INLINE on a native
+    bridge add/get argument is a full-payload copy per call — the
+    churn the arena/borrow protocol exists to kill
+    (docs/host_bridge.md).  Named buffers and non-bridge calls stay
+    legal."""
+    rules = _lint_src(tmp_path, """\
+        import numpy as np
+
+        def bad(rt, h, grad, x):
+            rt.array_add(h, grad.astype(np.float32))            # BAD
+            rt.matrix_add_all(h, np.ascontiguousarray(grad))    # BAD
+            rt.array_add(h, delta=x.copy())                     # BAD (kwarg)
+            lib.MV_AddArrayTable(h, _fp(x.astype(np.float32)), 4)  # BAD
+
+        def good(rt, h, grad, arena):
+            buf = arena.alloc(grad.shape)
+            np.copyto(buf, grad)
+            rt.array_add(h, buf, borrowed=True)       # arena: fine
+            d = grad.astype(np.float32)               # hoisted: fine
+            rt.array_add(h, d)
+            other = np.ascontiguousarray(grad)        # not a bridge call
+            consume(other.copy())
+        """)
+    # The raw MV_* line draws BOTH rules: MV001 (ctypes temporary) and
+    # MV012 (inline churn through the _fp wrapper).
+    assert sorted(rules) == [("MV001", 7), ("MV012", 4), ("MV012", 5),
+                             ("MV012", 6), ("MV012", 7)], rules
+
+
+def test_mv012_out_of_scope_and_suppressible(tmp_path):
+    """Tests are exempt (they build ad-hoc arrays); a genuinely
+    required copy suppresses with its why."""
+    src = """\
+        import numpy as np
+
+        def f(rt, h, x):
+            rt.array_add(h, x.astype(np.float32))
+        """
+    assert [r for r, _ in _lint_src(tmp_path, src)] == ["MV012"]
+    assert _lint_src(tmp_path, src, name="test_snippet.py") == []
+    suppressed = src.replace(
+        "rt.array_add(h, x.astype(np.float32))",
+        "rt.array_add(h, x.astype(np.float32))  "
+        "# mvlint: disable=MV012 — cold path, caller dtype unknown")
+    assert _lint_src(tmp_path, suppressed) == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

@@ -100,6 +100,18 @@ Rules (docs/static_analysis.md has the full rationale):
   identifier that names a key/row (``key``, ``row``, ``row_id``,
   ``word``, ``token``...), including through ``str()`` / f-strings.
 
+- **MV012 bridge-copy-churn** — an argument flowing into a native
+  bridge add/get call (``rt.array_add(...)``, ``matrix_get_rows(...)``,
+  raw ``lib.MV_Add*``/``MV_Get*``...) may not be minted INLINE by
+  ``astype(...)`` / ``.copy()`` / ``np.ascontiguousarray(...)``: that
+  is a full-payload copy per call on the exact path the host-bridge
+  fast path exists to de-copy (docs/host_bridge.md).  Allocate the
+  buffer once through ``rt.arena().alloc(...)`` and pass it with
+  ``borrowed=``/``out=`` (zero-copy, layout guaranteed by
+  construction), or hoist the conversion out of the hot loop.  Tests
+  are exempt; a genuinely-required copy carries a suppression with its
+  why.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -430,7 +442,13 @@ def check_unbounded_client_cache(tree, path):
 CONTIG_PRODUCERS = {"ascontiguousarray", "_f32", "ravel", "copy",
                     "zeros", "ones", "full", "empty", "arange",
                     "zeros_like", "ones_like", "full_like", "empty_like",
-                    "frombuffer", "fromiter"}
+                    "frombuffer", "fromiter",
+                    # The binding's out=/borrow= validator: RAISES on a
+                    # non-contiguous / wrong-dtype buffer instead of
+                    # copying (the host-bridge borrow protocol,
+                    # docs/host_bridge.md) — contiguity is proven by the
+                    # call having returned.
+                    "_contig_f32"}
 
 
 def check_noncontiguous_ctypes(tree, path):
@@ -634,6 +652,55 @@ def check_label_cardinality(tree, path):
     return out
 
 
+# ---------------------------------------------------------------- MV012
+# The numpy-facing native bridge surface (NativeRuntime + the raw MV_*
+# entry points): arguments headed here are on the host-bridge hot path.
+BRIDGE_CALLS = {
+    "array_add", "array_get", "array_get_async",
+    "matrix_add_all", "matrix_get_all",
+    "matrix_add_rows", "matrix_get_rows", "matrix_get_rows_async",
+    "kv_add", "kv_get",
+}
+# Inline producers that cost a full payload copy per call.
+CHURN_PRODUCERS = {"astype", "copy", "ascontiguousarray"}
+
+
+def check_bridge_copy_churn(tree, path):
+    """MV012: astype/.copy()/ascontiguousarray minted inline on an
+    argument of a native bridge add/get call — per-call copy churn the
+    arena/borrow protocol exists to kill (docs/host_bridge.md)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _call_name(node.func)
+        is_bridge = tail in BRIDGE_CALLS or (
+            tail is not None and tail.startswith("MV_")
+            and ("Add" in tail or "Get" in tail))
+        if not is_bridge:
+            continue
+        args = list(node.args) + [k.value for k in node.keywords]
+        # One level into the ctypes pointer helpers: `_fp(x.astype(...))`
+        # is the same churn wearing a wrapper.
+        for a in list(args):
+            if isinstance(a, ast.Call) and _call_name(a.func) in PTR_HELPERS:
+                args.extend(a.args)
+        for arg in args:
+            if not isinstance(arg, ast.Call):
+                continue
+            churn = _call_name(arg.func)
+            if churn in CHURN_PRODUCERS:
+                out.append(Finding(
+                    path, arg.lineno, "MV012",
+                    f"{churn}(...) minted inline on an argument of "
+                    f"{tail}(...) — a full-payload copy per bridge "
+                    f"call; allocate through rt.arena().alloc(...) and "
+                    f"pass borrowed=/out= (zero-copy, contiguity by "
+                    f"construction), or hoist the conversion out of "
+                    f"the hot path (docs/host_bridge.md)"))
+    return out
+
+
 # ---------------------------------------------------------------- MV009
 # Native reactor-context lint: the only non-Python rule.  A file opts in
 # with this marker (the epoll engine sources carry it); the rule then
@@ -725,6 +792,10 @@ def lint_file(path):
                 or os.path.basename(path).startswith("test_"))
     if not in_tests:
         findings += check_unbounded_retry(tree, path)
+        # MV012: bridge copy churn — runtime code only (tests build
+        # ad-hoc arrays, and the seeded-violation suite must be able
+        # to spell the violation).
+        findings += check_bridge_copy_churn(tree, path)
     # Library code only: apps/ are executable worker scripts whose
     # stdout IS their protocol (NATIVE_LR_OK markers etc.).
     in_library = (("multiverso_tpu" in path)
