@@ -103,6 +103,16 @@ skew-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/skew_demo.py
 
+# Sparse-embedding serving smoke (docs/embedding.md): a 2-rank sharded
+# embedding table under a zipf hot head — the servers' top-K push
+# serves replica hits (worker-stub AND anonymous client), a server-side
+# add is observed fresh at staleness 0 within one replica lease, the
+# row-granular cache beats cold wire lookups outright, and the
+# multi-shard borrowed AddRows out-issues the per-rank staging path.
+embedding-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/embedding_demo.py
+
 # Host-bridge smoke (docs/host_bridge.md): borrowed arena adds land
 # exactly with mid-flight releases deferred (no use-after-recycle), the
 # zero-copy path beats the copying path outright, and a transformer
@@ -116,7 +126,7 @@ bridge-demo:
 # Demo umbrella: every acceptance smoke in sequence (each target builds
 # the native runtime once; later builds are no-ops).
 demos: metrics-demo serve-demo wire-demo fanin-demo ops-demo skew-demo \
-       bridge-demo
+       embedding-demo bridge-demo
 
 # Continuous perf gate (docs/PERF.md): diff the newest bench JSON line
 # against the committed BENCH_BASELINE.json with per-key noise bands;
@@ -129,5 +139,5 @@ clean:
 	$(MAKE) -C $(NATIVE) clean
 
 .PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
-        serve-demo wire-demo fanin-demo ops-demo skew-demo bridge-demo \
-        demos bench-gate clean
+        serve-demo wire-demo fanin-demo ops-demo skew-demo \
+        embedding-demo bridge-demo demos bench-gate clean

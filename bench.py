@@ -630,6 +630,43 @@ def bench_skew(nclients: int = 1000, rows: int = 2048, reqs: int = 2048):
     return res
 
 
+def bench_embedding(rows: int = 1 << 16, reqs: int = 512):
+    """Sparse-embedding serving fast path (docs/embedding.md; schema
+    14): a 2-rank epoll fleet holding one row-sharded embedding table
+    (shard-faithful scaled-down stand-in for the O(10^7)-row
+    recommender), measured on an identical zipf-hot-head row-get
+    stream at three tiers — ``embedding_cold_p50_ms`` (serve cache
+    off: every lookup is a wire round trip), ``embedding_rowcache_*``
+    (the row-granular versioned client cache;
+    ``embedding_rowcache_vs_cold_p50`` acceptance >= 10x), and
+    ``embedding_replica_*`` (the native hot-key replica serving the
+    servers' pushed top-K rows in one pinned-buffer native call;
+    ``embedding_replica_vs_rowcache_p50`` acceptance >= 1).  Plus the
+    full-zipf(1.0) tail (``embedding_zipf_p99_ms``), bytes/lookup for
+    cold-tail all-zero rows with the sparse reply codec off/on
+    (``embedding_sparse_bytes_ratio``), and the multi-shard
+    borrowed-vs-staged AddRows issue-cost A/B
+    (``embedding_addrows_borrow_speedup``, acceptance >= 2x — the
+    per-rank staging copies the borrowed run-iovec path removes).
+    Fleet + driver live in ``apps/embedding_bench_worker.py``."""
+    import re
+
+    outs = _spawn_native_workers("embedding_bench_worker.py", 2,
+                                 "EMBED_BENCH_OK", (rows, reqs))
+    res = {}
+    for out in outs:
+        for m in re.finditer(r"(\w+)=([0-9.]+)", out):
+            key = m.group(1)
+            if key == "rank":
+                continue
+            name = key if key.startswith("embedding_") \
+                else f"embedding_{key}"
+            res[name] = float(m.group(2))
+            if key.endswith("_ms"):
+                _observe_iter(float(m.group(2)) * 1e-3)
+    return res
+
+
 def bench_w2v(batch: int = 8192, vocab: int = 100_000, dim: int = 128,
               negatives: int = 5):
     import jax
@@ -1447,7 +1484,7 @@ def bench_lightlda_mh(num_docs: int = 2048, vocab: int = 10000,
 # (VERDICT r4 weak #1).
 _SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_w2v_native8,
              bench_wire_micro, bench_ssp, bench_serve, bench_serve_fanin,
-             bench_ops, bench_skew, bench_bridge,
+             bench_ops, bench_skew, bench_embedding, bench_bridge,
              bench_add_get,
              bench_transformer_large, bench_transformer, bench_moe,
              bench_lightlda, bench_lightlda_mh, bench_long_context]
@@ -1474,7 +1511,7 @@ def main() -> None:
     # Schema/partial line FIRST — before any JAX-touching import — so
     # even a backend-init hang killed by `timeout` leaves one parseable
     # line on stdout.
-    results = {"bench_schema": 13}
+    results = {"bench_schema": 14}
     errors = []
     _emit(results, errors)
 
@@ -1534,7 +1571,18 @@ def main() -> None:
     # copying A/B (bridge_borrow_speedup), and offload_overlap_pct
     # (share of the bridge round trip hidden by OffloadedState's double
     # buffering); gate keys bridge_add_host_gbps/bridge_get_host_gbps/
-    # offload_overlap_pct are new names so old rounds cannot collide.
+    # offload_overlap_pct are new names so old rounds cannot collide;
+    # 14 = sparse-embedding serving fast path (docs/embedding.md):
+    # bench_embedding drives a 2-rank sharded embedding table with a
+    # zipf hot-head row-get stream through three serving tiers —
+    # embedding_cold_* (cache off, wire per lookup), embedding_
+    # rowcache_* (row-granular versioned cache; _vs_cold_p50 >= 10x),
+    # embedding_replica_* (native hot-key replica, pinned-buffer call;
+    # _vs_rowcache_p50 >= 1) — plus embedding_zipf_p99_ms,
+    # embedding_sparse_bytes_ratio (all-zero tail rows, sparse reply
+    # codec off/on), and embedding_addrows_borrow_speedup (multi-shard
+    # borrowed run-iovec AddRows vs per-rank staging; >= 2x), all
+    # bench-gated.
 
     # A budget SIGTERM lands mid-section: convert it to an exception so
     # the JSON accumulated so far still prints (the whole point of the

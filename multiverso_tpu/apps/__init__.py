@@ -12,6 +12,7 @@ the TPU-native tables, each with
   TPU-native hot loop that the benchmarks run).
 """
 
+from .dlrm import DLRMRecommender, synthetic_clicks, zipf_ids
 from .lightlda import LightLDA, synthetic_documents
 from .logistic_regression import LogisticRegression, synthetic_classification
 from .skipgram_mixture import SkipGramMixture, synthetic_homonym_corpus
@@ -22,5 +23,6 @@ __all__ = [
     "SkipGram", "synthetic_corpus",
     "SkipGramMixture", "synthetic_homonym_corpus",
     "LightLDA", "synthetic_documents",
+    "DLRMRecommender", "synthetic_clicks", "zipf_ids",
     # torch-dependent (import from .resnet directly): ResNet20DataParallel
 ]

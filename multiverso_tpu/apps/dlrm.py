@@ -1,0 +1,160 @@
+"""DLRM-style sparse-embedding recommender — ROADMAP item 3's flagship
+"millions of users" workload (docs/embedding.md).
+
+Reference lineage: Multiverso's native habitat is huge sparse embedding
+tables (PAPER.md §0 — word embedding, LightLDA); the modern shape of
+that workload is recommender serving: a row-sharded embedding table with
+O(10^7+) ids, zipf-skewed id traffic, training via sparse row adds and
+serving via cached row reads.
+
+This app is the JAX-plane driver of that shape:
+
+- **the table** — one :class:`~multiverso_tpu.tables.MatrixTable`
+  holding user AND item embeddings (items live at ``num_users + item``,
+  one id space so a single sharded table serves both sides), trained
+  with ``add_rows`` — only touched rows move;
+- **training** — dot-product + sigmoid click prediction with binary
+  cross-entropy; the per-row gradients come out of ONE jitted
+  grad program over the gathered rows and push back as a batched
+  ``add_rows`` (mvlint MV013 polices the row-at-a-time antipattern);
+- **serving** — ``scores`` reads rows through the row-granular serve
+  cache (docs/embedding.md): hot rows hit locally, misses fetch only
+  the missing rows;
+- **traffic** — :func:`zipf_ids` draws the standard zipf(s) id stream
+  the bench/demo use, so hot-key sketches and the read replica see the
+  skew the real workload has.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..tables import MatrixTable
+from ..updaters import AddOption
+
+__all__ = ["DLRMRecommender", "zipf_ids", "synthetic_clicks"]
+
+
+def zipf_ids(n: int, k: int, rng, s: float = 1.0) -> np.ndarray:
+    """``n`` draws from zipf(``s``) over ``[0, k)`` — ``p(i) ∝ 1/(i+1)^s``.
+
+    The distribution head (ids 0, 1, 2, …) is the planted hot set every
+    embedding bench/demo in this repo asserts against."""
+    p = 1.0 / np.arange(1, k + 1, dtype=np.float64) ** s
+    p /= p.sum()
+    return rng.choice(k, size=n, p=p).astype(np.int64)
+
+
+def synthetic_clicks(batch: int, num_users: int, num_items: int,
+                     rng, s: float = 1.0
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One zipf-skewed interaction batch: (user ids, item ids, labels).
+
+    Labels follow a planted preference (hot users like hot items) so
+    training has signal to descend."""
+    users = zipf_ids(batch, num_users, rng, s)
+    items = zipf_ids(batch, num_items, rng, s)
+    labels = ((users + items) % 3 == 0).astype(np.float32)
+    return users, items, labels
+
+
+class DLRMRecommender:
+    """Dot-product click model over one sharded embedding table.
+
+    ``num_users + num_items`` rows of dimension ``dim``; row
+    ``num_users + i`` is item ``i``.  The table shards over the table
+    mesh like every MatrixTable — at recommender scale the row count is
+    what makes it "the flagship": O(10^7) rows is just a bigger
+    constructor argument (the bench runs shard-faithful scaled-down
+    tables so CI stays fast).
+    """
+
+    def __init__(self, num_users: int, num_items: int, dim: int = 16,
+                 learning_rate: float = 0.05, name: str = "dlrm",
+                 seed: int = 0, serve_cache: Optional[int] = None,
+                 max_staleness: Optional[int] = None):
+        self.num_users = int(num_users)
+        self.num_items = int(num_items)
+        self.dim = int(dim)
+        self.option = AddOption(learning_rate=learning_rate)
+        rng = np.random.RandomState(seed)
+        rows = self.num_users + self.num_items
+        init = (0.05 * rng.randn(rows, self.dim)).astype(np.float32)
+        kw = {}
+        if serve_cache is not None:
+            kw["serve_cache"] = serve_cache
+        if max_staleness is not None:
+            kw["max_staleness"] = max_staleness
+        self.table = MatrixTable(rows, self.dim, init=init, name=name,
+                                 updater_type="sgd",
+                                 default_option=self.option, **kw)
+        self._grad_fn = None
+
+    # ------------------------------------------------------------- training
+    def _grads(self, u_rows, v_rows, labels):
+        """One jitted BCE grad over the gathered rows (built lazily so
+        constructing the model costs no compile)."""
+        import jax
+
+        if self._grad_fn is None:
+            def loss(u, v, y):
+                import jax.numpy as jnp
+
+                logits = jnp.sum(u * v, axis=-1)
+                # Numerically-stable BCE-with-logits.
+                return jnp.mean(jnp.maximum(logits, 0) - logits * y +
+                                jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+            self._grad_fn = jax.jit(jax.value_and_grad(loss,
+                                                       argnums=(0, 1)))
+        return self._grad_fn(u_rows, v_rows, labels)
+
+    def train_step(self, user_ids, item_ids, labels) -> float:
+        """Pull touched rows, one grad program, push sparse updates.
+
+        The reference training-loop shape (§3.4) at row granularity:
+        gather → grad → ``add_rows`` — ONE batched add per side, never a
+        Python loop over ids (mvlint MV013)."""
+        users = np.asarray(user_ids, np.int64)
+        items = np.asarray(item_ids, np.int64) + self.num_users
+        y = np.asarray(labels, np.float32)
+        u_rows = self.table.get_rows(users)
+        v_rows = self.table.get_rows(items)
+        loss, (du, dv) = self._grads(u_rows, v_rows, y)
+        self.table.add_rows(users, np.asarray(du, np.float32))
+        self.table.add_rows(items, np.asarray(dv, np.float32))
+        return float(loss)
+
+    # -------------------------------------------------------------- serving
+    def scores(self, user_id: int, item_ids) -> np.ndarray:
+        """Serve scores for one user against candidate items — every
+        row read rides the row-granular serve cache, so the zipf head
+        stops paying fetches at all."""
+        items = np.asarray(item_ids, np.int64) + self.num_users
+        u = self.table.get_rows(np.asarray([user_id], np.int64))[0]
+        v = self.table.get_rows(items)
+        return (v @ u).astype(np.float32)
+
+    def hot_report(self) -> dict:
+        """The table's workload report (hot ids, skew) — what placement
+        feeds on (docs/observability.md)."""
+        return self.table.workload_report()
+
+    def train_epoch(self, batches: int, batch: int, seed: int = 0,
+                    s: float = 1.0) -> list:
+        """Convenience loop for tests/demos: zipf traffic, returns the
+        per-batch loss trajectory."""
+        rng = np.random.RandomState(seed)
+        make = partial(synthetic_clicks, batch, self.num_users,
+                       self.num_items, rng, s)
+        losses = []
+        for _ in range(batches):
+            users, items, y = make()
+            losses.append(self.train_step(users, items, y))
+        return losses
+
+    def close(self) -> None:
+        self.table.close()

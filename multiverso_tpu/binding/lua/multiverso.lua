@@ -122,6 +122,11 @@ int MV_TableLoadStats(int32_t handle, long long* gets, long long* adds,
                       double* add_linf, long long* nan_count,
                       long long* inf_count);
 int MV_SetHotKeyTracking(int on);
+int MV_SetHotKeyReplica(int on);
+int MV_ReplicaRefresh(int32_t handle);
+int MV_ReplicaStats(int32_t handle, long long* hits, long long* misses,
+                    long long* rows, long long* refreshes,
+                    long long* pushes);
 char* MV_OpsFleetReport(const char* kind);
 ]]
 
@@ -435,6 +440,31 @@ end
 --- Toggle the workload accounting live (boot value: -hotkey_enabled).
 function mv.set_hotkey_tracking(on)
   check(C.MV_SetHotKeyTracking(on and 1 or 0), "MV_SetHotKeyTracking")
+end
+
+--- Toggle the hot-key read replica live (docs/embedding.md; boot
+--- value: -hotkey_replica): matrix row gets consult the servers'
+--- pushed top-K rows before the wire.
+function mv.set_hotkey_replica(on)
+  check(C.MV_SetHotKeyReplica(on and 1 or 0), "MV_SetHotKeyReplica")
+end
+
+--- Force one replica refresh round trip for a matrix table.
+function mv.replica_refresh(handle)
+  check(C.MV_ReplicaRefresh(handle), "MV_ReplicaRefresh")
+end
+
+--- Replica ledger for a matrix table: hits, misses, rows held,
+--- refresh round trips, server-side pushes.
+function mv.replica_stats(handle)
+  local h = ffi.new("long long[1]")
+  local m = ffi.new("long long[1]")
+  local r = ffi.new("long long[1]")
+  local f = ffi.new("long long[1]")
+  local p = ffi.new("long long[1]")
+  check(C.MV_ReplicaStats(handle, h, m, r, f, p), "MV_ReplicaStats")
+  return tonumber(h[0]), tonumber(m[0]), tonumber(r[0]),
+         tonumber(f[0]), tonumber(p[0])
 end
 
 --- Fleet-scope ops report assembled by THIS rank over the rank wire

@@ -208,6 +208,14 @@ define_double("coalesce_window_us", 200.0,
 define_int("serve_max_batch", 64,
            "size cap per coalescing window — a full batch seals (and "
            "executes) early")
+define_bool("serve_row_cache", True,
+            "row-granular serve cache (docs/embedding.md): with the "
+            "serve cache armed, Matrix/KV per-id reads cache INDIVIDUAL "
+            "rows/keys gated by their bucket versions, so a hot row "
+            "keeps hitting across different id sets and adds elsewhere. "
+            "False falls back to the PR 4 whole-id-set entries.  "
+            "Single-controller only either way — multi-host id reads "
+            "bypass the cache (the fetch is a lockstep collective)")
 # --- workload observability (docs/observability.md) ------------------------
 define_bool("hotkey_enabled", True,
             "per-table workload accounting: hot-key sketches "
@@ -219,6 +227,19 @@ define_int("hotkey_topk", 16,
            "capacity of the space-saving top-K hot-key sketch per table "
            "(memory bound; every key with frequency > total/K is "
            "guaranteed monitored)")
+define_bool("hotkey_replica", False,
+            "hot-key read replica (docs/embedding.md, native-flag "
+            "parity): matrix worker stubs keep a side table of the "
+            "servers' pushed SpaceSaving top-K rows and serve row gets "
+            "from it before the wire; invalidation rides the "
+            "version-stamp protocol")
+define_double("replica_lease_ms", 50.0,
+              "hot-key replica snapshot lease (native-flag parity): the "
+              "pushed row set re-pulls once the snapshot ages past this")
+define_int("replica_max_staleness", 0,
+           "version distance a replica-served row may be behind the "
+           "last observed apply (native-flag parity); 0 = a row older "
+           "than any later observed add misses")
 
 define_double("version_lease_ms", 50.0,
               "how long a learned server version stays trusted before "

@@ -215,6 +215,13 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_TableLoadStats.restype = ctypes.c_int
     lib.MV_SetHotKeyTracking.argtypes = [ctypes.c_int]
     lib.MV_SetHotKeyTracking.restype = ctypes.c_int
+    lib.MV_SetHotKeyReplica.argtypes = [ctypes.c_int]
+    lib.MV_SetHotKeyReplica.restype = ctypes.c_int
+    lib.MV_ReplicaRefresh.argtypes = [ctypes.c_int32]
+    lib.MV_ReplicaRefresh.restype = ctypes.c_int
+    lib.MV_ReplicaStats.argtypes = [
+        ctypes.c_int32] + [ctypes.POINTER(ctypes.c_longlong)] * 5
+    lib.MV_ReplicaStats.restype = ctypes.c_int
     lib.MV_OpsFleetReport.argtypes = [ctypes.c_char_p]
     lib.MV_OpsFleetReport.restype = ctypes.c_void_p
     lib.MV_SetFault.argtypes = [ctypes.c_char_p, ctypes.c_double]
@@ -808,6 +815,33 @@ class NativeRuntime:
         ``hotkey_track_overhead_pct`` bench bar."""
         self._check(self.lib.MV_SetHotKeyTracking(1 if on else 0),
                     "MV_SetHotKeyTracking")
+
+    def set_hotkey_replica(self, on: bool = True) -> None:
+        """Toggle the hot-key read replica live (docs/embedding.md;
+        boot value: the ``-hotkey_replica`` flag).  Armed, matrix row
+        gets consult the servers' pushed top-K rows before the wire;
+        invalidation rides the version-stamp protocol."""
+        self._check(self.lib.MV_SetHotKeyReplica(1 if on else 0),
+                    "MV_SetHotKeyReplica")
+
+    def replica_refresh(self, handle: int) -> None:
+        """Force one replica refresh round trip (RequestReplica to
+        every shard) for a matrix table — GetRows otherwise refreshes
+        lazily past ``-replica_lease_ms``."""
+        self._check(self.lib.MV_ReplicaRefresh(handle),
+                    "MV_ReplicaRefresh")
+
+    def replica_stats(self, handle: int) -> dict:
+        """Replica ledger for a matrix table: ``{"hits", "misses",
+        "rows", "refreshes", "pushes"}`` — rows served locally vs sent
+        to the wire, rows currently held, refresh round trips, and this
+        rank's server-side push count."""
+        vals = [ctypes.c_longlong(0) for _ in range(5)]
+        self._check(self.lib.MV_ReplicaStats(
+            handle, *(ctypes.byref(v) for v in vals)),
+            "MV_ReplicaStats")
+        keys = ("hits", "misses", "rows", "refreshes", "pushes")
+        return dict(zip(keys, (v.value for v in vals)))
 
     def ops_fleet_report(self, kind: str = "health") -> str:
         """Fleet-scope ops report assembled BY THIS RANK over the rank

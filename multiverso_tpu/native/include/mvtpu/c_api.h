@@ -246,6 +246,26 @@ int MV_SetHotKeyTracking(int on);
 // "tables" | "hotkeys".  malloc'd; caller frees with MV_FreeString.
 char* MV_OpsFleetReport(const char* kind);
 
+// ---- hot-key read replica (docs/embedding.md) ------------------------
+// Toggle replica-served matrix row reads live (the `-hotkey_replica`
+// flag is the boot value).  Armed, MatrixWorkerTable::GetRows consults
+// a worker-local side table of the servers' pushed SpaceSaving top-K
+// rows BEFORE the wire; invalidation rides the version-stamp protocol
+// (entries older than last_version - `-replica_max_staleness` miss),
+// and the snapshot re-pulls past `-replica_lease_ms`.
+int MV_SetHotKeyReplica(int on);
+// Force one replica refresh round trip (RequestReplica to every shard)
+// for a matrix table.  rc 0, -1 not started, -2 not a matrix table,
+// -3 dead shard / deadline, -6 shed (retryable).
+int MV_ReplicaRefresh(int32_t handle);
+// Replica ledger for a matrix table (any output pointer may be NULL):
+// rows served from the replica (hits), rows that went to the wire
+// (misses), rows currently held, refresh round trips, and this rank's
+// server-side push count.  rc 0, -1 not started, -2 not a matrix table.
+int MV_ReplicaStats(int32_t handle, long long* hits, long long* misses,
+                    long long* rows, long long* refreshes,
+                    long long* pushes);
+
 // ---- serve layer (docs/serving.md) -----------------------------------
 // Version probe: one header-only round trip filling *version with the
 // max CURRENT version over every server shard of the table — the cheap

@@ -43,6 +43,17 @@ enum class MsgType : int32_t {
   // answered WITHOUT processing.  Retryable — and unlike a deadline -3
   // it is not indeterminate: the server did no work.
   ReplyBusy = 10,
+  // Hot-key replica pull (docs/embedding.md): the requester asks a
+  // server shard to PUSH its current SpaceSaving top-K rows.  The
+  // reply carries three blobs — [int32 global row ids][int64 per-row
+  // bucket versions][float row data, k*cols] — snapshotted atomically
+  // against concurrent adds, plus the shard's table version in the
+  // header.  Workers (and anonymous serve clients) install the rows in
+  // a read-replica side table consulted BEFORE the wire; invalidation
+  // rides the existing version-stamp protocol (an entry older than the
+  // staleness bound misses).  Sheddable like a Get — never blocks adds.
+  RequestReplica = 11,
+  ReplyReplica = 12,
   // SSP clock announcement (msg_id = the worker's new clock).  Rides
   // each worker->server connection BEHIND that clock's adds (FIFO), so
   // "min worker clock >= c" implies every rank's adds through clock c

@@ -42,6 +42,13 @@ namespace workload {
 bool Armed();
 void Arm(bool on);
 
+// Hot-key replica arm switch (docs/embedding.md): latched from
+// `-hotkey_replica` at Zoo::Start, togglable live via
+// MV_SetHotKeyReplica.  Disarmed, the worker GetRows replica probe is
+// one relaxed atomic load (the same discipline as Armed()).
+bool ReplicaArmed();
+void ArmReplica(bool on);
+
 // Stable 64-bit key hash shared with the Python mirror
 // (multiverso_tpu/sketch.py) so per-rank sketches merge coherently:
 // FNV-1a, the same function KVHash uses for the partition contract.

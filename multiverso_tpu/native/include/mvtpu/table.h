@@ -134,6 +134,25 @@ class ServerTable {
     return tracker_.TopK();
   }
 
+  // ---- hot-key replica push (docs/embedding.md) ----------------------
+  // Fill a ReplyReplica with this shard's current SpaceSaving top-K
+  // rows: [int32 row ids][int64 bucket versions][float row data], rows
+  // and versions snapshotted atomically against concurrent adds.  The
+  // default is an empty push (table kinds with no row-replica form);
+  // MatrixServerTable overrides.  Answered by the server actor for
+  // MsgType::RequestReplica — sheddable like a Get, never blocks adds.
+  virtual void BuildReplica(Message* reply) { (void)reply; }
+  int64_t replica_pushes() const {
+    return replica_pushes_.load(std::memory_order_relaxed);
+  }
+
+ protected:
+  void NoteReplicaPush() {
+    replica_pushes_.fetch_add(1, std::memory_order_relaxed);
+  }
+
+ public:
+
  protected:
   // One call per ProcessGet/ProcessAdd; bucket < 0 = whole-table op
   // (counts toward totals only — charging all 64 buckets would fake a
@@ -201,6 +220,7 @@ class ServerTable {
   std::atomic<int64_t> total_gets_{0};
   std::atomic<int64_t> total_adds_{0};
   workload::HotKeyTracker tracker_;
+  std::atomic<int64_t> replica_pushes_{0};
   mutable Mutex health_mu_;
   double add_l2sq_ GUARDED_BY(health_mu_) = 0.0;
   double add_linf_ GUARDED_BY(health_mu_) = 0.0;
@@ -236,6 +256,10 @@ class MatrixServerTable : public ServerTable {
                     int rank = 0, int size = 1);
   void ProcessGet(const Message& req, Message* reply) override;
   void ProcessAdd(const Message& req) override;
+  // Hot-key replica push (docs/embedding.md): this shard's current
+  // top-K rows with their bucket versions, snapshotted under mu_ so a
+  // concurrent add can neither tear a row nor out-date a stamp.
+  void BuildReplica(Message* reply) override;
   bool Store(Stream* out) const override;
   bool Load(Stream* in) override;
   int64_t rows() const { return range_.len(); }
@@ -488,6 +512,26 @@ class MatrixWorkerTable : public WorkerTable {
                        const float* delta, const AddOption& opt,
                        bool blocking);
 
+  // ---- hot-key read replica (docs/embedding.md) ----------------------
+  // With `-hotkey_replica` armed, GetRows consults a worker-local side
+  // table of the servers' pushed top-K rows BEFORE the wire: a row is a
+  // hit when the snapshot is inside `-replica_lease_ms` AND its pushed
+  // bucket version satisfies last_version() - `-replica_max_staleness`
+  // (version gating IS the invalidation: this worker's own add acks
+  // advance last_version, staling every older entry at staleness 0).
+  // Refresh = one RequestReplica round trip per shard ("push-on-pull":
+  // the SERVER chooses what to replicate — its SpaceSaving top-K).
+  bool RefreshReplica();
+  void OnReplicaPush(const Message& reply);  // install one shard's push
+  struct ReplicaStats {
+    long long hits = 0;       // rows served from the replica
+    long long misses = 0;     // rows that had to go to the wire
+    long long rows = 0;       // rows currently held
+    long long refreshes = 0;  // RequestReplica round trips
+  };
+  ReplicaStats replica_stats() const;
+  void OnClockInvalidate() override;  // clock boundary: replica is void
+
  protected:
   void SendAggregate(const float* sum, int64_t n,
                      const AddOption& opt) override;
@@ -497,6 +541,11 @@ class MatrixWorkerTable : public WorkerTable {
  private:
   // The one sharded-send plan for AddAll and the aggregation flush.
   bool SendAddAll(const float* delta, const AddOption& opt, bool blocking);
+  // AddRows' send plan: the single-shard borrowed fast path, the
+  // multi-shard borrowed run-iovec path (docs/embedding.md), the
+  // sparse-codec staging path, and the plain staging fallback.
+  bool SendAddRows(const int32_t* row_ids, int64_t k, const float* delta,
+                   const AddOption& opt, bool blocking);
   // THE one owner-partitioning plan for GetRows/GetRowsAsync: fills
   // `positions` (caller slots per shard), zero-fills the output (the
   // out-of-range-id contract), returns the per-shard requests.  Both
@@ -505,6 +554,26 @@ class MatrixWorkerTable : public WorkerTable {
   std::vector<MessagePtr> PlanRowsGet(
       const int32_t* row_ids, int64_t k, float* data,
       std::vector<std::vector<int64_t>>* positions);
+  // GetRows' wire body (the pre-replica fetch path); GetRows itself now
+  // serves replica hits first and routes only the remainder here.
+  bool FetchRowsWire(const int32_t* row_ids, int64_t k, float* data);
+  // Refresh the replica when the snapshot aged past -replica_lease_ms.
+  void MaybeRefreshReplica();
+  // Drop replica entries for rows this worker just added (belt to the
+  // version gate's braces — the ack that would stale them may race a
+  // concurrent read).
+  void InvalidateReplicaRows(const int32_t* row_ids, int64_t k);
+
+  struct ReplicaRow {
+    int64_t version = 0;        // pushed bucket version at snapshot
+    std::vector<float> data;    // cols_ floats
+  };
+  mutable Mutex replica_mu_;
+  std::unordered_map<int32_t, ReplicaRow> replica_ GUARDED_BY(replica_mu_);
+  int64_t replica_ts_ms_ GUARDED_BY(replica_mu_) = -1;  // -1: never
+  std::atomic<long long> replica_hits_{0};
+  std::atomic<long long> replica_misses_{0};
+  std::atomic<long long> replica_refreshes_{0};
 };
 
 // Sparse variant (SURVEY.md §2.13, table/sparse_matrix_table.h): the

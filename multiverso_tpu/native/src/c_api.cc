@@ -691,6 +691,38 @@ char* MV_OpsFleetReport(const char* kind) {
       Zoo::Get()->FleetReport(kind ? kind : "health"));
 }
 
+// ---- hot-key read replica (docs/embedding.md) ------------------------
+
+int MV_SetHotKeyReplica(int on) {
+  mvtpu::workload::ArmReplica(on != 0);
+  return 0;
+}
+
+int MV_ReplicaRefresh(int32_t handle) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->matrix_worker(handle);
+  if (!t) return -2;
+  return t->RefreshReplica() ? 0 : FailRc();
+}
+
+int MV_ReplicaStats(int32_t handle, long long* hits, long long* misses,
+                    long long* rows, long long* refreshes,
+                    long long* pushes) {
+  if (RequireStarted()) return -1;
+  auto* t = Zoo::Get()->matrix_worker(handle);
+  if (!t) return -2;
+  auto s = t->replica_stats();
+  if (hits) *hits = s.hits;
+  if (misses) *misses = s.misses;
+  if (rows) *rows = s.rows;
+  if (refreshes) *refreshes = s.refreshes;
+  if (pushes) {
+    auto* st = Zoo::Get()->server_table(handle);
+    *pushes = st ? st->replica_pushes() : 0;
+  }
+  return 0;
+}
+
 // ---- serve layer (docs/serving.md) -----------------------------------
 
 int MV_TableVersion(int32_t handle, long long* version) {

@@ -282,6 +282,25 @@ void RegisterDefaults() {
               "server table (memory bound: this many monitored keys; "
               "every true heavy hitter with frequency > total/K is "
               "guaranteed monitored)");
+    DefineBool("hotkey_replica", false,
+               "hot-key read replica (docs/embedding.md): matrix worker "
+               "stubs keep a side table of the servers' pushed "
+               "SpaceSaving top-K rows and serve GetRows hits from it "
+               "before the wire; invalidation rides the version-stamp "
+               "protocol (an entry older than last_version - "
+               "-replica_max_staleness misses).  Requires "
+               "-hotkey_enabled (the push IS the top-K sketch); "
+               "MV_SetHotKeyReplica toggles live");
+    DefineInt("replica_lease_ms", 50,
+              "hot-key replica snapshot lease: GetRows refreshes the "
+              "pushed row set (one RequestReplica round trip per shard) "
+              "once the snapshot ages past this; entries are never "
+              "served from a snapshot older than the lease");
+    DefineInt("replica_max_staleness", 0,
+              "version distance a replica-served row may be behind the "
+              "last observed apply (the worker's reply-stamp ledger); "
+              "0 = a row older than ANY later observed add misses — "
+              "staleness-0 reads after an acked add always refetch");
     DefineBool("arena_pin", true,
                "host bridge (docs/host_bridge.md): mlock(2) HostArena "
                "buffers so the scatter-gather send path never page-"

@@ -532,6 +532,7 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
     m.src = peer;
     bool counted =
         m.type == MsgType::RequestGet || m.type == MsgType::RequestVersion ||
+        m.type == MsgType::RequestReplica ||
         m.type == MsgType::RequestFlush ||
         (m.type == MsgType::RequestAdd && m.msg_id >= 0);
     int64_t cap = FlagOr("client_inflight_max", 64);
@@ -753,6 +754,7 @@ bool EpollNet::Enqueue(const std::shared_ptr<Conn>& c, const Message& msg,
   if (may_block && transport::IsClientRank(c->peer.load()) &&
       (msg.type == MsgType::ReplyGet || msg.type == MsgType::ReplyAdd ||
        msg.type == MsgType::ReplyVersion ||
+       msg.type == MsgType::ReplyReplica ||
        msg.type == MsgType::ReplyBusy || msg.type == MsgType::ReplyFlush ||
        msg.type == MsgType::ReplyError)) {
     long long now = c->inflight.fetch_add(-1);

@@ -14,6 +14,11 @@ namespace {
 // re-latches from the parsed flags, MV_SetHotKeyTracking toggles live.
 std::atomic<bool> g_armed{true};
 
+// Replica disarmed by default (the `-hotkey_replica` flag default):
+// serving reads from a side table is an opt-in semantics choice, not
+// free observability.
+std::atomic<bool> g_replica_armed{false};
+
 // Minimal JSON string escape for key labels (KV keys are caller data).
 std::string JsonEscape(const std::string& s) {
   std::string out;
@@ -37,6 +42,13 @@ std::string JsonEscape(const std::string& s) {
 
 bool Armed() { return g_armed.load(std::memory_order_relaxed); }
 void Arm(bool on) { g_armed.store(on, std::memory_order_relaxed); }
+
+bool ReplicaArmed() {
+  return g_replica_armed.load(std::memory_order_relaxed);
+}
+void ArmReplica(bool on) {
+  g_replica_armed.store(on, std::memory_order_relaxed);
+}
 
 uint64_t KeyHash(const void* data, size_t n) {
   // FNV-1a 64 — identical to table.h KVHash and the Python mirror

@@ -13,6 +13,7 @@
 
 #include <chrono>
 #include <cmath>
+#include <cstdlib>
 #include <cstring>
 
 #include "mvtpu/codec.h"
@@ -238,14 +239,43 @@ void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
   reply->data.push_back(std::move(out));
 }
 
+namespace {
+
+// AddRows delta rows may arrive split across SEVERAL blobs (the
+// borrowed multi-shard path ships each contiguous caller-order run as
+// its own zero-copy iovec, docs/embedding.md); blob boundaries are
+// row-aligned by the sender contract.  This cursor walks rows across
+// the blob sequence [first, req.data.size()).
+struct RowBlobCursor {
+  const Message& req;
+  size_t blob;
+  size_t off = 0;  // floats consumed inside the current blob
+  RowBlobCursor(const Message& r, size_t first) : req(r), blob(first) {}
+  const float* Next(int64_t cols) {
+    while (blob < req.data.size() &&
+           off + static_cast<size_t>(cols) > req.data[blob].count<float>()) {
+      blob += 1;
+      off = 0;
+    }
+    if (blob >= req.data.size()) return nullptr;
+    const float* p = req.data[blob].As<float>() + off;
+    off += static_cast<size_t>(cols);
+    return p;
+  }
+};
+
+}  // namespace
+
 void MatrixServerTable::ProcessAdd(const Message& req) {
   Monitor mon("MatrixServer::ProcessAdd");
   const AddOption* opt = req.data[0].As<AddOption>();
   NoteAdd(-1);
-  if (!req.data.empty())
-    NoteAddHealth(req.data.back().As<float>(),
-                  req.data.back().count<float>());
-  if (workload::Armed() && req.data.size() == 3) {
+  // Update-health scan over EVERY delta blob (a multi-shard borrowed
+  // AddRows splits the payload across run blobs — scanning only
+  // data.back() would miss NaNs in the earlier runs).
+  for (size_t b = req.data.size() == 2 ? 1 : 2; b < req.data.size(); ++b)
+    NoteAddHealth(req.data[b].As<float>(), req.data[b].count<float>());
+  if (workload::Armed() && req.data.size() >= 3) {
     const int32_t* note_ids = req.data[1].As<int32_t>();
     size_t note_k = req.data[1].count<int32_t>();
     for (size_t i = 0; i < note_k; ++i)
@@ -268,20 +298,25 @@ void MatrixServerTable::ProcessAdd(const Message& req) {
   }
   const int32_t* ids = req.data[1].As<int32_t>();
   size_t k = req.data[1].count<int32_t>();
-  const float* delta = req.data[2].As<float>();
-  if (req.data[2].count<float>() != k * static_cast<size_t>(cols_)) {
+  size_t delta_floats = 0;
+  for (size_t b = 2; b < req.data.size(); ++b)
+    delta_floats += req.data[b].count<float>();
+  if (delta_floats != k * static_cast<size_t>(cols_)) {
     Log::Error("MatrixServerTable: AddRows size mismatch");
     return;
   }
+  RowBlobCursor cur(req, 2);
   if (!slots) {
     // Stateless add: sequential application composes like consecutive
     // reference Adds (duplicates sum).
     for (size_t i = 0; i < k; ++i) {
+      const float* row = cur.Next(cols_);
+      if (!row) break;
       int64_t r = ids[i] - range_.begin;
       if (ids[i] < 0 || ids[i] >= global_rows_ || r < 0 || r >= range_.len())
         continue;
-      ApplyUpdate(updater_, *opt, data_.data() + r * cols_, nullptr,
-                  delta + i * cols_, static_cast<size_t>(cols_));
+      ApplyUpdate(updater_, *opt, data_.data() + r * cols_, nullptr, row,
+                  static_cast<size_t>(cols_));
       BumpVersion(RowBucket(ids[i]));
     }
     return;
@@ -291,13 +326,14 @@ void MatrixServerTable::ProcessAdd(const Message& req) {
   // before one updater call per row (tables/matrix_table.py).
   std::unordered_map<int64_t, std::vector<float>> agg;
   for (size_t i = 0; i < k; ++i) {
+    const float* row = cur.Next(cols_);
+    if (!row) break;
     int64_t r = ids[i] - range_.begin;
     if (ids[i] < 0 || ids[i] >= global_rows_ || r < 0 || r >= range_.len())
       continue;
     auto& acc = agg[r];
     if (acc.empty()) acc.assign(static_cast<size_t>(cols_), 0.0f);
-    const float* src = delta + i * cols_;
-    for (int64_t c = 0; c < cols_; ++c) acc[c] += src[c];
+    for (int64_t c = 0; c < cols_; ++c) acc[c] += row[c];
   }
   for (auto& kv : agg) {
     ApplyUpdate(updater_, *opt, data_.data() + kv.first * cols_,
@@ -305,6 +341,49 @@ void MatrixServerTable::ProcessAdd(const Message& req) {
                 static_cast<size_t>(cols_));
     BumpVersion(RowBucket(kv.first + range_.begin));  // global row bucket
   }
+}
+
+void MatrixServerTable::BuildReplica(Message* reply) {
+  Monitor mon("MatrixServer::BuildReplica");
+  NoteReplicaPush();
+  // The SERVER chooses what to replicate: its SpaceSaving top-K row
+  // ids (docs/embedding.md).  Tracker disarmed or cold => empty push
+  // (still three blobs — the wire shape is fixed).
+  auto top = HotTopK();
+  std::vector<int32_t> ids;
+  ids.reserve(top.size());
+  for (const auto& item : top) {
+    char* end = nullptr;
+    long v = std::strtol(item.label.c_str(), &end, 10);
+    if (!end || *end != '\0' || item.label.empty()) continue;
+    if (v < range_.begin || v >= range_.end) continue;  // not my shard
+    ids.push_back(static_cast<int32_t>(v));
+  }
+  Blob id_blob(ids.size() * sizeof(int32_t));
+  Blob ver_blob(ids.size() * sizeof(int64_t));
+  Blob row_blob(ids.size() * static_cast<size_t>(cols_) * sizeof(float));
+  int32_t* id_p = id_blob.As<int32_t>();
+  int64_t* ver_p = ver_blob.As<int64_t>();
+  float* row_p = row_blob.As<float>();
+  {
+    // One lock over versions AND data: ProcessAdd bumps versions under
+    // mu_ too, so a pushed row can never carry a version newer than its
+    // bytes (the stamp may be conservative, never optimistic — the same
+    // pre-fetch discipline the client caches follow).
+    MutexLock lk(mu_);
+    for (size_t i = 0; i < ids.size(); ++i) {
+      id_p[i] = ids[i];
+      ver_p[i] = bucket_version(RowBucket(ids[i]));
+      std::memcpy(row_p + i * cols_,
+                  data_.data() + (ids[i] - range_.begin) * cols_,
+                  static_cast<size_t>(cols_) * sizeof(float));
+    }
+    reply->version = version();
+  }
+  reply->data.push_back(std::move(id_blob));
+  reply->data.push_back(std::move(ver_blob));
+  reply->data.push_back(std::move(row_blob));
+  Dashboard::Record("replica.push", static_cast<double>(ids.size()));
 }
 
 bool MatrixServerTable::Store(Stream* out) const {
@@ -527,6 +606,17 @@ Blob WrapPayload(const void* p, size_t bytes) {
   }
   return Blob(p, bytes);
 }
+
+namespace {
+// True when the active borrow scope covers [p, p+bytes) — the gate the
+// multi-shard borrowed AddRows uses to pick run-iovec shipping over
+// per-rank staging (docs/embedding.md).
+bool BorrowCovers(const void* p, size_t bytes) {
+  const char* cp = static_cast<const char*>(p);
+  return g_borrow.base != nullptr && cp >= g_borrow.base &&
+         cp + bytes <= g_borrow.base + g_borrow.len;
+}
+}  // namespace
 
 // ---- wire codec + add aggregation (docs/wire_compression.md) ---------
 
@@ -980,13 +1070,167 @@ std::vector<MessagePtr> MatrixWorkerTable::PlanRowsGet(
   return reqs;
 }
 
-bool MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
-                                float* data) {
-  Monitor mon("MatrixWorker::GetRows");
+bool MatrixWorkerTable::FetchRowsWire(const int32_t* row_ids, int64_t k,
+                                      float* data) {
   std::vector<std::vector<int64_t>> positions;
   auto reqs = PlanRowsGet(row_ids, k, data, &positions);
   RowsDest d{data, cols_, &positions};
   return RoundTrip(std::move(reqs), ScatterRowsReply, &d);
+}
+
+bool MatrixWorkerTable::GetRows(const int32_t* row_ids, int64_t k,
+                                float* data) {
+  Monitor mon("MatrixWorker::GetRows");
+  if (!workload::ReplicaArmed() || k <= 0)
+    return FetchRowsWire(row_ids, k, data);
+  // Hot-key read replica (docs/embedding.md): serve what the servers'
+  // pushed top-K covers, wire-fetch only the remainder.  FIFO parity
+  // with the wire path: buffered aggregates flush first, so a replica
+  // hit is never *less* fresh than the wire read it replaces.
+  FlushAdds();
+  MaybeRefreshReplica();
+  std::vector<int32_t> rem;
+  std::vector<int64_t> rem_slot;
+  // Version gating IS the invalidation: our own add acks (and every
+  // reply stamp) advance last_version, so at -replica_max_staleness=0
+  // any entry older than the last observed apply misses.
+  int64_t min_v = last_version() - TableFlagOr("replica_max_staleness", 0);
+  {
+    int64_t lease = TableFlagOr("replica_lease_ms", 50);
+    MutexLock lk(replica_mu_);
+    bool fresh = replica_ts_ms_ >= 0 &&
+                 SteadyNowMs() - replica_ts_ms_ <= lease;
+    for (int64_t i = 0; i < k; ++i) {
+      if (fresh) {
+        auto it = replica_.find(row_ids[i]);
+        if (it != replica_.end() && it->second.version >= min_v) {
+          std::memcpy(data + i * cols_, it->second.data.data(),
+                      static_cast<size_t>(cols_) * sizeof(float));
+          replica_hits_.fetch_add(1, std::memory_order_relaxed);
+          continue;
+        }
+      }
+      rem.push_back(row_ids[i]);
+      rem_slot.push_back(i);
+    }
+  }
+  replica_misses_.fetch_add(static_cast<long long>(rem.size()),
+                            std::memory_order_relaxed);
+  if (rem.empty()) {
+    Dashboard::Record("replica.serve", 0.0);  // zero-wire row get
+    return true;
+  }
+  if (rem.size() == static_cast<size_t>(k))
+    return FetchRowsWire(row_ids, k, data);
+  std::vector<float> buf(rem.size() * static_cast<size_t>(cols_));
+  if (!FetchRowsWire(rem.data(), static_cast<int64_t>(rem.size()),
+                     buf.data()))
+    return false;
+  for (size_t j = 0; j < rem.size(); ++j)
+    std::memcpy(data + rem_slot[j] * cols_,
+                buf.data() + j * cols_,
+                static_cast<size_t>(cols_) * sizeof(float));
+  return true;
+}
+
+namespace {
+// RefreshReplica's consume trampoline (runs under WorkerTable::mu_ on
+// the worker actor thread; OnReplicaPush takes replica_mu_ after it —
+// the one fixed order those two locks are ever taken in).
+void ConsumeReplica(void* arg, const Message& reply) {
+  static_cast<MatrixWorkerTable*>(arg)->OnReplicaPush(reply);
+}
+}  // namespace
+
+void MatrixWorkerTable::MaybeRefreshReplica() {
+  int64_t lease = TableFlagOr("replica_lease_ms", 50);
+  {
+    MutexLock lk(replica_mu_);
+    if (replica_ts_ms_ >= 0 && SteadyNowMs() - replica_ts_ms_ <= lease)
+      return;
+    // Stamp the ATTEMPT, not the success: a shedding/dead shard must
+    // not turn every GetRows into a failed refresh round trip — the
+    // lease paces attempts either way.
+    replica_ts_ms_ = SteadyNowMs();
+  }
+  RefreshReplica();
+}
+
+bool MatrixWorkerTable::RefreshReplica() {
+  Monitor mon("MatrixWorker::RefreshReplica");
+  replica_refreshes_.fetch_add(1, std::memory_order_relaxed);
+  int64_t msg_id = Zoo::Get()->NextMsgId();
+  std::vector<MessagePtr> reqs;
+  for (int r = 0; r < servers_; ++r) {
+    auto req = MakeReq(MsgType::RequestReplica, table_id_, msg_id, r);
+    req->version = last_version();  // observed-staleness stamp
+    reqs.push_back(std::move(req));
+  }
+  return RoundTrip(std::move(reqs), ConsumeReplica, this);
+}
+
+void MatrixWorkerTable::OnReplicaPush(const Message& reply) {
+  if (reply.data.size() < 3) return;
+  const int32_t* ids = reply.data[0].As<int32_t>();
+  size_t k = reply.data[0].count<int32_t>();
+  const int64_t* vers = reply.data[1].As<int64_t>();
+  const float* rows = reply.data[2].As<float>();
+  if (reply.data[1].count<int64_t>() < k ||
+      reply.data[2].count<float>() < k * static_cast<size_t>(cols_))
+    return;  // malformed push: drop, never install torn rows
+  // Bound the historical hot set: the map holds at most a few pushes'
+  // worth of rows (per-shard top-K); a workload whose head drifts
+  // re-fills from scratch instead of growing without bound (MV007's
+  // discipline, native edition).
+  int64_t topk = TableFlagOr("hotkey_topk", 16);
+  size_t cap = static_cast<size_t>(4 * std::max<int64_t>(topk, 1) *
+                                   std::max(servers_, 1));
+  MutexLock lk(replica_mu_);
+  if (replica_.size() > cap) replica_.clear();
+  for (size_t i = 0; i < k; ++i) {
+    ReplicaRow& r = replica_[ids[i]];
+    // Install at the SNAPSHOT's table version (reply.version), not the
+    // row's bucket version: the push copied data and version under one
+    // server lock, so every pushed row is current AS OF that version —
+    // gating on the (older) bucket stamp would mark a row stale the
+    // moment any OTHER row was ever added after it, starving the
+    // replica at staleness 0.  The per-row bucket stamps still ride
+    // the wire (blob 1) for clients that track per-bucket knowledge.
+    int64_t v = std::max(reply.version, vers[i]);
+    if (r.version > v) continue;  // never roll a fresher entry back
+    r.version = v;
+    r.data.assign(rows + i * cols_, rows + (i + 1) * cols_);
+  }
+  replica_ts_ms_ = SteadyNowMs();
+}
+
+MatrixWorkerTable::ReplicaStats MatrixWorkerTable::replica_stats() const {
+  ReplicaStats s;
+  s.hits = replica_hits_.load(std::memory_order_relaxed);
+  s.misses = replica_misses_.load(std::memory_order_relaxed);
+  s.refreshes = replica_refreshes_.load(std::memory_order_relaxed);
+  MutexLock lk(replica_mu_);
+  s.rows = static_cast<long long>(replica_.size());
+  return s;
+}
+
+void MatrixWorkerTable::InvalidateReplicaRows(const int32_t* row_ids,
+                                              int64_t k) {
+  MutexLock lk(replica_mu_);
+  if (replica_.empty()) return;
+  if (k < 0) {  // whole-table add: every replicated row changed
+    replica_.clear();
+    return;
+  }
+  for (int64_t i = 0; i < k; ++i) replica_.erase(row_ids[i]);
+}
+
+void MatrixWorkerTable::OnClockInvalidate() {
+  // Clock closed: peers' adds are applied server-side — every pushed
+  // row may be stale regardless of its version stamp's lease.
+  MutexLock lk(replica_mu_);
+  replica_.clear();
+  replica_ts_ms_ = -1;
 }
 
 namespace {
@@ -1011,6 +1255,7 @@ AsyncGetPtr MatrixWorkerTable::GetRowsAsync(const int32_t* row_ids,
 
 bool MatrixWorkerTable::SendAddAll(const float* delta, const AddOption& opt,
                                    bool blocking) {
+  InvalidateReplicaRows(nullptr, -1);  // whole-table add: replica void
   int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
   std::vector<MessagePtr> reqs;
   for (int r = 0; r < servers_; ++r) {
@@ -1040,8 +1285,10 @@ bool MatrixWorkerTable::AddAll(const float* delta, const AddOption& opt,
   Monitor mon("MatrixWorker::AddAll");
   if (blocking)
     FlushAdds();  // the ack must cover buffered aggregates too
-  else if (MaybeAggregate(delta, rows_ * cols_, opt))
+  else if (MaybeAggregate(delta, rows_ * cols_, opt)) {
+    InvalidateReplicaRows(nullptr, -1);  // whole table changed
     return true;
+  }
   return SendAddAll(delta, opt, blocking);
 }
 
@@ -1052,6 +1299,17 @@ bool MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
   // FIFO with any buffered whole-table aggregate: it ships first so the
   // server applies adds in submission order.
   FlushAdds();
+  bool ok = SendAddRows(row_ids, k, delta, opt, blocking);
+  // Replica invalidation is belt to the version gate's braces: the ack
+  // that would stale the touched entries may still be in flight when a
+  // concurrent read consults the replica.
+  InvalidateReplicaRows(row_ids, k);
+  return ok;
+}
+
+bool MatrixWorkerTable::SendAddRows(const int32_t* row_ids, int64_t k,
+                                    const float* delta,
+                                    const AddOption& opt, bool blocking) {
   // Single-shard fast path (the offload bridge's embedding case,
   // docs/host_bridge.md): with one server and only in-range ids there
   // is nothing to partition — ship the id list once and let the packed
@@ -1080,6 +1338,72 @@ bool MatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
       for (auto& r : reqs)
         Zoo::Get()->SendTo(actor::kWorker, std::move(r));
       return true;
+    }
+  }
+  // Multi-shard borrowed fast path (docs/embedding.md — the gap PR 9's
+  // single-shard path left open): when the packed delta sits inside
+  // the active host-bridge borrow window (an arena buffer), every
+  // shard's rows ship as borrowed iovecs straight out of that ONE
+  // buffer — contiguous caller-order runs owned by the same shard
+  // collapse into one Blob::Borrow each, and the server re-walks rows
+  // across the blob sequence (RowBlobCursor).  No per-rank staging
+  // copies, no send-side Blob copy.  The sparse codec keeps staging
+  // (its encode owns a fresh blob anyway); a pathological interleaving
+  // whose run count would blow the sendmsg iovec budget falls back.
+  if (servers_ > 1 && k > 0 && wire_codec() != Codec::kSparse &&
+      BorrowCovers(delta, static_cast<size_t>(k * cols_) * sizeof(float))) {
+    bool all_valid = true;
+    for (int64_t i = 0; i < k; ++i)
+      if (row_ids[i] < 0 || row_ids[i] >= rows_) {
+        all_valid = false;
+        break;
+      }
+    if (all_valid) {
+      // One pass: per-shard id lists + caller-order (first_idx, nrows)
+      // runs.  A run extends while consecutive caller rows share an
+      // owner — its bytes are contiguous in the caller's buffer by
+      // construction (row i sits at delta + i*cols).
+      constexpr size_t kMaxRunsPerShard = 256;  // sendmsg IOV budget
+      std::vector<std::vector<int32_t>> ids(servers_);
+      std::vector<std::vector<std::pair<int64_t, int64_t>>> runs(servers_);
+      bool runs_ok = true;
+      int prev_owner = -1;
+      for (int64_t i = 0; i < k; ++i) {
+        int owner = OwnerOf(row_ids[i], rows_, servers_);
+        ids[owner].push_back(row_ids[i]);
+        if (i > 0 && owner == prev_owner) {
+          runs[owner].back().second += 1;
+        } else {
+          runs[owner].emplace_back(i, 1);
+          if (runs[owner].size() > kMaxRunsPerShard) {
+            runs_ok = false;
+            break;
+          }
+        }
+        prev_owner = owner;
+      }
+      if (runs_ok) {
+        int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
+        std::vector<MessagePtr> reqs;
+        for (int r = 0; r < servers_; ++r) {
+          if (ids[r].empty()) continue;
+          auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
+          req->data.emplace_back(&opt, sizeof(opt));
+          req->data.emplace_back(ids[r].data(),
+                                 ids[r].size() * sizeof(int32_t));
+          for (const auto& run : runs[r])
+            req->data.push_back(WrapPayload(
+                delta + run.first * cols_,
+                static_cast<size_t>(run.second * cols_) * sizeof(float)));
+          reqs.push_back(std::move(req));
+        }
+        Dashboard::Record("addrows.borrowed", 0.0);
+        if (blocking)
+          return RoundTrip(std::move(reqs), DiscardReply, nullptr);
+        for (auto& req : reqs)
+          Zoo::Get()->SendTo(actor::kWorker, std::move(req));
+        return true;
+      }
     }
   }
   std::vector<std::vector<int32_t>> per_rank_ids(servers_);
@@ -1212,7 +1536,9 @@ bool SparseMatrixWorkerTable::AddRows(const int32_t* row_ids, int64_t k,
 
 void SparseMatrixWorkerTable::OnClockInvalidate() {
   // Clock closed: peers' adds are now applied server-side — every
-  // cached row may be stale.
+  // cached row may be stale.  The base clears the hot-key replica for
+  // the same reason.
+  MatrixWorkerTable::OnClockInvalidate();
   MutexLock lk(cache_mu_);
   ++cache_epoch_;
   if (!valid_.empty()) std::fill(valid_.begin(), valid_.end(), 0);

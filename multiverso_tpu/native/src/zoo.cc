@@ -61,6 +61,10 @@ class WorkerActor : public Actor {
       // Serve-layer probe: same worker->server leg as Get.
       Zoo::Get()->Deliver(actor::kServer, std::move(m));
     });
+    RegisterHandler(MsgType::RequestReplica, [](MessagePtr& m) {
+      // Hot-key replica pull (docs/embedding.md): same leg as Get.
+      Zoo::Get()->Deliver(actor::kServer, std::move(m));
+    });
     RegisterHandler(MsgType::ClockTick, [](MessagePtr& m) {
       // Outbound SSP tick: same worker->server leg as Get/Add, so the
       // per-connection FIFO keeps it behind this clock's adds.
@@ -93,6 +97,10 @@ class WorkerActor : public Actor {
       Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
     });
     RegisterHandler(MsgType::ReplyVersion, [](MessagePtr& m) {
+      Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
+    });
+    RegisterHandler(MsgType::ReplyReplica, [](MessagePtr& m) {
+      // The pending RefreshReplica's consume installs the pushed rows.
       Zoo::Get()->worker_table(m->table_id)->Notify(m->msg_id, *m);
     });
     RegisterHandler(MsgType::ReplyBusy, [](MessagePtr& m) {
@@ -157,6 +165,29 @@ class ServerActor : public Actor {
                            ? table->bucket_version(
                                  static_cast<int>(m->version))
                            : table->version();
+      Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
+    });
+    RegisterHandler(MsgType::RequestReplica, [](MessagePtr& m) {
+      // Hot-key replica push (docs/embedding.md): answer with this
+      // shard's current SpaceSaving top-K rows + bucket versions.  A
+      // read, so it sheds under backpressure exactly like a Get —
+      // never competes with adds.
+      auto* table = Zoo::Get()->server_table(m->table_id);
+      if (!table) {
+        Log::Error("RequestReplica for table %d on non-server rank",
+                   m->table_id);
+        return;
+      }
+      if (Zoo::Get()->ShedIfOverloaded(m)) return;
+      auto reply = std::make_unique<Message>();
+      reply->type = MsgType::ReplyReplica;
+      reply->table_id = m->table_id;
+      reply->msg_id = m->msg_id;
+      reply->trace_id = m->trace_id;
+      reply->src = Zoo::Get()->rank();
+      reply->dst = m->src;
+      TraceScope scope(m->trace_id);
+      table->BuildReplica(reply.get());
       Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
     });
     RegisterHandler(MsgType::ClockTick, [](MessagePtr& m) {
@@ -377,6 +408,7 @@ bool Zoo::Start(int argc, const char* const* argv) {
   // accounting arm switch from the flag (MV_SetHotKeyTracking toggles
   // it live for armed-vs-disarmed overhead A/Bs).
   workload::Arm(configure::GetBool("hotkey_enabled"));
+  workload::ArmReplica(configure::GetBool("hotkey_replica"));
   if (configure::GetBool("trace")) Dashboard::SetTraceEnabled(true);
   started_ = true;
   ops::BlackboxEvent("lifecycle",
@@ -1153,10 +1185,13 @@ std::string Zoo::OpsHotKeysJson(int32_t id) {
   // Snapshot pointers under tables_mu_, read stats OUTSIDE it (the
   // accessors take per-table/tracker locks; tables never unregister).
   std::vector<ServerTable*> snapshot;
+  std::vector<WorkerTable*> workers;
   {
     MutexLock lk(tables_mu_);
     for (auto& t : server_tables_)
       snapshot.push_back(t.get());
+    for (auto& t : worker_tables_)
+      workers.push_back(t.get());
   }
   std::ostringstream os;
   os << "[";
@@ -1189,6 +1224,22 @@ std::string Zoo::OpsHotKeysJson(int32_t id) {
     std::snprintf(num, sizeof(num), "%.6g", load.staleness_mean);
     os << ",\"staleness_mean\":" << num;
     os << ",\"armed\":" << (workload::Armed() ? "true" : "false");
+    // Hot-key replica plane (docs/embedding.md): this shard's push
+    // count plus the co-located worker stub's replica hit ledger (in
+    // static mode every rank carries both roles, so the pair describes
+    // the rank's full replica participation).
+    os << ",\"replica\":{\"armed\":"
+       << (workload::ReplicaArmed() ? "true" : "false");
+    os << ",\"pushes\":" << st->replica_pushes();
+    auto* mw = i < workers.size()
+                   ? dynamic_cast<MatrixWorkerTable*>(workers[i])
+                   : nullptr;
+    if (mw) {
+      auto rs = mw->replica_stats();
+      os << ",\"hits\":" << rs.hits << ",\"misses\":" << rs.misses
+         << ",\"rows\":" << rs.rows << ",\"refreshes\":" << rs.refreshes;
+    }
+    os << "}";
     os << ",\"hotkeys\":" << st->HotKeysJson();
     os << "}";
   }
@@ -1378,6 +1429,7 @@ void Zoo::RouteInbound(Message&& m) {
     case MsgType::RequestAdd:
     case MsgType::RequestFlush:
     case MsgType::RequestVersion:
+    case MsgType::RequestReplica:
     case MsgType::ClockTick:
       SendTo(actor::kServer, std::move(msg));
       break;
@@ -1385,6 +1437,7 @@ void Zoo::RouteInbound(Message&& m) {
     case MsgType::ReplyAdd:
     case MsgType::ReplyFlush:
     case MsgType::ReplyVersion:
+    case MsgType::ReplyReplica:
     case MsgType::ReplyBusy:
       SendTo(actor::kWorker, std::move(msg));
       break;

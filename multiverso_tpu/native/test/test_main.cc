@@ -27,6 +27,7 @@
 #include "mvtpu/mt_queue.h"
 #include "mvtpu/net.h"
 #include "mvtpu/sketch.h"
+#include "mvtpu/table.h"
 #include "mvtpu/updater.h"
 #include "mvtpu/waiter.h"
 
@@ -747,6 +748,104 @@ static int TestWorkload() {
                           nullptr, nullptr) == 0);
   CHECK(gets2 == gets);
   CHECK(MV_SetHotKeyTracking(1) == 0);
+  return 0;
+}
+
+static int TestReplica() {
+  // Hot-key read replica (docs/embedding.md), single process: the
+  // server's SpaceSaving top-K pushes into the worker-side table,
+  // GetRows serves hits with zero additional server applies, and the
+  // version gate IS the invalidation — at -replica_max_staleness=0 an
+  // acked add stales every entry from before it (the regression the
+  // acceptance bar names: RED on a replica that serves without
+  // invalidation).
+  int32_t h;
+  CHECK(MV_NewMatrixTable(64, 4, &h) == 0);
+  std::vector<float> ones(2 * 4, 1.0f), out(3 * 4, -1.0f);
+  int32_t hot[2] = {1, 2};
+  CHECK(MV_AddMatrixTableByRows(h, ones.data(), hot, 2, 4) == 0);
+  int32_t ids[3] = {1, 2, 3};
+  for (int i = 0; i < 10; ++i)
+    CHECK(MV_GetMatrixTableByRows(h, out.data(), ids, 3, 4) == 0);
+  CHECK(MV_SetHotKeyReplica(1) == 0);
+  CHECK(MV_ReplicaRefresh(h) == 0);
+  long long hits = 0, misses = 0, rows = 0, refreshes = 0, pushes = 0;
+  CHECK(MV_ReplicaStats(h, &hits, &misses, &rows, &refreshes,
+                        &pushes) == 0);
+  CHECK(rows >= 2);      // the hot rows were pushed
+  CHECK(pushes >= 1);
+  long long hits0 = hits;
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), ids, 3, 4) == 0);
+  CHECK(out[0] == 1.0f && out[4] == 1.0f);
+  CHECK(MV_ReplicaStats(h, &hits, &misses, nullptr, nullptr,
+                        nullptr) == 0);
+  CHECK(hits > hits0);   // served from the replica, not the wire
+  // Invalidation, own-add shape: a blocking add to row 1 (ack bumps
+  // last_version) — the next read of row 1 MUST return the new value.
+  std::vector<float> bump(4, 5.0f);
+  int32_t one[1] = {1};
+  CHECK(MV_AddMatrixTableByRows(h, bump.data(), one, 1, 4) == 0);
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), one, 1, 4) == 0);
+  CHECK(out[0] == 6.0f);
+  // Version gate specifically: row 2 is still IN the replica (the add
+  // touched only row 1's entry) but its stamp predates the acked add —
+  // at staleness 0 it must MISS to the wire, not serve the old stamp.
+  long long miss0 = 0;
+  CHECK(MV_ReplicaStats(h, nullptr, &miss0, nullptr, nullptr,
+                        nullptr) == 0);
+  int32_t two[1] = {2};
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), two, 1, 4) == 0);
+  CHECK(out[0] == 1.0f);
+  CHECK(MV_ReplicaStats(h, nullptr, &misses, nullptr, nullptr,
+                        nullptr) == 0);
+  CHECK(misses > miss0);
+  // A fresh refresh re-covers the hot set at the NEW version: reads
+  // hit again and serve the post-add value.
+  CHECK(MV_ReplicaRefresh(h) == 0);
+  CHECK(MV_ReplicaStats(h, &hits0, nullptr, nullptr, nullptr,
+                        nullptr) == 0);
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), one, 1, 4) == 0);
+  CHECK(out[0] == 6.0f);
+  CHECK(MV_ReplicaStats(h, &hits, nullptr, nullptr, nullptr,
+                        nullptr) == 0);
+  CHECK(hits > hits0);
+  CHECK(MV_SetHotKeyReplica(0) == 0);
+  return 0;
+}
+
+static int TestMultiBlobAdd() {
+  // Multi-shard borrowed AddRows wire shape (docs/embedding.md): the
+  // delta may arrive split across SEVERAL row-aligned blobs (one per
+  // contiguous caller run); the server walks rows across the sequence
+  // (RowBlobCursor) and a cross-blob size mismatch drops cleanly.
+  mvtpu::MatrixServerTable t(8, 2, mvtpu::UpdaterType::kDefault);
+  mvtpu::AddOption opt;
+  mvtpu::Message req;
+  req.data.emplace_back(&opt, sizeof(opt));
+  int32_t ids[3] = {1, 2, 5};
+  req.data.emplace_back(ids, sizeof(ids));
+  float run1[4] = {1.0f, 1.0f, 2.0f, 2.0f};  // rows 1, 2
+  float run2[2] = {5.0f, 5.0f};              // row 5
+  req.data.emplace_back(run1, sizeof(run1));
+  req.data.emplace_back(run2, sizeof(run2));
+  t.ProcessAdd(req);
+  mvtpu::Message get, reply;
+  get.data.emplace_back(ids, sizeof(ids));
+  t.ProcessGet(get, &reply);
+  const float* vals = reply.data[0].As<float>();
+  CHECK(vals[0] == 1.0f && vals[1] == 1.0f);
+  CHECK(vals[2] == 2.0f && vals[3] == 2.0f);
+  CHECK(vals[4] == 5.0f && vals[5] == 5.0f);
+  // 3 ids but only 2 rows of delta across the blobs: dropped whole.
+  mvtpu::Message bad;
+  bad.data.emplace_back(&opt, sizeof(opt));
+  bad.data.emplace_back(ids, sizeof(ids));
+  bad.data.emplace_back(run1, sizeof(run1));
+  t.ProcessAdd(bad);
+  mvtpu::Message reply2;
+  t.ProcessGet(get, &reply2);
+  const float* vals2 = reply2.data[0].As<float>();
+  for (int i = 0; i < 6; ++i) CHECK(vals2[i] == vals[i]);
   return 0;
 }
 
@@ -2081,6 +2180,159 @@ static int BridgeChild(const char* machine_file, const char* rank,
   return 0;
 }
 
+static int EmbedChild(const char* machine_file, const char* rank,
+                      const char* engine) {
+  // Sparse-embedding data plane UNDER CHAOS (docs/embedding.md): 2
+  // ranks, multi-shard borrowed AddRows shipping run-iovecs out of one
+  // arena buffer, and hot-key replica pushes — with drop/dup/delay
+  // armed on rank 1's sends.  Like BridgeChild the point is lifetime
+  // and semantics, not arithmetic luck: a dropped run frame loses
+  // exactly the remote shard's rows, a duplicated one doubles them, a
+  // delayed one parks the borrow past a mid-flight release (deferred
+  // recycle), and a dropped/duplicated/delayed replica push can never
+  // make the version gate serve a stale row.  The sanitizer sweeps
+  // (tests/test_native.py) run this under TSan and ASan.
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  std::string eng = std::string("-net_engine=") + engine;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), eng.c_str(),
+                         "-updater_type=default", "-log_level=error",
+                         "-rpc_timeout_ms=60000",
+                         "-barrier_timeout_ms=60000",
+                         "-hotkey_topk=8", "-replica_lease_ms=50"};
+  CHECK(MV_Init(9, argv2) == 0);
+  CHECK(MV_SetFaultSeed(2424) == 0);
+  int me = MV_WorkerId();
+  int32_t h;
+  CHECK(MV_NewMatrixTable(16, 4, &h) == 0);  // 8 rows per shard
+  CHECK(MV_Barrier() == 0);
+
+  // Rank 1 drives: SORTED ids {1, 9} span both shards — row 1 is
+  // REMOTE (rank 0's shard), row 9 local — so the borrowed
+  // multi-shard run path (one iovec per shard) is what every round
+  // exercises.
+  void* p = nullptr;
+  CHECK(MV_ArenaAcquire(2 * 4 * sizeof(float), &p) == 0);
+  float* buf = static_cast<float*>(p);
+  for (int i = 0; i < 8; ++i) buf[i] = 1.0f;
+  int32_t ids[2] = {1, 9};
+  std::vector<float> out(16 * 4, -1.0f);
+  int32_t all[16];
+  for (int i = 0; i < 16; ++i) all[i] = i;
+
+  // Round 1: drop exactly the remote run frame — row 1's add dies,
+  // row 9's local apply lands.
+  if (me == 1) {
+    CHECK(MV_SetFaultN("drop", 1) == 0);
+    // No ClearFaults here: the async send happens on the worker-actor
+    // thread, so the N=1 budget must stay armed until IT fires (the
+    // BridgeChild discipline) — budgets self-consume.
+    CHECK(MV_AddAsyncMatrixTableByRowsBorrowed(h, buf, ids, 2, 4) == 0);
+  } else {
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), all, 16, 4) == 0);
+  if (me == 1) {
+    CHECK(out[1 * 4] == 0.0f);   // dropped remote run
+    CHECK(out[9 * 4] == 1.0f);   // local run applied
+  }
+  CHECK(MV_Barrier() == 0);
+
+  // Round 2: duplicate the remote run frame — the dup's shallow copy
+  // EXTENDS the borrow; row 1 applies twice.
+  if (me == 1) {
+    CHECK(MV_SetFaultN("dup", 1) == 0);
+    CHECK(MV_AddAsyncMatrixTableByRowsBorrowed(h, buf, ids, 2, 4) == 0);
+  } else {
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), all, 16, 4) == 0);
+  if (me == 1) {
+    CHECK(out[1 * 4] == 2.0f);   // 0 + dup(2)
+    CHECK(out[9 * 4] == 2.0f);   // 1 + 1
+  }
+  CHECK(MV_Barrier() == 0);
+
+  // Round 3: DELAY the remote run frame and release the arena buffer
+  // mid-flight — the recycle must defer behind the parked borrow (a
+  // naive arena frees and the delayed sendmsg reads freed memory:
+  // ASan red).
+  if (me == 1) {
+    CHECK(MV_SetFault("delay_ms", 50) == 0);
+    CHECK(MV_SetFaultN("delay", 1) == 0);
+    CHECK(MV_AddAsyncMatrixTableByRowsBorrowed(h, buf, ids, 2, 4) == 0);
+    CHECK(MV_ArenaRelease(p) == 0);  // mid-flight: defer, no UAF
+  } else {
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+    CHECK(MV_ArenaRelease(p) == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetMatrixTableByRows(h, out.data(), all, 16, 4) == 0);
+  if (me == 1) {
+    CHECK(out[1 * 4] == 3.0f);
+    CHECK(out[9 * 4] == 3.0f);
+  }
+  CHECK(MV_Barrier() == 0);
+
+  // Replica plane under chaos.  Rank 1 warms rank 0's tracker on rows
+  // 1/2 (remote gets), then refreshes with faults armed:
+  //  - DROPPED push: the refresh round-trip times out (bounded by a
+  //    lowered rpc deadline) and the replica simply stays cold — no
+  //    torn install;
+  //  - DUPLICATED push: OnReplicaPush is idempotent (never rolls a
+  //    fresher entry back);
+  //  - after a fresh add, a replica read must serve the NEW value
+  //    (version gate, cross-chaos).
+  CHECK(MV_SetHotKeyReplica(1) == 0);
+  if (me == 1) {
+    int32_t warm[2] = {1, 2};
+    std::vector<float> w(2 * 4);
+    for (int i = 0; i < 6; ++i)
+      CHECK(MV_GetMatrixTableByRows(h, w.data(), warm, 2, 4) == 0);
+    CHECK(MV_SetFlag("rpc_timeout_ms", "500") == 0);
+    CHECK(MV_SetFaultN("drop", 1) == 0);
+    CHECK(MV_ReplicaRefresh(h) != 0);  // dropped push: bounded failure
+    CHECK(MV_ClearFaults() == 0);
+    CHECK(MV_SetFlag("rpc_timeout_ms", "60000") == 0);
+    CHECK(MV_SetFaultN("dup", 1) == 0);
+    CHECK(MV_ReplicaRefresh(h) == 0);  // duplicated push: idempotent
+    CHECK(MV_ClearFaults() == 0);
+    long long rows = 0;
+    CHECK(MV_ReplicaStats(h, nullptr, nullptr, &rows, nullptr,
+                          nullptr) == 0);
+    CHECK(rows >= 1);
+    // Fresh blocking add to replicated row 1, then read: the version
+    // gate must refetch — never the pre-add replica value.
+    float bump[4] = {10.0f, 10.0f, 10.0f, 10.0f};
+    int32_t one[1] = {1};
+    CHECK(MV_AddMatrixTableByRows(h, bump, one, 1, 4) == 0);
+    std::vector<float> fresh(4, -1.0f);
+    CHECK(MV_GetMatrixTableByRows(h, fresh.data(), one, 1, 4) == 0);
+    CHECK(fresh[0] == 13.0f);
+  } else {
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_SetHotKeyReplica(0) == 0);
+
+  // Every borrow must drain (the dup's extra frame finishes async of
+  // the barrier).
+  long long in_flight = 1, deferred = 0;
+  for (int spin = 0; spin < 100 && in_flight != 0; ++spin) {
+    CHECK(MV_ArenaStats(nullptr, nullptr, nullptr, &in_flight, &deferred,
+                        nullptr, nullptr) == 0);
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  CHECK(in_flight == 0);
+  if (me == 1) CHECK(deferred >= 1);
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("EMBED_CHAOS_OK %d\n", me);
+  return 0;
+}
+
 static int ChaosBarrierTimeoutChild(const char* machine_file,
                                     const char* rank) {
   // Deadline-bounded barrier: rank 1 simply never arrives (busy for 4 s)
@@ -2212,6 +2464,9 @@ int main(int argc, char** argv) {
     return ScenarioExit(AsyncOverlapChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "codec_wire")
     return ScenarioExit(CodecWireChild(argv[2], argv[3]));
+  if ((argc == 4 || argc == 5) && std::string(argv[1]) == "embed_child")
+    return ScenarioExit(EmbedChild(argv[2], argv[3],
+                                   argc == 5 ? argv[4] : "epoll"));
   if ((argc == 4 || argc == 5) && std::string(argv[1]) == "bridge_child")
     return ScenarioExit(BridgeChild(argv[2], argv[3],
                                     argc == 5 ? argv[4] : "epoll"));
@@ -2256,6 +2511,8 @@ int main(int argc, char** argv) {
       {"kv", TestKV},             {"threads", TestThreads},
       {"serve", TestServeVersions},
       {"workload", TestWorkload},
+      {"replica", TestReplica},
+      {"multiblob_add", TestMultiBlobAdd},
   };
   int failures = 0;
   std::string only = argc > 1 ? argv[1] : "";

@@ -67,6 +67,40 @@ class VersionedLRUCache:
         self._tick("hit")
         return hit
 
+    def lookup_many(self, keys, min_versions) -> list:
+        """Batched row-granular lookup (docs/embedding.md): one lock
+        acquisition and one counter update for the whole id set — the
+        per-key ``lookup`` loop's lock/metrics cost is what kept the
+        row cache from clearing the 10x serving bar.  ``min_versions``
+        aligns with ``keys`` (or is a scalar applied to all); returns
+        one value-or-None per key (None = absent or stale)."""
+        scalar = not hasattr(min_versions, "__len__")
+        out = []
+        hits = misses = stale = 0
+        with self._lock:
+            for i, key in enumerate(keys):
+                entry = self._entries.get(key)
+                if entry is None:
+                    out.append(None)
+                    misses += 1
+                    continue
+                mv = min_versions if scalar else min_versions[i]
+                if mv is not None and entry[1] < mv:
+                    out.append(None)
+                    stale += 1
+                    misses += 1
+                    continue
+                self._entries.move_to_end(key)
+                out.append(entry[0])
+                hits += 1
+        if hits:
+            metrics.counter(f"{self._name}.cache.hit").inc(hits)
+        if misses:
+            metrics.counter(f"{self._name}.cache.miss").inc(misses)
+        if stale:
+            metrics.counter(f"{self._name}.cache.stale").inc(stale)
+        return out
+
     def store(self, key: Hashable, value: Any, version: int) -> None:
         """Insert/refresh an entry; never lowers a cached version (a
         racing slow fetch must not roll a fresher entry back)."""

@@ -62,12 +62,19 @@ class ServeClient:
                  window_us: Optional[float] = None,
                  max_batch: Optional[int] = None,
                  lease_ms: Optional[float] = None,
+                 row_cache: Optional[bool] = None,
                  retry: Optional[fault.RetryPolicy] = None):
         self.rt = rt
         self.max_staleness = int(_flag(max_staleness, "max_staleness"))
         entries = int(_flag(cache_entries, "serve_cache_entries"))
         self.cache = VersionedLRUCache(max(entries, 1))
         self._cache_on = entries > 0
+        # Row-granular entries for matrix row / KV key reads
+        # (docs/embedding.md): each id is its own versioned entry, so a
+        # hot row hits across different requested id sets and a miss
+        # wire-fetches only the missing ids.  -serve_row_cache=false
+        # reverts to the PR 4 whole-id-set entries.
+        self._row_cache = bool(_flag(row_cache, "serve_row_cache"))
         self.coalescer = Coalescer(
             window_s=float(_flag(window_us, "coalesce_window_us")) * 1e-6,
             max_batch=int(_flag(max_batch, "serve_max_batch")))
@@ -180,11 +187,18 @@ class ServeClient:
     def matrix_get_rows(self, handle: int, row_ids: Sequence[int],
                         cols: int) -> np.ndarray:
         """Row-range read: concurrent callers' id sets UNION into one
-        wire request; each gets back exactly its rows.  Per-id-set cache
-        entries ride the same versioned staleness bound."""
+        wire request; each gets back exactly its rows.
+
+        With the cache armed the entries are ROW-GRANULAR
+        (docs/embedding.md): each id caches individually under the same
+        versioned staleness bound, so a hot row hits across different
+        id sets and a partial miss wire-fetches only the missing rows.
+        ``-serve_row_cache=false`` reverts to per-id-set entries."""
         ids = np.ascontiguousarray(row_ids, dtype=np.int32)
-        key = (handle, "rows", tuple(ids.tolist()))
         v0 = self._read_version(handle)
+        if v0 is not None and self._row_cache and ids.size:
+            return self._get_rows_row_granular(handle, ids, cols, v0)
+        key = (handle, "rows", tuple(ids.tolist()))
         if v0 is not None:
             if self._forced_stale():
                 metrics.counter("serve.cache.miss").inc()
@@ -212,12 +226,78 @@ class ServeClient:
             self.cache.store(key, val.copy(), v0)
         return val
 
+    def _get_rows_row_granular(self, handle: int, ids: np.ndarray,
+                               cols: int, v0: int) -> np.ndarray:
+        """Row-granular read tail: per-row lookups, one coalesced union
+        wire fetch for the misses, per-row stores stamped with the
+        PRE-fetch version estimate (the same conservative discipline as
+        ``_cached``)."""
+        forced = self._forced_stale()
+        if forced:
+            metrics.counter("serve.cache.miss").inc()
+        id_list = ids.tolist()
+        uniq = list(dict.fromkeys(id_list))  # order-preserving dedup
+        hits: dict = {}
+        missing = []
+        if forced:
+            missing = uniq
+        else:
+            # ONE lock + counter update for the whole id set — per-key
+            # lookup() calls cost more than the wire fetch they save.
+            got = self.cache.lookup_many(
+                [(handle, "row", r) for r in uniq],
+                v0 - self.max_staleness)
+            for r, val in zip(uniq, got):
+                if val is not None:
+                    hits[r] = val
+                else:
+                    missing.append(r)
+        if missing:
+            miss = np.asarray(missing, np.int32)
+
+            def execute(items):
+                union = np.unique(np.concatenate(items))
+
+                def wire():
+                    fault.inject("serve.busy")
+                    return self.rt.matrix_get_rows(handle, union, cols)
+                fetched = self.retry.run(wire)
+                return [fetched[np.searchsorted(union, it)]
+                        for it in items]
+
+            with tracing.span("serve::get_rows", table=str(handle),
+                              k=int(miss.size)):
+                got = self.coalescer.submit((handle, "rows"), miss,
+                                            execute)
+            self._note(handle)
+            for j, r in enumerate(missing):
+                row = np.ascontiguousarray(got[j])
+                # Read-only in the cache: one copy per consumer at its
+                # own boundary (np.stack below), aliasing slips fail
+                # loudly.
+                row.flags.writeable = False
+                self.cache.store((handle, "row", r), row, v0)
+                hits[r] = row
+        # Fresh caller-owned result assembled row by row out of the
+        # read-only cached rows (np.empty + copyto beats np.stack's
+        # sequence machinery ~2x on the 8-row hot path).
+        out = np.empty((len(id_list), cols), np.float32)
+        for j, r in enumerate(id_list):
+            out[j] = hits[r]
+        return out
+
     def kv_get(self, handle: int, keys) -> Any:
-        """KV read (str or list of str), cached per key set."""
+        """KV read (str or list of str).  Batch reads cache per KEY
+        (docs/embedding.md) when the row cache is armed — a hot key
+        hits across different key sets, a partial miss wire-fetches
+        only the missing keys; ``-serve_row_cache=false`` reverts to
+        per-key-set entries."""
         single = isinstance(keys, str)
+        v0 = self._read_version(handle)
+        if v0 is not None and self._row_cache and not single and keys:
+            return self._kv_get_key_granular(handle, list(keys), v0)
         tup = (keys,) if single else tuple(keys)
         key = (handle, "kv", tup)
-        v0 = self._read_version(handle)
         if v0 is not None:
             if self._forced_stale():
                 metrics.counter("serve.cache.miss").inc()
@@ -248,6 +328,56 @@ class ServeClient:
         # Single-key reads are python floats (immutable); batch reads are
         # one ndarray SHARED by every coalesced waiter — copy per caller.
         return val if single else np.array(val, copy=True)
+
+    def _kv_get_key_granular(self, handle: int, keys: list,
+                             v0: int) -> np.ndarray:
+        """Per-key cached KV batch read: values are python floats
+        (immutable — no copy discipline needed), missing keys fetch in
+        one coalesced union wire request."""
+        forced = self._forced_stale()
+        if forced:
+            metrics.counter("serve.cache.miss").inc()
+        uniq = list(dict.fromkeys(keys))
+        hits: dict = {}
+        missing = []
+        if forced:
+            missing = uniq
+        else:
+            got = self.cache.lookup_many(
+                [(handle, "kvkey", k) for k in uniq],
+                v0 - self.max_staleness)
+            for k, val in zip(uniq, got):
+                if val is not None:
+                    hits[k] = val
+                else:
+                    missing.append(k)
+        if missing:
+            def execute(items):
+                union = []
+                seen = set()
+                for it in items:
+                    for k in it:
+                        if k not in seen:
+                            seen.add(k)
+                            union.append(k)
+
+                def wire():
+                    fault.inject("serve.busy")
+                    return self.rt.kv_get(handle, union)
+                fetched = self.retry.run(wire)
+                lut = dict(zip(union, fetched))
+                return [[lut[k] for k in it] for it in items]
+
+            with tracing.span("serve::kv_get", table=str(handle),
+                              k=len(missing)):
+                got = self.coalescer.submit((handle, "kv"), missing,
+                                            execute)
+            self._note(handle)
+            for k, v in zip(missing, got):
+                v = float(v)
+                self.cache.store((handle, "kvkey", k), v, v0)
+                hits[k] = v
+        return np.asarray([hits[k] for k in keys], np.float32)
 
     # ----------------------------------------------------------- writes
     def array_add(self, handle: int, delta, *, coalesce: bool = True,
@@ -315,3 +445,14 @@ class ServeClient:
         s["coalesced_batches"] = h.count
         s["coalesce_batch_p95"] = h.quantile(0.95)
         return s
+
+    def replica_stats(self, handle: int) -> dict:
+        """Native hot-key replica ledger for one matrix table
+        (docs/embedding.md): rows this process's worker stub served
+        from the replica vs sent to the wire, plus the co-located
+        shard's push count.  ``{}`` when the runtime has no replica
+        surface (stub runtimes in tests)."""
+        fn = getattr(self.rt, "replica_stats", None)
+        if fn is None:
+            return {}
+        return fn(handle)

@@ -57,6 +57,11 @@ MSG = {
     "RequestVersion": 8,
     "ReplyVersion": 9,
     "ReplyBusy": 10,
+    # Hot-key replica pull (docs/embedding.md): the server pushes its
+    # SpaceSaving top-K rows + bucket versions; anonymous clients keep
+    # them as a local hot-row side table consulted before RequestGet.
+    "RequestReplica": 11,
+    "ReplyReplica": 12,
     # Introspection plane (docs/observability.md): in-band scrape.  The
     # request's first blob names the report kind; `version` carries the
     # scope (OPS_SCOPE_LOCAL / OPS_SCOPE_FLEET).  Local-scope queries
@@ -174,6 +179,32 @@ class AnonServeClient:
         reply = self.recv_reply()
         _check(reply, mid, "ReplyGet")
         return np.frombuffer(reply["blobs"][0], dtype=np.float32)
+
+    def get_replica(self, table_id: int) -> dict:
+        """Hot-key replica pull (RequestReplica, docs/embedding.md):
+        the contacted shard pushes its current SpaceSaving top-K rows.
+        Returns ``{row_id: (version, row)}`` with read-only float32
+        rows plus the shard version under key ``"_version"`` — the
+        client-side hot-row side table to consult before paying a
+        ``RequestGet``.  Empty when the shard's tracker is cold or
+        ``-hotkey_enabled=false``."""
+        mid = self._next_id()
+        self.send_raw(pack_frame(MSG["RequestReplica"], table_id, mid))
+        reply = self.recv_reply()
+        _check(reply, mid, "ReplyReplica")
+        out: dict = {"_version": reply["version"]}
+        if len(reply["blobs"]) < 3:
+            return out
+        ids = np.frombuffer(reply["blobs"][0], dtype=np.int32)
+        vers = np.frombuffer(reply["blobs"][1], dtype=np.int64)
+        rows = np.frombuffer(reply["blobs"][2], dtype=np.float32)
+        if ids.size == 0 or rows.size % ids.size != 0:
+            return out
+        cols = rows.size // ids.size
+        rows = rows.reshape(ids.size, cols)
+        for i, rid in enumerate(ids.tolist()):
+            out[rid] = (int(vers[i]), rows[i])
+        return out
 
     def close(self) -> None:
         try:

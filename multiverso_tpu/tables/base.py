@@ -231,6 +231,12 @@ class Table:
             self._workload = None
         entries = int(config.get("serve_cache_entries")
                       if serve_cache is None else serve_cache)
+        # Row-granular cache arm (docs/embedding.md): per-id reads cache
+        # INDIVIDUAL rows/keys instead of whole id-set tuples, so a hot
+        # row keeps hitting across different id sets.  Rides the same
+        # VersionedLRUCache; -serve_row_cache=false reverts to the PR 4
+        # id-set entries.
+        self._serve_row_cache = bool(config.get("serve_row_cache"))
         if entries > 0:
             from ..serve import Coalescer, VersionedLRUCache
 
@@ -627,6 +633,19 @@ class Table:
             idx = np.asarray(list(buckets), np.int64) % self.SERVE_BUCKETS
             self._serve_buckets[idx] = v
 
+    def _serve_current_many(self, buckets):
+        """Per-bucket version estimates for a batch of reads — ONE lock
+        acquisition for the whole id set (the row-granular cache gates
+        each row on its own bucket, so per-row ``_serve_current`` calls
+        would pay the lock k times)."""
+        import numpy as np
+
+        idx = np.asarray([int(b) for b in buckets], np.int64)
+        with self._serve_ver_lock:
+            if self._serve_buckets is None or idx.size == 0:
+                return np.full(idx.shape, self._serve_version, np.int64)
+            return self._serve_buckets[idx % self.SERVE_BUCKETS].copy()
+
     def _serve_current(self, buckets=None) -> int:
         """Version gating a read: table version, or the max over the
         touched buckets (adds elsewhere don't invalidate this read)."""
@@ -707,6 +726,110 @@ class Table:
         # full-payload copy per miss.
         cache.store(key, val, cur)
         return copy(val)
+
+    def _serve_read_rows(self, kind, keys, fetch_subset, buckets=None,
+                         note_keys=None):
+        """Row-granular serve cache (docs/embedding.md).
+
+        Per-KEY cache entries ``(id(self), kind, key)``, each gated by
+        its OWN bucket version — a cached hot row keeps hitting across
+        different requested id sets and across adds to other buckets,
+        and a miss fetches only the missing keys (never the whole set,
+        never the whole table).  ``fetch_subset(sub)`` returns one value
+        per key of ``sub`` (deduplicated, arbitrary order preserved).
+
+        Returns the per-key value list in request order, or ``None``
+        when this path is disarmed — serve cache off, ``-serve_row_cache
+        =false``, or multi-host (per-rank key sets would break the
+        lockstep fetch collective; the caller falls back to the id-set
+        path, which bypasses correctly).  Returned values are the CACHED
+        objects (stored read-only): the caller copies at its own
+        boundary (np.stack / per-value .copy()).
+
+        Miss accounting mirrors the PR 4 review fix: nothing accrues
+        unless this path is ARMED — a disabled row cache must not count
+        chaos-forced misses (the regression tests/test_embedding.py
+        pins this).
+        """
+        cache = self._serve_cache
+        if (cache is None or not self._serve_row_cache
+                or is_multiprocess()):
+            return None
+        if self._workload is not None:
+            self._workload.note_get(
+                note_keys if note_keys is not None
+                else [int(k) for k in keys])
+        import numpy as np
+
+        keys_list = list(keys)
+        bucket_list = list(buckets) if buckets is not None else keys_list
+        vers = self._serve_current_many(bucket_list)
+        forced = False
+        try:
+            # Chaos seam: an injected serve.stale forces this read to
+            # miss wholesale (tests script staleness storms) — counted
+            # only here, past the armed gate.
+            fault.inject("serve.stale")
+        except fault.FaultError:
+            forced = True
+            metrics.counter("serve.cache.miss").inc()
+        values: dict = {}
+        missing = []
+        miss_vers: dict = {}
+        first_idx: dict = {}
+        for i, k in enumerate(keys_list):
+            if k not in first_idx:
+                first_idx[k] = i  # order-preserving dedup
+        uniq = list(first_idx)
+        if forced:
+            missing = uniq
+            miss_vers = {k: int(vers[first_idx[k]]) for k in uniq}
+        else:
+            # ONE lock + counter update for the whole id set
+            # (VersionedLRUCache.lookup_many) — per-key lookup() calls
+            # would pay the lock and the metrics registry k times.
+            got = cache.lookup_many(
+                [(id(self), kind, k) for k in uniq],
+                [int(vers[first_idx[k]]) - self._serve_staleness
+                 for k in uniq])
+            for k, v in zip(uniq, got):
+                if v is not None:
+                    values[k] = v
+                else:
+                    missing.append(k)
+                    # Pre-fetch stamp per key: the fetch runs after
+                    # this estimate, so the data is at least this new.
+                    miss_vers[k] = int(vers[first_idx[k]])
+        if missing:
+            def execute(items):
+                # Coalesced miss fetch: concurrent readers' missing
+                # sets union into ONE subset fetch (the ServeClient
+                # row-get discipline, host-local edition).
+                union = []
+                seen = set()
+                for it in items:
+                    for k in it:
+                        if k not in seen:
+                            seen.add(k)
+                            union.append(k)
+                fetched = fetch_subset(union)
+                lut = dict(zip(union, fetched))
+                return [[lut[k] for k in it] for it in items]
+
+            with tracing.span("serve::row_get", table=self.name,
+                              k=len(missing)):
+                got = self._serve_coalescer.submit(
+                    (id(self), kind, "rows"), missing, execute)
+            for k, v in zip(missing, got):
+                if isinstance(v, np.ndarray):
+                    # Loud ValueError on any aliasing slip instead of
+                    # silent cache corruption (the ServeClient
+                    # discipline); callers copy at their boundary.
+                    v = v.copy()
+                    v.flags.writeable = False
+                cache.store((id(self), kind, k), v, miss_vers[k])
+                values[k] = v
+        return [values[k] for k in keys_list]
 
     # -- host-bridge borrow/out= protocol (docs/host_bridge.md) --------------
     def _coerce_delta(self, delta, borrow: bool):

@@ -91,25 +91,45 @@ class KVTable(Table):
         with self._monitor("Get"):
             keys = list(keys)
 
-            def fetch():
+            # Key-granular serve cache first (docs/embedding.md): one
+            # versioned entry PER KEY, gated by its own crc32 bucket —
+            # a hot key keeps hitting across different key sets, and a
+            # miss fetches only the missing keys.  None = disarmed;
+            # the key-set path below takes over.
+            def fetch_subset(sub):
                 with self._lock:
-                    for k in keys:
-                        w = self._store.get(k)
-                        self._cache[k] = (w.copy() if w is not None
-                                          else self._zero())
-                return {k: self._cache[k] for k in keys}
+                    return [
+                        (self._store[k].copy() if k in self._store
+                         else self._zero())
+                        for k in sub]
 
-            # Serve layer: per-key-set entries gated by the touched key
-            # BUCKETS (crc32 — rank-stable), so adds to unrelated keys
-            # keep these hitting.  Values are copied on both cache
-            # boundaries — a caller mutating its dict must not corrupt
-            # the cached copy.
-            out = self._serve_read(
-                ("kv", tuple(keys)), fetch,
+            vals = self._serve_read_rows(
+                "kv", keys, fetch_subset,
                 buckets=[self.serve_key_bucket(k) for k in keys],
-                collective_safe=False,
-                copy=lambda d: {k: v.copy() for k, v in d.items()},
-                keys=[str(k) for k in keys])
+                note_keys=[str(k) for k in keys])
+            if vals is not None:
+                # Per-caller copies: the cached values are read-only.
+                out = {k: v.copy() for k, v in zip(keys, vals)}
+            else:
+                def fetch():
+                    with self._lock:
+                        for k in keys:
+                            w = self._store.get(k)
+                            self._cache[k] = (w.copy() if w is not None
+                                              else self._zero())
+                    return {k: self._cache[k] for k in keys}
+
+                # Serve layer: per-key-set entries gated by the touched
+                # key BUCKETS (crc32 — rank-stable), so adds to
+                # unrelated keys keep these hitting.  Values are copied
+                # on both cache boundaries — a caller mutating its dict
+                # must not corrupt the cached copy.
+                out = self._serve_read(
+                    ("kv", tuple(keys)), fetch,
+                    buckets=[self.serve_key_bucket(k) for k in keys],
+                    collective_safe=False,
+                    copy=lambda d: {k: v.copy() for k, v in d.items()},
+                    keys=[str(k) for k in keys])
             # raw() contract: the mirror holds every key the app Get()s
             # even when the serve cache short-circuits fetch() above.
             with self._lock:

@@ -101,6 +101,29 @@ class MatrixTable(Table):
         with self._monitor("GetRows"):
             rows = np.asarray(row_ids, dtype=np.int64)
 
+            # Row-granular serve cache first (docs/embedding.md): each
+            # requested row is its own versioned entry, so a hot row
+            # keeps hitting across DIFFERENT id sets and a miss fetches
+            # only the missing rows — never the whole set.  Disarmed
+            # (cache off / -serve_row_cache=false / multi-host) this
+            # returns None and the id-set path below takes over.
+            if rows.shape[0]:
+                def fetch_subset(sub):
+                    got = self._gather_host(
+                        np.asarray(sub, np.int64).astype(np.int32))
+                    return list(got)
+
+                vals = self._serve_read_rows(
+                    "row", [int(r) for r in rows], fetch_subset,
+                    note_keys=rows.tolist())
+                if vals is not None:
+                    # np.stack allocates the caller's fresh result — the
+                    # cached (read-only) rows are never handed out
+                    # mutably.
+                    return self._fill_out(
+                        out, np.stack(vals).astype(self.dtype,
+                                                   copy=False))
+
             def fetch():
                 if is_multiprocess():
                     union = self._allgather_row_ids(rows)

@@ -63,6 +63,20 @@ class SparseMatrixTable(MatrixTable):
                     (self.num_rows, self.num_cols), dtype=self.dtype)
             safe = rows[in_range]
             missing = np.unique(safe[~self._cache_valid[safe]])
+            # Workload plane (docs/observability.md): rows served from
+            # this table's own mirror never reach the base `_serve_read`
+            # keys= hook, so the hot-key sketch / bucket load counters
+            # would miss exactly the HOT traffic.  Note the mirror-hit
+            # rows here; the `super().get_rows(missing)` call below
+            # notes the misses itself — no double counting.
+            if self._workload is not None:
+                hit_mask = np.ones(rows.shape[0], dtype=bool)
+                hit_mask &= in_range
+                if missing.shape[0]:
+                    hit_mask &= ~np.isin(rows, missing)
+                hits = rows[hit_mask]
+                if hits.shape[0]:
+                    self._workload.note_get(hits.tolist())
             # Multi-host the base fetch is a lockstep collective, so every
             # rank must join it even with zero local misses (peers may
             # miss different rows; the union path merges the id sets).
@@ -87,10 +101,12 @@ class SparseMatrixTable(MatrixTable):
                 rows = rows[(rows >= 0) & (rows < self.num_rows)]
                 self._cache_valid[rows] = False
 
-    def add_rows(self, row_ids, delta, option=None, sync: bool = False) -> None:
+    def add_rows(self, row_ids, delta, option=None, sync: bool = False,
+                 borrow: bool = False) -> None:
         from .base import is_multiprocess
 
-        super().add_rows(row_ids, delta, option=option, sync=sync)
+        super().add_rows(row_ids, delta, option=option, sync=sync,
+                         borrow=borrow)
         if is_multiprocess():
             # The collective apply touched the UNION of every rank's rows
             # (matrix_table._multihost_union); invalidating only the local
@@ -99,8 +115,9 @@ class SparseMatrixTable(MatrixTable):
         else:
             self._invalidate(np.asarray(row_ids, dtype=np.int64))
 
-    def add(self, delta, option=None, sync: bool = False) -> None:
-        super().add(delta, option=option, sync=sync)
+    def add(self, delta, option=None, sync: bool = False,
+            borrow: bool = False) -> None:
+        super().add(delta, option=option, sync=sync, borrow=borrow)
         self._invalidate()
 
     def flush(self) -> None:

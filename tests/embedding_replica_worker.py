@@ -1,0 +1,93 @@
+"""Two-rank hot-key replica invalidation holder (not a pytest module).
+
+Run as ``python embedding_replica_worker.py <machine_file> <rank>``:
+rank 1 warms rank 0's hot-key tracker, pulls the replica, and serves a
+hot row locally; rank 0 then updates that row SERVER-SIDE (a blocking
+add from the other worker — rank 1's own version ledger learns nothing
+from it).  Rank 1 must observe the new value within one replica lease
+(the snapshot re-pull is the cross-worker invalidation path;
+docs/embedding.md).  Rank 1 prints ``REPLICA_FRESH_MS <ms>``;
+both ranks print ``REPLICA_WORKER_OK``.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from multiverso_tpu import native as nat  # noqa: E402
+
+ROWS = 64
+COLS = 4
+LEASE_MS = 100
+
+
+def main() -> int:
+    mf, rank = sys.argv[1], int(sys.argv[2])
+    rt = nat.NativeRuntime(args=[
+        f"-machine_file={mf}", f"-rank={rank}", "-log_level=error",
+        "-rpc_timeout_ms=30000", "-barrier_timeout_ms=60000",
+        "-hotkey_topk=16", f"-replica_lease_ms={LEASE_MS}",
+        "-hotkey_replica=true"])
+    h = rt.new_matrix_table(ROWS, COLS)
+    h_kv = rt.new_kv_table()
+    rt.barrier()
+
+    if rank == 1:
+        # Seed + warm the tracker on rank 0's shard (rows 1, 2).
+        rt.matrix_add_rows(h, [1, 2], np.ones((2, COLS), np.float32))
+        for _ in range(8):
+            rt.matrix_get_rows(h, [1, 2], COLS)
+        rt.replica_refresh(h)
+        first = rt.matrix_get_rows(h, [1], COLS)
+        assert first[0, 0] == 1.0, first
+        stats = rt.replica_stats(h)
+        assert stats["rows"] >= 1, stats
+        rt.kv_add(h_kv, "ready", 1.0)
+        deadline = time.time() + 30
+        while rt.kv_get(h_kv, "updated") < 1.0:
+            if time.time() > deadline:
+                raise RuntimeError("rank 0 never updated")
+            time.sleep(0.01)
+        # The server-side add bumped row 1 to 11; rank 1's own version
+        # ledger saw no ack for it, so only the lease re-pull can
+        # surface it — within ~one lease, never a stale value forever.
+        t0 = time.perf_counter()
+        fresh_ms = -1.0
+        while time.perf_counter() - t0 < 10.0:
+            got = float(rt.matrix_get_rows(h, [1], COLS)[0, 0])
+            assert got in (1.0, 11.0), got  # never a torn value
+            if got == 11.0:
+                fresh_ms = (time.perf_counter() - t0) * 1e3
+                break
+            time.sleep(0.01)
+        assert fresh_ms >= 0, "stale past 10 s"
+        assert fresh_ms <= 20 * LEASE_MS, fresh_ms  # within ~one lease
+        print(f"REPLICA_FRESH_MS {fresh_ms:.1f}", flush=True)
+        rt.kv_add(h_kv, "done", 1.0)
+    else:
+        deadline = time.time() + 60
+        while rt.kv_get(h_kv, "ready") < 1.0:
+            if time.time() > deadline:
+                raise RuntimeError("rank 1 never readied")
+            time.sleep(0.01)
+        # SERVER-SIDE update from this rank: rank 1 gets no ack stamp.
+        rt.matrix_add_rows(h, [1], np.full((1, COLS), 10.0, np.float32))
+        rt.kv_add(h_kv, "updated", 1.0)
+        while rt.kv_get(h_kv, "done") < 1.0:
+            if time.time() > deadline:
+                raise RuntimeError("rank 1 never finished")
+            time.sleep(0.01)
+
+    rt.barrier()
+    rt.shutdown()
+    print(f"REPLICA_WORKER_OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -143,6 +143,41 @@ def test_gate_passes_in_band_bridge_line(tmp_path):
     assert rc == 0, out
 
 
+def test_gate_guards_embedding_keys(tmp_path):
+    """bench_embedding acceptance bars (docs/embedding.md, schema 14):
+    the row-cache speedup collapsing under its 10x floor, the replica
+    p50 falling behind the row-cached p50, the borrowed AddRows
+    speedup evaporating (a later codec/staging change silently
+    re-copying), the replica push no longer covering the hot head, or
+    the sparse reply codec losing its byte saving must all fail."""
+    line = {"extras": {"embedding_rowcache_vs_cold_p50": 6.0,   # < 10
+                       "embedding_replica_vs_rowcache_p50": 0.7,
+                       "embedding_addrows_borrow_speedup": 1.2,  # < 2
+                       "embedding_replica_hit_rate": 0.2,
+                       "embedding_sparse_bytes_ratio": 1.1}}
+    p = tmp_path / "embedding_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "embedding_rowcache_vs_cold_p50" in out and "FAIL" in out, out
+    assert "embedding_replica_vs_rowcache_p50" in out, out
+    assert "embedding_addrows_borrow_speedup" in out, out
+    assert "embedding_replica_hit_rate" in out, out
+    assert "embedding_sparse_bytes_ratio" in out, out
+
+
+def test_gate_passes_in_band_embedding_line(tmp_path):
+    line = {"extras": {"embedding_rowcache_vs_cold_p50": 11.5,
+                       "embedding_replica_vs_rowcache_p50": 1.3,
+                       "embedding_addrows_borrow_speedup": 5.0,
+                       "embedding_replica_hit_rate": 0.9,
+                       "embedding_sparse_bytes_ratio": 5.5}}
+    p = tmp_path / "embedding_ok.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
 def test_last_parseable_line_wins(tmp_path):
     """Schema-7 cumulative emission: the LAST line is the freshest
     cumulative state and must shadow earlier partials."""

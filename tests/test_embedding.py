@@ -1,0 +1,403 @@
+"""Tier-1 gate for the sparse-embedding fast path (docs/embedding.md):
+the row-granular serve cache (per-row versioned entries, miss-only
+subset fetches, staleness-0 correctness under churn, the armed-gate
+miss accounting the PR 4 review fix requires), the KV key-granular
+twin, the ServeClient row cache over the native wire, the sparse table
+workload wiring, the DLRM recommender app, and the native hot-key
+replica — including the 2-process cross-worker invalidation bar (a
+server-side add is observed within one replica lease).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+# ------------------------------------------------- row-granular serve cache
+
+def test_row_cache_hits_across_id_sets(mv):
+    """A hot row caches INDIVIDUALLY: a different id set sharing it
+    still hits, and the miss fetch pulls only the missing rows."""
+    from multiverso_tpu import metrics
+
+    mv.init()
+    metrics.reset()
+    t = mv.MatrixTable(32, 4, name="rowc", serve_cache=64)
+    t.add_rows([1, 2], np.ones((2, 4), np.float32))
+
+    fetched_sets = []
+    orig = t._gather_host
+
+    def spy(rows):
+        fetched_sets.append(sorted(int(r) for r in rows))
+        return orig(rows)
+
+    t._gather_host = spy
+    a = t.get_rows([1, 2, 3])
+    np.testing.assert_allclose(a[0], 1.0)
+    b = t.get_rows([2, 3, 4])        # rows 2, 3 cached — fetch only 4
+    np.testing.assert_allclose(b[0], 1.0)
+    np.testing.assert_allclose(b[2], 0.0)
+    assert fetched_sets == [[1, 2, 3], [4]], fetched_sets
+    assert metrics.counter("serve.cache.hit").value >= 2
+    # Caller mutation cannot corrupt the cache (read-only stored rows,
+    # fresh assembly per caller).
+    c = t.get_rows([2])
+    c[:] = 99.0
+    np.testing.assert_allclose(t.get_rows([2])[0], 1.0)
+
+
+def test_row_cache_staleness0_never_serves_pre_add(mv):
+    """max_staleness=0: a read after an add ALWAYS reflects it — under
+    sequential churn and under a concurrent writer thread."""
+    mv.init()
+    t = mv.MatrixTable(16, 2, name="churn", serve_cache=64,
+                       max_staleness=0)
+    for i in range(5):
+        t.get_rows([3])
+        t.add_rows([3], np.ones((1, 2), np.float32))
+        np.testing.assert_allclose(t.get_rows([3])[0], float(i + 1))
+
+    errs = []
+    stop = threading.Event()
+
+    def writer():
+        try:
+            for _ in range(50):
+                t.add_rows([7], np.ones((1, 2), np.float32))
+        except Exception as exc:  # surface in the main thread
+            errs.append(exc)
+        finally:
+            stop.set()
+
+    seen = [0.0]
+
+    def reader():
+        try:
+            while not stop.is_set():
+                v = float(t.get_rows([7])[0, 0])
+                assert v >= seen[0], (v, seen[0])  # monotone: no rollback
+                seen[0] = v
+        except Exception as exc:
+            errs.append(exc)
+
+    th_w = threading.Thread(target=writer)
+    th_r = threading.Thread(target=reader)
+    th_r.start()
+    th_w.start()
+    th_w.join()
+    th_r.join()
+    assert not errs, errs
+    # Every add acked: the final read must see all 50 (staleness 0).
+    np.testing.assert_allclose(t.get_rows([7])[0, 0], 50.0)
+
+
+def test_row_cache_whole_table_bump_not_lost(mv):
+    """The PR 4 staleness-gate bug shape against the NEW path: rows
+    cached while the bucket array is still lazy must not keep hitting
+    across a WHOLE-TABLE bump (dense add / load_state)."""
+    mv.init()
+    t = mv.MatrixTable(8, 2, name="bump", serve_cache=64,
+                       max_staleness=0)
+    np.testing.assert_allclose(t.get_rows([1])[0], 0.0)  # cached @ v0
+    t.add(np.ones((8, 2), np.float32))   # whole-table bump
+    np.testing.assert_allclose(t.get_rows([1])[0], 1.0)  # MUST refetch
+    # And bucket-granular bumps after the whole-table one keep working.
+    t.add_rows([1], np.ones((1, 2), np.float32))
+    np.testing.assert_allclose(t.get_rows([1])[0], 2.0)
+
+
+def test_row_cache_miss_counts_only_when_armed(mv):
+    """Satellite regression (the PR 4 review-fix discipline): a chaos-
+    forced stale read must NOT accrue serve.cache.miss when the row
+    cache is disarmed — flags off means no cache stats, period."""
+    from multiverso_tpu import fault, metrics
+
+    mv.init()
+    metrics.reset()
+    # Disarmed: serve cache off entirely.
+    t0 = mv.MatrixTable(8, 2, name="gate0", serve_cache=0)
+    fault.configure(sites={"serve.stale": {"times": 1}})
+    try:
+        m0 = metrics.counter("serve.cache.miss").value
+        t0.get_rows([1])
+        assert metrics.counter("serve.cache.miss").value == m0
+    finally:
+        fault.reset()
+    # Disarmed via -serve_row_cache=false with the id-set path armed:
+    # the chaos miss counts ONCE (the old path's armed accounting).
+    mv.config.set_flag("serve_row_cache", False)
+    t1 = mv.MatrixTable(8, 2, name="gate1", serve_cache=16)
+    assert not t1._serve_row_cache
+    fault.configure(sites={"serve.stale": {"times": 1}})
+    try:
+        m0 = metrics.counter("serve.cache.miss").value
+        t1.get_rows([1])
+        assert metrics.counter("serve.cache.miss").value > m0
+    finally:
+        fault.reset()
+        mv.config.set_flag("serve_row_cache", True)
+    # Armed row path: the forced miss counts too.
+    t2 = mv.MatrixTable(8, 2, name="gate2", serve_cache=16)
+    fault.configure(sites={"serve.stale": {"times": 1}})
+    try:
+        m0 = metrics.counter("serve.cache.miss").value
+        t2.get_rows([1])
+        assert metrics.counter("serve.cache.miss").value > m0
+    finally:
+        fault.reset()
+
+
+def test_row_cache_disabled_flag_falls_back(mv):
+    """-serve_row_cache=false reverts to the PR 4 id-set entries: the
+    values stay correct, and a repeated identical id set still hits."""
+    from multiverso_tpu import metrics
+
+    mv.config.set_flag("serve_row_cache", False)
+    try:
+        mv.init()
+        metrics.reset()
+        t = mv.MatrixTable(16, 2, name="fallback", serve_cache=32)
+        t.add_rows([5], np.ones((1, 2), np.float32))
+        a = t.get_rows([5, 6])
+        h0 = metrics.counter("serve.cache.hit").value
+        b = t.get_rows([5, 6])               # identical set: hits
+        np.testing.assert_allclose(a, b)
+        assert metrics.counter("serve.cache.hit").value > h0
+        t.add_rows([5], np.ones((1, 2), np.float32))
+        np.testing.assert_allclose(t.get_rows([5, 6])[0], 2.0)
+    finally:
+        mv.config.set_flag("serve_row_cache", True)
+
+
+def test_kv_key_granular_cache(mv):
+    from multiverso_tpu import metrics
+
+    mv.init()
+    metrics.reset()
+    t = mv.KVTable(name="kvrow", serve_cache=64, max_staleness=0)
+    t.add({"a": 1.0, "b": 2.0})
+    r1 = t.get(["a", "b"])
+    h0 = metrics.counter("serve.cache.hit").value
+    r2 = t.get(["b", "c"])               # b cached, c fresh-missing
+    assert metrics.counter("serve.cache.hit").value > h0
+    assert float(r1["b"]) == 2.0 and float(r2["b"]) == 2.0
+    assert float(r2["c"]) == 0.0
+    t.add({"b": 1.0})
+    assert float(t.get(["b"])["b"]) == 3.0   # staleness 0: fresh
+    # raw() mirror still tracks every Get()'d key (reference contract).
+    assert set(t.raw) >= {"a", "b", "c"}
+
+
+def test_sparse_table_workload_notes_hot_traffic(mv):
+    """Satellite: the sparse table's mirror hits feed the hot-key
+    sketch — without the wiring, exactly the HOT rows (served from the
+    mirror, never reaching the base keys= hook) would be invisible."""
+    mv.config.set_flag("hotkey_enabled", True)
+    mv.init()
+    t = mv.SparseMatrixTable(32, 2, name="spw")
+    for _ in range(5):
+        t.get_rows([3, 4])               # first call misses, rest hit
+    rep = t.workload_report()
+    assert rep["armed"], rep
+    # Without the wiring only the FIRST call (the mirror miss) would be
+    # visible: gets would read 1 and every bucket load 1.  With it, all
+    # five calls count and the touched buckets carry one note per call.
+    assert rep["gets"] == 5, rep
+    assert rep["bucket_load_max"] == 5, rep
+    top = [e["key"] for e in rep["hotkeys"]["topk"]]
+    assert "3" in top and "4" in top, top
+
+
+# ------------------------------------------------------------- DLRM app
+
+def test_dlrm_trains_and_serves(mv):
+    mv.init()
+    from multiverso_tpu.apps import DLRMRecommender
+
+    m = DLRMRecommender(num_users=128, num_items=64, dim=8,
+                        learning_rate=0.3, serve_cache=256)
+    losses = m.train_epoch(batches=30, batch=128, seed=3)
+    first = float(np.mean(losses[:5]))
+    last = float(np.mean(losses[-5:]))
+    assert last < first, (first, last)   # zipf head memorized
+    s = m.scores(0, [0, 1, 2, 3])
+    assert s.shape == (4,) and np.isfinite(s).all()
+    rep = m.hot_report()
+    assert rep["armed"] and rep["gets"] > 0
+    m.close()
+
+
+# --------------------------------------------------- native replica plane
+
+@pytest.fixture()
+def native_rt():
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    rt = nat.NativeRuntime(args=["-log_level=error", "-hotkey_topk=16"])
+    yield rt
+    rt.set_hotkey_replica(False)
+    rt.shutdown()
+
+
+@needs_gxx
+def test_native_replica_serves_and_invalidates(native_rt):
+    """Single-process replica protocol: pushed top-K rows serve hits;
+    an acked add stales the ledger at replica_max_staleness=0 so the
+    next read returns the NEW value (red on a replica path without
+    invalidation)."""
+    rt = native_rt
+    h = rt.new_matrix_table(64, 4)
+    rt.matrix_add_rows(h, [1, 2], np.ones((2, 4), np.float32))
+    for _ in range(8):
+        rt.matrix_get_rows(h, [1, 2], 4)
+    rt.set_hotkey_replica(True)
+    rt.replica_refresh(h)
+    stats0 = rt.replica_stats(h)
+    assert stats0["rows"] >= 2 and stats0["pushes"] >= 1, stats0
+    got = rt.matrix_get_rows(h, [1, 2], 4)
+    np.testing.assert_allclose(got, 1.0)
+    stats1 = rt.replica_stats(h)
+    assert stats1["hits"] > stats0["hits"], (stats0, stats1)
+    # Staleness-0 freshness after an acked add.
+    rt.matrix_add_rows(h, [1], np.full((1, 4), 5.0, np.float32))
+    np.testing.assert_allclose(rt.matrix_get_rows(h, [1], 4)[0], 6.0)
+    # The "hotkeys" ops report carries the replica ledger.
+    rep = rt.hot_keys(h)
+    assert rep and "replica" in rep[0], rep
+    assert rep[0]["replica"]["pushes"] >= 1, rep
+
+
+@needs_gxx
+def test_native_replica_disarmed_is_inert(native_rt):
+    rt = native_rt
+    h = rt.new_matrix_table(16, 2)
+    rt.matrix_add_rows(h, [1], np.ones((1, 2), np.float32))
+    for _ in range(4):
+        rt.matrix_get_rows(h, [1], 2)
+    stats = rt.replica_stats(h)
+    assert stats["hits"] == 0 and stats["refreshes"] == 0, stats
+
+
+@needs_gxx
+def test_anon_client_replica_pull(tmp_path):
+    """Anonymous serve clients participate (docs/embedding.md): a raw
+    RequestReplica frame pulls the shard's hot rows + versions."""
+    import socket
+
+    from multiverso_tpu import native as nat
+    from multiverso_tpu.serve.wire import AnonServeClient
+
+    nat.ensure_built()
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    mf = tmp_path / "machines"
+    mf.write_text(f"127.0.0.1:{port}\n127.0.0.1:1\n")
+    # A 2-line machine file with only rank 0 alive still serves
+    # anonymous clients on rank 0's listen port (epoll engine).
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    code = (
+        "import sys, time; import numpy as np\n"
+        "sys.path.insert(0, %r)\n"
+        "from multiverso_tpu import native as nat\n"
+        "rt = nat.NativeRuntime(args=['-machine_file=%s', '-rank=0',"
+        " '-log_level=error', '-hotkey_topk=8',"
+        " '-barrier_timeout_ms=1000'])\n"
+        "h = rt.new_matrix_table(8, 2)\n"
+        "rt.matrix_add_rows(h, [1], np.ones((1, 2), np.float32))\n"
+        "for _ in range(6): rt.matrix_get_rows(h, [1], 2)\n"
+        "print('SERVING', flush=True)\n"
+        "time.sleep(8)\n" % (REPO, str(mf).replace('\\', '/')))
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env)
+    try:
+        assert "SERVING" in proc.stdout.readline()
+        with AnonServeClient(f"127.0.0.1:{port}", timeout=10) as c:
+            rep = c.get_replica(0)
+        assert 1 in rep, sorted(rep)
+        version, row = rep[1]
+        assert version >= 1
+        np.testing.assert_allclose(row, 1.0)
+    finally:
+        proc.kill()
+        proc.communicate(timeout=10)
+
+
+@needs_gxx
+def test_replica_cross_worker_invalidation_2proc(tmp_path):
+    """Acceptance bar: a hot row updated ON THE SERVER (by the other
+    worker — no ack ever reaches this rank's version ledger) is
+    observed fresh within one replica lease; no torn or rolled-back
+    value is ever served."""
+    import socket
+
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    socks = [socket.socket() for _ in range(2)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = tmp_path / "machines"
+    mf.write_text("\n".join(eps) + "\n")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    worker = os.path.join(REPO, "tests", "embedding_replica_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(mf), str(r)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for r in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"REPLICA_WORKER_OK {r}" in out, out[-2000:]
+    assert "REPLICA_FRESH_MS" in outs[1]
+
+
+# -------------------------------------------------- ServeClient row cache
+
+@needs_gxx
+def test_serveclient_row_granular_over_native(native_rt):
+    from multiverso_tpu import metrics
+    from multiverso_tpu.serve.client import ServeClient
+
+    metrics.reset()
+    rt = native_rt
+    h = rt.new_matrix_table(64, 4)
+    rt.matrix_add_rows(h, [1, 2], np.ones((2, 4), np.float32))
+    sc = ServeClient(rt, cache_entries=64, max_staleness=0,
+                     lease_ms=500.0, window_us=0.0)
+    a = sc.matrix_get_rows(h, [1, 2, 3], 4)
+    h0 = metrics.counter("serve.cache.hit").value
+    b = sc.matrix_get_rows(h, [2, 3, 4], 4)   # 2, 3 hit; 4 fetches
+    assert metrics.counter("serve.cache.hit").value >= h0 + 2
+    np.testing.assert_allclose(a[1], b[0])
+    # Write-through + staleness 0: the add invalidates, the next read
+    # reflects it.
+    sc.matrix_add_rows(h, [2], np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(sc.matrix_get_rows(h, [2], 4)[0], 2.0)
+    # Duplicate ids in one request assemble correctly.
+    d = sc.matrix_get_rows(h, [1, 1, 2], 4)
+    np.testing.assert_allclose(d[0], d[1])
+    # KV key-granular twin.
+    hk = rt.new_kv_table()
+    rt.kv_add(hk, ["x", "y"], [1.0, 2.0])
+    v1 = sc.kv_get(hk, ["x", "y"])
+    v2 = sc.kv_get(hk, ["y", "z"])
+    assert v1[1] == 2.0 and v2[0] == 2.0 and v2[1] == 0.0
+    assert sc.replica_stats(h)["hits"] >= 0  # surface exists

@@ -259,6 +259,22 @@ def test_native_multi_process_net(native, tmp_path, nprocs):
         assert f"NET_CHILD_OK {r}" in out, out[-2000:]
 
 
+@pytest.mark.parametrize("engine", ["tcp", "epoll"])
+def test_native_embed_chaos_scenario(native, tmp_path, engine):
+    """Sparse-embedding data plane under chaos (docs/embedding.md): 2
+    ranks, multi-shard borrowed AddRows run-iovecs and hot-key replica
+    pushes with drop/dup/delay injected — a dropped run loses exactly
+    the remote shard's rows, a dup doubles them, a delayed frame
+    defers a mid-flight arena release, a dropped replica push fails
+    bounded, and the version gate never serves a stale replica row."""
+    mf = _machine_file(tmp_path, 2)
+    b = _binary()
+    outs, procs = _run_ranks(b, "embed_child", mf, 2, extra=(engine,))
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"EMBED_CHAOS_OK {r}" in out, out[-2000:]
+
+
 @pytest.mark.parametrize("updater",
                          ["sgd", "adagrad", "momentum", "smooth_gradient"])
 def test_native_stateful_updater_cross_rank(native, tmp_path, updater):
@@ -356,7 +372,8 @@ def test_native_tsan_scenarios(native, tmp_path):
                                     ("async_overlap", 2, ()),
                                     # Borrowed arena sends under
                                     # drop/dup/delay (host_bridge.md).
-                                    ("bridge_child", 2, ("epoll",))]:
+                                    ("bridge_child", 2, ("epoll",)),
+                                    ("embed_child", 2, ("epoll",))]:
         mf = _machine_file(tmp_path, nprocs)  # rewritten per scenario
         procs = [subprocess.Popen([tsan_bin, scenario, mf, str(r), *extra],
                                   stdout=subprocess.PIPE,
@@ -406,7 +423,8 @@ def test_native_asan_scenarios(native, tmp_path):
                                     # Borrowed arena sends under
                                     # drop/dup/delay: the use-after-
                                     # recycle class lives here.
-                                    ("bridge_child", 2, ("epoll",))]:
+                                    ("bridge_child", 2, ("epoll",)),
+                                    ("embed_child", 2, ("epoll",))]:
         mf = _machine_file(tmp_path, nprocs)  # rewritten per scenario
         procs = [subprocess.Popen([asan_bin, scenario, mf, str(r), *extra],
                                   stdout=subprocess.PIPE,

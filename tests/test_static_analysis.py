@@ -439,6 +439,58 @@ def test_mv011_out_of_scope_and_suppressible(tmp_path):
     assert _lint_src(d, suppressed) == []
 
 
+def test_mv013_fires_on_row_at_a_time_loop(tmp_path):
+    """Row-at-a-time table fetch/add inside a for over ids (apps/ and
+    models/ scope): each iteration pays a full monitor/serve/wire round
+    trip the batched rows=/keys= call amortizes (docs/embedding.md)."""
+    d = tmp_path / "multiverso_tpu" / "apps"
+    d.mkdir(parents=True)
+    rules = _lint_src(d, """\
+        def bad(table, kv, ids, keys, deltas):
+            for i in ids:
+                table.get_rows([i])                    # BAD
+            for i in ids:
+                table.add_rows([i], deltas[i])         # BAD
+            for k in keys:
+                kv.get([k])                            # BAD
+            for k in keys:
+                kv.add({k: deltas[k]})                 # BAD
+
+        def good(table, kv, ids, keys, deltas, cfg):
+            table.get_rows(ids)                        # batched: fine
+            table.add_rows(ids, deltas)
+            kv.get(keys)
+            for k in keys:
+                cfg.get(k)                             # dict.get: fine
+            for i in ids:
+                table.get_rows([0, 1, 2])              # constant set: fine
+        """)
+    assert [r for r, _ in rules] == ["MV013"] * 4, rules
+
+
+def test_mv013_out_of_scope_and_suppressible(tmp_path):
+    """Library/tests are out of scope (the rule polices app/model
+    training loops); an in-scope finding silences with the usual
+    comment."""
+    src = """\
+        def f(table, ids):
+            for i in ids:
+                table.get_rows([i])
+        """
+    apps = tmp_path / "multiverso_tpu" / "apps"
+    apps.mkdir(parents=True)
+    assert [r for r, _ in _lint_src(apps, src)] == ["MV013"]
+    lib = tmp_path / "multiverso_tpu" / "tables"
+    lib.mkdir(parents=True)
+    assert _lint_src(lib, src) == []           # library scope: exempt
+    assert _lint_src(apps, src,
+                     name="test_snippet.py") == []   # tests: exempt
+    suppressed = src.replace(
+        "table.get_rows([i])",
+        "table.get_rows([i])  # mvlint: disable=MV013")
+    assert _lint_src(apps, suppressed) == []
+
+
 def test_mv012_fires_on_bridge_copy_churn(tmp_path):
     """astype/.copy()/ascontiguousarray minted INLINE on a native
     bridge add/get argument is a full-payload copy per call — the

@@ -112,6 +112,15 @@ Rules (docs/static_analysis.md has the full rationale):
   are exempt; a genuinely-required copy carries a suppression with its
   why.
 
+- **MV013 row-at-a-time-table-loop** — app/model code (``apps/``,
+  ``models/``) may not fetch or push table rows ONE AT A TIME inside a
+  Python loop over ids (``for i in ids: t.get_rows([i])`` /
+  ``t.add_rows([i], d)`` / ``kv.get([k])`` / ``kv.add({k: v})``): every
+  iteration pays a full monitor/serve/wire round trip that the batched
+  ``rows=``/``keys=`` call amortizes — at embedding scale the loop is
+  the difference between one gather and ten thousand
+  (docs/embedding.md).  Batch the ids and call once.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -701,6 +710,74 @@ def check_bridge_copy_churn(tree, path):
     return out
 
 
+# ---------------------------------------------------------------- MV013
+# Table ops whose per-row Python-loop form MV013 flags (a batched
+# rows=/keys= spelling exists for every one of them).
+ROW_CALLS = {"get_rows", "add_rows", "matrix_get_rows",
+             "matrix_add_rows"}
+KV_CALLS = {"get", "add"}
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def check_row_at_a_time(tree, path):
+    """MV013: row-at-a-time table fetch/add inside a ``for`` over ids
+    (apps/ and models/ only — the batched call is the whole point of
+    the row APIs; docs/embedding.md)."""
+    out = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, ast.For):
+            continue
+        targets = _names_in(loop.target)
+        if not targets:
+            continue
+        for node in _walk_same_scope(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_name(node.func)
+            args = list(node.args) + [k.value for k in node.keywords]
+
+            def uses_target(a):
+                # The loop variable itself, or a 1-element list/tuple
+                # literal wrapping it: `t.get_rows([i])`.
+                if isinstance(a, ast.Name) and a.id in targets:
+                    return True
+                if isinstance(a, (ast.List, ast.Tuple)) \
+                        and len(a.elts) == 1:
+                    e = a.elts[0]
+                    return isinstance(e, ast.Name) and e.id in targets
+                return False
+
+            fired = False
+            if tail in ROW_CALLS and any(uses_target(a) for a in args):
+                fired = True
+            elif tail in KV_CALLS:
+                # kv.get([k]) / kv.add({k: v}): only the unambiguous
+                # single-element literal forms (dict.get(k) etc. must
+                # not false-positive).
+                for a in args:
+                    if isinstance(a, (ast.List, ast.Tuple)) \
+                            and len(a.elts) == 1 \
+                            and isinstance(a.elts[0], ast.Name) \
+                            and a.elts[0].id in targets:
+                        fired = True
+                    if isinstance(a, ast.Dict) and len(a.keys) == 1 \
+                            and isinstance(a.keys[0], ast.Name) \
+                            and a.keys[0].id in targets:
+                        fired = True
+            if fired:
+                out.append(Finding(
+                    path, node.lineno, "MV013",
+                    f"row-at-a-time {tail}(...) over loop variable(s) "
+                    f"{sorted(targets & (_names_in(node)))} — each "
+                    f"iteration pays a full monitor/serve/wire round "
+                    f"trip; batch the ids and call {tail} ONCE with "
+                    f"the whole rows=/keys= set (docs/embedding.md)"))
+    return out
+
+
 # ---------------------------------------------------------------- MV009
 # Native reactor-context lint: the only non-Python rule.  A file opts in
 # with this marker (the epoll engine sources carry it); the rule then
@@ -796,6 +873,13 @@ def lint_file(path):
         # ad-hoc arrays, and the seeded-violation suite must be able
         # to spell the violation).
         findings += check_bridge_copy_churn(tree, path)
+    # App/model plane: the batched-row-call discipline (the serve/wire
+    # layers amortize per CALL, so a per-row Python loop defeats every
+    # one of them at once).
+    in_apps = any(f"{sep}{d}{sep}" in path.replace(os.sep, "/")
+                  for sep in ("/",) for d in ("apps", "models"))
+    if in_apps and not in_tests:
+        findings += check_row_at_a_time(tree, path)
     # Library code only: apps/ are executable worker scripts whose
     # stdout IS their protocol (NATIVE_LR_OK markers etc.).
     in_library = (("multiverso_tpu" in path)
