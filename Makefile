@@ -123,10 +123,21 @@ bridge-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bridge_demo.py
 
+# Latency-attribution smoke (docs/observability.md "latency plane"): a
+# 2-rank fleet with wire timing + the SIGPROF sampler armed — an
+# anonymous timed probe's per-stage breakdown sums to within 10% of its
+# end-to-end latency, the fleet report's p99 exemplar resolves in the
+# merged Chrome trace beside profile:* flame spans, and with an
+# injected apply-path delay fault, tools/latdoctor.py --fleet names
+# `apply` (never the wire) as the dominant p99 stage.
+latency-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/latency_demo.py
+
 # Demo umbrella: every acceptance smoke in sequence (each target builds
 # the native runtime once; later builds are no-ops).
 demos: metrics-demo serve-demo wire-demo fanin-demo ops-demo skew-demo \
-       embedding-demo bridge-demo
+       embedding-demo bridge-demo latency-demo
 
 # Continuous perf gate (docs/PERF.md): diff the newest bench JSON line
 # against the committed BENCH_BASELINE.json with per-key noise bands;
@@ -140,4 +151,4 @@ clean:
 
 .PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
         serve-demo wire-demo fanin-demo ops-demo skew-demo \
-        embedding-demo bridge-demo demos bench-gate clean
+        embedding-demo bridge-demo latency-demo demos bench-gate clean

@@ -601,6 +601,39 @@ def bench_ops():
     return res
 
 
+def bench_latency(nclients: int = 1000):
+    """Latency-attribution plane (docs/observability.md "latency
+    plane"; schema 15): the 1k-socket anonymous fan-in herd probes one
+    epoll server rank in three sweeps — untimed baseline, wire-stamped
+    (per-stage p50/p99 breakdown reconstructed from the reply timing
+    trails: ``latency_stage_{queue,wire_out,mailbox,apply,reactor,
+    wire_back}_{p50,p99}_ms`` + ``latency_e2e_*``), then wire-stamped
+    with BOTH sampling profilers (native SIGPROF + the Python sampler
+    thread) armed in the busy herd process.
+    ``latency_profiler_overhead_pct`` is the QPS the always-on profiler
+    cost (acceptance: < 1%), ``latency_timing_overhead_pct`` what the
+    48-byte trail + stamps cost, and ``latency_stage_sum_ratio`` checks
+    the offset-corrected stages telescope back to the end-to-end
+    latency (acceptance: >= 0.85).  Herd + fleet live in
+    ``apps/fanin_bench_worker.py`` (mode=latency)."""
+    import re
+
+    outs = _spawn_native_workers("fanin_bench_worker.py", 2,
+                                 "FANIN_BENCH_OK",
+                                 (nclients, 8, 0, "latency"))
+    res = {}
+    for out in outs:
+        for m in re.finditer(r"(\w+)=([0-9.]+)", out):
+            key = m.group(1)
+            if key == "rank":
+                continue
+            name = key if key.startswith("latency_") else f"latency_{key}"
+            res[name] = float(m.group(2))
+            if key.endswith("_ms"):
+                _observe_iter(float(m.group(2)) * 1e-3)
+    return res
+
+
 def bench_skew(nclients: int = 1000, rows: int = 2048, reqs: int = 2048):
     """Workload observability plane (docs/observability.md): a zipf(1.0)
     vs uniform row-get stream from a 1000-socket anonymous herd against
@@ -1484,7 +1517,8 @@ def bench_lightlda_mh(num_docs: int = 2048, vocab: int = 10000,
 # (VERDICT r4 weak #1).
 _SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_w2v_native8,
              bench_wire_micro, bench_ssp, bench_serve, bench_serve_fanin,
-             bench_ops, bench_skew, bench_embedding, bench_bridge,
+             bench_ops, bench_latency, bench_skew, bench_embedding,
+             bench_bridge,
              bench_add_get,
              bench_transformer_large, bench_transformer, bench_moe,
              bench_lightlda, bench_lightlda_mh, bench_long_context]
@@ -1511,7 +1545,7 @@ def main() -> None:
     # Schema/partial line FIRST — before any JAX-touching import — so
     # even a backend-init hang killed by `timeout` leaves one parseable
     # line on stdout.
-    results = {"bench_schema": 14}
+    results = {"bench_schema": 15}
     errors = []
     _emit(results, errors)
 

@@ -25,6 +25,14 @@ reports ``ops_scrape_p50_ms``/``ops_scrape_p99_ms`` (scrape latency
 under the fan-in load) plus ``ops_overhead_pct``: the serve-probe QPS
 the live scrape path cost, proving introspection is effectively free.
 
+``mode=latency`` (bench.py ``bench_latency``, docs/observability.md
+"latency plane") runs the probe phase THREE times over the same herd —
+untimed baseline, wire-stamped (per-stage p50/p99 breakdown from the
+reply trails + ``timing_overhead_pct``), then wire-stamped WITH both
+sampling profilers armed in the herd process (``profiler_overhead_pct``
+— the "always-on" bar, < 1%).  ``stage_sum_ratio`` checks the
+offset-corrected stages telescope back to the end-to-end latency.
+
 Rank 1 prints the measured keys; both ranks print ``FANIN_BENCH_OK``.
 """
 
@@ -96,6 +104,113 @@ def _scrape_child(endpoint: str) -> int:
     client.close()
     print(" ".join(f"{v:.9f}" for v in lat), flush=True)
     return 0
+
+
+def _latency_herd(endpoint: str, nclients: int, rt) -> dict:
+    """mode=latency body: three probe sweeps over one socket herd.
+
+    Sweep A (untimed) is the baseline QPS; sweep B stamps timing trails
+    and aggregates the reply-side stage breakdown; sweep C repeats B
+    with the native SIGPROF sampler AND the Python sampler thread armed
+    in THIS (busy) process — the profiler_overhead_pct A/B."""
+    import numpy as np
+
+    from multiverso_tpu import profiler as pyprof
+    from multiverso_tpu.serve.wire import (OffsetEstimator, ntp_sample,
+                                           stage_durations)
+
+    host, port = endpoint.rsplit(":", 1)
+    _raise_fd_limit(nclients + 256)
+    sel = selectors.DefaultSelector()
+    socks = []
+    for i in range(nclients):
+        s = socket.socket()
+        s.connect((host, int(port)))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.setblocking(False)
+        sel.register(s, selectors.EVENT_READ,
+                     {"dec": FrameDecoder(), "id": i})
+        socks.append(s)
+    est = OffsetEstimator()
+
+    def sweep(timing: bool, stages_out=None):
+        done = 0
+        t0 = time.perf_counter()
+        window = 8
+        mid = [0]
+        for base in range(0, nclients, window):
+            batch = socks[base:base + window]
+            for s in batch:
+                mid[0] += 1
+                s.sendall(pack_frame(MSG["RequestVersion"], 0, mid[0],
+                                     timing=timing))
+            deadline = time.time() + 60
+            got = 0
+            while got < len(batch) and time.time() < deadline:
+                for key, _ in sel.select(timeout=1.0):
+                    data = key.data
+                    try:
+                        chunk = key.fileobj.recv(65536)
+                    except BlockingIOError:
+                        continue
+                    if not chunk:
+                        raise RuntimeError(f"conn {data['id']} died")
+                    data["dec"].feed(chunk)
+                    while True:
+                        body = data["dec"].next_frame()
+                        if body is None:
+                            break
+                        reply = unpack_frame(body)
+                        got += 1
+                        trail = reply.get("timing")
+                        if trail and stages_out is not None:
+                            now = time.monotonic_ns()
+                            sample = ntp_sample(trail, now)
+                            if sample is not None:
+                                est.update(*sample)
+                            stages_out.append(stage_durations(
+                                trail, now, est.offset_ns))
+            if got < len(batch):
+                raise RuntimeError(f"only {got}/{len(batch)} replies")
+            done += got
+        return done / (time.perf_counter() - t0)
+
+    out = {"clients": float(nclients)}
+    qps_plain = sweep(timing=False)
+    stages = []
+    qps_timed = sweep(timing=True, stages_out=stages)
+    out["timing_overhead_pct"] = (
+        max(0.0, (qps_plain - qps_timed) / qps_plain * 100.0)
+        if qps_plain else 0.0)
+
+    rt.set_profiler(97)
+    sampler = pyprof.start(97)
+    try:
+        qps_profiled = sweep(timing=True, stages_out=[])
+    finally:
+        pyprof.stop(to_trace=False)
+        rt.set_profiler(0)
+    out["profiler_overhead_pct"] = (
+        max(0.0, (qps_timed - qps_profiled) / qps_timed * 100.0)
+        if qps_timed else 0.0)
+    out["profiler_samples"] = float(sampler.samples)
+
+    totals = np.asarray([s.get("total", 0.0) for s in stages]) * 1e3
+    out["e2e_p50_ms"] = float(np.percentile(totals, 50))
+    out["e2e_p99_ms"] = float(np.percentile(totals, 99))
+    sums = np.asarray([sum(v for k, v in s.items() if k != "total")
+                       for s in stages]) * 1e3
+    ratios = sums[totals > 0] / totals[totals > 0]
+    out["stage_sum_ratio"] = float(np.mean(ratios)) if len(ratios) else 0.0
+    for name in ("queue", "wire_out", "mailbox", "apply", "reactor",
+                 "wire_back"):
+        vals = np.asarray([s.get(name, 0.0) for s in stages]) * 1e3
+        out[f"stage_{name}_p50_ms"] = float(np.percentile(vals, 50))
+        out[f"stage_{name}_p99_ms"] = float(np.percentile(vals, 99))
+    for s in socks:
+        sel.unregister(s)
+        s.close()
+    return out
 
 
 def _raise_fd_limit(need: int) -> None:
@@ -236,7 +351,9 @@ def main() -> int:
             time.sleep(0.05)
     else:
         eps = [ln.strip() for ln in open(mf) if ln.strip()]
-        if mode == "ops":
+        if mode == "latency":
+            out = _latency_herd(eps[0], nclients, rt)
+        elif mode == "ops":
             # A/B the latency phase: plain, then under a live in-band
             # scraper — the delta is what introspection costs serving.
             plain = _herd(eps[0], nclients)

@@ -128,6 +128,11 @@ int MV_ReplicaStats(int32_t handle, long long* hits, long long* misses,
                     long long* rows, long long* refreshes,
                     long long* pushes);
 char* MV_OpsFleetReport(const char* kind);
+int MV_SetWireTiming(int on);
+int MV_ClockOffset(int rank, long long* offset_ns, long long* rtt_ns);
+int MV_SetProfiler(int hz);
+char* MV_ProfilerDump(void);
+int MV_ProfilerClear(void);
 ]]
 
 -- libmvtpu.so sits two directories up from this file (native/build/).
@@ -465,6 +470,44 @@ function mv.replica_stats(handle)
   check(C.MV_ReplicaStats(handle, h, m, r, f, p), "MV_ReplicaStats")
   return tonumber(h[0]), tonumber(m[0]), tonumber(r[0]),
          tonumber(f[0]), tonumber(p[0])
+end
+
+--- Toggle wire-header timing trails live (latency attribution;
+--- boot value: -wire_timing, docs/observability.md "latency plane").
+function mv.set_wire_timing(on)
+  check(C.MV_SetWireTiming(on and 1 or 0), "MV_SetWireTiming")
+end
+
+--- Best NTP-style clock-offset estimate for a peer rank: returns
+--- offset_ns (peer clock ahead of ours), rtt_ns — or nil when no
+--- timed round trip to that rank completed yet.
+function mv.clock_offset(rank)
+  local off = ffi.new("long long[1]")
+  local rtt = ffi.new("long long[1]")
+  local rc = C.MV_ClockOffset(rank, off, rtt)
+  if rc == -2 then return nil end
+  check(rc, "MV_ClockOffset")
+  return tonumber(off[0]), tonumber(rtt[0])
+end
+
+--- (Re)arm the SIGPROF sampling profiler at hz (CPU-time sampling);
+--- hz <= 0 stops it.  Boot value: the -profile_hz flag.
+function mv.set_profiler(hz)
+  check(C.MV_SetProfiler(hz or 97), "MV_SetProfiler")
+end
+
+--- Folded-stack aggregation of everything sampled so far (one
+--- "outer;...;leaf count" line per distinct stack).
+function mv.profiler_dump()
+  local p = C.MV_ProfilerDump()
+  local text = ffi.string(p)
+  C.MV_FreeString(p)
+  return text
+end
+
+--- Drop recorded profiler samples (per-phase A/B runs).
+function mv.profiler_clear()
+  check(C.MV_ProfilerClear(), "MV_ProfilerClear")
 end
 
 --- Fleet-scope ops report assembled by THIS rank over the rank wire

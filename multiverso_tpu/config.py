@@ -173,6 +173,19 @@ define_string("trace_dir", "",
               "trace-event JSON, Perfetto-loadable) here at shutdown; "
               "merge ranks with tracing.merge_dir (docs/observability.md)")
 
+# --- latency attribution (docs/observability.md "latency plane") -----------
+define_bool("wire_timing", True,
+            "stamp a timing trail into request/reply wire headers and "
+            "fold replies into lat.stage.* histograms + per-peer clock "
+            "offsets (native-flag parity; the Python serve clients "
+            "stamp their own trails)")
+define_int("profile_hz", 0,
+           "arm the always-on sampling profiler at this rate: the "
+           "native SIGPROF sampler (native-flag parity) plus the "
+           "Python sampler thread (multiverso_tpu/profiler.py), whose "
+           "folded stacks land in trace_rank<r>.json beside spans at "
+           "shutdown.  0 (default) disarms; 97 is the house rate")
+
 # --- wire data plane (docs/wire_compression.md) ----------------------------
 define_string("wire_codec", "raw",
               "payload codec for table wire traffic: raw|1bit|sparse. "

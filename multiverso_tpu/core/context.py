@@ -267,6 +267,14 @@ def init(args: Optional[List[str]] = None,
         _recorder.attach(rank=node.rank)
         _recorder.record("lifecycle",
                          f"init rank {node.rank}/{node.size}")
+        # Latency plane (docs/observability.md): -profile_hz arms the
+        # Python sampler thread; its folded stacks land in the trace
+        # export at shutdown beside the spans.
+        profile_hz = int(config.get("profile_hz"))
+        if profile_hz > 0:
+            from .. import profiler as _profiler
+
+            _profiler.start(profile_hz)
         flush_ms = int(config.get("metrics_flush_ms"))
         if flush_ms > 0:
             import os
@@ -305,6 +313,11 @@ def shutdown(finalize: bool = True) -> None:
         # export (-trace_dir), then the classic Dashboard dump — which
         # now prints percentiles from the same registry.
         metrics.stop_flush()
+        # Profiler down BEFORE the trace export so its folded stacks
+        # ride trace_rank<r>.json (stop() folds them into the buffer).
+        from .. import profiler as _profiler
+
+        _profiler.stop(to_trace=True)
         trace_dir = str(config.get("trace_dir"))
         if trace_dir and tracing.enabled():
             import os

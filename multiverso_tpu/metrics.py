@@ -434,15 +434,20 @@ class Registry:
         return pts[-1][1] - pts[lo][1]
 
     def rate(self, name: str, labels: Optional[Dict[str, str]] = None,
-             window_s: Optional[float] = None) -> float:
+             window_s: Optional[float] = None) -> Optional[float]:
         """Per-second rate over the recorded window: (last - first)
         / elapsed, where "first" is the oldest point inside
-        ``window_s`` (or the whole ring).  0.0 with fewer than two
-        points or zero elapsed — a counter that never moved is a zero
-        rate, not a NaN."""
+        ``window_s`` (or the whole ring).  ``None`` with fewer than
+        two recorded points (or zero elapsed): before the second
+        flush there IS no rate yet — histogram ``_count``/``_sum``
+        series included — and returning 0.0 made a fresh scrape
+        indistinguishable from genuinely zero traffic (the mvtop
+        "dead shard" misread).  Renderers print ``-`` for ``None``.
+        A counter that recorded twice without moving is still a true
+        0.0 — that IS zero traffic."""
         pts = self.history(name, labels)
         if len(pts) < 2:
-            return 0.0
+            return None
         t_last, v_last = pts[-1]
         first = pts[0]
         if window_s is not None:
@@ -452,7 +457,7 @@ class Registry:
                     break
         t_first, v_first = first
         if t_last <= t_first:
-            return 0.0
+            return None
         return (v_last - v_first) / (t_last - t_first)
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
@@ -569,8 +574,10 @@ def record_history(now: Optional[float] = None) -> int:
 
 
 def rate(name: str, labels: Optional[Dict[str, str]] = None,
-         window_s: Optional[float] = None) -> float:
-    """Per-second rate of a series over the recorded history window."""
+         window_s: Optional[float] = None) -> Optional[float]:
+    """Per-second rate of a series over the recorded history window
+    (``None`` until two snapshots exist — a fresh scrape must never
+    read as "zero traffic")."""
     return REGISTRY.rate(name, labels, window_s)
 
 

@@ -215,6 +215,18 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_TableLoadStats.restype = ctypes.c_int
     lib.MV_SetHotKeyTracking.argtypes = [ctypes.c_int]
     lib.MV_SetHotKeyTracking.restype = ctypes.c_int
+    lib.MV_SetWireTiming.argtypes = [ctypes.c_int]
+    lib.MV_SetWireTiming.restype = ctypes.c_int
+    lib.MV_ClockOffset.argtypes = [ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_longlong),
+                                   ctypes.POINTER(ctypes.c_longlong)]
+    lib.MV_ClockOffset.restype = ctypes.c_int
+    lib.MV_SetProfiler.argtypes = [ctypes.c_int]
+    lib.MV_SetProfiler.restype = ctypes.c_int
+    lib.MV_ProfilerDump.argtypes = []
+    lib.MV_ProfilerDump.restype = ctypes.c_void_p
+    lib.MV_ProfilerClear.argtypes = []
+    lib.MV_ProfilerClear.restype = ctypes.c_int
     lib.MV_SetHotKeyReplica.argtypes = [ctypes.c_int]
     lib.MV_SetHotKeyReplica.restype = ctypes.c_int
     lib.MV_ReplicaRefresh.argtypes = [ctypes.c_int32]
@@ -815,6 +827,49 @@ class NativeRuntime:
         ``hotkey_track_overhead_pct`` bench bar."""
         self._check(self.lib.MV_SetHotKeyTracking(1 if on else 0),
                     "MV_SetHotKeyTracking")
+
+    # ------------------------------------------- latency attribution
+    def set_wire_timing(self, on: bool = True) -> None:
+        """Toggle wire-header timing trails live (boot value: the
+        ``-wire_timing`` flag, default ON).  Armed, every request
+        carries six monotonic stage stamps and replies fold into the
+        ``lat.stage.*`` histograms + per-peer clock offsets
+        (docs/observability.md "latency plane")."""
+        self._check(self.lib.MV_SetWireTiming(1 if on else 0),
+                    "MV_SetWireTiming")
+
+    def clock_offset(self, rank: int):
+        """Best NTP-style clock-offset estimate for a peer rank, as
+        ``{"offset_ns", "rtt_ns"}`` — how far the peer's monotonic
+        clock runs ahead of this process's, and the minimum round trip
+        backing the sample.  ``None`` when no timed round trip to that
+        rank completed yet."""
+        off = ctypes.c_longlong(0)
+        rtt = ctypes.c_longlong(0)
+        rc = self.lib.MV_ClockOffset(rank, ctypes.byref(off),
+                                     ctypes.byref(rtt))
+        if rc == -2:
+            return None
+        self._check(rc, "MV_ClockOffset")
+        return {"offset_ns": off.value, "rtt_ns": rtt.value}
+
+    def set_profiler(self, hz: int) -> None:
+        """(Re)arm the SIGPROF sampling profiler at ``hz`` (CPU-time
+        sampling; 97 is the house rate), or stop it with ``hz <= 0``.
+        Boot value: the ``-profile_hz`` flag."""
+        self._check(self.lib.MV_SetProfiler(hz), "MV_SetProfiler")
+
+    def profiler_dump(self) -> str:
+        """Folded-stack aggregation of everything sampled so far (one
+        ``outer;...;leaf count`` line per distinct stack) —
+        ``multiverso_tpu.profiler.add_native_profile`` lands it in the
+        Chrome trace beside the spans."""
+        return self._dump_string(self.lib.MV_ProfilerDump,
+                                 "MV_ProfilerDump")
+
+    def profiler_clear(self) -> None:
+        """Drop recorded profiler samples (per-phase A/B runs)."""
+        self._check(self.lib.MV_ProfilerClear(), "MV_ProfilerClear")
 
     def set_hotkey_replica(self, on: bool = True) -> None:
         """Toggle the hot-key read replica live (docs/embedding.md;

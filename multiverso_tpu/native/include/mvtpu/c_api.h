@@ -246,6 +246,35 @@ int MV_SetHotKeyTracking(int on);
 // "tables" | "hotkeys".  malloc'd; caller frees with MV_FreeString.
 char* MV_OpsFleetReport(const char* kind);
 
+// ---- latency attribution plane (docs/observability.md) ---------------
+// Toggle wire-header timing trails live (boot value: `-wire_timing`,
+// default ON).  Armed, every worker request carries six monotonic
+// stage stamps (client enqueue/send, server recv/dequeue/apply_done/
+// reply_send); replies echo + extend the trail, and the client folds
+// each round trip into lat.stage.{queue,wire_out,mailbox,apply,
+// reactor,wire_back} + lat.total Dashboard histograms (PR 7 exemplars
+// included) and the per-peer clock-offset estimator.  The "latency"
+// OpsQuery kind / MV_OpsReport("latency") serves the JSON breakdown.
+int MV_SetWireTiming(int on);
+// Best current NTP-style clock-offset estimate for a peer rank:
+// *offset_ns is how far the peer's monotonic clock runs ahead of this
+// process's; *rtt_ns the minimum observed round trip backing it.
+// Estimated from every timed request/reply AND the PR 2 heartbeat
+// echo.  rc 0; -1 not started / bad args; -2 no timed round trip to
+// that rank completed yet.
+int MV_ClockOffset(int rank, long long* offset_ns, long long* rtt_ns);
+// Sampling profiler (SIGPROF, CPU-time): hz > 0 (re)arms at that rate,
+// hz <= 0 stops.  Boot value: the `-profile_hz` flag.  rc 0, -1 when
+// the timer/handler could not be installed.
+int MV_SetProfiler(int hz);
+// Folded-stack aggregation of everything sampled so far — one line per
+// distinct stack, "outer;...;leaf count\n" (the flamegraph folded
+// convention; multiverso_tpu/profiler.py lands it in the Chrome trace
+// beside the spans).  malloc'd; caller frees with MV_FreeString.
+char* MV_ProfilerDump(void);
+// Drop recorded samples (per-phase A/B runs, test isolation).
+int MV_ProfilerClear(void);
+
 // ---- hot-key read replica (docs/embedding.md) ------------------------
 // Toggle replica-served matrix row reads live (the `-hotkey_replica`
 // flag is the boot value).  Armed, MatrixWorkerTable::GetRows consults

@@ -32,6 +32,14 @@ class Fault {
   // drives the retry-then-succeed chaos scenario.
   static bool FailSendAttempt();
 
+  // Consult once per server-side APPLY (ProcessGet/ProcessAdd): the
+  // returned milliseconds (0 = none) are slept INSIDE the apply stage,
+  // so the latency-attribution plane (docs/observability.md) can prove
+  // it pins a seeded slowdown on `lat.stage.apply` rather than the
+  // wire — the latdoctor acceptance scenario.  kind "apply_delay";
+  // the shared "delay_ms" knob sets the length.
+  static int64_t ApplyDelayMs();
+
   // kind: drop | delay | dup | fail_send (probability per op in [0,1]);
   // delay_ms sets the injected delay length.  Returns 0, -1 on unknown
   // kind / bad rate.

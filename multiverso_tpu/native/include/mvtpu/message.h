@@ -109,7 +109,36 @@ namespace msgflag {
 inline constexpr int32_t kAcceptRaw = 1 << 0;
 inline constexpr int32_t kAccept1Bit = 1 << 1;
 inline constexpr int32_t kAcceptSparse = 1 << 2;
+// Latency-attribution trail (docs/observability.md "latency plane"): a
+// TimingTrail follows the WireHeader on the wire.  VERSION-TOLERANT by
+// construction: a peer that never sets the bit ships the PR 3 header
+// unchanged and is parsed exactly as before; a receiver that does not
+// understand the bit would still frame correctly (the trail is inside
+// the length-prefixed frame) — replies only carry a trail when the
+// REQUEST did, so an old client is never handed bytes it cannot parse.
+inline constexpr int32_t kHasTiming = 1 << 3;
 }  // namespace msgflag
+
+// Wire-stamped request-lifecycle timing trail (docs/observability.md):
+// six monotonic-clock nanosecond stamps, each taken on whichever rank
+// owns the stage boundary.  Client-side stamps (enqueue/send) and
+// server-side stamps (recv/dequeue/apply_done/reply_send) live on
+// DIFFERENT clocks — cross-clock stage deltas are only meaningful after
+// the per-peer NTP-style offset correction (mvtpu/latency.h).  0 = the
+// stage boundary was never crossed (local delivery has no recv stamp;
+// an old peer stamps nothing).
+struct TimingTrail {
+  enum Stamp {
+    kEnqueue = 0,    // client: request minted (MakeReq)
+    kSend = 1,       // client: handed to the transport (Zoo::Deliver)
+    kRecv = 2,       // server: frame complete at the reactor/reader
+    kDequeue = 3,    // server: actor dequeued it (handler entry)
+    kApplyDone = 4,  // server: table work done, reply built
+    kReplySend = 5,  // server: reply handed to the transport
+    kStamps = 6,
+  };
+  int64_t t[kStamps] = {0, 0, 0, 0, 0, 0};
+};
 
 // Fixed-size wire header — ONE definition shared by Message::Serialize
 // (contiguous form: tests, MpiNet) and TcpNet's scatter-gather send
@@ -151,7 +180,14 @@ struct Message {
   // msgflag:: accept bits: the reply codecs this request's sender can
   // decode (stamped by Get/version requests; replies echo kAcceptRaw).
   int32_t flags = msgflag::kAcceptRaw;
+  // Latency trail — on the wire ONLY when flags carries kHasTiming
+  // (docs/observability.md): requests stamp the client-side slots,
+  // the server copies the trail into the reply and adds its own, and
+  // the client attributes the round trip per stage on reply receipt.
+  TimingTrail timing;
   std::vector<Blob> data;
+
+  bool has_timing() const { return (flags & msgflag::kHasTiming) != 0; }
 
   // Header <-> message field marshalling (shared by Serialize and the
   // transport's scatter-gather framing).

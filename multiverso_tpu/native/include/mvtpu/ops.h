@@ -40,7 +40,8 @@ namespace ops {
 void SetHostMetrics(const std::string& prom_text);
 
 // This rank's report for `kind` ("metrics" | "health" | "tables" |
-// "hotkeys").
+// "hotkeys" | "latency" — the latency-attribution plane's per-stage
+// histograms + clock offsets + profiler status).
 // Unknown kinds return a one-line JSON error instead of failing — a
 // scraper probing a newer protocol must not kill the connection.
 std::string LocalReport(const std::string& kind);

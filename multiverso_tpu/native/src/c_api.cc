@@ -10,6 +10,8 @@
 #include "mvtpu/dashboard.h"
 #include "mvtpu/fault.h"
 #include "mvtpu/host_arena.h"
+#include "mvtpu/latency.h"
+#include "mvtpu/profiler.h"
 #include "mvtpu/mutex.h"
 #include "mvtpu/ops.h"
 #include "mvtpu/sketch.h"
@@ -638,6 +640,35 @@ int MV_WireStats(long long* sent_bytes, long long* recv_bytes,
 
 char* MV_OpsReport(const char* kind) {
   return MallocString(mvtpu::ops::LocalReport(kind ? kind : "health"));
+}
+
+// ---- latency attribution plane (docs/observability.md) ---------------
+
+int MV_SetWireTiming(int on) {
+  mvtpu::latency::Arm(on != 0);
+  return 0;
+}
+
+int MV_ClockOffset(int rank, long long* offset_ns, long long* rtt_ns) {
+  if (rank < 0) return -1;
+  int64_t off = 0, rtt = 0;
+  if (!mvtpu::latency::PeerOffset(rank, &off, &rtt)) return -2;
+  if (offset_ns) *offset_ns = off;
+  if (rtt_ns) *rtt_ns = rtt;
+  return 0;
+}
+
+int MV_SetProfiler(int hz) {
+  return mvtpu::profiler::Start(hz) ? 0 : -1;
+}
+
+char* MV_ProfilerDump(void) {
+  return MallocString(mvtpu::profiler::DumpFolded());
+}
+
+int MV_ProfilerClear(void) {
+  mvtpu::profiler::Clear();
+  return 0;
 }
 
 int MV_SetOpsHostMetrics(const char* prom_text) {

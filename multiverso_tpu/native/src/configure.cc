@@ -306,6 +306,22 @@ void RegisterDefaults() {
                "buffers so the scatter-gather send path never page-"
                "faults mid-write.  Best-effort — RLIMIT_MEMLOCK misses "
                "are counted in MV_ArenaStats, not fatal");
+    DefineBool("wire_timing", true,
+               "latency attribution (docs/observability.md): stamp a "
+               "48-byte TimingTrail into request/reply wire headers "
+               "(client enqueue/send, server recv/dequeue/apply_done/"
+               "reply_send) and fold replies into lat.stage.* "
+               "histograms + the per-peer NTP-style clock-offset "
+               "estimator.  Version-tolerant: peers that never stamp "
+               "are parsed exactly as before.  MV_SetWireTiming "
+               "toggles live (the overhead A/B)");
+    DefineInt("profile_hz", 0,
+              "boot the SIGPROF sampling profiler at this rate "
+              "(CPU-time sampling; folded stacks via MV_ProfilerDump "
+              "land in the Chrome trace beside spans).  0 (default) "
+              "boots disarmed; MV_SetProfiler toggles live.  97 Hz is "
+              "the house rate — prime, so it cannot phase-lock with "
+              "millisecond-periodic work");
     DefineInt("shed_storm_threshold", 0,
               "flight-recorder trigger: this many CONSECUTIVE busy-sheds "
               "(-server_inflight_max) dump the black box once per storm "

@@ -23,6 +23,7 @@
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/fault.h"
+#include "mvtpu/latency.h"
 #include "mvtpu/log.h"
 #include "mvtpu/net.h"
 #include "mvtpu/ops.h"
@@ -138,6 +139,9 @@ struct EpollNet::PendingFrame {
       ++n;
     };
     push(&head, sizeof(head));
+    // Latency trail rides between header and blob prefixes (message.cc
+    // Serialize order); head.frame_len already counts it (WireBytes).
+    if (msg.has_timing()) push(&msg.timing, sizeof(TimingTrail));
     for (size_t i = 0; i < msg.data.size(); ++i) {
       push(&lens[i], sizeof(int64_t));
       push(msg.data[i].data(), msg.data[i].size());
@@ -479,6 +483,9 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
   c->body_len = -1;
   c->body_got = 0;
   if (!ok) return false;
+  // Latency trail: frame-complete AT THE REACTOR — the stamp the
+  // mailbox stage starts from (docs/observability.md).
+  latency::StampRecv(&m);
 
   int peer = c->peer.load();
   if (c->accepted && peer < 0) {
@@ -520,6 +527,11 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
       ops::BuildReply(m, &reply);
       reply.src = rank_;
       reply.dst = m.src;
+      // The reactor IS this query's actor+applier: close the mailbox
+      // and apply stages here so a timed scrape still attributes.
+      latency::StampDequeue(&m);
+      latency::StampReply(m, &reply);
+      latency::StampSend(&reply);
       return Enqueue(c, reply, /*may_block=*/false);
     }
     // Fleet scope: the zoo fans out on a bounded detached thread —
@@ -550,6 +562,9 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
       busy.trace_id = m.trace_id;
       busy.src = rank_;
       busy.dst = peer;
+      latency::StampDequeue(&m);
+      latency::StampReply(m, &busy);
+      latency::StampSend(&busy);
       // Reactor thread: never block on our own write queue.
       return Enqueue(c, busy, /*may_block=*/false);
     }

@@ -21,6 +21,7 @@ struct State {
   Knob delay;
   Knob dup;
   Knob fail_send;
+  Knob apply_delay;
   int64_t delay_ms = 50;
   uint64_t rng = 0x9e3779b97f4a7c15ull;
 };
@@ -64,6 +65,7 @@ Knob* Find(const char* kind) REQUIRES(g_mu) {
   if (k == "delay") return &S().delay;
   if (k == "dup") return &S().dup;
   if (k == "fail_send") return &S().fail_send;
+  if (k == "apply_delay") return &S().apply_delay;
   return nullptr;
 }
 
@@ -71,7 +73,7 @@ void Recompute() REQUIRES(g_mu) {
   State& s = S();
   auto live = [](const Knob& k) { return k.rate > 0.0 || k.budget > 0; };
   g_enabled.store(live(s.drop) || live(s.delay) || live(s.dup) ||
-                      live(s.fail_send),
+                      live(s.fail_send) || live(s.apply_delay),
                   std::memory_order_relaxed);
 }
 
@@ -94,6 +96,7 @@ void InitFromEnvLocked() REQUIRES(g_mu) {
   s.delay.rate = EnvRate("MVTPU_FAULT_DELAY");
   s.dup.rate = EnvRate("MVTPU_FAULT_DUP");
   s.fail_send.rate = EnvRate("MVTPU_FAULT_FAIL_SEND");
+  s.apply_delay.rate = EnvRate("MVTPU_FAULT_APPLY_DELAY");
   if (const char* v = getenv("MVTPU_FAULT_DELAY_MS")) s.delay_ms = atoll(v);
   Recompute();
 }
@@ -127,6 +130,15 @@ Fault::Action Fault::OnSend(int64_t* delay_ms) {
     return Action::kDuplicate;
   }
   return Action::kNone;
+}
+
+int64_t Fault::ApplyDelayMs() {
+  if (!Enabled()) return 0;
+  MutexLock lk(g_mu);
+  if (!Fire(&S().apply_delay)) return 0;
+  int64_t ms = S().delay_ms;
+  Recompute();
+  return ms;
 }
 
 bool Fault::FailSendAttempt() {
@@ -171,6 +183,7 @@ void Fault::Clear() {
   s.delay = Knob{};
   s.dup = Knob{};
   s.fail_send = Knob{};
+  s.apply_delay = Knob{};
   Recompute();
 }
 

@@ -32,6 +32,7 @@ void Message::AdoptWireHeader(const WireHeader& h) {
 
 int64_t Message::WireBytes() const {
   int64_t total = static_cast<int64_t>(sizeof(WireHeader));
+  if (has_timing()) total += static_cast<int64_t>(sizeof(TimingTrail));
   for (const auto& b : data)
     total += static_cast<int64_t>(sizeof(int64_t) + b.size());
   return total;
@@ -44,6 +45,10 @@ Blob Message::Serialize() const {
   FillWireHeader(&h);
   std::memcpy(p, &h, sizeof(h));
   p += sizeof(h);
+  if (has_timing()) {
+    std::memcpy(p, &timing, sizeof(timing));
+    p += sizeof(timing);
+  }
   for (const auto& b : data) {
     int64_t len = static_cast<int64_t>(b.size());
     std::memcpy(p, &len, sizeof(len));
@@ -62,6 +67,17 @@ bool Message::DeserializeView(std::shared_ptr<std::vector<char>> slab,
   std::memcpy(&h, base, sizeof(h));
   out->AdoptWireHeader(h);
   out->data.clear();
+  out->timing = TimingTrail{};
+  size_t pos = sizeof(h);
+  // Optional latency trail (docs/observability.md): present iff the
+  // sender set kHasTiming — an old-header frame parses exactly as
+  // before, and a flagged frame too short to hold the trail is
+  // malformed, not a silent misparse of blob bytes as timestamps.
+  if (out->has_timing()) {
+    if (len < sizeof(WireHeader) + sizeof(TimingTrail)) return false;
+    std::memcpy(&out->timing, base + pos, sizeof(TimingTrail));
+    pos += sizeof(TimingTrail);
+  }
   // num_blobs comes off the wire: bound it against the frame BEFORE the
   // reserve — each blob costs at least its 8-byte length prefix, so a
   // frame of `len` bytes cannot hold more than (len - header)/8 blobs.
@@ -69,10 +85,8 @@ bool Message::DeserializeView(std::shared_ptr<std::vector<char>> slab,
   // INT32_MAX blobs and force a multi-GB allocation the frame caps
   // exist to prevent.
   if (h.num_blobs < 0 ||
-      static_cast<size_t>(h.num_blobs) >
-          (len - sizeof(WireHeader)) / sizeof(int64_t))
+      static_cast<size_t>(h.num_blobs) > (len - pos) / sizeof(int64_t))
     return false;
-  size_t pos = sizeof(h);
   out->data.reserve(static_cast<size_t>(h.num_blobs));
   for (int32_t i = 0; i < h.num_blobs; ++i) {
     if (pos + sizeof(int64_t) > len) return false;
@@ -105,6 +119,10 @@ Message Message::Deserialize(const Blob& buf) {
   std::memcpy(&h, p, sizeof(h));
   p += sizeof(h);
   m.AdoptWireHeader(h);
+  if (m.has_timing()) {
+    std::memcpy(&m.timing, p, sizeof(m.timing));
+    p += sizeof(m.timing);
+  }
   m.data.reserve(static_cast<size_t>(h.num_blobs));
   for (int32_t i = 0; i < h.num_blobs; ++i) {
     int64_t len;

@@ -9,6 +9,7 @@
 
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
+#include "mvtpu/latency.h"
 #include "mvtpu/log.h"
 
 namespace mvtpu {
@@ -279,7 +280,9 @@ void MpiNet::ProbeLoop() {
     }
     if (got) {
       Dashboard::Record("net.bytes.recv", static_cast<double>(buf.size()));
-      inbound_(Message::Deserialize(buf));  // outside the MPI lock
+      Message m = Message::Deserialize(buf);
+      latency::StampRecv(&m);  // frame-complete on the MPI wire
+      inbound_(std::move(m));  // outside the MPI lock
     } else
       std::this_thread::sleep_for(std::chrono::microseconds(200));
   }

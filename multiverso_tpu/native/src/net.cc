@@ -18,6 +18,7 @@
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/fault.h"
+#include "mvtpu/latency.h"
 #include "mvtpu/log.h"
 
 namespace mvtpu {
@@ -153,8 +154,13 @@ bool TcpNet::SendFramed(int fd, const Message& msg) {
   msg.FillWireHeader(&head.h);
   std::vector<int64_t> lens(msg.data.size());
   std::vector<iovec> iov;
-  iov.reserve(1 + 2 * msg.data.size());
+  iov.reserve(2 + 2 * msg.data.size());
   iov.push_back({&head, sizeof(head)});
+  // Latency trail (docs/observability.md): rides between the header and
+  // the blob prefixes when stamped — WireBytes() already counts it.
+  if (msg.has_timing())
+    iov.push_back({const_cast<TimingTrail*>(&msg.timing),
+                   sizeof(TimingTrail)});
   for (size_t i = 0; i < msg.data.size(); ++i) {
     lens[i] = static_cast<int64_t>(msg.data[i].size());
     iov.push_back({&lens[i], sizeof(int64_t)});
@@ -435,6 +441,9 @@ void TcpNet::ReadLoop(int fd) {
     // total = bytes (1 unit = 1 byte) — MV_WireStats / the Python
     // net.bytes{dir=recv} bridge read both from this one monitor.
     Dashboard::Record("net.bytes.recv", static_cast<double>(frame_bytes));
+    // Latency trail: frame-complete stamp (the reader thread is this
+    // engine's "reactor" boundary) — requests only, stamp-if-zero.
+    latency::StampRecv(&m);
     if (inbound_) inbound_(std::move(m));
   }
 }

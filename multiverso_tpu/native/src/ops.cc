@@ -9,8 +9,10 @@
 
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
+#include "mvtpu/latency.h"
 #include "mvtpu/log.h"
 #include "mvtpu/mutex.h"
+#include "mvtpu/profiler.h"
 #include "mvtpu/zoo.h"
 
 namespace mvtpu {
@@ -139,6 +141,107 @@ std::string RenderNativePrometheus() {
   return os.str();
 }
 
+// Interpolated q-quantile out of the Dashboard's fixed log2 buckets
+// (bucket i holds values <= 1e-6 * 2^i seconds; the last is +inf) —
+// the native mirror of metrics.py Histogram.quantile, so latdoctor and
+// a Python scrape agree to within one bucket ratio.
+double BucketQuantile(const std::vector<long long>& buckets,
+                      long long count, double vmax, double q) {
+  if (count <= 0 || buckets.empty()) return 0.0;
+  double target = q * static_cast<double>(count);
+  long long cum = 0;
+  double bound = 1e-6;
+  for (size_t i = 0; i < buckets.size(); ++i) {
+    long long c = buckets[i];
+    if (c > 0 && static_cast<double>(cum + c) >= target) {
+      double lo = i > 0 ? bound / 2.0 : 0.0;
+      double hi = i + 1 < buckets.size() ? bound : vmax;
+      double v = lo + (hi - lo) * (target - static_cast<double>(cum)) /
+                          static_cast<double>(c);
+      return std::min(v, vmax > 0 ? vmax : v);
+    }
+    cum += c;
+    if (i + 1 < buckets.size()) bound *= 2.0;
+  }
+  return vmax;
+}
+
+// One stage's JSON object from a parsed MV_DumpMonitors line.
+std::string StageJson(const std::vector<std::string>& fields) {
+  long long count = std::stoll(fields[1]);
+  double total = std::stod(fields[2]);
+  double vmax = std::stod(fields[3]);
+  auto buckets = SplitCsv(fields[4]);
+  std::ostringstream os;
+  os << "{\"count\":" << count << ",\"sum_s\":" << FmtDouble(total)
+     << ",\"max_ms\":" << FmtDouble(vmax * 1e3);
+  for (auto [name, q] : {std::pair<const char*, double>{"p50_ms", 0.50},
+                         {"p95_ms", 0.95},
+                         {"p99_ms", 0.99}})
+    os << ",\"" << name << "\":"
+       << FmtDouble(BucketQuantile(buckets, count, vmax, q) * 1e3);
+  if (fields.size() >= 6) {
+    // The p99 bucket's exemplar trace id (0 = none): the link from a
+    // slow stage straight into the merged Chrome trace.
+    auto exemplars = SplitCsv(fields[5]);
+    double target = 0.99 * static_cast<double>(count);
+    long long cum = 0;
+    long long ex = 0;
+    for (size_t i = 0; i < buckets.size() && i < exemplars.size(); ++i) {
+      cum += buckets[i];
+      if (buckets[i] > 0 && exemplars[i] != 0) ex = exemplars[i];
+      if (static_cast<double>(cum) >= target && ex != 0) break;
+    }
+    if (ex != 0) {
+      char hex[32];
+      std::snprintf(hex, sizeof(hex), "0x%llx",
+                    static_cast<unsigned long long>(ex));
+      os << ",\"exemplar_p99\":\"" << hex << "\"";
+    }
+  }
+  os << "}";
+  return os.str();
+}
+
+// The "latency" OpsQuery kind (docs/observability.md "latency plane"):
+// per-stage histograms (from the lat.stage.* Dashboard monitors the
+// timing trail feeds), the end-to-end lat.total, per-peer clock
+// offsets, and the sampling profiler's status — everything latdoctor
+// needs to name the dominant stage per percentile.  Fleet scope comes
+// free through the generic JSON merge.
+std::string LatencyJson() {
+  std::ostringstream os;
+  os << "{\"rank\":" << Zoo::Get()->rank();
+  os << ",\"armed\":" << (latency::Armed() ? "true" : "false");
+  os << ",\"stages\":{";
+  bool first = true;
+  std::string total_json;
+  std::istringstream in(Dashboard::Dump());
+  std::string line;
+  while (std::getline(in, line)) {
+    if (line.empty()) continue;
+    auto fields = SplitTabs(line);
+    if (fields.size() < 5) continue;
+    const std::string& name = fields[0];
+    if (name == "lat.total") {
+      total_json = StageJson(fields);
+      continue;
+    }
+    constexpr const char kPrefix[] = "lat.stage.";
+    if (name.rfind(kPrefix, 0) != 0) continue;
+    if (!first) os << ',';
+    first = false;
+    os << "\"" << name.substr(sizeof(kPrefix) - 1) << "\":"
+       << StageJson(fields);
+  }
+  os << "}";
+  if (!total_json.empty()) os << ",\"total\":" << total_json;
+  os << ",\"offsets\":" << latency::OffsetsJson();
+  os << ",\"profiler\":" << profiler::StatusJson();
+  os << "}";
+  return os.str();
+}
+
 }  // namespace
 
 std::string PromName(const std::string& name) {
@@ -171,6 +274,9 @@ std::string LocalReport(const std::string& kind) {
   // Workload plane (docs/observability.md): per-table hot-key top-K +
   // count-min estimates, bucket-load skew, staleness, health sentinels.
   if (kind == "hotkeys") return Zoo::Get()->OpsHotKeysJson();
+  // Latency-attribution plane (docs/observability.md): stage
+  // histograms + clock offsets + profiler status.
+  if (kind == "latency") return LatencyJson();
   return "{\"error\":\"unknown ops kind '" + JsonEscape(kind) + "'\"}";
 }
 

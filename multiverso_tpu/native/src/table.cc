@@ -19,6 +19,7 @@
 #include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
+#include "mvtpu/latency.h"
 #include "mvtpu/log.h"
 #include "mvtpu/ops.h"
 #include "mvtpu/zoo.h"
@@ -718,6 +719,20 @@ void WorkerTable::FlushAdds() {
 }
 
 void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
+  // Latency attribution: fold the reply's timing trail into the
+  // per-stage histograms + the peer clock-offset estimator BEFORE the
+  // pending lookup — an expired round trip's reply still carries a
+  // complete (and perfectly valid) stage breakdown.  The reply's trace
+  // id is adopted for the scope so the stage buckets capture it as
+  // their EXEMPLAR (PR 7): a p99 stage links straight into the merged
+  // Chrome trace that explains it.
+  {
+    int64_t prev_tid = Dashboard::ThreadTraceId();
+    bool adopt = reply.trace_id != 0 && Dashboard::TraceEnabled();
+    if (adopt) Dashboard::SetThreadTraceId(reply.trace_id);
+    latency::OnReply(reply, reply.src);
+    if (adopt) Dashboard::SetThreadTraceId(prev_tid);
+  }
   // Serve layer: every reply's version stamp refreshes the free local
   // lower bound on the server version (max-merge; replies can race).
   if (reply.version > 0) {
@@ -881,6 +896,9 @@ MessagePtr MakeReq(MsgType type, int32_t table_id, int64_t msg_id,
   req->trace_id = Dashboard::ThreadTraceId();
   req->src = Zoo::Get()->rank();
   req->dst = Zoo::Get()->server_rank(shard_idx);
+  // Latency trail (docs/observability.md): the enqueue stamp opens the
+  // client queue stage; the reply's trail closes the whole breakdown.
+  latency::StampEnqueue(req.get());
   return req;
 }
 

@@ -11,7 +11,10 @@
 #include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
+#include "mvtpu/fault.h"
+#include "mvtpu/latency.h"
 #include "mvtpu/log.h"
+#include "mvtpu/profiler.h"
 #include "mvtpu/mpi_net.h"
 #include "mvtpu/ops.h"
 #include "mvtpu/sketch.h"
@@ -115,6 +118,10 @@ class ServerActor : public Actor {
  public:
   ServerActor() : Actor(actor::kServer) {
     RegisterHandler(MsgType::RequestGet, [](MessagePtr& m) {
+      // Latency trail (docs/observability.md): the dequeue stamp closes
+      // the mailbox stage — taken BEFORE the shed/SSP checks so a shed
+      // or park is attributed to the mailbox, not the apply.
+      latency::StampDequeue(m.get());
       auto* table = Zoo::Get()->server_table(m->table_id);
       if (!table) {  // misrouted: this rank has no server role/shard
         Log::Error("RequestGet for table %d on non-server rank",
@@ -138,7 +145,18 @@ class ServerActor : public Actor {
       // server-side ProcessGet monitor's span (and any send it triggers)
       // correlates with the worker's Get across ranks.
       TraceScope scope(m->trace_id);
+      // Seeded apply-path slowdown (docs/fault_tolerance.md): sleeps
+      // INSIDE the dequeue->apply_done stage so the latency plane can
+      // prove it names `apply`, not the wire (latdoctor acceptance).
+      if (Fault::Enabled()) {
+        int64_t d = Fault::ApplyDelayMs();
+        if (d > 0) {
+          Dashboard::Record("fault.apply_delay", 0.0);
+          std::this_thread::sleep_for(std::chrono::milliseconds(d));
+        }
+      }
       table->ProcessGet(*m, reply.get());
+      latency::StampReply(*m, reply.get());
       // Reply-codec negotiation: a requester that advertised
       // kAcceptSparse gets a lossless sparse payload when smaller.
       codec::MaybeEncodeReply(reply.get(), m->flags);
@@ -147,6 +165,7 @@ class ServerActor : public Actor {
     RegisterHandler(MsgType::RequestVersion, [](MessagePtr& m) {
       // Serve-layer probe: answer with the current table (or bucket)
       // version — a header-only reply, no payload, no table lock.
+      latency::StampDequeue(m.get());
       auto* table = Zoo::Get()->server_table(m->table_id);
       if (!table) {
         Log::Error("RequestVersion for table %d on non-server rank",
@@ -165,6 +184,7 @@ class ServerActor : public Actor {
                            ? table->bucket_version(
                                  static_cast<int>(m->version))
                            : table->version();
+      latency::StampReply(*m, reply.get());
       Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
     });
     RegisterHandler(MsgType::RequestReplica, [](MessagePtr& m) {
@@ -172,6 +192,7 @@ class ServerActor : public Actor {
       // shard's current SpaceSaving top-K rows + bucket versions.  A
       // read, so it sheds under backpressure exactly like a Get —
       // never competes with adds.
+      latency::StampDequeue(m.get());
       auto* table = Zoo::Get()->server_table(m->table_id);
       if (!table) {
         Log::Error("RequestReplica for table %d on non-server rank",
@@ -188,12 +209,14 @@ class ServerActor : public Actor {
       reply->dst = m->src;
       TraceScope scope(m->trace_id);
       table->BuildReplica(reply.get());
+      latency::StampReply(*m, reply.get());
       Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
     });
     RegisterHandler(MsgType::ClockTick, [](MessagePtr& m) {
       Zoo::Get()->OnClockTick(m->src, m->msg_id);
     });
     RegisterHandler(MsgType::RequestAdd, [](MessagePtr& m) {
+      latency::StampDequeue(m.get());
       auto* table = Zoo::Get()->server_table(m->table_id);
       if (!table) {
         Log::Error("RequestAdd for table %d on non-server rank",
@@ -210,6 +233,13 @@ class ServerActor : public Actor {
         return;
       }
       TraceScope scope(m->trace_id);  // correlate apply with the Add
+      if (Fault::Enabled()) {
+        int64_t d = Fault::ApplyDelayMs();
+        if (d > 0) {
+          Dashboard::Record("fault.apply_delay", 0.0);
+          std::this_thread::sleep_for(std::chrono::milliseconds(d));
+        }
+      }
       table->ProcessAdd(*m);
       if (m->msg_id >= 0) {  // blocking add wants an ack
         auto reply = std::make_unique<Message>();
@@ -222,6 +252,7 @@ class ServerActor : public Actor {
         // The ack carries the post-apply version: a write-through
         // client learns its own add's version for free (serving.md).
         reply->version = table->version();
+        latency::StampReply(*m, reply.get());
         Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
       }
     });
@@ -252,7 +283,25 @@ class ControllerActor : public Actor {
       Zoo::Get()->OnBarrierRelease(m->msg_id);
     });
     RegisterHandler(MsgType::Heartbeat, [](MessagePtr& m) {
+      // Rank 0 never announces, so src==0 means this is rank 0's ECHO
+      // of our own timed heartbeat — an NTP sample for the rank-0
+      // clock offset (docs/observability.md), nothing lease-related.
+      if (m->src == 0) {
+        latency::OnReply(*m, 0);
+        return;
+      }
+      latency::StampDequeue(m.get());
       Zoo::Get()->OnHeartbeat(m->src);
+      if (m->has_timing()) {
+        // Echo the trail back so the announcing rank can close the
+        // NTP round trip over the heartbeat RTT (PR 2's lease wire).
+        auto echo = std::make_unique<Message>();
+        echo->type = MsgType::Heartbeat;
+        echo->src = Zoo::Get()->rank();
+        echo->dst = m->src;
+        latency::StampReply(*m, echo.get());
+        Zoo::Get()->Deliver(actor::kController, std::move(echo));
+      }
     });
   }
 };
@@ -409,6 +458,11 @@ bool Zoo::Start(int argc, const char* const* argv) {
   // it live for armed-vs-disarmed overhead A/Bs).
   workload::Arm(configure::GetBool("hotkey_enabled"));
   workload::ArmReplica(configure::GetBool("hotkey_replica"));
+  // Latency plane (docs/observability.md): -wire_timing latches the
+  // header-trail stamping; -profile_hz boots the SIGPROF sampler.
+  latency::Arm(configure::GetBool("wire_timing"));
+  if (configure::GetInt("profile_hz") > 0)
+    profiler::Start(static_cast<int>(configure::GetInt("profile_hz")));
   if (configure::GetBool("trace")) Dashboard::SetTraceEnabled(true);
   started_ = true;
   ops::BlackboxEvent("lifecycle",
@@ -445,6 +499,7 @@ void Zoo::Stop() {
   if (size_ > 1) Barrier();
   else FlushWorkerAdds();
   ops::BlackboxEvent("lifecycle", "stop rank " + std::to_string(rank_));
+  if (configure::GetInt("profile_hz") > 0) profiler::Stop();
   // Lease loop dies before the transport it sends through.
   if (hb_running_.exchange(false)) {
     if (hb_thread_.joinable()) hb_thread_.join();
@@ -714,6 +769,11 @@ void Zoo::HeartbeatLoop() {
       hb.type = MsgType::Heartbeat;
       hb.src = rank_;
       hb.dst = 0;
+      // Timed lease renewal: the echo closes an NTP offset sample for
+      // rank 0 (docs/observability.md), so every heartbeat interval
+      // refreshes the cross-rank clock estimate for free.
+      latency::StampEnqueue(&hb);
+      latency::StampSend(&hb);
       if (net_) net_->Send(0, hb);
       continue;
     }
@@ -972,6 +1032,7 @@ bool Zoo::ShedIfOverloaded(MessagePtr& msg) {
   reply->trace_id = msg->trace_id;
   reply->src = rank_;
   reply->dst = msg->src;
+  latency::StampReply(*msg, reply.get());
   Deliver(actor::kWorker, std::move(reply));
   return true;
 }
@@ -1373,6 +1434,11 @@ void Zoo::SendTo(const std::string& actor_name, MessagePtr msg) {
 }
 
 void Zoo::Deliver(const std::string& actor_name, MessagePtr msg) {
+  // Latency trail: the transport hand-off stamp (requests close the
+  // client queue stage, replies open the wire_back stage) — taken for
+  // local deliveries too, so a single process still attributes its
+  // mailbox and apply stages.
+  latency::StampSend(msg.get());
   if (msg->dst < 0 || msg->dst == rank_ || !net_) {
     SendTo(actor_name, std::move(msg));
     return;

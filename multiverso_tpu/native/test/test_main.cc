@@ -22,6 +22,7 @@
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/host_arena.h"
+#include "mvtpu/latency.h"
 #include "mvtpu/message.h"
 #include "mvtpu/mpi_net.h"
 #include "mvtpu/mt_queue.h"
@@ -193,6 +194,96 @@ static int TestMessage() {
   CHECK(back.data[0].count<float>() == 3);
   CHECK(back.data[0].As<float>()[2] == 3.0f);
   CHECK(back.data[1].As<int32_t>()[1] == 5);
+  return 0;
+}
+
+static int TestLatencyTrail() {
+  using mvtpu::latency::NowNs;
+  mvtpu::latency::Reset();
+  mvtpu::latency::Arm(true);
+
+  // ---- trail rides the wire only when flagged (version tolerance) ---
+  mvtpu::Message plain;
+  plain.type = mvtpu::MsgType::RequestGet;
+  float payload[2] = {1.0f, 2.0f};
+  plain.data.emplace_back(payload, sizeof(payload));
+  int64_t plain_bytes = plain.WireBytes();
+  mvtpu::Message req = plain;
+  mvtpu::latency::StampEnqueue(&req);
+  CHECK(req.has_timing());
+  CHECK(req.WireBytes() == plain_bytes +
+        static_cast<int64_t>(sizeof(mvtpu::TimingTrail)));
+  mvtpu::latency::StampSend(&req);
+  mvtpu::Message back = mvtpu::Message::Deserialize(req.Serialize());
+  CHECK(back.has_timing());
+  CHECK(back.timing.t[mvtpu::TimingTrail::kEnqueue] ==
+        req.timing.t[mvtpu::TimingTrail::kEnqueue]);
+  CHECK(back.timing.t[mvtpu::TimingTrail::kSend] ==
+        req.timing.t[mvtpu::TimingTrail::kSend]);
+  // Old-header frame (no flag): parses exactly as before, no trail.
+  mvtpu::Message old_back = mvtpu::Message::Deserialize(plain.Serialize());
+  CHECK(!old_back.has_timing());
+  CHECK(old_back.data.size() == 1 && old_back.data[0].count<float>() == 2);
+  // Zero-copy path agrees.
+  mvtpu::Blob w = req.Serialize();
+  auto slab = std::make_shared<std::vector<char>>(w.data(),
+                                                  w.data() + w.size());
+  mvtpu::Message view;
+  CHECK(mvtpu::Message::DeserializeView(slab, 0, slab->size(), &view));
+  CHECK(view.has_timing());
+  CHECK(view.timing.t[mvtpu::TimingTrail::kSend] ==
+        req.timing.t[mvtpu::TimingTrail::kSend]);
+  // A flagged frame too short for the trail is malformed, not misread.
+  auto runt = std::make_shared<std::vector<char>>(
+      slab->begin(), slab->begin() + sizeof(mvtpu::WireHeader));
+  mvtpu::Message bad;
+  CHECK(!mvtpu::Message::DeserializeView(runt, 0, runt->size(), &bad));
+
+  // ---- stamp-once / reply-slot discipline ---------------------------
+  mvtpu::latency::StampRecv(&back);
+  int64_t recv1 = back.timing.t[mvtpu::TimingTrail::kRecv];
+  CHECK(recv1 != 0);
+  mvtpu::latency::StampRecv(&back);  // duplicate keeps the first
+  CHECK(back.timing.t[mvtpu::TimingTrail::kRecv] == recv1);
+  mvtpu::latency::StampDequeue(&back);
+  mvtpu::Message reply;
+  reply.type = mvtpu::MsgType::ReplyGet;
+  mvtpu::latency::StampReply(back, &reply);
+  CHECK(reply.has_timing());
+  CHECK(reply.timing.t[mvtpu::TimingTrail::kApplyDone] != 0);
+  mvtpu::latency::StampSend(&reply);  // reply type -> reply-send slot
+  CHECK(reply.timing.t[mvtpu::TimingTrail::kReplySend] != 0);
+  CHECK(reply.timing.t[mvtpu::TimingTrail::kSend] ==
+        req.timing.t[mvtpu::TimingTrail::kSend]);
+
+  // ---- OnReply: stages recorded + an offset estimate materializes ---
+  // Simulate a peer clock running exactly 5 ms ahead by shifting the
+  // server-side stamps; the NTP sample must recover ~that offset.
+  const int64_t kShift = 5'000'000;
+  reply.timing.t[mvtpu::TimingTrail::kRecv] += kShift;
+  reply.timing.t[mvtpu::TimingTrail::kDequeue] += kShift;
+  reply.timing.t[mvtpu::TimingTrail::kApplyDone] += kShift;
+  reply.timing.t[mvtpu::TimingTrail::kReplySend] += kShift;
+  mvtpu::Dashboard::Reset();
+  mvtpu::latency::OnReply(reply, 3);
+  long long n = 0;
+  CHECK(mvtpu::Dashboard::Query("lat.total", &n, nullptr) && n == 1);
+  CHECK(mvtpu::Dashboard::Query("lat.stage.apply", &n, nullptr) && n == 1);
+  int64_t off = 0, rtt = 0;
+  CHECK(mvtpu::latency::PeerOffset(3, &off, &rtt));
+  // The estimate absorbs the handler wall time between the stamps, so
+  // only bound it loosely around the injected shift.
+  CHECK(off > kShift / 2 && off < kShift * 2);
+  CHECK(rtt >= 0);
+  CHECK(!mvtpu::latency::PeerOffset(99, &off, &rtt));
+
+  // Disarmed: StampEnqueue mints nothing.
+  mvtpu::latency::Arm(false);
+  mvtpu::Message dis;
+  mvtpu::latency::StampEnqueue(&dis);
+  CHECK(!dis.has_timing());
+  mvtpu::latency::Arm(true);
+  mvtpu::latency::Reset();
   return 0;
 }
 
@@ -2502,6 +2593,7 @@ int main(int argc, char** argv) {
       {"blob", TestBlob},         {"blob_borrow", TestBlobBorrow},
       {"arena", TestArena},       {"queue", TestQueue},
       {"configure", TestConfigure}, {"message", TestMessage},
+      {"latency", TestLatencyTrail},
       {"codec", TestCodec},
       {"dashboard", TestDashboard},
       {"updater", TestUpdater},   {"array", TestArray},

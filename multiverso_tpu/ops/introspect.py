@@ -114,6 +114,16 @@ class OpsClient:
         usual ``{"ranks": {...}, "silent": [...]}`` wrapper."""
         return json.loads(self.report("hotkeys", fleet=fleet))
 
+    def latency(self, fleet: bool = False):
+        """Latency-attribution report (docs/observability.md "latency
+        plane"): per-stage histograms (``queue``/``wire_out``/
+        ``mailbox``/``apply``/``reactor``/``wire_back`` p50/p95/p99
+        with exemplar trace ids), the end-to-end ``total``, per-peer
+        clock offsets, and the sampling profiler's status.  Fleet
+        scope returns the usual ``{"ranks": {...}}`` wrapper —
+        ``tools/latdoctor.py`` is the CLI over this."""
+        return json.loads(self.report("latency", fleet=fleet))
+
     def metrics(self, fleet: bool = False) -> Tuple[
             Dict[str, float], Dict[str, Dict[str, str]]]:
         """(values, exemplars) of the scraped exposition text."""

@@ -38,15 +38,23 @@ from __future__ import annotations
 
 import socket
 import struct
+import time
 from typing import Optional
 
 import numpy as np
 
 __all__ = ["AnonServeClient", "MSG", "pack_frame", "unpack_frame",
-           "HEADER", "OPS_SCOPE_LOCAL", "OPS_SCOPE_FLEET"]
+           "HEADER", "TIMING", "FLAG_TIMING", "STAGES",
+           "stage_durations", "ntp_sample", "OffsetEstimator",
+           "OPS_SCOPE_LOCAL", "OPS_SCOPE_FLEET"]
 
 # WireHeader (mvtpu/message.h): 4 x int32, 3 x int64, 4 x int32.
 HEADER = struct.Struct("<4i3q4i")
+# TimingTrail (mvtpu/message.h): six int64 monotonic-ns stage stamps
+# following the header when FLAG_TIMING is set — enqueue, send, recv,
+# dequeue, apply_done, reply_send (docs/observability.md).
+TIMING = struct.Struct("<6q")
+FLAG_TIMING = 1 << 3  # msgflag::kHasTiming
 _LEN = struct.Struct("<q")
 
 # MsgType values used by the serve protocol (mvtpu/message.h).
@@ -78,12 +86,19 @@ _ACCEPT_RAW = 1  # msgflag::kAcceptRaw
 
 
 def pack_frame(msg_type: int, table_id: int, msg_id: int, *,
-               version: int = -1, blobs=()) -> bytes:
+               version: int = -1, blobs=(), timing: bool = False) -> bytes:
     """One wire frame.  ``src=-1`` is what makes the connection
     anonymous: the reactor sees no valid rank in the first frame and
-    assigns a pseudo-rank instead."""
+    assigns a pseudo-rank instead.  ``timing=True`` stamps a latency
+    trail (enqueue+send = now, monotonic ns) after the header — the
+    server echoes and extends it, and the reply's trail attributes the
+    round trip per stage (docs/observability.md "latency plane")."""
+    flags = _ACCEPT_RAW | (FLAG_TIMING if timing else 0)
     body = HEADER.pack(-1, -1, msg_type, table_id, msg_id, 0, version,
-                       0, _ACCEPT_RAW, len(blobs), 0)
+                       0, flags, len(blobs), 0)
+    if timing:
+        now = time.monotonic_ns()
+        body += TIMING.pack(now, now, 0, 0, 0, 0)
     for b in blobs:
         body += _LEN.pack(len(b)) + bytes(b)
     return _LEN.pack(len(body)) + body
@@ -95,6 +110,10 @@ def unpack_frame(body: bytes) -> dict:
      num_blobs, _pad) = HEADER.unpack_from(body, 0)
     blobs = []
     pos = HEADER.size
+    timing = None
+    if flags & FLAG_TIMING:
+        timing = TIMING.unpack_from(body, pos)
+        pos += TIMING.size
     for _ in range(num_blobs):
         (blen,) = _LEN.unpack_from(body, pos)
         pos += _LEN.size
@@ -104,7 +123,82 @@ def unpack_frame(body: bytes) -> dict:
             "type_name": _TYPE_NAME.get(mtype, str(mtype)),
             "table_id": table_id, "msg_id": msg_id, "trace_id": trace_id,
             "version": version, "codec": codec, "flags": flags,
-            "blobs": blobs}
+            "timing": timing, "blobs": blobs}
+
+
+# Stage names, in trail order (docs/observability.md "latency plane").
+STAGES = ("queue", "wire_out", "mailbox", "apply", "reactor", "wire_back")
+
+
+def ntp_sample(trail, now_ns: int):
+    """One NTP offset sample from a reply's timing trail: ``(offset_ns,
+    rtt_ns)`` where offset is how far the SERVER's monotonic clock runs
+    ahead of ours, rtt the round trip minus the server hold time.
+    ``None`` when the trail never crossed the wire (local serve)."""
+    t_send, t_recv, t_reply = trail[1], trail[2], trail[5]
+    if not (t_send and t_recv and t_reply):
+        return None
+    offset = ((t_recv - t_send) + (t_reply - now_ns)) // 2
+    rtt = (now_ns - t_send) - (t_reply - t_recv)
+    return (offset, rtt) if rtt >= 0 else None
+
+
+def stage_durations(trail, now_ns: int, offset_ns: int = 0) -> dict:
+    """Per-stage durations (SECONDS, clamped at 0) from a reply's
+    timing trail — the Python mirror of the native latency plane's
+    attribution math.  Cross-clock stages (wire_out / wire_back) are
+    corrected by ``offset_ns``; with a good estimate the stage sum
+    telescopes back to ``total`` exactly."""
+    t_enq, t_send, t_recv, t_deq, t_apply, t_reply = trail
+    out = {}
+
+    def put(name, ns):
+        out[name] = max(ns, 0) * 1e-9
+
+    if t_enq and t_send:
+        put("queue", t_send - t_enq)
+    remote = t_send and t_recv and t_reply
+    if remote:
+        put("wire_out", (t_recv - offset_ns) - t_send)
+        if t_deq:
+            put("mailbox", t_deq - t_recv)
+    elif t_send and t_deq:
+        put("mailbox", t_deq - t_send)
+    if t_deq and t_apply:
+        put("apply", t_apply - t_deq)
+    if t_apply and t_reply:
+        put("reactor", t_reply - t_apply)
+    if t_reply:
+        put("wire_back",
+            now_ns - (t_reply - offset_ns) if remote else now_ns - t_reply)
+    if t_enq:
+        put("total", now_ns - t_enq)
+    return out
+
+
+class OffsetEstimator:
+    """Bounded-window NTP clock filter (the native latency.cc mirror):
+    feed every ``(offset, rtt)`` sample; the minimum-RTT sample of the
+    last ``window`` wins — queueing delay inflates RTT and,
+    asymmetrically, offset error."""
+
+    def __init__(self, window: int = 8):
+        self._ring = []          # [(rtt, offset)]
+        self._window = max(1, int(window))
+        self.samples = 0
+
+    def update(self, offset_ns: int, rtt_ns: int) -> None:
+        self._ring.append((int(rtt_ns), int(offset_ns)))
+        del self._ring[:-self._window]
+        self.samples += 1
+
+    @property
+    def offset_ns(self) -> int:
+        return min(self._ring)[1] if self._ring else 0
+
+    @property
+    def rtt_ns(self) -> Optional[int]:
+        return min(self._ring)[0] if self._ring else None
 
 
 class AnonServeClient:
@@ -113,15 +207,31 @@ class AnonServeClient:
     Blocking convenience wrapper; the fan-in bench/demo drive hundreds
     of these sockets through ``selectors`` instead (send ``request()``
     bytes, feed received bytes to a :class:`FrameDecoder`).
+
+    With ``timing=True`` (the default) every request carries a latency
+    trail; each reply then refreshes :attr:`offset` (the NTP-style
+    server clock-offset estimate) and :attr:`last_stages` — the
+    per-stage breakdown of that round trip, in seconds
+    (docs/observability.md "latency plane").  A pre-trail server (or
+    ``timing=False``) simply leaves both untouched: the old header
+    round-trips exactly as before.
     """
 
-    def __init__(self, endpoint: str, timeout: Optional[float] = 30.0):
+    def __init__(self, endpoint: str, timeout: Optional[float] = 30.0,
+                 timing: bool = True):
         host, port = endpoint.rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)),
                                              timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._decoder = FrameDecoder()
         self._msg_id = 0
+        self.timing = timing
+        self.offset = OffsetEstimator()
+        self.last_stages: Optional[dict] = None
+        # Optional observer fn(stages_dict) — multiverso_tpu.latency
+        # wires this to the metrics registry (lat.stage.* histograms);
+        # kept as a plain callable so this module stays stdlib-only.
+        self.stage_hook = None
 
     # ------------------------------------------------------------- low level
     def send_raw(self, data: bytes) -> None:
@@ -132,11 +242,25 @@ class AnonServeClient:
         while True:
             frame = self._decoder.next_frame()
             if frame is not None:
-                return unpack_frame(frame)
+                reply = unpack_frame(frame)
+                if reply["timing"]:
+                    self._attribute(reply["timing"])
+                return reply
             chunk = self.sock.recv(65536)
             if not chunk:
                 raise ConnectionError("server closed the connection")
             self._decoder.feed(chunk)
+
+    def _attribute(self, trail) -> None:
+        now = time.monotonic_ns()
+        sample = ntp_sample(trail, now)
+        if sample is not None:
+            self.offset.update(*sample)
+        self.last_stages = stage_durations(trail, now,
+                                           self.offset.offset_ns)
+        hook = self.stage_hook
+        if hook is not None:
+            hook(self.last_stages)
 
     # ------------------------------------------------------------ serve ops
     def table_version(self, table_id: int) -> int:
@@ -144,7 +268,8 @@ class AnonServeClient:
         contacted shard's current table version; a shed raises
         :class:`ServeBusy`."""
         mid = self._next_id()
-        self.send_raw(pack_frame(MSG["RequestVersion"], table_id, mid))
+        self.send_raw(pack_frame(MSG["RequestVersion"], table_id, mid,
+                                 timing=self.timing))
         reply = self.recv_reply()
         _check(reply, mid, "ReplyVersion")
         return reply["version"]
@@ -158,7 +283,8 @@ class AnonServeClient:
         rank and explicitly marking silent ranks."""
         mid = self._next_id()
         self.send_raw(pack_frame(MSG["OpsQuery"], -1, mid, version=scope,
-                                 blobs=[kind.encode()]))
+                                 blobs=[kind.encode()],
+                                 timing=self.timing))
         reply = self.recv_reply()
         _check(reply, mid, "OpsReply")
         return reply["blobs"][0].decode() if reply["blobs"] else ""
@@ -175,7 +301,8 @@ class AnonServeClient:
         (docs/host_bridge.md).  Callers that need to mutate copy at
         their own boundary."""
         mid = self._next_id()
-        self.send_raw(pack_frame(MSG["RequestGet"], table_id, mid))
+        self.send_raw(pack_frame(MSG["RequestGet"], table_id, mid,
+                                 timing=self.timing))
         reply = self.recv_reply()
         _check(reply, mid, "ReplyGet")
         return np.frombuffer(reply["blobs"][0], dtype=np.float32)
@@ -189,7 +316,8 @@ class AnonServeClient:
         ``RequestGet``.  Empty when the shard's tracker is cold or
         ``-hotkey_enabled=false``."""
         mid = self._next_id()
-        self.send_raw(pack_frame(MSG["RequestReplica"], table_id, mid))
+        self.send_raw(pack_frame(MSG["RequestReplica"], table_id, mid,
+                                 timing=self.timing))
         reply = self.recv_reply()
         _check(reply, mid, "ReplyReplica")
         out: dict = {"_version": reply["version"]}

@@ -245,14 +245,39 @@ def save(path: str, evts: Optional[List[SpanEvent]] = None) -> int:
 def merge_dir(trace_dir: str, out_name: str = "trace_merged.json") -> str:
     """Merge every ``trace_rank*.json`` (and any other ``*.json`` trace
     except a previous merge) in ``trace_dir`` into one Chrome trace;
-    returns the merged file path."""
+    returns the merged file path.
+
+    A truncated / mid-write / otherwise unparseable per-rank file is
+    SKIPPED with a warning (and a synthetic ``trace_merge_skipped``
+    metadata event naming it in the merged output) instead of raising:
+    the flight recorder dumps while ranks are being SIGKILLed, and one
+    corpse's half-written JSON must not cost the post-mortem every
+    surviving rank's timeline."""
     merged: List[Dict[str, Any]] = []
+    skipped: List[str] = []
     for name in sorted(os.listdir(trace_dir)):
         if not name.endswith(".json") or name == out_name:
             continue
-        with open(os.path.join(trace_dir, name), "rb") as f:
-            doc = json.load(f)
-        merged.extend(doc.get("traceEvents", []))
+        try:
+            with open(os.path.join(trace_dir, name), "rb") as f:
+                doc = json.load(f)
+            events = doc.get("traceEvents", [])
+            if not isinstance(events, list):
+                raise ValueError("traceEvents is not a list")
+        except (OSError, ValueError) as exc:
+            # json.JSONDecodeError is a ValueError: truncated file,
+            # interleaved partial write, or non-trace JSON all land here.
+            Log.error("tracing.merge_dir: skipping unreadable %s (%s)",
+                      name, exc)
+            skipped.append(name)
+            continue
+        merged.extend(events)
+    for name in skipped:
+        merged.append({"name": "trace_merge_skipped", "ph": "i",
+                       "ts": 0, "pid": -1, "tid": 0, "s": "g",
+                       "args": {"file": name,
+                                "why": "unparseable (truncated or "
+                                       "mid-write)"}})
     merged.sort(key=lambda e: e.get("ts", 0))
     out_path = os.path.join(trace_dir, out_name)
     from .io.stream import LocalStream

@@ -1,0 +1,79 @@
+"""Fleet holder for the latency-attribution tests (not a pytest module).
+
+Run as ``python latency_worker.py <machine_file> <rank>``: joins a
+2-rank native epoll fleet (heartbeat armed — the lease echo is one of
+the clock-offset channels), does cross-rank table traffic so every
+stage histogram and the per-peer offset estimator have data, prints
+``LAT_READY`` — then serves stdin commands until ``quit``:
+
+- ``report``  — print ``LAT_REPORT <one-line JSON>`` (this rank's
+  ``MV_OpsReport("latency")``) and ``LAT_OFFSET <json|null>`` (the
+  rank-0 clock-offset estimate).
+- ``fault``   — arm a 100%% 25 ms ``apply_delay`` fault on THIS rank's
+  server apply path, print ``LAT_FAULT_ARMED``.
+- ``traffic`` — 20 more cross-rank gets (their replies land in this
+  rank's stage histograms), print ``LAT_TRAFFIC_DONE``.
+- ``quit``    — clean shutdown, print ``LAT_OK <rank>``.
+
+tests/test_latency.py drives the command protocol; the seeded-fault
+scenario arms ``fault`` on rank 0 and ``traffic`` on rank 1, then
+asserts latdoctor names ``apply`` (not the wire) as the dominant p99
+stage of rank 1's breakdown.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from multiverso_tpu import native as nat  # noqa: E402
+
+SIZE = 64
+
+
+def main() -> int:
+    mf, rank = sys.argv[1], int(sys.argv[2])
+    rt = nat.NativeRuntime(args=[
+        f"-machine_file={mf}", f"-rank={rank}", "-log_level=error",
+        "-trace=true",
+        "-heartbeat_ms=100", "-heartbeat_timeout_ms=2000",
+        "-rpc_timeout_ms=10000", "-barrier_timeout_ms=30000",
+        "-connect_retry_ms=2000"])
+    assert rt.net_engine() == "epoll", rt.net_engine()
+    h = rt.new_array_table(SIZE)
+    rt.barrier()
+    for _ in range(8):
+        rt.array_add(h, np.ones(SIZE, np.float32))
+        rt.array_get(h, SIZE)
+    rt.barrier()
+    print("LAT_READY", flush=True)
+
+    for line in sys.stdin:
+        cmd = line.strip()
+        if cmd == "report":
+            print("LAT_REPORT " + rt.ops_report("latency"), flush=True)
+            print("LAT_OFFSET " + json.dumps(rt.clock_offset(1 - rank)),
+                  flush=True)
+        elif cmd == "fault":
+            rt.set_fault("delay_ms", 25)
+            rt.set_fault("apply_delay", 1.0)
+            print("LAT_FAULT_ARMED", flush=True)
+        elif cmd == "traffic":
+            for _ in range(20):
+                rt.array_get(h, SIZE)
+            print("LAT_TRAFFIC_DONE", flush=True)
+        elif cmd == "quit":
+            break
+    rt.clear_faults()
+    rt.barrier()
+    rt.shutdown()
+    print(f"LAT_OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

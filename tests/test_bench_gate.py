@@ -85,6 +85,35 @@ def test_gate_guards_ops_keys(tmp_path):
     assert "ops_overhead_pct" in out, out
 
 
+def test_gate_guards_latency_keys(tmp_path):
+    """bench_latency acceptance bars (docs/observability.md "latency
+    plane"): profiler overhead past the always-on 1% bar, a stage sum
+    that stopped telescoping to the end-to-end latency (lost stamps /
+    bad clock offsets), or trail overhead past its band must all fail
+    the gate."""
+    line = {"extras": {"latency_profiler_overhead_pct": 3.0,   # > 1 bar
+                       "latency_stage_sum_ratio": 0.5,         # lost stages
+                       "latency_timing_overhead_pct": 8.0}}    # way past
+    p = tmp_path / "latency_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "latency_profiler_overhead_pct" in out and "FAIL" in out, out
+    assert "latency_stage_sum_ratio" in out, out
+    assert "latency_timing_overhead_pct" in out, out
+
+
+def test_gate_passes_in_band_latency_line(tmp_path):
+    line = {"extras": {"latency_profiler_overhead_pct": 0.4,
+                       "latency_timing_overhead_pct": 1.0,
+                       "latency_stage_sum_ratio": 0.98,
+                       "latency_e2e_p99_ms": 2.0}}
+    p = tmp_path / "latency_ok.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
 def test_gate_guards_skew_keys(tmp_path):
     """bench_skew acceptance bars (docs/observability.md, workload
     plane): a collapsed zipf skew ratio (the sketches stopped seeing the

@@ -1,0 +1,392 @@
+"""Tier-1 gate for the latency-attribution plane
+(docs/observability.md "latency plane"): the wire timing trail (pack /
+unpack / version tolerance), the stage-duration + NTP clock-offset
+math, the sampling profiler (Python sampler thread + folded-stack
+plumbing), the ``merge_dir`` truncated-file tolerance satellite, and —
+over a live 2-rank fleet — stage monotonicity after offset correction,
+old-header round trips, and latdoctor naming a seeded apply-path delay
+as the dominant stage (never the wire).
+"""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+from multiverso_tpu.serve import wire  # noqa: E402
+from multiverso_tpu import latency  # noqa: E402
+
+
+# ------------------------------------------------------------ frame format
+
+def test_pack_frame_timing_trail_round_trips():
+    frame = wire.pack_frame(wire.MSG["RequestVersion"], 3, 7,
+                            timing=True)
+    body = frame[wire._LEN.size:]
+    got = wire.unpack_frame(body)
+    assert got["flags"] & wire.FLAG_TIMING
+    assert got["timing"] is not None and len(got["timing"]) == 6
+    t0, t1 = got["timing"][0], got["timing"][1]
+    assert t0 > 0 and t1 >= t0
+    assert got["timing"][2:] == (0, 0, 0, 0)
+
+
+def test_pack_frame_old_header_unchanged():
+    """Version tolerance: a trail-less frame is byte-identical to the
+    PR 3 layout and parses with ``timing=None``."""
+    frame = wire.pack_frame(wire.MSG["RequestGet"], 1, 2)
+    body = frame[wire._LEN.size:]
+    assert len(body) == wire.HEADER.size          # header only, no trail
+    got = wire.unpack_frame(body)
+    assert got["timing"] is None
+    assert not (got["flags"] & wire.FLAG_TIMING)
+    # ...and a timed frame costs exactly one TimingTrail more.
+    timed = wire.pack_frame(wire.MSG["RequestGet"], 1, 2, timing=True)
+    assert len(timed) == len(frame) + wire.TIMING.size
+
+
+# ----------------------------------------------------- stage / offset math
+
+def _trail(t0, t1, t2, t3, t4, t5):
+    return (t0, t1, t2, t3, t4, t5)
+
+
+def test_stage_durations_telescope_to_total():
+    ms = 1_000_000
+    # Server clock 5 ms AHEAD: its stamps carry +5ms.
+    shift = 5 * ms
+    trail = _trail(10 * ms, 11 * ms,
+                   13 * ms + shift, 14 * ms + shift, 17 * ms + shift,
+                   18 * ms + shift)
+    now = 20 * ms
+    stages = wire.stage_durations(trail, now, offset_ns=shift)
+    assert stages["queue"] == pytest.approx(1e-3)
+    assert stages["wire_out"] == pytest.approx(2e-3)
+    assert stages["mailbox"] == pytest.approx(1e-3)
+    assert stages["apply"] == pytest.approx(3e-3)
+    assert stages["reactor"] == pytest.approx(1e-3)
+    assert stages["wire_back"] == pytest.approx(2e-3)
+    assert stages["total"] == pytest.approx(10e-3)
+    # Offset-corrected stages telescope back to the end-to-end total.
+    ssum = sum(v for k, v in stages.items() if k != "total")
+    assert ssum == pytest.approx(stages["total"], rel=1e-9)
+
+
+def test_ntp_sample_recovers_seeded_offset():
+    ms = 1_000_000
+    shift = 7 * ms
+    # Symmetric 1 ms wire each way, 2 ms server hold.
+    trail = _trail(0, 10 * ms, 11 * ms + shift, 0, 0, 13 * ms + shift)
+    now = 14 * ms
+    off, rtt = wire.ntp_sample(trail, now)
+    assert off == shift
+    assert rtt == 2 * ms
+    # Local trail (never crossed the wire): no sample.
+    assert wire.ntp_sample(_trail(1, 2, 0, 3, 4, 5), 6) is None
+
+
+def test_offset_estimator_min_rtt_wins():
+    est = wire.OffsetEstimator(window=4)
+    est.update(100, 50)
+    est.update(999, 400)      # congested sample: must not win
+    est.update(105, 60)
+    assert est.offset_ns == 100
+    assert est.rtt_ns == 50
+    assert est.samples == 3
+    for _ in range(4):        # window slides the min-rtt sample out
+        est.update(200, 80)
+    assert est.offset_ns == 200
+
+
+def test_record_stages_and_dominant_stage(monkeypatch):
+    from multiverso_tpu import metrics
+
+    metrics.reset()
+    latency.record_stages({"queue": 1e-4, "apply": 5e-3, "total": 6e-3})
+    snap = metrics.snapshot()
+    assert snap["lat.stage.apply"]["count"] == 1
+    assert snap["lat.total"]["count"] == 1
+    metrics.reset()
+
+    report = {"stages": {"apply": {"p99_ms": 25.0, "p50_ms": 20.0},
+                         "wire_out": {"p99_ms": 1.0, "p50_ms": 0.5}},
+              "total": {"p99_ms": 26.5, "p50_ms": 21.0, "p95_ms": 25.0,
+                        "count": 9}}
+    assert latency.dominant_stage(report) == "apply"
+    assert latency.dominant_stage(report, "p50_ms") == "apply"
+    assert latency.dominant_stage({"stages": {}}) is None
+    summary = latency.stage_summary(report)
+    assert summary["total"]["p99_ms"] == 26.5
+    assert set(summary) == {"apply", "wire_out", "total"}
+
+
+# ------------------------------------------------------------- profiler
+
+def test_python_sampling_profiler_catches_a_busy_stack():
+    from multiverso_tpu import profiler
+
+    stop = threading.Event()
+
+    def _burn():
+        while not stop.is_set():
+            sum(i * i for i in range(200))
+
+    t = threading.Thread(target=_burn, daemon=True)
+    t.start()
+    p = profiler.SamplingProfiler(hz=200).start()
+    try:
+        deadline = time.time() + 10.0
+        while p.samples < 10 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        p.stop()
+        stop.set()
+        t.join(timeout=5)
+    assert p.samples >= 10
+    folded = p.folded()
+    assert folded
+    assert any("_burn" in stack for stack in folded)
+    # Folded keys are outermost-first: the leaf is the innermost frame.
+    burn_stack = next(s for s in folded if "_burn" in s)
+    assert ";" in burn_stack
+
+
+def test_parse_folded_and_profile_to_spans():
+    from multiverso_tpu import profiler, tracing
+
+    folded = profiler.parse_folded(
+        "main;serve;apply 30\nmain;idle 5\n\nnot_a_count x\n")
+    assert folded == {"main;serve;apply": 30, "main;idle": 5}
+    tracing.clear()
+    tracing.enable(rank=0)
+    try:
+        n = profiler.profile_to_spans(folded, period_s=0.01)
+        assert n == 2
+        evs = [e for e in tracing.events()
+               if e.name.startswith("profile:")]
+        assert {e.name for e in evs} == {"profile:apply", "profile:idle"}
+        hot = next(e for e in evs if e.name == "profile:apply")
+        assert hot.dur_us == 300_000           # 30 samples x 10 ms
+        assert hot.args["stack"] == "main;serve;apply"
+        assert hot.args["plane"] == "profiler/python"
+    finally:
+        tracing.disable()
+        tracing.clear()
+
+
+def test_profile_to_spans_noop_when_tracing_off():
+    from multiverso_tpu import profiler, tracing
+
+    tracing.disable()
+    assert profiler.profile_to_spans({"a;b": 3}, 0.01) == 0
+
+
+# ------------------------------------------- merge_dir tolerance satellite
+
+def test_merge_dir_skips_truncated_rank_file(tmp_path):
+    from multiverso_tpu import tracing
+
+    good = {"traceEvents": [{"name": "x", "ph": "X", "ts": 5, "dur": 1,
+                             "pid": 0, "tid": 0, "args": {}}]}
+    (tmp_path / "trace_rank0.json").write_text(json.dumps(good))
+    # A rank SIGKILLed mid-write leaves a truncated JSON document.
+    (tmp_path / "trace_rank1.json").write_text(
+        json.dumps(good)[: len(json.dumps(good)) // 2])
+    (tmp_path / "trace_rank2.json").write_text('{"traceEvents": 42}')
+    out = tracing.merge_dir(str(tmp_path))
+    doc = json.load(open(out))
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "x" in names
+    skipped = [e for e in doc["traceEvents"]
+               if e["name"] == "trace_merge_skipped"]
+    assert {e["args"]["file"] for e in skipped} == {
+        "trace_rank1.json", "trace_rank2.json"}
+
+
+# ------------------------------------------------------------- wire plane
+
+def _spawn_fleet(tmp_path, nranks=2):
+    socks = [socket.socket() for _ in range(nranks)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = os.path.join(str(tmp_path), "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "tests", "latency_worker.py"), mf,
+             str(r)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
+        for r in range(nranks)
+    ]
+    for p in procs:
+        line = p.stdout.readline()
+        assert "LAT_READY" in line, line
+    return eps, procs
+
+
+def _cmd(proc, cmd, marker, timeout=60):
+    proc.stdin.write(cmd + "\n")
+    proc.stdin.flush()
+    deadline = time.time() + timeout
+    lines = []
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line.rstrip("\n"))
+        if marker in line:
+            return lines
+    raise AssertionError(f"no {marker} after {cmd!r}: {lines}")
+
+
+def _quit(procs):
+    outs = []
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.stdin.write("quit\n")
+                p.stdin.flush()
+            except (BrokenPipeError, OSError):
+                pass
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=120)[0])
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs.append(p.communicate()[0])
+    return outs
+
+
+@needs_gxx
+def test_fleet_stage_attribution_offsets_and_old_header(tmp_path):
+    """A live 2-rank fleet: cross-rank traffic leaves per-stage
+    histograms (wire_out/apply populated) and a clock-offset estimate
+    on both ranks; an anonymous TIMED probe's corrected stamps are
+    monotonic; an OLD-HEADER (trail-less) client round-trips cleanly."""
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    eps, procs = _spawn_fleet(tmp_path)
+    try:
+        reports = {}
+        offsets = {}
+        for r, p in enumerate(procs):
+            lines = _cmd(p, "report", "LAT_OFFSET")
+            rep = next(ln for ln in lines if ln.startswith("LAT_REPORT "))
+            off = next(ln for ln in lines if ln.startswith("LAT_OFFSET "))
+            reports[r] = json.loads(rep[len("LAT_REPORT "):])
+            offsets[r] = json.loads(off[len("LAT_OFFSET "):])
+        for r in (0, 1):
+            stages = reports[r]["stages"]
+            assert reports[r]["armed"] is True
+            for name in ("queue", "wire_out", "mailbox", "apply",
+                         "reactor", "wire_back"):
+                assert stages.get(name, {}).get("count", 0) > 0, \
+                    (r, name, sorted(stages))
+            assert reports[r]["total"]["count"] > 0
+            # Every timed cross-rank reply (and the heartbeat echo)
+            # feeds the peer-offset estimator.
+            assert offsets[r] is not None, offsets
+            assert offsets[r]["rtt_ns"] >= 0
+            assert reports[r]["offsets"], reports[r]["offsets"]
+
+        # ---- anonymous timed probe: corrected stamps are monotonic ----
+        c = wire.AnonServeClient(eps[0], timeout=15, timing=True)
+        try:
+            trail = None
+            for i in range(8):
+                mid = c._next_id()
+                c.send_raw(wire.pack_frame(wire.MSG["RequestVersion"],
+                                           0, mid, timing=True))
+                reply = c.recv_reply()
+                assert reply["type_name"] == "ReplyVersion"
+                trail = reply["timing"]
+                now = time.monotonic_ns()
+            assert trail is not None and all(t > 0 for t in trail)
+            off = c.offset.offset_ns
+            corrected = [trail[0], trail[1], trail[2] - off,
+                         trail[3] - off, trail[4] - off, trail[5] - off,
+                         now]
+            slack = max(c.offset.rtt_ns or 0, 1_000_000)
+            for a, b in zip(corrected, corrected[1:]):
+                assert b >= a - slack, (corrected, off, slack)
+            assert c.last_stages and c.last_stages["total"] > 0
+            ssum = sum(v for k, v in c.last_stages.items()
+                       if k != "total")
+            assert ssum == pytest.approx(c.last_stages["total"],
+                                         rel=0.25, abs=2e-3)
+        finally:
+            c.close()
+
+        # ---- old-header peer: no trail, identical behavior ------------
+        old = wire.AnonServeClient(eps[0], timeout=15, timing=False)
+        try:
+            v = old.table_version(0)
+            assert v > 0
+            assert old.last_stages is None
+            assert old.offset.samples == 0
+        finally:
+            old.close()
+    finally:
+        outs = _quit(procs)
+    for r, out in enumerate(outs):
+        assert f"LAT_OK {r}" in out, out[-2000:]
+
+
+@needs_gxx
+def test_latdoctor_names_seeded_apply_delay(tmp_path):
+    """The acceptance scenario: a 100% 25 ms ``apply_delay`` fault on
+    rank 0's server apply path must make ``apply`` (never the wire) the
+    dominant p99 stage of rank 1's breakdown — asserted through the
+    fleet-scope "latency" report AND latdoctor's rendered verdict."""
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    eps, procs = _spawn_fleet(tmp_path)
+    try:
+        _cmd(procs[0], "fault", "LAT_FAULT_ARMED")
+        _cmd(procs[1], "traffic", "LAT_TRAFFIC_DONE", timeout=120)
+
+        from multiverso_tpu.ops.introspect import OpsClient
+
+        with OpsClient(eps[0], timeout=15) as c:
+            fleet = c.latency(fleet=True)
+        rank1 = fleet["ranks"]["1"]
+        assert latency.dominant_stage(rank1, "p99_ms") == "apply"
+        apply_p99 = rank1["stages"]["apply"]["p99_ms"]
+        wire_p99 = max(rank1["stages"].get("wire_out",
+                                           {}).get("p99_ms", 0.0),
+                       rank1["stages"].get("wire_back",
+                                           {}).get("p99_ms", 0.0))
+        assert apply_p99 > 10.0, apply_p99       # the 25 ms delay shows
+        assert apply_p99 > wire_p99 * 2, (apply_p99, wire_p99)
+
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "latdoctor.py"),
+             eps[0], "--fleet"],
+            capture_output=True, text=True, timeout=60,
+            env=dict(os.environ, PYTHONPATH=REPO))
+        assert out.returncode == 0, out.stderr
+        assert "dominant p99 stage = apply" in out.stdout, out.stdout
+    finally:
+        outs = _quit(procs)
+    for r, out in enumerate(outs):
+        assert f"LAT_OK {r}" in out, out[-2000:]

@@ -539,6 +539,57 @@ def test_mv012_out_of_scope_and_suppressible(tmp_path):
     assert _lint_src(tmp_path, suppressed) == []
 
 
+def test_mv014_fires_on_wall_clock_interval(tmp_path):
+    """An interval measured as time.time() minus time.time() (directly
+    or through assigned names) steps with NTP/DST — the latency plane
+    (docs/observability.md) requires monotonic clocks for durations."""
+    lib = tmp_path / "multiverso_tpu"
+    lib.mkdir()
+    rules = _lint_src(lib, """\
+        import time
+
+        def bad_direct(t0):
+            t0 = time.time()
+            return time.time() - t0                     # BAD
+
+        def bad_datetime():
+            import datetime
+            start = datetime.datetime.now()
+            return datetime.datetime.now() - start      # BAD
+
+        def fine_monotonic():
+            t0 = time.monotonic()
+            return time.monotonic() - t0                # monotonic: fine
+
+        def fine_timestamp(dt):
+            return (time.time() - dt) * 1e6             # ts math: fine
+        """)
+    assert [r for r, _ in rules] == ["MV014", "MV014"], rules
+
+
+def test_mv014_out_of_scope_and_suppressible(tmp_path):
+    src = """\
+        import time
+
+        def f():
+            t0 = time.time()
+            return time.time() - t0
+        """
+    lib = tmp_path / "multiverso_tpu"
+    lib.mkdir()
+    assert [r for r, _ in _lint_src(lib, src)] == ["MV014"]
+    # apps/ and tests are out of scope (a test may step clocks on
+    # purpose; apps' stdout protocols are not library hot paths).
+    apps = lib / "apps"
+    apps.mkdir()
+    assert _lint_src(apps, src) == []
+    assert _lint_src(lib, src, name="test_clock.py") == []
+    suppressed = src.replace(
+        "return time.time() - t0",
+        "return time.time() - t0  # mvlint: disable=MV014")
+    assert _lint_src(lib, suppressed) == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

@@ -206,9 +206,17 @@ def test_metrics_history_histogram_and_bounds(registry):
         registry.record_history(now=10.0 + i)
     assert len(registry.history("op.lat_count")) == \
         registry.HISTORY_SNAPSHOTS
-    # Fewer than two points / unknown series: 0.0, never a crash.
-    assert registry.rate("nope") == 0.0
+    # Fewer than two points / unknown series: None, never a crash and
+    # NEVER a zero — a fresh scrape must not read as "zero traffic"
+    # (renderers print '-'); delta keeps its 0.0 contract.
+    assert registry.rate("nope") is None
     assert registry.delta("nope") == 0.0
+    h2 = registry.histogram("fresh.lat", bounds=[1.0])
+    h2.observe(0.5)
+    registry.record_history(now=100.0)
+    assert registry.rate("fresh.lat_count") is None  # one flush so far
+    registry.record_history(now=110.0)
+    assert registry.rate("fresh.lat_count") == pytest.approx(0.0)
 
 
 def test_metrics_flush_records_history(registry, tmp_path):
@@ -259,7 +267,14 @@ def test_mvtop_compute_rates_and_sparkline():
     # A restarted rank's counter reset clamps to 0, not negative.
     assert mvtop.compute_rates({"vmax": 500.0}, {"vmax": 10.0},
                                1.0)["vmax"] == 0.0
-    assert mvtop.compute_rates({}, {"vmax": 10.0}, 0.0)["vmax"] == 0.0
+    # Uncomputable rates (no baseline sample, zero elapsed, or a None
+    # from a pre-second-flush metrics.rate()) are ABSENT — the renderer
+    # prints '-', never a fake 0.0 a fresh scrape would misread as
+    # "zero traffic".
+    assert mvtop.compute_rates({}, {"vmax": 10.0}, 0.0) == {}
+    assert mvtop.compute_rates({}, {"vmax": 10.0}, 1.0) == {}
+    assert mvtop.compute_rates({"vmax": None}, {"vmax": 10.0}, 1.0) == {}
+    assert mvtop.compute_rates({"vmax": 1.0}, {"vmax": None}, 1.0) == {}
 
     assert mvtop.sparkline([]) == "-"
     assert mvtop.sparkline([0, 0]) == "▁▁"

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""latency-demo — acceptance smoke for the latency-attribution plane
+(docs/observability.md "latency plane"; ``make latency-demo``).
+
+Spawns a TWO-RANK native fleet (epoll engine, tracing + wire timing +
+the SIGPROF sampler armed) and proves, over the anonymous ops wire:
+
+(a) **Stage attribution adds up** — an anonymous timed probe's
+    offset-corrected per-stage breakdown sums to within 10% of its
+    end-to-end latency, and the fleet's ``"latency"`` report carries
+    every stage histogram on both ranks.
+(b) **The p99 explains itself** — the report's p99 exemplar trace id
+    resolves in the merged Chrome trace, which ALSO carries the
+    profiler's ``profile:*`` flame spans beside the request spans.
+(c) **latdoctor names the culprit** — with an injected
+    ``MV_SetFault("apply_delay")`` slowdown on rank 0's server apply
+    path, ``tools/latdoctor.py --fleet`` names ``apply`` (never the
+    wire) as the dominant p99 stage of rank 1's breakdown.
+
+Prints ``LATENCY_DEMO_OK`` and exits 0 on success.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _cmd(proc, cmd, marker, timeout=120):
+    proc.stdin.write(cmd + "\n")
+    proc.stdin.flush()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if marker in line:
+            return
+    raise AssertionError(f"no {marker} after {cmd!r}")
+
+
+def main() -> int:
+    from multiverso_tpu import latency, tracing
+    from multiverso_tpu import native as nat
+    from multiverso_tpu.ops.introspect import OpsClient
+    from multiverso_tpu.serve import wire
+
+    nat.ensure_built()
+    socks = [socket.socket() for _ in range(2)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    tmp = tempfile.mkdtemp(prefix="mvtpu_lat_")
+    mf = os.path.join(tmp, "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+    trace_dir = os.path.join(tmp, "traces")
+    os.makedirs(trace_dir)
+
+    worker = os.path.join(REPO, "multiverso_tpu", "apps",
+                          "latency_demo_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, mf, str(r), trace_dir],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
+        for r in range(2)
+    ]
+    try:
+        for p in procs:
+            line = p.stdout.readline()
+            assert "LATD_READY" in line, line
+
+        # ---- (a) per-probe stage sums telescope to the e2e latency ---
+        client = wire.AnonServeClient(eps[0], timeout=15, timing=True)
+        ratios = []
+        for _ in range(20):
+            client.table_version(0)
+            st = client.last_stages
+            ssum = sum(v for k, v in st.items() if k != "total")
+            if st["total"] > 0:
+                ratios.append(ssum / st["total"])
+        client.close()
+        mean_ratio = sum(ratios) / len(ratios)
+        assert 0.9 <= mean_ratio <= 1.1, mean_ratio
+        print(f"stage sums: mean {mean_ratio * 100.0:.1f}% of the "
+              f"end-to-end latency over {len(ratios)} timed probes "
+              f"(bar: within 10%)")
+
+        with OpsClient(eps[0], timeout=15) as c:
+            fleet = c.latency(fleet=True)
+        assert set(fleet["ranks"]) == {"0", "1"}, fleet
+        for r in ("0", "1"):
+            rep = fleet["ranks"][r]
+            assert rep["armed"], rep
+            for name in ("queue", "wire_out", "mailbox", "apply",
+                         "reactor", "wire_back"):
+                assert rep["stages"][name]["count"] > 0, (r, name)
+            assert rep["offsets"], (r, rep["offsets"])
+            assert rep["profiler"]["running"], rep["profiler"]
+        print("fleet latency report: all 6 stages populated on both "
+              "ranks, clock offsets estimated, profiler running")
+
+        # ---- (b1) the p99 exemplar id (resolved after the export) ----
+        exemplar = fleet["ranks"]["1"].get("total", {}).get(
+            "exemplar_p99") or fleet["ranks"]["0"].get("total", {}).get(
+            "exemplar_p99")
+        assert exemplar, "no p99 exemplar on either rank's total"
+
+        # ---- (c) seeded apply delay -> latdoctor names `apply` -------
+        _cmd(procs[0], "fault", "LATD_FAULT_ARMED")
+        _cmd(procs[1], "traffic", "LATD_TRAFFIC_DONE")
+        with OpsClient(eps[0], timeout=15) as c:
+            fleet2 = c.latency(fleet=True)
+        rank1 = fleet2["ranks"]["1"]
+        dom = latency.dominant_stage(rank1, "p99_ms")
+        assert dom == "apply", (dom, rank1["stages"])
+        doctor = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "latdoctor.py"),
+             eps[0], "--fleet"],
+            capture_output=True, text=True, timeout=60, env=env)
+        assert doctor.returncode == 0, doctor.stderr
+        assert "dominant p99 stage = apply" in doctor.stdout, \
+            doctor.stdout
+        apply_ms = rank1["stages"]["apply"]["p99_ms"]
+        wire_ms = max(rank1["stages"]["wire_out"]["p99_ms"],
+                      rank1["stages"]["wire_back"]["p99_ms"])
+        print(f"latdoctor: injected 25 ms apply delay named as "
+              f"dominant p99 stage = apply ({apply_ms:.1f} ms vs wire "
+              f"{wire_ms:.1f} ms)")
+    finally:
+        outs = []
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.stdin.write("quit\n")
+                    p.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    pass
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=180)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or f"LATD_OK {r}" not in out:
+            print(out[-3000:])
+            print(f"LATENCY_DEMO_FAIL: rank {r} rc={p.returncode}")
+            return 1
+
+    # ---- (b2) exemplar + flame data resolve in the merged trace ------
+    from multiverso_tpu import tracing as _tracing
+
+    merged = _tracing.merge_dir(trace_dir)
+    mdoc = json.load(open(merged))
+    trace_ids = {e["args"].get("trace_id")
+                 for e in mdoc["traceEvents"]} - {None}
+    assert exemplar in trace_ids, (exemplar, len(trace_ids))
+    flames = [e for e in mdoc["traceEvents"]
+              if e["name"].startswith("profile:")]
+    assert flames, "no profiler flame spans in the merged trace"
+    print(f"merged trace: p99 exemplar {exemplar} resolves among "
+          f"{len(trace_ids)} span ids; {len(flames)} profile:* flame "
+          f"span(s) ride beside the request spans")
+    print("LATENCY_DEMO_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

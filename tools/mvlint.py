@@ -121,6 +121,16 @@ Rules (docs/static_analysis.md has the full rationale):
   the difference between one gather and ten thousand
   (docs/embedding.md).  Batch the ids and call once.
 
+- **MV014 wall-clock-interval** — library code may not measure an
+  INTERVAL with a non-monotonic clock: ``t0 = time.time()`` ... ``dur =
+  time.time() - t0`` (or ``datetime.now()``/``utcnow()`` differences)
+  jumps with NTP steps and DST — on exactly the paths the latency plane
+  (docs/observability.md) depends on, a stepped clock turns into a
+  phantom p99 spike or a negative stage.  Use ``time.monotonic()`` /
+  ``time.monotonic_ns()`` / ``time.perf_counter()`` for durations;
+  ``time.time()`` stays legal as a wall-clock TIMESTAMP (trace event
+  times, log lines) — only clock-minus-clock subtraction fires.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -778,6 +788,70 @@ def check_row_at_a_time(tree, path):
     return out
 
 
+# ---------------------------------------------------------------- MV014
+# Non-monotonic clock reads whose DIFFERENCE is an interval.
+_WALL_CLOCK_ATTRS = {("time", "time"), ("datetime", "now"),
+                     ("datetime", "utcnow")}
+
+
+def _wall_clock_call(node):
+    """True for ``time.time()`` / ``datetime.now()`` /
+    ``datetime.utcnow()`` (module- or class-qualified)."""
+    if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute):
+        return False
+    base = node.func.value
+    base_name = (base.attr if isinstance(base, ast.Attribute)
+                 else base.id if isinstance(base, ast.Name) else None)
+    return (base_name, node.func.attr) in _WALL_CLOCK_ATTRS
+
+
+def check_wall_clock_interval(tree, path):
+    """MV014: both operands of a subtraction derive from a
+    non-monotonic clock read — an interval measured on a clock that
+    steps.  Scoped per function (plus the module body), so a
+    wall-clock TIMESTAMP that merely rides into arithmetic with a
+    monotonic duration (``time.time() - dt``) stays legal."""
+    out = []
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+    for scope in scopes:
+        body = scope.body if isinstance(
+            scope, (ast.FunctionDef, ast.AsyncFunctionDef)) else [
+            n for n in scope.body
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef))]
+        derived = set()
+        for node in body:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and _wall_clock_call(
+                        sub.value):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            derived.add(tgt.id)
+
+        def clockish(n):
+            return _wall_clock_call(n) or (
+                isinstance(n, ast.Name) and n.id in derived)
+
+        for node in body:
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.BinOp)
+                        and isinstance(sub.op, ast.Sub)
+                        and clockish(sub.left) and clockish(sub.right)):
+                    out.append(Finding(
+                        path, sub.lineno, "MV014",
+                        "interval measured with a non-monotonic clock "
+                        "(time.time()/datetime.now() minus another "
+                        "wall-clock read): NTP steps/DST turn this "
+                        "into phantom latency spikes or negative "
+                        "durations — use time.monotonic()/"
+                        "monotonic_ns()/perf_counter() for durations "
+                        "(docs/observability.md latency plane)"))
+    return out
+
+
 # ---------------------------------------------------------------- MV009
 # Native reactor-context lint: the only non-Python rule.  A file opts in
 # with this marker (the epoll engine sources carry it); the rule then
@@ -888,6 +962,9 @@ def lint_file(path):
     if in_library:
         findings += check_print_in_library(tree, path)
         findings += check_unbounded_client_cache(tree, path)
+        # MV014: durations on a clock that steps — library code only
+        # (a test may freeze/step wall clocks on purpose).
+        findings += check_wall_clock_interval(tree, path)
         # metrics.py IS the registry — it legitimately constructs the
         # series classes it registers.
         if os.path.basename(path) != "metrics.py":

@@ -77,14 +77,20 @@ def compute_rates(prev: dict, cur: dict, dt: float) -> dict:
     ``prev``/``cur`` are ``{counter_name: value}`` dicts (the vmax /
     gets / adds / shed counters a health+tables scrape yields); the
     result maps each key to ``max(0, (cur - prev) / dt)`` — a restarted
-    rank's counter reset reads as 0, not a negative rate."""
+    rank's counter reset reads as 0, not a negative rate.  A counter
+    missing from either sample (or ``None`` — the metrics registry's
+    ``rate()`` answer before two flushes exist) is simply absent from
+    the result: the caller renders ``-``, never a fake 0.0 that would
+    read as "zero traffic" on a fresh scrape."""
     out = {}
     if dt <= 0:
-        return {k: 0.0 for k in cur}
+        return out
     for k, v in cur.items():
+        if v is None or prev.get(k) is None:
+            continue
         try:
-            d = float(v) - float(prev.get(k, v))
-        except (TypeError, ValueError):
+            d = float(v) - float(prev[k])
+        except (KeyError, TypeError, ValueError):
             continue
         out[k] = max(0.0, d / dt)
     return out
@@ -109,13 +115,19 @@ class RateTracker:
         if prev is None:
             return cols
         rates = compute_rates(prev[1], counters, ts - prev[0])
+
+        def fmt(key):
+            # An uncomputable rate renders '-', never a fake zero.
+            v = rates.get(key)
+            return "-" if v is None else f"{v:.1f}"
+
         trend = self._trend.setdefault(rank, [])
         trend.append(rates.get("vmax", 0.0))
         del trend[:-self.HISTORY]
-        cols["v/s"] = f"{rates.get('vmax', 0.0):.1f}"
-        cols["get/s"] = f"{rates.get('gets', 0.0):.1f}"
-        cols["add/s"] = f"{rates.get('adds', 0.0):.1f}"
-        cols["shed/s"] = f"{rates.get('shed', 0.0):.1f}"
+        cols["v/s"] = fmt("vmax")
+        cols["get/s"] = fmt("gets")
+        cols["add/s"] = fmt("adds")
+        cols["shed/s"] = fmt("shed")
         cols["trend"] = sparkline(trend)
         return cols
 
