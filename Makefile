@@ -134,10 +134,22 @@ latency-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/latency_demo.py
 
+# Delivery-audit smoke (docs/observability.md "audit plane"): 2-rank
+# fleets on BOTH wire engines where blocking adds eat injected
+# fail_send faults (retry absorbs — exact value proves zero lost acked
+# adds) and exactly two injected dup sends (the auditor names both with
+# their seq ranges); a seeded silent server-side discard fires the
+# audit_gap blackbox and diffs as a gap + never-acked tail, not a lost
+# acked add; and an -audit=false fleet proves unflagged pre-audit
+# frames still parse.
+audit-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/audit_demo.py
+
 # Demo umbrella: every acceptance smoke in sequence (each target builds
 # the native runtime once; later builds are no-ops).
 demos: metrics-demo serve-demo wire-demo fanin-demo ops-demo skew-demo \
-       embedding-demo bridge-demo latency-demo
+       embedding-demo bridge-demo latency-demo audit-demo
 
 # Continuous perf gate (docs/PERF.md): diff the newest bench JSON line
 # against the committed BENCH_BASELINE.json with per-key noise bands;
@@ -151,4 +163,5 @@ clean:
 
 .PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
         serve-demo wire-demo fanin-demo ops-demo skew-demo \
-        embedding-demo bridge-demo latency-demo demos bench-gate clean
+        embedding-demo bridge-demo latency-demo audit-demo demos \
+        bench-gate clean

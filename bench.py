@@ -634,6 +634,35 @@ def bench_latency(nclients: int = 1000):
     return res
 
 
+def bench_audit(nclients: int = 1000):
+    """Delivery-audit plane (docs/observability.md "audit plane";
+    schema 16): the ``bench_serve_fanin`` probe herd re-run with
+    auditing armed vs disarmed (MV_SetAudit) → ``audit_overhead_pct``
+    (what the always-on plane costs the serve tier; acceptance: < 1%),
+    the same A/B over an async add stream (the path the seq stamps and
+    server books actually ride) → ``audit_add_overhead_pct``, and one
+    injected duplicate send polled through the in-band ``"audit"``
+    scrape → ``audit_detect_ms`` (dup injected → named, with its seq
+    range, by the anomaly ring).  Herd + fleet live in
+    ``apps/fanin_bench_worker.py`` (mode=audit)."""
+    import re
+
+    outs = _spawn_native_workers("fanin_bench_worker.py", 2,
+                                 "FANIN_BENCH_OK",
+                                 (nclients, 8, 0, "audit"))
+    res = {}
+    for out in outs:
+        for m in re.finditer(r"(\w+)=(-?[0-9.]+)", out):
+            key = m.group(1)
+            if key == "rank":
+                continue
+            name = key if key.startswith("audit_") else f"audit_{key}"
+            res[name] = float(m.group(2))
+            if key.endswith("_ms") and float(m.group(2)) >= 0:
+                _observe_iter(float(m.group(2)) * 1e-3)
+    return res
+
+
 def bench_skew(nclients: int = 1000, rows: int = 2048, reqs: int = 2048):
     """Workload observability plane (docs/observability.md): a zipf(1.0)
     vs uniform row-get stream from a 1000-socket anonymous herd against
@@ -1517,7 +1546,8 @@ def bench_lightlda_mh(num_docs: int = 2048, vocab: int = 10000,
 # (VERDICT r4 weak #1).
 _SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_w2v_native8,
              bench_wire_micro, bench_ssp, bench_serve, bench_serve_fanin,
-             bench_ops, bench_latency, bench_skew, bench_embedding,
+             bench_ops, bench_latency, bench_audit, bench_skew,
+             bench_embedding,
              bench_bridge,
              bench_add_get,
              bench_transformer_large, bench_transformer, bench_moe,
@@ -1545,7 +1575,7 @@ def main() -> None:
     # Schema/partial line FIRST — before any JAX-touching import — so
     # even a backend-init hang killed by `timeout` leaves one parseable
     # line on stdout.
-    results = {"bench_schema": 15}
+    results = {"bench_schema": 16}
     errors = []
     _emit(results, errors)
 
@@ -1616,6 +1646,19 @@ def main() -> None:
     # embedding_sparse_bytes_ratio (all-zero tail rows, sparse reply
     # codec off/on), and embedding_addrows_borrow_speedup (multi-shard
     # borrowed run-iovec AddRows vs per-rank staging; >= 2x), all
+    # bench-gated;
+    # 15 = latency-attribution plane (docs/observability.md "latency
+    # plane"): bench_latency sweeps the 1k herd untimed / wire-stamped /
+    # stamped+profiled — latency_stage_*_{p50,p99}_ms breakdown,
+    # latency_stage_sum_ratio (offset-corrected stages telescope to the
+    # e2e), latency_timing_overhead_pct and
+    # latency_profiler_overhead_pct (always-on bars, < 1%);
+    # 16 = delivery-audit plane (docs/observability.md "audit plane"):
+    # bench_audit re-runs the fan-in herd armed vs disarmed
+    # (audit_overhead_pct < 1%), A/Bs an async add stream
+    # (audit_add_overhead_pct — the path the seq stamps ride), and
+    # times one injected duplicate send until the in-band "audit"
+    # scrape names it (audit_detect_ms, audit_dup_named = 1), all
     # bench-gated.
 
     # A budget SIGTERM lands mid-section: convert it to an exception so

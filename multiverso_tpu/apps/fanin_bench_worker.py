@@ -25,6 +25,15 @@ reports ``ops_scrape_p50_ms``/``ops_scrape_p99_ms`` (scrape latency
 under the fan-in load) plus ``ops_overhead_pct``: the serve-probe QPS
 the live scrape path cost, proving introspection is effectively free.
 
+``mode=audit`` (bench.py ``bench_audit``, docs/observability.md "audit
+plane") re-runs the probe herd twice — delivery auditing armed (the
+default) then disarmed via MV_SetAudit — and reports
+``audit_overhead_pct`` (the serve-probe QPS the always-on audit plane
+cost; acceptance: < 1%) plus ``audit_add_overhead_pct`` (the same A/B
+over an async add stream, the path the seq stamps actually ride) and
+``audit_detect_ms``: one injected duplicate send → the wall time until
+rank 0's in-band ``"audit"`` scrape names it.
+
 ``mode=latency`` (bench.py ``bench_latency``, docs/observability.md
 "latency plane") runs the probe phase THREE times over the same herd —
 untimed baseline, wire-stamped (per-stage p50/p99 breakdown from the
@@ -213,6 +222,132 @@ def _latency_herd(endpoint: str, nclients: int, rt) -> dict:
     return out
 
 
+def _audit_bench(endpoint: str, nclients: int, rt, h) -> dict:
+    """mode=audit body (docs/observability.md "audit plane").
+
+    Phase A re-runs the fan-in probe herd with auditing armed vs
+    disarmed (MV_SetAudit): ``audit_overhead_pct`` is what the plane
+    costs the serve tier.  Phase B A/Bs an async add stream — the path
+    the seq stamps, ledger writes, and server books actually ride.
+    Phase C injects ONE duplicate send and polls rank 0's in-band
+    ``"audit"`` scrape until the dup is named: ``audit_detect_ms``."""
+    import json
+
+    out = {}
+    # ONE persistent socket herd, interleaved probe sweeps: separate
+    # 1000-connection herds swing several-fold run to run (connect
+    # storms, TIME_WAIT pressure), which would drown the <1% bar the
+    # A/B exists to measure.  Same discipline as mode=latency.
+    host, port = endpoint.rsplit(":", 1)
+    _raise_fd_limit(nclients + 256)
+    sel = selectors.DefaultSelector()
+    socks = []
+    for i in range(nclients):
+        s = socket.socket()
+        s.connect((host, int(port)))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.setblocking(False)
+        sel.register(s, selectors.EVENT_READ,
+                     {"dec": FrameDecoder(), "id": i})
+        socks.append(s)
+    mid = [0]
+
+    def sweep():
+        done = 0
+        t0 = time.perf_counter()
+        window = 8
+        for base in range(0, nclients, window):
+            batch = socks[base:base + window]
+            for s in batch:
+                mid[0] += 1
+                s.sendall(pack_frame(MSG["RequestVersion"], 0, mid[0]))
+            deadline = time.time() + 60
+            got = 0
+            while got < len(batch) and time.time() < deadline:
+                for key, _ in sel.select(timeout=1.0):
+                    data = key.data
+                    try:
+                        chunk = key.fileobj.recv(65536)
+                    except BlockingIOError:
+                        continue
+                    if not chunk:
+                        raise RuntimeError(f"conn {data['id']} died")
+                    data["dec"].feed(chunk)
+                    while data["dec"].next_frame() is not None:
+                        got += 1
+            if got < len(batch):
+                raise RuntimeError(f"only {got}/{len(batch)} replies")
+            done += got
+        return done / (time.perf_counter() - t0)
+
+    sweep()                                   # warm sweep: discarded
+    armed_sweeps, disarmed_sweeps = [], []
+    for _ in range(3):
+        rt.set_audit(False)
+        disarmed_sweeps.append(sweep())
+        rt.set_audit(True)
+        armed_sweeps.append(sweep())
+    for s in socks:
+        sel.unregister(s)
+        s.close()
+    base = max(disarmed_sweeps)
+    out["audit_overhead_pct"] = (
+        max(0.0, (base - max(armed_sweeps)) / base * 100.0)
+        if base else 0.0)
+    out["audit_probe_qps"] = max(armed_sweeps)
+
+    delta = np.ones(SIZE, np.float32)
+
+    def add_stream(n=256):
+        t0 = time.perf_counter()
+        for _ in range(n - 1):
+            rt.array_add(h, delta, sync=False)
+        rt.array_add(h, delta, sync=True)   # the ack closes the window
+        return n / (time.perf_counter() - t0)
+
+    add_stream()                             # full warm sweep: the
+    add_stream()                             # first streams pay the
+    # post-herd backlog drain, not the audit plane — discard them.
+    # Interleaved best-of-3 per arm: loopback add throughput swings
+    # ~2x run to run (PERF.md), and slowdown noise is one-sided.
+    armed_runs, disarmed_runs = [], []
+    for _ in range(3):
+        rt.set_audit(False)
+        disarmed_runs.append(add_stream())
+        rt.set_audit(True)
+        armed_runs.append(add_stream())
+    qps_armed = max(armed_runs)
+    qps_disarmed = max(disarmed_runs)
+    out["audit_add_overhead_pct"] = (
+        max(0.0, (qps_disarmed - qps_armed) / qps_disarmed * 100.0)
+        if qps_disarmed else 0.0)
+    out["audit_add_qps"] = qps_armed
+
+    def total_dups(rep) -> int:
+        return sum(o.get("dups", 0)
+                   for t in rep.get("tables", [])
+                   if isinstance(t.get("server"), dict)
+                   for o in t["server"].get("origins", []))
+
+    with AnonServeClient(endpoint, timeout=30) as client:
+        dups0 = total_dups(json.loads(client.ops_report("audit")))
+        rt.set_fault_n("dup", 1)
+        t0 = time.perf_counter()
+        rt.array_add(h, delta)               # blocking: on the wire now
+        detect = -1.0
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            rep = json.loads(client.ops_report("audit"))
+            if total_dups(rep) > dups0:
+                detect = (time.perf_counter() - t0) * 1e3
+                break
+            time.sleep(0.002)
+        rt.clear_faults()
+    out["audit_detect_ms"] = detect
+    out["audit_dup_named"] = 1.0 if detect >= 0 else 0.0
+    return out
+
+
 def _raise_fd_limit(need: int) -> None:
     import resource
 
@@ -353,6 +488,8 @@ def main() -> int:
         eps = [ln.strip() for ln in open(mf) if ln.strip()]
         if mode == "latency":
             out = _latency_herd(eps[0], nclients, rt)
+        elif mode == "audit":
+            out = _audit_bench(eps[0], nclients, rt, h)
         elif mode == "ops":
             # A/B the latency phase: plain, then under a live in-band
             # scraper — the delta is what introspection costs serving.
@@ -369,7 +506,10 @@ def main() -> int:
 
     # Zero lost adds: the exact final value, read through the fleet
     # (busy-shed retries until admitted — sheds are retryable by
-    # contract, rc -6 means the server did no work).
+    # contract, rc -6 means the server did no work).  mode=audit skips
+    # the exact-value check: its add streams (and the deliberately
+    # injected duplicate, which double-applies by design) change the
+    # total — the audit books, not the value, are its assertion.
     want = 1.0 + (CHAOS_ADDS if chaos else 0)
     for attempt in range(60):
         try:
@@ -379,7 +519,8 @@ def main() -> int:
             time.sleep(0.05)
     else:
         raise RuntimeError("get shed 60 times in a row")
-    np.testing.assert_allclose(got, want)
+    if mode != "audit":
+        np.testing.assert_allclose(got, want)
 
     if rank == 0:
         st = rt.fanin_stats()

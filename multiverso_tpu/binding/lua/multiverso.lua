@@ -129,6 +129,7 @@ int MV_ReplicaStats(int32_t handle, long long* hits, long long* misses,
                     long long* pushes);
 char* MV_OpsFleetReport(const char* kind);
 int MV_SetWireTiming(int on);
+int MV_SetAudit(int on);
 int MV_ClockOffset(int rank, long long* offset_ns, long long* rtt_ns);
 int MV_SetProfiler(int hz);
 char* MV_ProfilerDump(void);
@@ -476,6 +477,14 @@ end
 --- boot value: -wire_timing, docs/observability.md "latency plane").
 function mv.set_wire_timing(on)
   check(C.MV_SetWireTiming(on and 1 or 0), "MV_SetWireTiming")
+end
+
+--- Toggle the delivery-audit plane live (acked-add ledgers, applied
+--- watermarks, dup/reorder/gap anomaly rings; boot value: -audit,
+--- docs/observability.md "audit plane").  mv.ops_report("audit")
+--- serves the JSON books.
+function mv.set_audit(on)
+  check(C.MV_SetAudit(on and 1 or 0), "MV_SetAudit")
 end
 
 --- Best NTP-style clock-offset estimate for a peer rank: returns

@@ -186,6 +186,26 @@ define_int("profile_hz", 0,
            "folded stacks land in trace_rank<r>.json beside spans at "
            "shutdown.  0 (default) disarms; 97 is the house rate")
 
+# --- delivery audit (docs/observability.md "audit plane") ------------------
+define_bool("audit", True,
+            "delivery-audit plane: stamp every native-plane Add with a "
+            "per-(worker, table, shard) seq range, keep acked-add "
+            "ledgers + applied watermarks, and serve the 'audit' "
+            "OpsQuery kind (native-flag parity; tools/mvaudit.py diffs "
+            "the books fleet-wide)")
+define_int("audit_grace_ms", 2000,
+           "delivery-audit gap grace window before the audit_gap "
+           "flight-recorder trigger fires (native-flag parity)")
+define_int("audit_ring", 64,
+           "delivery-audit anomaly ring capacity per server table "
+           "(native-flag parity)")
+define_int("blackbox_keep", 4,
+           "flight-recorder dump rotation: timestamped "
+           "blackbox_rank<r>.<ts>.<n>.json archives retained per rank "
+           "beside the canonical latest dump, listed in "
+           "blackbox_rank<r>.manifest.json (a second trigger no "
+           "longer overwrites the first dump's evidence)")
+
 # --- wire data plane (docs/wire_compression.md) ----------------------------
 define_string("wire_codec", "raw",
               "payload codec for table wire traffic: raw|1bit|sparse. "

@@ -217,6 +217,8 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_SetHotKeyTracking.restype = ctypes.c_int
     lib.MV_SetWireTiming.argtypes = [ctypes.c_int]
     lib.MV_SetWireTiming.restype = ctypes.c_int
+    lib.MV_SetAudit.argtypes = [ctypes.c_int]
+    lib.MV_SetAudit.restype = ctypes.c_int
     lib.MV_ClockOffset.argtypes = [ctypes.c_int,
                                    ctypes.POINTER(ctypes.c_longlong),
                                    ctypes.POINTER(ctypes.c_longlong)]
@@ -340,8 +342,10 @@ class AsyncGet:
             return
         try:
             self._rt.lib.MV_CancelGet(self._ticket)
-        except Exception:
-            pass  # interpreter teardown / already reclaimed at shutdown
+        except Exception:  # mvlint: disable=MV015 — __del__ at
+            # interpreter teardown: the lib may already be reclaimed,
+            # and raising from a finalizer only aborts the teardown.
+            pass
 
 
 class HostArena:
@@ -837,6 +841,27 @@ class NativeRuntime:
         (docs/observability.md "latency plane")."""
         self._check(self.lib.MV_SetWireTiming(1 if on else 0),
                     "MV_SetWireTiming")
+
+    def set_audit(self, on: bool = True) -> None:
+        """Toggle the delivery-audit plane live (boot value: the
+        ``-audit`` flag, default ON; docs/observability.md "audit
+        plane").  Armed, every Add carries a per-(worker, table,
+        shard) seq range, acks advance the client acked-add ledger,
+        and server tables keep per-origin applied watermarks with
+        dup/reorder/gap anomaly rings — the ``audit_overhead_pct``
+        A/B toggle."""
+        self._check(self.lib.MV_SetAudit(1 if on else 0), "MV_SetAudit")
+
+    def audit_report(self) -> dict:
+        """This rank's delivery-audit books (the ``"audit"`` OpsQuery
+        kind, parsed): per table, the worker acked-add ledger
+        (sent/acked per shard stream), the server delivery book
+        (per-origin watermark, dups, reorders, pending out-of-order
+        ranges, anomaly ring) and per-bucket content checksums.
+        ``tools/mvaudit.py`` diffs these fleet-wide."""
+        import json
+
+        return json.loads(self.ops_report("audit"))
 
     def clock_offset(self, rank: int):
         """Best NTP-style clock-offset estimate for a peer rank, as

@@ -256,6 +256,15 @@ char* MV_OpsFleetReport(const char* kind);
 // included) and the per-peer clock-offset estimator.  The "latency"
 // OpsQuery kind / MV_OpsReport("latency") serves the JSON breakdown.
 int MV_SetWireTiming(int on);
+// Toggle the delivery-audit plane live (boot value: `-audit`, default
+// ON; docs/observability.md "audit plane").  Armed, every worker Add
+// carries a per-(worker, table, shard) seq range behind a wire flag,
+// ReplyAdd acks echo it into the client acked-add ledger, and server
+// tables keep per-origin applied watermarks + dup/reorder/gap anomaly
+// rings with an `audit_gap` flight-recorder trigger past
+// `-audit_grace_ms`.  The "audit" OpsQuery kind / MV_OpsReport("audit")
+// serves the JSON books; tools/mvaudit.py diffs them fleet-wide.
+int MV_SetAudit(int on);
 // Best current NTP-style clock-offset estimate for a peer rank:
 // *offset_ns is how far the peer's monotonic clock runs ahead of this
 // process's; *rtt_ns the minimum observed round trip backing it.

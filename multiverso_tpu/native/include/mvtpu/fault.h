@@ -40,6 +40,13 @@ class Fault {
   // the shared "delay_ms" knob sets the length.
   static int64_t ApplyDelayMs();
 
+  // Consult once per server-side RequestAdd: true = SILENTLY discard
+  // the delivered add before it is applied or booked — the seeded
+  // "real loss" the delivery-audit plane (docs/observability.md
+  // "audit plane") must detect as an audit_gap; retry cannot absorb it
+  // because the wire delivery succeeded.  kind "discard_apply".
+  static bool DiscardApply();
+
   // kind: drop | delay | dup | fail_send (probability per op in [0,1]);
   // delay_ms sets the injected delay length.  Returns 0, -1 on unknown
   // kind / bad rate.

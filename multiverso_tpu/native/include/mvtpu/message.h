@@ -117,6 +117,12 @@ inline constexpr int32_t kAcceptSparse = 1 << 2;
 // the length-prefixed frame) — replies only carry a trail when the
 // REQUEST did, so an old client is never handed bytes it cannot parse.
 inline constexpr int32_t kHasTiming = 1 << 3;
+// Delivery-audit stamp (docs/observability.md "audit plane"): an
+// AuditStamp follows the WireHeader (after the TimingTrail when both
+// bits are set).  Version-tolerant exactly like kHasTiming: peers that
+// never stamp ship/parse the old layout, and replies carry a stamp
+// only when the request did.
+inline constexpr int32_t kHasAudit = 1 << 4;
 }  // namespace msgflag
 
 // Wire-stamped request-lifecycle timing trail (docs/observability.md):
@@ -138,6 +144,22 @@ struct TimingTrail {
     kStamps = 6,
   };
   int64_t t[kStamps] = {0, 0, 0, 0, 0, 0};
+};
+
+// Delivery-audit identity (docs/observability.md "audit plane"): the
+// inclusive range of per-(worker, table, server-shard) Add sequence
+// numbers this message covers.  A plain add covers one seq (lo == hi);
+// a PR 5 aggregation flush covers the whole collapsed window, so the
+// auditor can account every absorbed logical add through the single
+// wire message that carried it.  The origin rank rides in the header's
+// `src`; seqs start at 1 and are dense PER SHARD STREAM — each server
+// shard observes 1,2,3,... from each origin, which is what makes the
+// applied watermark (mvtpu/audit.h) a loss/dup/reorder detector rather
+// than a heuristic.  Retries re-send the identical stamp: a duplicated
+// delivery is counted as a dup, never double-advanced.
+struct AuditStamp {
+  int64_t seq_lo = 0;
+  int64_t seq_hi = 0;
 };
 
 // Fixed-size wire header — ONE definition shared by Message::Serialize
@@ -185,9 +207,15 @@ struct Message {
   // the server copies the trail into the reply and adds its own, and
   // the client attributes the round trip per stage on reply receipt.
   TimingTrail timing;
+  // Delivery-audit stamp — on the wire ONLY when flags carries
+  // kHasAudit (docs/observability.md "audit plane"): Add requests
+  // carry the covered seq range, the server's ReplyAdd ack echoes it
+  // so the client ledger can advance its acked watermark.
+  AuditStamp audit;
   std::vector<Blob> data;
 
   bool has_timing() const { return (flags & msgflag::kHasTiming) != 0; }
+  bool has_audit() const { return (flags & msgflag::kHasAudit) != 0; }
 
   // Header <-> message field marshalling (shared by Serialize and the
   // transport's scatter-gather framing).

@@ -18,6 +18,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "mvtpu/audit.h"
 #include "mvtpu/codec.h"
 #include "mvtpu/message.h"
 #include "mvtpu/mutex.h"
@@ -146,6 +147,26 @@ class ServerTable {
     return replica_pushes_.load(std::memory_order_relaxed);
   }
 
+  // ---- delivery audit (docs/observability.md "audit plane") ----------
+  // Book one applied stamped add: the server actor calls this right
+  // after ProcessAdd for every RequestAdd carrying an AuditStamp, so
+  // the per-(table, origin) applied watermark tracks exactly what the
+  // updaters saw.  No-op when the message is unstamped or -audit=false.
+  void NoteAuditApply(const Message& req) {
+    if (!req.has_audit() || !audit::Armed()) return;
+    audit_book_.NoteApply(req.src, req.audit.seq_lo, req.audit.seq_hi,
+                          obs_table_id_);
+  }
+  audit::DeliveryBook& audit_book() { return audit_book_; }
+  const audit::DeliveryBook& audit_book() const { return audit_book_; }
+  // Per-bucket content checksums (CRC32 over table state, bucket
+  // mapping shared with the PR 4 version stamps): the replica-
+  // divergence primitive — two shards holding the same rows report
+  // identical values, independent of iteration order (XOR of per-entry
+  // CRCs seeded by the entry's identity).  The base reports a single
+  // whole-shard checksum; bucket-granular kinds override.
+  virtual std::vector<uint32_t> BucketChecksums() const { return {}; }
+
  protected:
   void NoteReplicaPush() {
     replica_pushes_.fetch_add(1, std::memory_order_relaxed);
@@ -221,6 +242,7 @@ class ServerTable {
   std::atomic<int64_t> total_adds_{0};
   workload::HotKeyTracker tracker_;
   std::atomic<int64_t> replica_pushes_{0};
+  audit::DeliveryBook audit_book_;
   mutable Mutex health_mu_;
   double add_l2sq_ GUARDED_BY(health_mu_) = 0.0;
   double add_linf_ GUARDED_BY(health_mu_) = 0.0;
@@ -237,6 +259,7 @@ class ArrayServerTable : public ServerTable {
   void ProcessAdd(const Message& req) override;
   bool Store(Stream* out) const override;
   bool Load(Stream* in) override;
+  std::vector<uint32_t> BucketChecksums() const override;
   int64_t size() const {
     MutexLock lk(mu_);
     return static_cast<int64_t>(data_.size());
@@ -262,6 +285,7 @@ class MatrixServerTable : public ServerTable {
   void BuildReplica(Message* reply) override;
   bool Store(Stream* out) const override;
   bool Load(Stream* in) override;
+  std::vector<uint32_t> BucketChecksums() const override;
   int64_t rows() const { return range_.len(); }
   int64_t cols() const { return cols_; }
 
@@ -369,6 +393,18 @@ class WorkerTable {
     return f;
   }
 
+  // ---- delivery audit (docs/observability.md "audit plane") ----------
+  // Stamp an outbound RequestAdd headed for server shard `shard` with
+  // the next seq range of that shard's stream (msgflag::kHasAudit).
+  // Inside a FlushAdds window the range covers every collapsed logical
+  // add (the PR 5 agg accounting); otherwise one.  No-op disarmed.
+  void StampAuditAdd(Message* req, int shard);
+  // The acked-add ledger: per shard, last seq sent and last seq acked
+  // (advanced by ReplyAdd acks in Notify — per-connection FIFO makes
+  // an ack cover every earlier seq on the stream).
+  audit::AckLedger& ack_ledger() { return ack_ledger_; }
+  std::string AuditLedgerJson() const { return ack_ledger_.Json(); }
+
   // ---- add aggregation (docs/wire_compression.md) --------------------
   // With `-add_agg_ms`/`-add_agg_bytes` armed, ASYNC dense adds are
   // summed into a local per-table buffer and shipped as ONE
@@ -447,6 +483,7 @@ class WorkerTable {
   };
   std::unordered_map<int64_t, Pending> pending_ GUARDED_BY(mu_);
   std::atomic<int64_t> last_version_{0};
+  audit::AckLedger ack_ledger_;
 
   // Wire codec (set at registration; MV_SetTableCodec may retarget).
   std::atomic<int32_t> codec_{static_cast<int32_t>(Codec::kRaw)};
@@ -631,6 +668,7 @@ class KVServerTable : public ServerTable {
   void ProcessAdd(const Message& req) override;
   bool Store(Stream* out) const override;
   bool Load(Stream* in) override;
+  std::vector<uint32_t> BucketChecksums() const override;
   size_t size() const;
 
  private:

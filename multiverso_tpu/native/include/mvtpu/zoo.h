@@ -108,6 +108,12 @@ class Zoo {
   // sentinels — the "hotkeys" OpsQuery kind / MV_HotKeys payload.
   // id >= 0 restricts to one table.
   std::string OpsHotKeysJson(int32_t id = -1);
+  // Delivery-audit plane (docs/observability.md "audit plane"): per
+  // table, the worker-side acked-add ledger (sent/acked per shard
+  // stream) and the server-side delivery book (per-origin applied
+  // watermark, dup/reorder/gap anomalies, pending out-of-order ranges)
+  // plus per-bucket content checksums — the "audit" OpsQuery kind.
+  std::string OpsAuditJson();
   // Run a fleet-scope aggregation SYNCHRONOUSLY from this rank (the
   // same bounded fan-out an inbound fleet OpsQuery triggers) — the
   // engine-agnostic entry point: on the blocking tcp engine, where no

@@ -5,6 +5,7 @@
 #include <stdexcept>
 #include <unordered_map>
 
+#include "mvtpu/audit.h"
 #include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
@@ -646,6 +647,13 @@ char* MV_OpsReport(const char* kind) {
 
 int MV_SetWireTiming(int on) {
   mvtpu::latency::Arm(on != 0);
+  return 0;
+}
+
+// ---- delivery-audit plane (docs/observability.md "audit plane") ------
+
+int MV_SetAudit(int on) {
+  mvtpu::audit::Arm(on != 0);
   return 0;
 }
 

@@ -322,6 +322,30 @@ void RegisterDefaults() {
               "boots disarmed; MV_SetProfiler toggles live.  97 Hz is "
               "the house rate — prime, so it cannot phase-lock with "
               "millisecond-periodic work");
+    DefineBool("audit", true,
+               "delivery-audit plane (docs/observability.md \"audit "
+               "plane\"): stamp every Add with a per-(worker, table, "
+               "shard) seq range behind a wire flag, keep client "
+               "acked-add ledgers + server per-origin applied "
+               "watermarks with dup/reorder/gap anomaly rings, and "
+               "serve the \"audit\" OpsQuery kind.  false compiles "
+               "every site down to one relaxed atomic load "
+               "(MV_SetAudit toggles live — the overhead A/B)");
+    DefineInt("audit_grace_ms", 2000,
+              "delivery-audit gap grace window: an out-of-order "
+              "pending range older than this fires the audit_gap "
+              "flight-recorder trigger (a benign reorder drains in "
+              "round-trip time; a real loss never does)");
+    DefineInt("audit_ring", 64,
+              "delivery-audit anomaly ring capacity per server table "
+              "(recent dup/reorder/gap records with their seq ranges "
+              "and origins, served in the \"audit\" report)");
+    DefineInt("blackbox_keep", 4,
+              "flight-recorder dump rotation: keep this many "
+              "timestamped blackbox_rank<r>.<ts>.json archives per "
+              "rank beside the canonical latest dump (a second "
+              "trigger no longer overwrites the first dump's "
+              "evidence); a manifest lists the retained dumps");
     DefineInt("shed_storm_threshold", 0,
               "flight-recorder trigger: this many CONSECUTIVE busy-sheds "
               "(-server_inflight_max) dump the black box once per storm "

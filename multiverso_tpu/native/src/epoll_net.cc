@@ -142,6 +142,9 @@ struct EpollNet::PendingFrame {
     // Latency trail rides between header and blob prefixes (message.cc
     // Serialize order); head.frame_len already counts it (WireBytes).
     if (msg.has_timing()) push(&msg.timing, sizeof(TimingTrail));
+    // Delivery-audit stamp rides after the trail (same Serialize
+    // order); head.frame_len counts it via WireBytes().
+    if (msg.has_audit()) push(&msg.audit, sizeof(AuditStamp));
     for (size_t i = 0; i < msg.data.size(); ++i) {
       push(&lens[i], sizeof(int64_t));
       push(msg.data[i].data(), msg.data[i].size());

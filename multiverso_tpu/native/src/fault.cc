@@ -22,6 +22,7 @@ struct State {
   Knob dup;
   Knob fail_send;
   Knob apply_delay;
+  Knob discard_apply;
   int64_t delay_ms = 50;
   uint64_t rng = 0x9e3779b97f4a7c15ull;
 };
@@ -66,6 +67,7 @@ Knob* Find(const char* kind) REQUIRES(g_mu) {
   if (k == "dup") return &S().dup;
   if (k == "fail_send") return &S().fail_send;
   if (k == "apply_delay") return &S().apply_delay;
+  if (k == "discard_apply") return &S().discard_apply;
   return nullptr;
 }
 
@@ -73,7 +75,8 @@ void Recompute() REQUIRES(g_mu) {
   State& s = S();
   auto live = [](const Knob& k) { return k.rate > 0.0 || k.budget > 0; };
   g_enabled.store(live(s.drop) || live(s.delay) || live(s.dup) ||
-                      live(s.fail_send) || live(s.apply_delay),
+                      live(s.fail_send) || live(s.apply_delay) ||
+                      live(s.discard_apply),
                   std::memory_order_relaxed);
 }
 
@@ -97,6 +100,7 @@ void InitFromEnvLocked() REQUIRES(g_mu) {
   s.dup.rate = EnvRate("MVTPU_FAULT_DUP");
   s.fail_send.rate = EnvRate("MVTPU_FAULT_FAIL_SEND");
   s.apply_delay.rate = EnvRate("MVTPU_FAULT_APPLY_DELAY");
+  s.discard_apply.rate = EnvRate("MVTPU_FAULT_DISCARD_APPLY");
   if (const char* v = getenv("MVTPU_FAULT_DELAY_MS")) s.delay_ms = atoll(v);
   Recompute();
 }
@@ -139,6 +143,14 @@ int64_t Fault::ApplyDelayMs() {
   int64_t ms = S().delay_ms;
   Recompute();
   return ms;
+}
+
+bool Fault::DiscardApply() {
+  if (!Enabled()) return false;
+  MutexLock lk(g_mu);
+  bool fire = Fire(&S().discard_apply);
+  if (fire) Recompute();
+  return fire;
 }
 
 bool Fault::FailSendAttempt() {
@@ -184,6 +196,7 @@ void Fault::Clear() {
   s.dup = Knob{};
   s.fail_send = Knob{};
   s.apply_delay = Knob{};
+  s.discard_apply = Knob{};
   Recompute();
 }
 

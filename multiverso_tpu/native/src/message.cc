@@ -33,6 +33,7 @@ void Message::AdoptWireHeader(const WireHeader& h) {
 int64_t Message::WireBytes() const {
   int64_t total = static_cast<int64_t>(sizeof(WireHeader));
   if (has_timing()) total += static_cast<int64_t>(sizeof(TimingTrail));
+  if (has_audit()) total += static_cast<int64_t>(sizeof(AuditStamp));
   for (const auto& b : data)
     total += static_cast<int64_t>(sizeof(int64_t) + b.size());
   return total;
@@ -48,6 +49,10 @@ Blob Message::Serialize() const {
   if (has_timing()) {
     std::memcpy(p, &timing, sizeof(timing));
     p += sizeof(timing);
+  }
+  if (has_audit()) {
+    std::memcpy(p, &audit, sizeof(audit));
+    p += sizeof(audit);
   }
   for (const auto& b : data) {
     int64_t len = static_cast<int64_t>(b.size());
@@ -68,15 +73,23 @@ bool Message::DeserializeView(std::shared_ptr<std::vector<char>> slab,
   out->AdoptWireHeader(h);
   out->data.clear();
   out->timing = TimingTrail{};
+  out->audit = AuditStamp{};
   size_t pos = sizeof(h);
   // Optional latency trail (docs/observability.md): present iff the
   // sender set kHasTiming — an old-header frame parses exactly as
   // before, and a flagged frame too short to hold the trail is
   // malformed, not a silent misparse of blob bytes as timestamps.
   if (out->has_timing()) {
-    if (len < sizeof(WireHeader) + sizeof(TimingTrail)) return false;
+    if (len < pos + sizeof(TimingTrail)) return false;
     std::memcpy(&out->timing, base + pos, sizeof(TimingTrail));
     pos += sizeof(TimingTrail);
+  }
+  // Optional delivery-audit stamp (docs/observability.md "audit
+  // plane"): same version-tolerance discipline as the trail.
+  if (out->has_audit()) {
+    if (len < pos + sizeof(AuditStamp)) return false;
+    std::memcpy(&out->audit, base + pos, sizeof(AuditStamp));
+    pos += sizeof(AuditStamp);
   }
   // num_blobs comes off the wire: bound it against the frame BEFORE the
   // reserve — each blob costs at least its 8-byte length prefix, so a
@@ -122,6 +135,10 @@ Message Message::Deserialize(const Blob& buf) {
   if (m.has_timing()) {
     std::memcpy(&m.timing, p, sizeof(m.timing));
     p += sizeof(m.timing);
+  }
+  if (m.has_audit()) {
+    std::memcpy(&m.audit, p, sizeof(m.audit));
+    p += sizeof(m.audit);
   }
   m.data.reserve(static_cast<size_t>(h.num_blobs));
   for (int32_t i = 0; i < h.num_blobs; ++i) {

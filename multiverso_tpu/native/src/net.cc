@@ -161,6 +161,11 @@ bool TcpNet::SendFramed(int fd, const Message& msg) {
   if (msg.has_timing())
     iov.push_back({const_cast<TimingTrail*>(&msg.timing),
                    sizeof(TimingTrail)});
+  // Delivery-audit stamp rides after the trail (message.cc Serialize
+  // order); WireBytes() already counts it.
+  if (msg.has_audit())
+    iov.push_back({const_cast<AuditStamp*>(&msg.audit),
+                   sizeof(AuditStamp)});
   for (size_t i = 0; i < msg.data.size(); ++i) {
     lens[i] = static_cast<int64_t>(msg.data[i].size());
     iov.push_back({&lens[i], sizeof(int64_t)});

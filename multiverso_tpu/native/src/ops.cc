@@ -277,6 +277,11 @@ std::string LocalReport(const std::string& kind) {
   // Latency-attribution plane (docs/observability.md): stage
   // histograms + clock offsets + profiler status.
   if (kind == "latency") return LatencyJson();
+  // Delivery-audit plane (docs/observability.md "audit plane"):
+  // acked-add ledgers, per-origin applied watermarks, dup/reorder/gap
+  // anomalies, bucket checksums.  Fleet scope free via the JSON merge;
+  // tools/mvaudit.py diffs acked-vs-applied across the fleet.
+  if (kind == "audit") return Zoo::Get()->OpsAuditJson();
   return "{\"error\":\"unknown ops kind '" + JsonEscape(kind) + "'\"}";
 }
 
@@ -295,6 +300,65 @@ void BuildReply(const Message& query, Message* reply) {
 }
 
 // ---- flight recorder -------------------------------------------------
+
+namespace {
+
+// Dump rotation: beside the canonical blackbox_rank<r>.json (always the
+// LATEST dump — every existing reader keeps working), each trigger also
+// lands a timestamped archive blackbox_rank<r>.<ts_us>.<n>.json, and a
+// small manifest lists the retained archives.  Keep-N (-blackbox_keep)
+// prunes the oldest — a second trigger on the same rank no longer
+// destroys the first dump's evidence.
+Mutex g_rot_mu;
+std::deque<std::string> g_archives GUARDED_BY(g_rot_mu);
+long long g_dump_seq GUARDED_BY(g_rot_mu) = 0;
+
+bool WriteWhole(const std::string& path, const std::string& doc) {
+  std::FILE* fp = std::fopen(path.c_str(), "wb");
+  if (!fp) return false;
+  size_t wrote = std::fwrite(doc.data(), 1, doc.size(), fp);
+  std::fclose(fp);
+  return wrote == doc.size();
+}
+
+void RotateDump(const std::string& dir, const std::string& doc) {
+  size_t keep = static_cast<size_t>(
+      std::max<long long>(1, configure::Has("blackbox_keep")
+                                 ? configure::GetInt("blackbox_keep")
+                                 : 4));
+  int rank = Zoo::Get()->rank();
+  std::string base = "blackbox_rank" + std::to_string(rank);
+  MutexLock lk(g_rot_mu);
+  // ts + per-process seq: two triggers in the same microsecond (or a
+  // stepped clock) still get distinct archive names.
+  std::string name = base + "." + std::to_string(NowUs()) + "." +
+                     std::to_string(++g_dump_seq) + ".json";
+  if (!WriteWhole(dir + "/" + name, doc)) {
+    Log::Error("blackbox: cannot archive %s", name.c_str());
+    return;
+  }
+  g_archives.push_back(name);
+  while (g_archives.size() > keep) {
+    std::remove((dir + "/" + g_archives.front()).c_str());
+    g_archives.pop_front();
+  }
+  std::ostringstream m;
+  m << "{\"rank\":" << rank << ",\"keep\":" << keep << ",\"dumps\":[";
+  for (size_t i = 0; i < g_archives.size(); ++i) {
+    if (i) m << ',';
+    m << "\"" << g_archives[i] << "\"";
+  }
+  m << "],\"total_triggers\":" << g_dump_seq << "}";
+  std::string mpath = dir + "/" + base + ".manifest.json";
+  std::string mtmp = mpath + ".tmp";
+  if (!WriteWhole(mtmp, m.str()) ||
+      std::rename(mtmp.c_str(), mpath.c_str()) != 0) {
+    Log::Error("blackbox: manifest write failed for %s", mpath.c_str());
+    std::remove(mtmp.c_str());
+  }
+}
+
+}  // namespace
 
 void BlackboxEvent(const std::string& kind, const std::string& detail) {
   size_t cap = static_cast<size_t>(
@@ -388,6 +452,7 @@ std::string BlackboxTrigger(const std::string& reason) {
     std::remove(tmp.c_str());
     return "";
   }
+  RotateDump(dir, doc);
   Log::Error("blackbox: dumped flight recorder to %s (reason: %s)",
              path.c_str(), reason.c_str());
   return path;
@@ -403,6 +468,12 @@ void BlackboxReset() {
     MutexLock lk(g_box_mu);
     g_events.clear();
     g_triggers = 0;
+  }
+  {
+    // Forget the rotation ledger (files on disk stay); g_dump_seq keeps
+    // counting so archive names never collide across resets.
+    MutexLock lk(g_rot_mu);
+    g_archives.clear();
   }
   MutexLock lk(g_mu);
   g_host_metrics.clear();

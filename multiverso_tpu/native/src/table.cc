@@ -192,6 +192,13 @@ bool ArrayServerTable::Load(Stream* in) {
   return true;
 }
 
+std::vector<uint32_t> ArrayServerTable::BucketChecksums() const {
+  // Arrays version whole-shard (BumpVersion(-1)), so one whole-shard
+  // checksum is the matching granularity.
+  MutexLock lk(mu_);
+  return {audit::Crc32(data_.data(), data_.size() * sizeof(float))};
+}
+
 MatrixServerTable::MatrixServerTable(int64_t rows, int64_t cols,
                                      UpdaterType updater, int rank, int size)
     : global_rows_(rows), cols_(cols), range_(ShardOf(rows, rank, size)),
@@ -408,6 +415,25 @@ bool MatrixServerTable::Load(Stream* in) {
   return true;
 }
 
+std::vector<uint32_t> MatrixServerTable::BucketChecksums() const {
+  // Per-bucket beacons on the SAME row->bucket map the version stamps
+  // use: each row's CRC is seeded with its GLOBAL row id (identical
+  // rows in different slots must not cancel) and XORed into its
+  // bucket, so the value is independent of iteration order and of how
+  // rows are distributed across replicas of the same shard.
+  std::vector<uint32_t> out(kVersionBuckets, 0);
+  MutexLock lk(mu_);
+  for (int64_t r = 0; r < range_.len(); ++r) {
+    int64_t gid = range_.begin + r;
+    uint32_t seed = audit::Crc32(&gid, sizeof(gid));
+    uint32_t c = audit::Crc32(data_.data() + r * cols_,
+                              static_cast<size_t>(cols_) * sizeof(float),
+                              seed);
+    out[RowBucket(gid)] ^= c;
+  }
+  return out;
+}
+
 // -------------------------------------------------------------------- KV
 
 Blob PackKeys(const std::vector<std::string>& keys) {
@@ -516,6 +542,21 @@ size_t KVServerTable::size() const {
   return data_.size();
 }
 
+std::vector<uint32_t> KVServerTable::BucketChecksums() const {
+  // Order-independent by construction: unordered_map iteration order
+  // is load-factor dependent, so each entry's CRC (value seeded by the
+  // key's CRC) XORs into its KVHash bucket — two shards holding the
+  // same pairs agree bit for bit.
+  std::vector<uint32_t> out(kVersionBuckets, 0);
+  MutexLock lk(mu_);
+  for (const auto& kv : data_) {
+    uint32_t seed = audit::Crc32(kv.first.data(), kv.first.size());
+    uint32_t c = audit::Crc32(&kv.second, sizeof(float), seed);
+    out[KVHash(kv.first.data(), kv.first.size()) % kVersionBuckets] ^= c;
+  }
+  return out;
+}
+
 bool KVServerTable::Store(Stream* out) const {
   MutexLock lk(mu_);
   int64_t n = static_cast<int64_t>(data_.size());
@@ -573,6 +614,14 @@ bool KVServerTable::Load(Stream* in) {
 namespace {
 thread_local bool g_rt_busy = false;
 
+// Delivery audit (docs/observability.md "audit plane"): while FlushAdds
+// ships a collapsed aggregation window, every message it creates covers
+// this many logical adds — the seq RANGE the wire stamp carries, so the
+// auditor can account each absorbed add through the one message that
+// carried it.  Thread-local because the flush runs on the caller's
+// thread and a concurrent plain add on another thread must keep span 1.
+thread_local int64_t g_audit_flush_span = 0;
+
 // Active host-bridge borrow window (docs/host_bridge.md) — thread-local
 // because the *Borrowed C API runs table ops on the caller's thread and
 // the window must never leak into unrelated ops on other threads.
@@ -618,6 +667,18 @@ bool BorrowCovers(const void* p, size_t bytes) {
          cp + bytes <= g_borrow.base + g_borrow.len;
 }
 }  // namespace
+
+// ---- delivery audit (docs/observability.md "audit plane") ------------
+
+void WorkerTable::StampAuditAdd(Message* req, int shard) {
+  if (!audit::Armed()) return;
+  int64_t span = g_audit_flush_span > 0 ? g_audit_flush_span : 1;
+  int64_t lo = 0, hi = 0;
+  ack_ledger_.NextRange(shard, span, &lo, &hi);
+  req->flags |= msgflag::kHasAudit;
+  req->audit.seq_lo = lo;
+  req->audit.seq_hi = hi;
+}
 
 // ---- wire codec + add aggregation (docs/wire_compression.md) ---------
 
@@ -715,7 +776,11 @@ void WorkerTable::FlushAdds() {
   // count = flush windows, total = adds collapsed: total/count is the
   // adds-per-wire-message ratio the bench/demo report.
   Dashboard::Record("agg.flush", static_cast<double>(adds));
+  // Audit accounting: every message this flush creates covers the whole
+  // collapsed window's seq range (docs/observability.md "audit plane").
+  g_audit_flush_span = adds;
   SendAggregate(sum.data(), static_cast<int64_t>(sum.size()), opt);
+  g_audit_flush_span = 0;
 }
 
 void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
@@ -732,6 +797,16 @@ void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
     if (adopt) Dashboard::SetThreadTraceId(reply.trace_id);
     latency::OnReply(reply, reply.src);
     if (adopt) Dashboard::SetThreadTraceId(prev_tid);
+  }
+  // Delivery audit: a ReplyAdd echoing its request's stamp advances
+  // the acked watermark for that shard's stream — recorded BEFORE the
+  // pending lookup, because an ack landing after the round trip's
+  // deadline still proves the server applied those seqs (the very
+  // distinction between "never acked" and "lost" the auditor draws).
+  if (reply.type == MsgType::ReplyAdd && reply.has_audit() &&
+      audit::Armed()) {
+    int shard = Zoo::Get()->server_index(reply.src);
+    if (shard >= 0) ack_ledger_.Ack(shard, reply.audit.seq_hi);
   }
   // Serve layer: every reply's version stamp refreshes the free local
   // lower bound on the server version (max-merge; replies can race).
@@ -1012,6 +1087,7 @@ bool ArrayWorkerTable::SendAdd(const float* delta, int64_t size,
     ShardRange rg = ShardOf(global_, r, servers_);
     if (rg.begin >= size) continue;
     auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
+    StampAuditAdd(req.get(), r);
     req->data.emplace_back(&opt, sizeof(opt));
     AppendEncodedDelta(req.get(), delta + rg.begin,
                        std::min(rg.len(), size - rg.begin), rg.begin,
@@ -1280,6 +1356,7 @@ bool MatrixWorkerTable::SendAddAll(const float* delta, const AddOption& opt,
     ShardRange rg = ShardOf(rows_, r, servers_);
     if (rg.len() == 0) continue;
     auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
+    StampAuditAdd(req.get(), r);
     req->data.emplace_back(&opt, sizeof(opt));
     AppendEncodedDelta(req.get(), delta + rg.begin * cols_,
                        rg.len() * cols_, rg.begin * cols_, rows_ * cols_);
@@ -1344,6 +1421,7 @@ bool MatrixWorkerTable::SendAddRows(const int32_t* row_ids, int64_t k,
     if (all_valid) {
       int64_t msg_id = blocking ? Zoo::Get()->NextMsgId() : -1;
       auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, 0);
+      StampAuditAdd(req.get(), 0);
       req->data.emplace_back(&opt, sizeof(opt));
       req->data.emplace_back(row_ids, static_cast<size_t>(k) *
                                           sizeof(int32_t));
@@ -1406,6 +1484,7 @@ bool MatrixWorkerTable::SendAddRows(const int32_t* row_ids, int64_t k,
         for (int r = 0; r < servers_; ++r) {
           if (ids[r].empty()) continue;
           auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
+          StampAuditAdd(req.get(), r);
           req->data.emplace_back(&opt, sizeof(opt));
           req->data.emplace_back(ids[r].data(),
                                  ids[r].size() * sizeof(int32_t));
@@ -1439,6 +1518,7 @@ bool MatrixWorkerTable::SendAddRows(const int32_t* row_ids, int64_t k,
   for (int r = 0; r < servers_; ++r) {
     if (per_rank_ids[r].empty()) continue;
     auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
+    StampAuditAdd(req.get(), r);
     req->data.emplace_back(&opt, sizeof(opt));
     req->data.emplace_back(per_rank_ids[r].data(),
                            per_rank_ids[r].size() * sizeof(int32_t));
@@ -1638,6 +1718,7 @@ bool KVWorkerTable::Add(const std::vector<std::string>& keys,
   for (int r = 0; r < servers_; ++r) {
     if (per_rank[r].empty()) continue;
     auto req = MakeReq(MsgType::RequestAdd, table_id_, msg_id, r);
+    StampAuditAdd(req.get(), r);
     req->data.emplace_back(&opt, sizeof(opt));
     req->data.push_back(PackKeys(per_rank[r]));
     req->data.emplace_back(per_vals[r].data(),

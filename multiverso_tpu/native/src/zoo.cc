@@ -8,6 +8,7 @@
 #include <map>
 #include <sstream>
 
+#include "mvtpu/audit.h"
 #include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
@@ -239,8 +240,20 @@ class ServerActor : public Actor {
           Dashboard::Record("fault.apply_delay", 0.0);
           std::this_thread::sleep_for(std::chrono::milliseconds(d));
         }
+        // Seeded SILENT server-side discard (docs/observability.md
+        // "audit plane"): the add vanishes after the wire delivered it
+        // — no apply, no book entry, no ack.  The one failure class
+        // retry/agg cannot absorb; exists so the audit plane's gap
+        // detection has a real loss to catch (make audit-demo).
+        if (Fault::DiscardApply()) {
+          Dashboard::Record("fault.discard_apply", 0.0);
+          return;
+        }
       }
       table->ProcessAdd(*m);
+      // Delivery audit: book the applied seq range AFTER the apply so
+      // the watermark never runs ahead of table state.
+      table->NoteAuditApply(*m);
       if (m->msg_id >= 0) {  // blocking add wants an ack
         auto reply = std::make_unique<Message>();
         reply->type = MsgType::ReplyAdd;
@@ -252,6 +265,12 @@ class ServerActor : public Actor {
         // The ack carries the post-apply version: a write-through
         // client learns its own add's version for free (serving.md).
         reply->version = table->version();
+        // Echo the audit stamp so the origin's acked-add ledger can
+        // advance its watermark (docs/observability.md "audit plane").
+        if (m->has_audit()) {
+          reply->flags |= msgflag::kHasAudit;
+          reply->audit = m->audit;
+        }
         latency::StampReply(*m, reply.get());
         Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
       }
@@ -458,6 +477,9 @@ bool Zoo::Start(int argc, const char* const* argv) {
   // it live for armed-vs-disarmed overhead A/Bs).
   workload::Arm(configure::GetBool("hotkey_enabled"));
   workload::ArmReplica(configure::GetBool("hotkey_replica"));
+  // Delivery-audit plane (docs/observability.md "audit plane"): -audit
+  // latches the seq stamping + server books; MV_SetAudit toggles live.
+  audit::Arm(configure::GetBool("audit"));
   // Latency plane (docs/observability.md): -wire_timing latches the
   // header-trail stamping; -profile_hz boots the SIGPROF sampler.
   latency::Arm(configure::GetBool("wire_timing"));
@@ -1305,6 +1327,46 @@ std::string Zoo::OpsHotKeysJson(int32_t id) {
     os << "}";
   }
   os << "]";
+  return os.str();
+}
+
+std::string Zoo::OpsAuditJson() {
+  // Snapshot pointers under tables_mu_, read books OUTSIDE it (the
+  // accessors take per-book locks; tables never unregister).
+  std::vector<std::pair<WorkerTable*, ServerTable*>> snapshot;
+  {
+    MutexLock lk(tables_mu_);
+    for (size_t i = 0; i < worker_tables_.size(); ++i)
+      snapshot.emplace_back(
+          worker_tables_[i].get(),
+          i < server_tables_.size() ? server_tables_[i].get() : nullptr);
+  }
+  std::ostringstream os;
+  os << "{\"rank\":" << rank_ << ",\"armed\":"
+     << (audit::Armed() ? "true" : "false") << ",\"tables\":[";
+  for (size_t i = 0; i < snapshot.size(); ++i) {
+    auto [wt, st] = snapshot[i];
+    if (i) os << ',';
+    os << "{\"id\":" << i;
+    if (wt) os << ",\"worker\":" << wt->AuditLedgerJson();
+    if (st) {
+      // A gap with no follow-up traffic must still fire its grace
+      // deadline — the scrape IS the periodic sweep.
+      st->audit_book().CheckGaps(static_cast<int32_t>(i));
+      os << ",\"server\":" << st->audit_book().Json();
+      os << ",\"checksums\":[";
+      auto sums = st->BucketChecksums();
+      for (size_t b = 0; b < sums.size(); ++b) {
+        if (b) os << ',';
+        os << sums[b];
+      }
+      os << "]";
+    } else {
+      os << ",\"server\":null";
+    }
+    os << "}";
+  }
+  os << "]}";
   return os.str();
 }
 

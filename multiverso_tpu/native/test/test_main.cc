@@ -16,6 +16,7 @@
 #include <thread>
 #include <vector>
 
+#include "mvtpu/audit.h"
 #include "mvtpu/blob.h"
 #include "mvtpu/c_api.h"
 #include "mvtpu/codec.h"
@@ -284,6 +285,159 @@ static int TestLatencyTrail() {
   CHECK(!dis.has_timing());
   mvtpu::latency::Arm(true);
   mvtpu::latency::Reset();
+  return 0;
+}
+
+static int TestAudit() {
+  mvtpu::audit::Arm(true);
+
+  // ---- stamp rides the wire only when flagged (version tolerance) ---
+  mvtpu::Message plain;
+  plain.type = mvtpu::MsgType::RequestAdd;
+  float payload[2] = {1.0f, 2.0f};
+  plain.data.emplace_back(payload, sizeof(payload));
+  int64_t plain_bytes = plain.WireBytes();
+  mvtpu::Message req = plain;
+  req.flags |= mvtpu::msgflag::kHasAudit;
+  req.audit = {7, 12};
+  CHECK(req.WireBytes() == plain_bytes +
+        static_cast<int64_t>(sizeof(mvtpu::AuditStamp)));
+  mvtpu::Message back = mvtpu::Message::Deserialize(req.Serialize());
+  CHECK(back.has_audit());
+  CHECK(back.audit.seq_lo == 7 && back.audit.seq_hi == 12);
+  // Old-header frame (no flag) parses exactly as before, no stamp.
+  mvtpu::Message old_back = mvtpu::Message::Deserialize(plain.Serialize());
+  CHECK(!old_back.has_audit());
+  CHECK(old_back.data.size() == 1 && old_back.data[0].count<float>() == 2);
+  // Timing trail + audit stamp compose (trail first, Serialize order).
+  mvtpu::latency::Arm(true);
+  mvtpu::latency::StampEnqueue(&req);
+  mvtpu::Blob w = req.Serialize();
+  auto slab = std::make_shared<std::vector<char>>(w.data(),
+                                                  w.data() + w.size());
+  mvtpu::Message view;
+  CHECK(mvtpu::Message::DeserializeView(slab, 0, slab->size(), &view));
+  CHECK(view.has_timing() && view.has_audit());
+  CHECK(view.audit.seq_lo == 7 && view.audit.seq_hi == 12);
+  CHECK(view.data[0].count<float>() == 2);
+  // A flagged frame too short for the stamp is malformed, not misread.
+  auto runt = std::make_shared<std::vector<char>>(
+      slab->begin(), slab->begin() + sizeof(mvtpu::WireHeader));
+  mvtpu::Message bad;
+  CHECK(!mvtpu::Message::DeserializeView(runt, 0, runt->size(), &bad));
+
+  // ---- AckLedger: dense per-shard streams + agg range accounting ----
+  mvtpu::audit::AckLedger led;
+  int64_t lo = 0, hi = 0;
+  led.NextRange(0, 1, &lo, &hi);
+  CHECK(lo == 1 && hi == 1);
+  led.NextRange(0, 6, &lo, &hi);       // a 6-add agg flush window
+  CHECK(lo == 2 && hi == 7);
+  led.NextRange(1, 1, &lo, &hi);       // shard 1 is its own stream
+  CHECK(lo == 1 && hi == 1);
+  led.Ack(0, 7);
+  led.Ack(0, 3);                       // stale ack never rolls back
+  auto snap = led.Snapshot();
+  CHECK(snap.size() == 2);
+  CHECK(snap[0].sent == 7 && snap[0].acked == 7);
+  CHECK(snap[1].sent == 1 && snap[1].acked == 0);
+
+  // ---- DeliveryBook: advance / dup / reorder / drain ----------------
+  mvtpu::audit::DeliveryBook book;
+  book.NoteApply(2, 1, 1, 0);
+  book.NoteApply(2, 2, 7, 0);          // agg range advances to 7
+  book.NoteApply(2, 2, 7, 0);          // retry dup: visible, no advance
+  book.NoteApply(2, 9, 9, 0);          // hole at 8: parked
+  book.NoteApply(2, 10, 10, 0);        // still parked
+  book.NoteApply(2, 8, 8, 0);          // hole filled: drains to 10
+  std::string j = book.Json();
+  CHECK(j.find("\"watermark\":10") != std::string::npos);
+  CHECK(j.find("\"dups\":1") != std::string::npos);
+  CHECK(j.find("\"reorders\":2") != std::string::npos);
+  CHECK(j.find("\"pending\":[]") != std::string::npos);
+  CHECK(j.find("\"kind\":\"dup\"") != std::string::npos);
+
+  // ---- seq wraparound safety near INT64_MAX -------------------------
+  // The books compare, never add, beyond +1 — a stream living at the
+  // top of the seq space must not overflow into a phantom gap.
+  mvtpu::audit::DeliveryBook top;
+  const int64_t big = std::numeric_limits<int64_t>::max() - 1;
+  top.NoteApply(0, 1, big, 0);
+  top.NoteApply(0, big + 1, big + 1, 0);   // contiguous at the top
+  std::string tj = top.Json();
+  CHECK(tj.find("\"reorders\":0") != std::string::npos);
+  CHECK(tj.find("\"dups\":0") != std::string::npos);
+
+  // ---- anomaly ring wraps (bounded), total keeps counting -----------
+  mvtpu::audit::DeliveryBook ringy;
+  ringy.NoteApply(5, 1, 1, 0);
+  for (int i = 0; i < 200; ++i) ringy.NoteApply(5, 1, 1, 0);  // 200 dups
+  std::string rj = ringy.Json();
+  CHECK(rj.find("\"anomaly_total\":200") != std::string::npos);
+  CHECK(rj.find("\"dups\":200") != std::string::npos);
+
+  // ---- checksum primitive -------------------------------------------
+  const char* msg = "123456789";
+  CHECK(mvtpu::audit::Crc32(msg, 9) == 0xcbf43926u);  // IEEE vector
+  // Chaining: Crc32(b, seed=Crc32(a)) == Crc32(a+b).
+  CHECK(mvtpu::audit::Crc32(msg + 4, 5, mvtpu::audit::Crc32(msg, 4)) ==
+        mvtpu::audit::Crc32(msg, 9));
+
+  // Bit-exact assign stores leave bit-identical bucket checksums; a
+  // single changed element changes exactly its bucket's beacon.
+  mvtpu::MatrixServerTable a(8, 4, mvtpu::UpdaterType::kAssign);
+  mvtpu::MatrixServerTable b(8, 4, mvtpu::UpdaterType::kAssign);
+  std::vector<float> rows(2 * 4, 1.5f);
+  int32_t ids[2] = {1, 6};
+  for (mvtpu::MatrixServerTable* t : {&a, &b}) {
+    mvtpu::Message add;
+    add.src = 3;
+    mvtpu::AddOption opt;
+    add.data.emplace_back(&opt, sizeof(opt));
+    add.data.emplace_back(ids, sizeof(ids));
+    add.data.emplace_back(rows.data(), rows.size() * sizeof(float));
+    t->ProcessAdd(add);
+  }
+  auto ca = a.BucketChecksums();
+  auto cb = b.BucketChecksums();
+  CHECK(ca.size() == cb.size() && ca == cb);
+  {
+    mvtpu::Message add;
+    add.src = 3;
+    mvtpu::AddOption opt;
+    int32_t one = 6;
+    float bump[4] = {0.25f, 0, 0, 0};
+    add.data.emplace_back(&opt, sizeof(opt));
+    add.data.emplace_back(&one, sizeof(one));
+    add.data.emplace_back(bump, sizeof(bump));
+    b.ProcessAdd(add);
+  }
+  cb = b.BucketChecksums();
+  int diffs = 0;
+  for (size_t i = 0; i < ca.size(); ++i) diffs += ca[i] != cb[i];
+  CHECK(diffs == 1);
+  CHECK(ca[6 % mvtpu::ServerTable::kVersionBuckets] !=
+        cb[6 % mvtpu::ServerTable::kVersionBuckets]);
+
+  // ---- server-side booking via the table hook -----------------------
+  mvtpu::Message stamped;
+  stamped.src = 4;
+  stamped.flags |= mvtpu::msgflag::kHasAudit;
+  stamped.audit = {1, 3};
+  a.NoteAuditApply(stamped);
+  CHECK(a.audit_book().Json().find("\"watermark\":3") !=
+        std::string::npos);
+
+  // ---- disarmed: stamps nothing, books nothing ----------------------
+  mvtpu::audit::Arm(false);
+  mvtpu::Message dis;
+  dis.src = 4;
+  dis.flags |= mvtpu::msgflag::kHasAudit;
+  dis.audit = {4, 4};
+  a.NoteAuditApply(dis);
+  CHECK(a.audit_book().Json().find("\"watermark\":3") !=
+        std::string::npos);
+  mvtpu::audit::Arm(true);
   return 0;
 }
 
@@ -2594,6 +2748,7 @@ int main(int argc, char** argv) {
       {"arena", TestArena},       {"queue", TestQueue},
       {"configure", TestConfigure}, {"message", TestMessage},
       {"latency", TestLatencyTrail},
+      {"audit", TestAudit},
       {"codec", TestCodec},
       {"dashboard", TestDashboard},
       {"updater", TestUpdater},   {"array", TestArray},

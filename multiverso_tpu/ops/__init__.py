@@ -8,14 +8,18 @@ Two unrelated-but-cohabiting meanings of "ops", both hot paths:
 - **Operations** — the live introspection plane
   (docs/observability.md): :class:`OpsClient` scrapes any rank's
   in-band ``/metrics`` + health + table stats over the anonymous serve
-  wire (``MsgType::OpsQuery``, answered at the reactor), and
+  wire (``MsgType::OpsQuery``, answered at the reactor),
   :mod:`flight_recorder` keeps the bounded black-box ring that dumps
-  ``blackbox_rank<r>.json`` on failure triggers.
+  ``blackbox_rank<r>.json`` on failure triggers (rotated, keep-N), and
+  :mod:`audit` diffs the delivery-audit books fleet-wide
+  (acked-vs-applied watermarks; docs/observability.md "audit plane").
 """
 
+from .audit import audit_rows, checksum_divergence, diff_fleet
 from .flash_attention import flash_attention
 from .flight_recorder import FlightRecorder, recorder
 from .introspect import OpsClient, parse_prometheus
 
 __all__ = ["flash_attention", "OpsClient", "parse_prometheus",
-           "FlightRecorder", "recorder"]
+           "FlightRecorder", "recorder", "diff_fleet", "audit_rows",
+           "checksum_divergence"]

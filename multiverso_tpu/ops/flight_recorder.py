@@ -43,6 +43,10 @@ class FlightRecorder:
             maxlen=max_events)
         self._runtime: Any = None
         self._triggers = 0
+        # Dump-rotation ledger: retained timestamped archive names (the
+        # canonical blackbox_rank<r>.json stays the LATEST dump).
+        self._archives: list = []
+        self._dump_seq = 0
         self.rank = 0
 
     # ------------------------------------------------------------ wiring
@@ -86,6 +90,9 @@ class FlightRecorder:
             self._events.clear()
             self._runtime = None
             self._triggers = 0
+            # Forget the rotation ledger (files on disk stay); the dump
+            # counter keeps counting so archive names never collide.
+            self._archives = []
 
     # ------------------------------------------------------------ trigger
     def trigger(self, reason: str) -> Optional[str]:
@@ -145,12 +152,54 @@ class FlightRecorder:
             with open(tmp, "w") as fh:
                 json.dump(doc, fh)
             os.replace(tmp, path)
+            self._rotate(trace_dir, rank, doc)
             Log.error("flight recorder: dumped black box to %s "
                       "(reason: %s)", path, reason)
             return path
         except Exception as exc:
             Log.error("flight recorder: dump failed: %s", exc)
             return None
+
+    def _rotate(self, trace_dir: str, rank: int, doc: Dict[str, Any],
+                keep: Optional[int] = None) -> None:
+        """Archive this dump beside the canonical file and prune to the
+        last N (``-blackbox_keep``): a second trigger on the same rank
+        keeps the first dump's evidence instead of overwriting it.  The
+        manifest lists the retained archives, oldest first."""
+        from .. import config
+
+        if keep is None:
+            try:
+                keep = int(config.get("blackbox_keep"))
+            except Exception:
+                keep = 4
+        keep = max(1, keep)
+        with self._lock:
+            self._dump_seq += 1
+            # ts + per-process seq: two triggers in the same
+            # microsecond still get distinct archive names.
+            name = (f"blackbox_rank{rank}."
+                    f"{int(time.time() * 1e6)}.{self._dump_seq}.json")
+            self._archives.append(name)
+            drop, self._archives = (self._archives[:-keep],
+                                    self._archives[-keep:])
+            archives = list(self._archives)
+            seq = self._dump_seq
+        with open(os.path.join(trace_dir, name), "w") as fh:
+            json.dump(doc, fh)
+        for old in drop:
+            try:
+                os.remove(os.path.join(trace_dir, old))
+            except OSError:
+                pass  # already gone: rotation is best-effort cleanup
+        manifest = {"rank": rank, "keep": keep, "dumps": archives,
+                    "total_triggers": seq}
+        mpath = os.path.join(trace_dir,
+                             f"blackbox_rank{rank}.manifest.json")
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh)
+        os.replace(tmp, mpath)
 
 
 # Process-global recorder: the trigger sites (context barrier timeout,

@@ -124,6 +124,18 @@ class OpsClient:
         ``tools/latdoctor.py`` is the CLI over this."""
         return json.loads(self.report("latency", fleet=fleet))
 
+    def audit(self, fleet: bool = False):
+        """Delivery-audit report (docs/observability.md "audit
+        plane"): per table, the worker-side acked-add ledger (last seq
+        sent / acked per shard stream), the server-side delivery book
+        (per-origin applied watermark, dup/reorder counts, pending
+        out-of-order ranges, the bounded anomaly ring) and per-bucket
+        content checksums.  Fleet scope returns the usual
+        ``{"ranks": {...}}`` wrapper — ``tools/mvaudit.py`` diffs
+        acked-vs-applied across it and names every gap, dup, or
+        reorder."""
+        return json.loads(self.report("audit", fleet=fleet))
+
     def metrics(self, fleet: bool = False) -> Tuple[
             Dict[str, float], Dict[str, Dict[str, str]]]:
         """(values, exemplars) of the scraped exposition text."""

@@ -44,7 +44,8 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["AnonServeClient", "MSG", "pack_frame", "unpack_frame",
-           "HEADER", "TIMING", "FLAG_TIMING", "STAGES",
+           "HEADER", "TIMING", "FLAG_TIMING", "AUDIT", "FLAG_AUDIT",
+           "STAGES",
            "stage_durations", "ntp_sample", "OffsetEstimator",
            "OPS_SCOPE_LOCAL", "OPS_SCOPE_FLEET"]
 
@@ -55,6 +56,12 @@ HEADER = struct.Struct("<4i3q4i")
 # dequeue, apply_done, reply_send (docs/observability.md).
 TIMING = struct.Struct("<6q")
 FLAG_TIMING = 1 << 3  # msgflag::kHasTiming
+# AuditStamp (mvtpu/message.h): the inclusive per-(worker, table,
+# shard) Add seq range this message covers, following the header (after
+# the timing trail when both flags are set) when FLAG_AUDIT is set —
+# the delivery-audit identity (docs/observability.md "audit plane").
+AUDIT = struct.Struct("<2q")
+FLAG_AUDIT = 1 << 4  # msgflag::kHasAudit
 _LEN = struct.Struct("<q")
 
 # MsgType values used by the serve protocol (mvtpu/message.h).
@@ -86,19 +93,25 @@ _ACCEPT_RAW = 1  # msgflag::kAcceptRaw
 
 
 def pack_frame(msg_type: int, table_id: int, msg_id: int, *,
-               version: int = -1, blobs=(), timing: bool = False) -> bytes:
+               version: int = -1, blobs=(), timing: bool = False,
+               audit=None) -> bytes:
     """One wire frame.  ``src=-1`` is what makes the connection
     anonymous: the reactor sees no valid rank in the first frame and
     assigns a pseudo-rank instead.  ``timing=True`` stamps a latency
     trail (enqueue+send = now, monotonic ns) after the header — the
     server echoes and extends it, and the reply's trail attributes the
-    round trip per stage (docs/observability.md "latency plane")."""
-    flags = _ACCEPT_RAW | (FLAG_TIMING if timing else 0)
+    round trip per stage (docs/observability.md "latency plane").
+    ``audit=(seq_lo, seq_hi)`` stamps a delivery-audit seq range after
+    the trail (docs/observability.md "audit plane")."""
+    flags = (_ACCEPT_RAW | (FLAG_TIMING if timing else 0)
+             | (FLAG_AUDIT if audit is not None else 0))
     body = HEADER.pack(-1, -1, msg_type, table_id, msg_id, 0, version,
                        0, flags, len(blobs), 0)
     if timing:
         now = time.monotonic_ns()
         body += TIMING.pack(now, now, 0, 0, 0, 0)
+    if audit is not None:
+        body += AUDIT.pack(int(audit[0]), int(audit[1]))
     for b in blobs:
         body += _LEN.pack(len(b)) + bytes(b)
     return _LEN.pack(len(body)) + body
@@ -114,6 +127,10 @@ def unpack_frame(body: bytes) -> dict:
     if flags & FLAG_TIMING:
         timing = TIMING.unpack_from(body, pos)
         pos += TIMING.size
+    audit = None
+    if flags & FLAG_AUDIT:
+        audit = AUDIT.unpack_from(body, pos)
+        pos += AUDIT.size
     for _ in range(num_blobs):
         (blen,) = _LEN.unpack_from(body, pos)
         pos += _LEN.size
@@ -123,7 +140,7 @@ def unpack_frame(body: bytes) -> dict:
             "type_name": _TYPE_NAME.get(mtype, str(mtype)),
             "table_id": table_id, "msg_id": msg_id, "trace_id": trace_id,
             "version": version, "codec": codec, "flags": flags,
-            "timing": timing, "blobs": blobs}
+            "timing": timing, "audit": audit, "blobs": blobs}
 
 
 # Stage names, in trail order (docs/observability.md "latency plane").

@@ -37,7 +37,9 @@ def get_local_ips() -> List[str]:
                 ips.insert(0, primary)
         finally:
             s.close()
-    except OSError:
+    except OSError:  # mvlint: disable=MV015 — interface discovery
+        # probe, not a delivery path: no route just means the loopback
+        # fallback below is the answer.
         pass
     if "127.0.0.1" not in ips:
         ips.append("127.0.0.1")

@@ -1,0 +1,125 @@
+"""Fleet driver for the delivery-audit tests (not a pytest module).
+
+Run as ``python audit_worker.py <machine_file> <rank> <mode>
+[trace_dir] [extra flags...]``: a 2-rank native fleet where rank 1
+drives stamped adds through injected chaos and rank 0 prints the
+fleet-scope ``"audit"`` report (``AUDIT_FLEET <json>`` — assembled via
+MV_OpsFleetReport, so the same path covers the epoll AND the blocking
+tcp engine, which refuses anonymous scrapers).  Modes:
+
+- ``chaos`` — blocking adds eating injected ``fail_send`` (retry
+  absorbs), exactly two injected ``dup`` sends, an async burst, then a
+  final blocking add whose ack (per-connection FIFO) covers the whole
+  tail.  The auditor must name exactly the two dups and ZERO lost
+  acked adds.
+- ``agg`` — ``-add_agg_bytes`` armed: an async burst collapses into
+  ONE wire message per shard whose stamp covers the whole window (the
+  seq-range accounting), then a blocking add acks everything.
+- ``loss`` — rank 0 arms a one-shot ``discard_apply`` fault (a SILENT
+  server-side discard: delivered, never applied, never booked).  Rank
+  1's async stream leaves a hole in the shard-0 seq stream; past
+  ``-audit_grace_ms`` the ``audit_gap`` blackbox fires on rank 0 and
+  the fleet diff names the missing seq.  The tail is async — never
+  acked — so the verdict must be gap + unacked, NOT a lost acked add.
+- ``checksum`` — identical bit-exact ``assign`` stores from both ranks'
+  views; rank 0 prints each rank's bucket checksums for the stability
+  assertion.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from multiverso_tpu import native as nat  # noqa: E402
+
+SIZE = 64
+ASYNC_BURST = 6
+
+
+def main() -> int:
+    mf, rank, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    trace_dir = sys.argv[4] if len(sys.argv) > 4 else ""
+    extra = sys.argv[5:]
+    args = [f"-machine_file={mf}", f"-rank={rank}", "-log_level=error",
+            "-rpc_timeout_ms=20000", "-barrier_timeout_ms=60000",
+            "-send_retries=3", "-send_backoff_ms=20",
+            "-audit_grace_ms=250", *extra]
+    if trace_dir:
+        args.append(f"-trace_dir={trace_dir}")
+    rt = nat.NativeRuntime(args=args)
+    h = rt.new_array_table(SIZE)
+    rt.barrier()
+
+    delta = np.ones(SIZE, np.float32)
+    if rank == 0 and mode == "loss":
+        # One-shot SILENT server-side discard: the next RequestAdd that
+        # reaches THIS rank's server actor vanishes pre-apply.
+        rt.set_fault_seed(11)
+        rt.set_fault_n("discard_apply", 1)
+    rt.barrier()
+
+    if rank == 1:
+        rt.set_fault_seed(7)
+        if mode == "chaos":
+            for _ in range(3):
+                rt.set_fault_n("fail_send", 1)   # retry absorbs
+                rt.array_add(h, delta)
+            rt.clear_faults()
+            rt.set_fault_n("dup", 2)             # exactly two dups
+            rt.array_add(h, delta)
+            rt.array_add(h, delta)
+            rt.clear_faults()
+            for _ in range(ASYNC_BURST):
+                rt.array_add(h, delta, sync=False)
+            # The final blocking ack covers the async tail (FIFO).
+            rt.array_add(h, delta)
+        elif mode == "agg":
+            for _ in range(ASYNC_BURST):
+                rt.array_add(h, delta, sync=False)
+            rt.array_add(h, delta)               # flush + ack everything
+        elif mode == "loss":
+            # Async stream: the first add to shard 0 is discarded there
+            # (seq 1 never applied), the rest arrive ahead of the hole.
+            for _ in range(4):
+                rt.array_add(h, delta, sync=False)
+            rt.array_get(h, SIZE)                # drain the pipeline
+            # Let the grace window expire, then force the sweep server-
+            # side via the audit scrape (rank 0 prints it below).
+            time.sleep(0.6)
+        elif mode == "checksum":
+            rt.array_add(h, delta)
+        ledger = rt.audit_report()["tables"][0]["worker"]
+        print(f"LEDGER {json.dumps(ledger)}", flush=True)
+    rt.barrier()
+
+    if rank == 0:
+        fleet = rt.ops_fleet_report("audit")
+        print(f"AUDIT_FLEET {fleet}", flush=True)
+        if mode == "checksum":
+            # A second identical store must leave checksums unchanged
+            # (assign is bit-exact): capture, re-store, re-capture.
+            before = rt.audit_report()["tables"][0]["checksums"]
+            print(f"CHECKSUM_BEFORE {json.dumps(before)}", flush=True)
+    rt.barrier()
+    if mode == "checksum":
+        if rank == 1:
+            rt.array_add(h, delta)               # second store, same bits
+        rt.barrier()
+        if rank == 0:
+            after = rt.audit_report()["tables"][0]["checksums"]
+            print(f"CHECKSUM_AFTER {json.dumps(after)}", flush=True)
+        rt.barrier()
+    rt.barrier()
+    rt.shutdown()
+    print(f"AUDIT_WORKER_OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

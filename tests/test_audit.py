@@ -1,0 +1,441 @@
+"""Tier-1 gate for the delivery-audit plane (docs/observability.md
+"audit plane"): the wire-framing mirror (version tolerance), the fleet
+diff logic (lost vs unacked vs dup vs gap), seq/agg-range accounting
+through the native books, checksum stability across bit-exact assign
+stores, the 2-proc chaos acceptance on BOTH wire engines (injected
+dups named exactly, zero lost acked adds), the seeded silent-loss →
+``audit_gap`` blackbox path, and the flight-recorder dump rotation
+regression (two triggers leave two readable dumps)."""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+# ------------------------------------------------------------- wire mirror
+
+def test_audit_stamp_frame_roundtrip():
+    from multiverso_tpu.serve.wire import (AUDIT, FLAG_AUDIT, MSG,
+                                           pack_frame, unpack_frame)
+
+    frame = pack_frame(MSG["RequestGet"], 0, 7, audit=(3, 9))
+    body = frame[8:]
+    msg = unpack_frame(body)
+    assert msg["flags"] & FLAG_AUDIT
+    assert msg["audit"] == (3, 9)
+    assert AUDIT.size == 16
+
+
+def test_audit_and_timing_compose_in_serialize_order():
+    """Trail first, stamp second — the native Serialize order; both
+    optional blocks in one frame must round-trip with blobs intact."""
+    from multiverso_tpu.serve.wire import MSG, pack_frame, unpack_frame
+
+    frame = pack_frame(MSG["RequestGet"], 1, 2, blobs=[b"payload8"],
+                       timing=True, audit=(5, 5))
+    msg = unpack_frame(frame[8:])
+    assert msg["timing"] is not None and msg["timing"][0] > 0
+    assert msg["audit"] == (5, 5)
+    assert msg["blobs"] == [b"payload8"]
+
+
+def test_unflagged_frame_parses_exactly_as_before():
+    """Version tolerance: a pre-audit frame (no flag bits) must parse
+    with audit=None and timing=None — the old layout unchanged."""
+    from multiverso_tpu.serve.wire import MSG, pack_frame, unpack_frame
+
+    msg = unpack_frame(pack_frame(MSG["RequestVersion"], 0, 1)[8:])
+    assert msg["audit"] is None and msg["timing"] is None
+
+
+# --------------------------------------------------------------- fleet diff
+
+def _fleet(ranks, silent=()):
+    return {"ranks": ranks, "silent": list(silent)}
+
+
+def _rank_doc(rank, tables):
+    return {"rank": rank, "armed": True, "tables": tables}
+
+
+def _server(origins, anomalies=()):
+    return {"origins": origins, "anomalies": list(anomalies),
+            "anomaly_total": len(anomalies)}
+
+
+def _origin(origin, watermark, **kw):
+    base = {"origin": origin, "watermark": watermark, "applied": 0,
+            "covered": 0, "dups": 0, "reorders": 0,
+            "pending_dropped": 0, "pending": [], "gap_fired": False}
+    base.update(kw)
+    return base
+
+
+def test_diff_fleet_clean_when_acked_covered():
+    from multiverso_tpu.ops.audit import diff_fleet
+
+    fleet = _fleet({
+        "0": _rank_doc(0, [{"id": 0,
+                            "worker": {"shards": [
+                                {"shard": 0, "sent": 5, "acked": 5}]},
+                            "server": _server([_origin(1, 7),
+                                               _origin(0, 5)])}]),
+        "1": _rank_doc(1, [{"id": 0,
+                            "worker": {"shards": [
+                                {"shard": 0, "sent": 7, "acked": 7}]},
+                            "server": _server([])}]),
+    })
+    assert diff_fleet(fleet) == []
+
+
+def test_diff_fleet_names_lost_acked_adds():
+    """acked > watermark on the owning shard = the contract violation,
+    named with its seq range."""
+    from multiverso_tpu.ops.audit import diff_fleet
+
+    fleet = _fleet({
+        "0": _rank_doc(0, [{"id": 0, "server": _server([_origin(1, 4)])}]),
+        "1": _rank_doc(1, [{"id": 0,
+                            "worker": {"shards": [
+                                {"shard": 0, "sent": 9, "acked": 9}]},
+                            "server": _server([])}]),
+    })
+    findings = diff_fleet(fleet)
+    lost = [f for f in findings if f["kind"] == "lost"]
+    assert len(lost) == 1
+    assert lost[0]["origin"] == 1 and lost[0]["shard"] == 0
+    assert (lost[0]["seq_lo"], lost[0]["seq_hi"]) == (5, 9)
+    # Severity order: the loss leads the list.
+    assert findings[0]["kind"] == "lost"
+
+
+def test_diff_fleet_unacked_tail_is_not_lost():
+    """sent > acked with the watermark covering acked = a SIGKILLed
+    worker's async tail: reported as never-acked, not lost."""
+    from multiverso_tpu.ops.audit import diff_fleet
+
+    fleet = _fleet({
+        "0": _rank_doc(0, [{"id": 0, "server": _server([_origin(1, 3)])}]),
+        "1": _rank_doc(1, [{"id": 0,
+                            "worker": {"shards": [
+                                {"shard": 0, "sent": 8, "acked": 3}]},
+                            "server": _server([])}]),
+    })
+    findings = diff_fleet(fleet)
+    kinds = [f["kind"] for f in findings]
+    assert "unacked" in kinds and "lost" not in kinds
+    tail = next(f for f in findings if f["kind"] == "unacked")
+    assert (tail["seq_lo"], tail["seq_hi"]) == (4, 8)
+
+
+def test_diff_fleet_names_dups_gaps_and_silent_ranks():
+    from multiverso_tpu.ops.audit import diff_fleet
+
+    anomalies = [{"kind": "dup", "origin": 1, "seq_lo": 4, "seq_hi": 4,
+                  "ts_ms": 1}]
+    fleet = _fleet({
+        "0": _rank_doc(0, [{"id": 0, "server": _server(
+            [_origin(1, 3, dups=1, reorders=2, pending=[[6, 7]],
+                     gap_fired=True)], anomalies)}]),
+    }, silent=[2])
+    findings = diff_fleet(fleet)
+    kinds = [f["kind"] for f in findings]
+    assert "dup" in kinds and "gap" in kinds and "silent" in kinds
+    dup = next(f for f in findings if f["kind"] == "dup")
+    assert dup["count"] == 1 and dup["seqs"] == [(4, 4)]
+    gap = next(f for f in findings if f["kind"] == "gap")
+    assert (gap["seq_lo"], gap["seq_hi"]) == (4, 5)  # missing 4..5
+
+
+def test_confirm_lost_drops_transient_race():
+    """A 'lost' verdict from a non-atomic scrape is believed only when
+    the refreshed snapshot still shows it for the same stream."""
+    from multiverso_tpu.ops.audit import confirm_lost
+
+    first = [{"kind": "lost", "table": 0, "origin": 1, "shard": 0,
+              "seq_lo": 5, "seq_hi": 9}]
+    refreshed_clean = [{"kind": "dup", "table": 0, "origin": 1,
+                        "shard": 0, "count": 1}]
+    out = confirm_lost(first, refreshed_clean)
+    assert [f["kind"] for f in out] == ["dup"]
+    refreshed_still = refreshed_clean + [
+        {"kind": "lost", "table": 0, "origin": 1, "shard": 0,
+         "seq_lo": 5, "seq_hi": 9}]
+    out = confirm_lost(first, refreshed_still)
+    assert [f["kind"] for f in out] == ["lost", "dup"]
+
+
+def test_checksum_divergence_primitive():
+    from multiverso_tpu.ops.audit import checksum_divergence
+
+    assert checksum_divergence([1, 2, 3], [1, 2, 3]) == []
+    assert checksum_divergence([1, 2, 3], [1, 9, 3]) == [1]
+    assert checksum_divergence([1], [1, 2]) == [0, 1]
+
+
+def test_audit_rows_lag_and_missing_origin_ledger():
+    from multiverso_tpu.ops.audit import audit_rows
+
+    fleet = _fleet({
+        "0": _rank_doc(0, [{"id": 0,
+                            "server": _server([_origin(1, 4),
+                                               _origin(9, 2)])}]),
+        "1": _rank_doc(1, [{"id": 0,
+                            "worker": {"shards": [
+                                {"shard": 0, "sent": 6, "acked": 6}]},
+                            "server": _server([])}]),
+    })
+    rows = audit_rows(fleet)
+    by_origin = {r["origin"]: r for r in rows}
+    assert by_origin[1]["acked"] == 6 and by_origin[1]["lag"] == 2
+    # Origin 9 has no reachable ledger: '-' semantics (None), never 0.
+    assert by_origin[9]["acked"] is None and by_origin[9]["lag"] is None
+
+
+def test_mvtop_audit_rate_discipline_dash_before_first_scrape():
+    """The --audit watch column obeys the PR 11 rate discipline: '-'
+    until two scrapes exist, then a real dup/s figure."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import mvtop
+
+    tracker = mvtop.RateTracker()
+    first = tracker.update("0/0/1", {"dups": 10}, now=100.0)
+    assert first.get("dup/s", "-") == "-"
+    second = tracker.update("0/0/1", {"dups": 30}, now=110.0)
+    assert second["dup/s"] == "2.0"
+
+
+# ------------------------------------------------- flight-recorder rotation
+
+def test_blackbox_rotation_keeps_both_dumps(tmp_path):
+    """Satellite regression: two distinct triggers on one rank must
+    leave TWO readable dumps (timestamped archives + manifest); the
+    canonical blackbox_rank<r>.json stays the latest."""
+    from multiverso_tpu import config
+    from multiverso_tpu.ops.flight_recorder import FlightRecorder
+
+    config.set_flag("trace_dir", str(tmp_path))
+    try:
+        rec = FlightRecorder()
+        rec.attach(rank=0)
+        rec.record("phase", "one")
+        assert rec.trigger("first failure")
+        rec.record("phase", "two")
+        assert rec.trigger("second failure")
+
+        manifest = json.load(
+            open(tmp_path / "blackbox_rank0.manifest.json"))
+        assert len(manifest["dumps"]) == 2
+        assert manifest["total_triggers"] == 2
+        docs = [json.load(open(tmp_path / name))
+                for name in manifest["dumps"]]
+        assert docs[0]["reason"] == "first failure"
+        assert docs[1]["reason"] == "second failure"
+        # Canonical latest-name contract: existing readers keep working.
+        latest = json.load(open(tmp_path / "blackbox_rank0.json"))
+        assert latest["reason"] == "second failure"
+    finally:
+        config.set_flag("trace_dir", "")
+
+
+def test_blackbox_rotation_prunes_to_keep(tmp_path):
+    from multiverso_tpu import config
+    from multiverso_tpu.ops.flight_recorder import FlightRecorder
+
+    config.set_flag("trace_dir", str(tmp_path))
+    config.set_flag("blackbox_keep", 2)
+    try:
+        rec = FlightRecorder()
+        rec.attach(rank=3)
+        for i in range(5):
+            rec.trigger(f"failure {i}")
+        manifest = json.load(
+            open(tmp_path / "blackbox_rank3.manifest.json"))
+        assert len(manifest["dumps"]) == 2
+        assert manifest["total_triggers"] == 5
+        archives = [p for p in os.listdir(tmp_path)
+                    if p.startswith("blackbox_rank3.")
+                    and p.endswith(".json")
+                    and "manifest" not in p
+                    and p != "blackbox_rank3.json"]
+        assert sorted(archives) == sorted(manifest["dumps"])
+        reasons = {json.load(open(tmp_path / n))["reason"]
+                   for n in manifest["dumps"]}
+        assert reasons == {"failure 3", "failure 4"}
+    finally:
+        config.set_flag("trace_dir", "")
+        config.set_flag("blackbox_keep", 4)
+
+
+# --------------------------------------------------------- native 2-proc
+
+def _run_fleet(tmp_path, mode, extra=(), nranks=2):
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    socks = [socket.socket() for _ in range(nranks)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = os.path.join(str(tmp_path), "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests",
+                                          "audit_worker.py"),
+             mf, str(r), mode, str(tmp_path), *map(str, extra)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        for r in range(nranks)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=180)[0])
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs.append(p.communicate()[0])
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0 and "AUDIT_WORKER_OK" in out, out[-3000:]
+    return outs
+
+
+def _fleet_doc(out0: str) -> dict:
+    line = next(ln for ln in out0.splitlines()
+                if ln.startswith("AUDIT_FLEET "))
+    return json.loads(line[len("AUDIT_FLEET "):])
+
+
+@needs_gxx
+@pytest.mark.parametrize("engine", ["epoll", "tcp"])
+def test_chaos_dups_named_zero_lost_acked(tmp_path, engine):
+    """The acceptance chaos (both wire engines): injected fail_send is
+    absorbed by retry, the two injected duplicate sends are named
+    EXACTLY (count and seq), and the diff shows zero lost acked adds
+    with every stream fully acked (the final blocking ack covers the
+    async tail by per-connection FIFO)."""
+    from multiverso_tpu.ops.audit import diff_fleet
+
+    outs = _run_fleet(tmp_path, "chaos",
+                      extra=(f"-net_engine={engine}",))
+    fleet = _fleet_doc(outs[0])
+    assert fleet["silent"] == []
+    findings = diff_fleet(fleet)
+    kinds = [f["kind"] for f in findings]
+    assert "lost" not in kinds and "gap" not in kinds, findings
+    assert "unacked" not in kinds, findings  # final ack covered the tail
+    # Exactly the injected dups: 2 dup'd sends, each to ONE remote
+    # shard (rank 1's local deliveries never traverse Net::Send).
+    dup_total = sum(f["count"] for f in findings if f["kind"] == "dup")
+    assert dup_total == 2, findings
+    for f in findings:
+        if f["kind"] == "dup":
+            assert f["origin"] == 1 and f["seqs"], f
+
+
+@needs_gxx
+def test_agg_window_range_accounting(tmp_path):
+    """A collapsed aggregation window ships ONE message per shard whose
+    stamp covers every absorbed add: applied counts messages, covered
+    counts logical adds, and the watermark lands on the window's end."""
+    outs = _run_fleet(tmp_path, "agg", extra=("-add_agg_bytes=1000000",))
+    fleet = _fleet_doc(outs[0])
+    for rank_doc in fleet["ranks"].values():
+        server = rank_doc["tables"][0]["server"]
+        origins = {o["origin"]: o for o in server["origins"]}
+        if 1 not in origins:
+            continue  # rank 1's own shard books local deliveries too
+        book = origins[1]
+        # 6 async adds collapsed into one flush message + 1 blocking
+        # add: 2 messages, 7 logical adds, watermark 7, fully in order.
+        assert book["applied"] == 2, server
+        assert book["covered"] == 7, server
+        assert book["watermark"] == 7, server
+        assert book["reorders"] == 0 and book["dups"] == 0, server
+    # The origin's ledger agrees: everything sent is acked.
+    ledger_line = next(ln for ln in outs[1].splitlines()
+                       if ln.startswith("LEDGER "))
+    ledger = json.loads(ledger_line[len("LEDGER "):])
+    for sh in ledger["shards"]:
+        assert sh["sent"] == 7 and sh["acked"] == 7, ledger
+
+
+@needs_gxx
+def test_seeded_silent_loss_fires_audit_gap(tmp_path):
+    """A silent server-side discard (the seeded real loss retry cannot
+    absorb) must leave a hole the books catch: the fleet diff names the
+    gap's seq range, the audit_gap blackbox fires on the discarding
+    rank, and — because the tail was async — the verdict is gap +
+    unacked, NOT a lost acked add."""
+    from multiverso_tpu.ops.audit import diff_fleet
+
+    outs = _run_fleet(tmp_path, "loss")
+    fleet = _fleet_doc(outs[0])
+    findings = diff_fleet(fleet)
+    kinds = [f["kind"] for f in findings]
+    assert "gap" in kinds, findings
+    assert "lost" not in kinds, findings
+    assert "unacked" in kinds, findings
+    gap = next(f for f in findings if f["kind"] == "gap")
+    assert gap["origin"] == 1 and gap["seq_lo"] == 1, findings
+    # Detection-time evidence: the blackbox dumped on rank 0 names the
+    # gap (canonical file or rotated archive — both must exist).
+    box = json.load(open(os.path.join(str(tmp_path),
+                                      "blackbox_rank0.json")))
+    assert "audit_gap" in box["reason"], box["reason"]
+    manifest = json.load(open(os.path.join(
+        str(tmp_path), "blackbox_rank0.manifest.json")))
+    assert manifest["dumps"], manifest
+
+
+@needs_gxx
+def test_checksums_stable_across_bit_exact_assign_stores(tmp_path):
+    """Two identical assign stores leave bit-identical bucket
+    checksums — the replica-divergence primitive's stability half."""
+    from multiverso_tpu.ops.audit import checksum_divergence
+
+    outs = _run_fleet(tmp_path, "checksum",
+                      extra=("-updater_type=assign",))
+    before = json.loads(next(
+        ln for ln in outs[0].splitlines()
+        if ln.startswith("CHECKSUM_BEFORE "))[len("CHECKSUM_BEFORE "):])
+    after = json.loads(next(
+        ln for ln in outs[0].splitlines()
+        if ln.startswith("CHECKSUM_AFTER "))[len("CHECKSUM_AFTER "):])
+    assert before, outs[0][-2000:]
+    assert checksum_divergence(before, after) == []
+
+
+# ------------------------------------------------------------ seq math
+
+def test_ack_ledger_wraparound_safety_in_diff():
+    """Streams living at the top of the int64 seq space must diff
+    without overflow into phantom findings (the books compare, never
+    add, beyond +1)."""
+    from multiverso_tpu.ops.audit import diff_fleet
+
+    top = 2**63 - 2
+    fleet = _fleet({
+        "0": _rank_doc(0, [{"id": 0, "server": _server(
+            [_origin(1, top + 1)])}]),
+        "1": _rank_doc(1, [{"id": 0,
+                            "worker": {"shards": [
+                                {"shard": 0, "sent": top + 1,
+                                 "acked": top + 1}]},
+                            "server": _server([])}]),
+    })
+    assert diff_fleet(fleet) == []

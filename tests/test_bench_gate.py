@@ -207,6 +207,36 @@ def test_gate_passes_in_band_embedding_line(tmp_path):
     assert rc == 0, out
 
 
+def test_gate_guards_audit_keys(tmp_path):
+    """bench_audit acceptance bars (docs/observability.md "audit
+    plane"): audit overhead past the always-on 1% bar, a detect
+    latency past 50 ms (the books stopped seeing dups promptly), or
+    the injected dup never surfacing at all must all fail the gate."""
+    line = {"extras": {"audit_overhead_pct": 2.5,        # > 1% bar
+                       "audit_add_overhead_pct": 9.0,    # way past band
+                       "audit_detect_ms": 400.0,         # dup went dark
+                       "audit_dup_named": 0.0}}          # never surfaced
+    p = tmp_path / "audit_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "audit_overhead_pct" in out and "FAIL" in out, out
+    assert "audit_add_overhead_pct" in out, out
+    assert "audit_detect_ms" in out, out
+    assert "audit_dup_named" in out, out
+
+
+def test_gate_passes_in_band_audit_line(tmp_path):
+    line = {"extras": {"audit_overhead_pct": 0.3,
+                       "audit_add_overhead_pct": 1.5,
+                       "audit_detect_ms": 0.5,
+                       "audit_dup_named": 1.0}}
+    p = tmp_path / "audit_ok.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
 def test_last_parseable_line_wins(tmp_path):
     """Schema-7 cumulative emission: the LAST line is the freshest
     cumulative state and must shadow earlier partials."""

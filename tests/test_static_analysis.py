@@ -590,6 +590,89 @@ def test_mv014_out_of_scope_and_suppressible(tmp_path):
     assert _lint_src(lib, suppressed) == []
 
 
+def test_mv015_fires_on_swallowed_native_exception(tmp_path):
+    """`except ...: pass` (and bare log-and-drop) around native-call/
+    wire/table code hides exactly the delivery failures the audit
+    plane exists to surface (docs/observability.md "audit plane")."""
+    lib = tmp_path / "multiverso_tpu"
+    lib.mkdir()
+    rules = _lint_src(lib, """\
+        def bad_pass(rt, h, delta):
+            try:
+                rt.array_add(h, delta)
+            except Exception:
+                pass                                    # BAD
+
+        def bad_log_and_drop(sock, frame, Log):
+            try:
+                sock.sendall(frame)
+            except OSError as exc:
+                Log.error("send failed: %s", exc)       # BAD: dropped
+
+        def bad_raw_capi(lib, h):
+            try:
+                lib.MV_FlushAdds(h)
+            except Exception:
+                pass                                    # BAD
+        """)
+    assert [r for r, _ in rules] == ["MV015"] * 3, rules
+
+
+def test_mv015_handling_and_cleanup_are_legal(tmp_path):
+    lib = tmp_path / "multiverso_tpu"
+    lib.mkdir()
+    rules = _lint_src(lib, """\
+        def fine_reraise(rt, h, delta):
+            try:
+                rt.array_add(h, delta)
+            except Exception:
+                raise RuntimeError("add failed")
+
+        def fine_fallback(sock, frame):
+            try:
+                sock.sendall(frame)
+            except OSError:
+                return False
+            return True
+
+        def fine_cleanup(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+        def fine_unrelated(d):
+            try:
+                return d["k"]
+            except KeyError:
+                pass
+        """)
+    assert rules == [], rules
+
+
+def test_mv015_out_of_scope_and_suppressible(tmp_path):
+    src = """\
+        def f(rt, h, delta):
+            try:
+                rt.array_add(h, delta)
+            except Exception:
+                pass
+        """
+    lib = tmp_path / "multiverso_tpu"
+    lib.mkdir()
+    assert [r for r, _ in _lint_src(lib, src)] == ["MV015"]
+    # apps/ and tests are out of scope (tests probe failure paths on
+    # purpose; apps are worker scripts, not library delivery paths).
+    apps = lib / "apps"
+    apps.mkdir()
+    assert _lint_src(apps, src) == []
+    assert _lint_src(lib, src, name="test_swallow.py") == []
+    suppressed = src.replace(
+        "except Exception:",
+        "except Exception:  # mvlint: disable=MV015 — deliberate drop")
+    assert _lint_src(lib, suppressed) == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

@@ -131,6 +131,16 @@ Rules (docs/static_analysis.md has the full rationale):
   ``time.time()`` stays legal as a wall-clock TIMESTAMP (trace event
   times, log lines) — only clock-minus-clock subtraction fires.
 
+- **MV015 swallowed-native-exception** — library code may not wrap
+  native-call / wire / table operations in an ``except`` whose body
+  only ``pass``es (or only logs): those are exactly the paths whose
+  failures the delivery-audit plane (docs/observability.md "audit
+  plane") exists to surface — a swallowed send error IS a silently
+  lost add.  Cleanup idioms stay legal (a ``try`` whose only calls are
+  ``close()``/``shutdown()``-style teardown), as does any handler that
+  re-raises, returns, falls back, or otherwise *handles*.  Suppress a
+  deliberate drop with the standard marker and a reason.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -852,6 +862,84 @@ def check_wall_clock_interval(tree, path):
     return out
 
 
+# ---------------------------------------------------------------- MV015
+# Native/wire/table call evidence: a try block touching any of these is
+# on a delivery path whose failures must not vanish into `except: pass`.
+NATIVE_WIRE_ATTRS = {
+    # raw sockets / framing
+    "sendall", "sendmsg", "sendto", "recv", "recv_into", "recvfrom",
+    "connect", "send_raw", "recv_reply", "next_frame", "unpack_frame",
+    "pack_frame", "ops_report", "get_shard", "get_replica",
+    # native runtime bridge + table ops
+    "array_add", "array_get", "matrix_add_all", "matrix_get_all",
+    "matrix_add_rows", "matrix_get_rows", "kv_add", "kv_get",
+    "barrier", "flush_adds", "table_version",
+}
+# Teardown calls: a try whose ONLY calls are these is the legal
+# best-effort-cleanup idiom (close may race a dead peer by design).
+CLEANUP_ATTRS = {"close", "shutdown", "unregister", "kill", "remove",
+                 "unlink", "terminate"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error",
+                "exception", "fatal", "critical"}
+
+
+def _is_log_call(node):
+    """Log.error(...) / logger.warning(...) / self._log.info(...)."""
+    return (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr in _LOG_METHODS)
+
+
+def _handler_swallows(handler):
+    """True when the except body only passes and/or logs — no raise,
+    no return value, no fallback assignment, no flow control."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass) or _is_log_call(stmt):
+            continue
+        return False
+    return True
+
+
+def _try_call_attrs(try_body):
+    """Attribute/function names called anywhere in the try body."""
+    names = set()
+    for stmt in try_body:
+        for node in _walk_same_scope(stmt):
+            if isinstance(node, ast.Call):
+                tail = _call_name(node.func)
+                if tail:
+                    names.add(tail)
+    return names
+
+
+def check_swallowed_native_exception(tree, path):
+    """MV015: `except ...: pass` (or bare log-and-drop) around
+    native-call/wire/table code in library scope — the delivery
+    failures the audit plane exists to surface, hidden at the source."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        called = _try_call_attrs(node.body)
+        risky = {n for n in called
+                 if n in NATIVE_WIRE_ATTRS or n.startswith("MV_")}
+        if not risky:
+            continue  # teardown-only (close/shutdown/...) never fires
+        for handler in node.handlers:
+            if not _handler_swallows(handler):
+                continue
+            out.append(Finding(
+                path, handler.lineno, "MV015",
+                f"exception around native/wire call(s) "
+                f"{sorted(risky)[:4]} swallowed ({'pass' if any(isinstance(s, ast.Pass) for s in handler.body) else 'log-and-drop'}) "
+                f"— a dropped send/apply error here is a silently lost "
+                f"add, exactly what the delivery-audit plane exists to "
+                f"surface (docs/observability.md \"audit plane\"); "
+                f"re-raise, return an error, or suppress with the "
+                f"marker + a reason if the drop is deliberate"))
+    return out
+
+
 # ---------------------------------------------------------------- MV009
 # Native reactor-context lint: the only non-Python rule.  A file opts in
 # with this marker (the epoll engine sources carry it); the rule then
@@ -962,6 +1050,10 @@ def lint_file(path):
     if in_library:
         findings += check_print_in_library(tree, path)
         findings += check_unbounded_client_cache(tree, path)
+        # MV015: swallowed exceptions around native/wire/table calls —
+        # library code only (tests legitimately probe failure paths,
+        # and the seeded-violation suite must be able to spell one).
+        findings += check_swallowed_native_exception(tree, path)
         # MV014: durations on a clock that steps — library code only
         # (a test may freeze/step wall clocks on purpose).
         findings += check_wall_clock_interval(tree, path)

@@ -14,6 +14,13 @@ per second, client sheds/s — plus a sparkline of the recent apply-rate
 history, so a hot shard reads as a moving number instead of a counter
 you eyeball twice.
 
+``--audit`` switches to the delivery-audit view (the ``"audit"``
+OpsQuery kind): one row per (server rank, table, origin) with the
+acked/applied watermark lag, dup/reorder counts and pending
+out-of-order ranges; under ``--watch`` a two-scrape ``dup/s`` rate
+column joins (``-`` before the first scrape, per the rate discipline —
+never a fake zero).  ``tools/mvaudit.py`` is the full diffing auditor.
+
 ``--hotkeys`` switches to the workload view (the ``"hotkeys"`` OpsQuery
 kind): one row per table per rank ranked by bucket-load skew ratio,
 with the space-saving top-K hot keys, observed staleness, and NaN/Inf
@@ -25,6 +32,7 @@ Usage::
     python tools/mvtop.py HOST:PORT --fleet               # rank fans out
     python tools/mvtop.py HOST:PORT ... --watch 2         # refresh loop
     python tools/mvtop.py HOST:PORT --hotkeys [--fleet]   # workload view
+    python tools/mvtop.py HOST:PORT --audit [--fleet]     # delivery audit
     python tools/mvtop.py HOST:PORT --metrics [--fleet]   # raw Prometheus
 
 ``--fleet`` asks the FIRST endpoint to aggregate the whole fleet
@@ -42,6 +50,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from multiverso_tpu.ops.audit import audit_rows  # noqa: E402
 from multiverso_tpu.ops.introspect import OpsClient  # noqa: E402
 
 _COLS = ("rank", "up", "healthy", "engine", "queue", "max", "clients",
@@ -52,6 +61,10 @@ _RATE_COLS = ("v/s", "get/s", "add/s", "shed/s", "trend")
 
 _HOTKEY_COLS = ("rank", "table", "gets", "adds", "skew", "stale~",
                 "nan", "inf", "top keys")
+
+_AUDIT_COLS = ("rank", "table", "origin", "applied", "acked", "lag",
+               "dups", "reorders", "pending", "gap")
+_AUDIT_RATE_COLS = ("dup/s",)
 
 _SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
 
@@ -129,6 +142,9 @@ class RateTracker:
         cols["add/s"] = fmt("adds")
         cols["shed/s"] = fmt("shed")
         cols["trend"] = sparkline(trend)
+        # Audit view's rate column rides the same two-scrape state.
+        if "dups" in counters:
+            cols["dup/s"] = fmt("dups")
         return cols
 
 
@@ -241,6 +257,39 @@ def hotkey_rows(endpoints: list, fleet: bool, timeout: float) -> list:
     return rows
 
 
+def collect_audit(endpoints: list, fleet: bool, timeout: float,
+                  tracker: "RateTracker" = None) -> list:
+    """One row per (server rank, table, origin) from the fleet audit
+    report; with a tracker (watch mode) a two-scrape dup/s column is
+    derived — '-' before two scrapes exist, never a fake zero."""
+    if fleet:
+        with OpsClient(endpoints[0], timeout=timeout) as c:
+            doc = c.audit(fleet=True)
+    else:
+        doc = {"ranks": {}, "silent": []}
+        for ep in endpoints:
+            try:
+                with OpsClient(ep, timeout=timeout) as c:
+                    local = c.audit()
+                doc["ranks"][str(local.get("rank", ep))] = local
+            except (ConnectionError, OSError, TimeoutError):
+                doc["silent"].append(ep)
+    rows = []
+    for r in audit_rows(doc):
+        row = {c: r.get(c, "-") for c in _AUDIT_COLS}
+        row["acked"] = "-" if r["acked"] is None else r["acked"]
+        row["lag"] = "-" if r["lag"] is None else r["lag"]
+        row["gap"] = "GAP" if r["gap"] else "-"
+        if tracker is not None:
+            key = f"{r['rank']}/{r['table']}/{r['origin']}"
+            rates = tracker.update(key, {"dups": r["dups"]})
+            row["dup/s"] = rates.get("dup/s", "-")
+        rows.append(row)
+    for ep in doc.get("silent") or []:
+        rows.append({c: "-" for c in _AUDIT_COLS} | {"rank": ep})
+    return rows
+
+
 def render(rows: list, cols=_COLS) -> str:
     rows = [{c: r.get(c, "-") for c in cols} for r in rows]
     widths = {c: max(len(c), *(len(str(r[c])) for r in rows))
@@ -260,6 +309,11 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics", action="store_true",
                     help="print the raw Prometheus exposition instead of "
                          "the table")
+    ap.add_argument("--audit", action="store_true",
+                    help="delivery-audit view: acked/applied watermark "
+                         "lag, dup/reorder counts and pending ranges "
+                         "per (rank, table, origin) — the \"audit\" "
+                         "OpsQuery kind (mvaudit diffs it fully)")
     ap.add_argument("--hotkeys", action="store_true",
                     help="workload view: tables ranked by bucket-load "
                          "skew ratio, with top-K hot keys and NaN/Inf "
@@ -275,6 +329,14 @@ def main(argv=None) -> int:
         if args.metrics:
             with OpsClient(args.endpoints[0], timeout=args.timeout) as c:
                 print(c.metrics_text(fleet=args.fleet))
+        elif args.audit:
+            t = tracker if args.watch > 0 else None
+            rows = collect_audit(args.endpoints, args.fleet,
+                                 args.timeout, tracker=t)
+            cols = _AUDIT_COLS + (_AUDIT_RATE_COLS if t else ())
+            stamp = time.strftime("%H:%M:%S")
+            print(f"mvtop --audit @ {stamp} — {len(rows)} stream(s)")
+            print(render(rows, cols))
         elif args.hotkeys:
             rows = hotkey_rows(args.endpoints, args.fleet, args.timeout)
             stamp = time.strftime("%H:%M:%S")
