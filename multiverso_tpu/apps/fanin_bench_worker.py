@@ -42,6 +42,17 @@ sampling profilers armed in the herd process (``profiler_overhead_pct``
 — the "always-on" bar, < 1%).  ``stage_sum_ratio`` checks the
 offset-corrected stages telescope back to the end-to-end latency.
 
+``mode=tail`` (bench.py ``bench_tail``, docs/serving.md "tail") is the
+tail-at-scale acceptance: a 10k-socket bulk Get storm (paced by the
+ReplyBusy backoff contract) against per-class weighted admission
+(``-qos_inflight_max=32``, ``bulk:1,gold:8``) while a gold prober runs
+in its OWN child process (``gold_probe`` entry — client-side GIL
+isolation, the scraper-child discipline) measuring both e2e and SERVER
+RESIDENCY per probe; plus the seeded-straggler hedge phase, the
+1 ns-budget deadline-shed phase, and the pre-packed stamp-overhead
+A/B.  The RLIMIT_NOFILE guard degrades the herd with a logged reason
+instead of dying with EMFILE.
+
 Rank 1 prints the measured keys; both ranks print ``FANIN_BENCH_OK``.
 """
 
@@ -63,6 +74,10 @@ from multiverso_tpu.serve.wire import (AnonServeClient,  # noqa: E402
 
 SIZE = 1024
 CHAOS_ADDS = 5
+# mode=tail's hedged-read matrix table (docs/serving.md "tail"): hot
+# rows live in rank 0's shard (the contacted endpoint).
+MROWS = 64
+MCOLS = 8
 
 
 class _Scraper:
@@ -151,8 +166,11 @@ def _latency_herd(endpoint: str, nclients: int, rt) -> dict:
             batch = socks[base:base + window]
             for s in batch:
                 mid[0] += 1
+                # Deadline propagation rides every probe (MV016):
+                # the stamp matches the 60 s collect deadline below.
                 s.sendall(pack_frame(MSG["RequestVersion"], 0, mid[0],
-                                     timing=timing))
+                                     timing=timing,
+                                     qos=(0, 60_000_000_000)))
             deadline = time.time() + 60
             got = 0
             while got < len(batch) and time.time() < deadline:
@@ -260,7 +278,8 @@ def _audit_bench(endpoint: str, nclients: int, rt, h) -> dict:
             batch = socks[base:base + window]
             for s in batch:
                 mid[0] += 1
-                s.sendall(pack_frame(MSG["RequestVersion"], 0, mid[0]))
+                s.sendall(pack_frame(MSG["RequestVersion"], 0, mid[0],
+                                     qos=(0, 60_000_000_000)))
             deadline = time.time() + 60
             got = 0
             while got < len(batch) and time.time() < deadline:
@@ -357,6 +376,394 @@ def _raise_fd_limit(need: int) -> None:
                            (min(max(need, soft), hard), hard))
 
 
+def _fd_budget(nclients: int, headroom: int = 256) -> int:
+    """RLIMIT_NOFILE guard (docs/serving.md "tail"): raise the soft
+    limit toward ``nclients + headroom``; when the hard limit cannot
+    cover it, DEGRADE the herd to what fits (floor 64) with a logged
+    reason instead of dying with EMFILE mid-connect — a low-ulimit
+    host runs the 10k-socket phase at 1k, it does not die."""
+    import resource
+
+    need = nclients + headroom
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < need:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(need, hard) if hard > 0 else need,
+                                hard))
+        except (ValueError, OSError) as exc:
+            print(f"fd_limit: setrlimit({need}) failed: {exc}",
+                  flush=True)
+        soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    if soft < need:
+        usable = max(64, soft - headroom)
+        print(f"fd_limit: RLIMIT_NOFILE soft={soft} hard={hard} cannot "
+              f"cover {nclients} sockets + {headroom} headroom — "
+              f"degrading herd to {usable}", flush=True)
+        return usable
+    return nclients
+
+
+class _GoldProber:
+    """Paced gold-class prober running as a child PROCESS
+    (``fanin_bench_worker.py gold_probe <ep> <socks>``) — the herd's
+    selector loop owns this process's GIL, so an in-process gold
+    prober would measure Python scheduling jitter on the CLIENT, not
+    the server's per-class isolation (the same discipline as the
+    bench_ops scraper child)."""
+
+    def __init__(self, endpoint: str, socks: int = 64):
+        import subprocess
+
+        self._proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "gold_probe",
+             endpoint, str(socks)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        ready = self._proc.stdout.readline()
+        assert "GOLD_READY" in ready, ready
+
+    def stop(self):
+        """(server_residency_ms, e2e_ms) arrays observed by the child.
+
+        Residency = the trail's recv -> reply_send span, both stamps on
+        the SERVER's clock — what the serve tier actually did to a gold
+        read, immune to client-side scheduling on a shared host (the
+        e2e numbers include the experiment's own CPU contention)."""
+        self._proc.stdin.write("\n")
+        self._proc.stdin.flush()
+        out = self._proc.communicate(timeout=120)[0]
+        res, e2e = [], []
+        for line in out.splitlines():
+            if line.startswith("RES "):
+                res = [float(t) for t in line.split()[1:]]
+            elif line.startswith("E2E "):
+                e2e = [float(t) for t in line.split()[1:]]
+        return np.asarray(res) * 1e3, np.asarray(e2e) * 1e3
+
+
+def _gold_probe_child(endpoint: str, nsocks: int) -> int:
+    """Child body: ``nsocks`` gold-class connections, paced
+    8-outstanding version probes (each stamped class gold + a 30 s
+    deadline budget) until a line arrives on stdin; prints the
+    latencies (seconds)."""
+    import select
+
+    host, port = endpoint.rsplit(":", 1)
+    _raise_fd_limit(nsocks + 64)
+    sel = selectors.DefaultSelector()
+    socks = []
+    for i in range(nsocks):
+        s = socket.socket()
+        s.connect((host, int(port)))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.setblocking(False)
+        sel.register(s, selectors.EVENT_READ,
+                     {"dec": FrameDecoder(), "t0": 0.0})
+        socks.append(s)
+    print("GOLD_READY", flush=True)
+    lat = []       # client-observed e2e (includes host contention)
+    res = []       # server residency: trail recv -> reply_send
+    mid = 0
+    window = 8
+    cap = 120_000            # bounded output; probing continues
+    # PACED probing (a paid reader, not a herd): one window per 10 ms.
+    # A max-rate prober would saturate its own CPU share on a shared
+    # host and measure scheduler contention, not the server's per-class
+    # isolation.
+    base = 0
+    while not select.select([sys.stdin], [], [], 0.01)[0]:
+        batch = socks[base:base + window]
+        base = (base + window) % nsocks
+        for s in batch:
+            mid += 1
+            sel.get_key(s).data["t0"] = time.perf_counter()
+            s.sendall(pack_frame(MSG["RequestVersion"], 0, mid,
+                                 timing=True, qos=(1, 30_000_000_000)))
+        got = 0
+        deadline = time.time() + 60
+        while got < len(batch) and time.time() < deadline:
+            for key, _ in sel.select(timeout=1.0):
+                data = key.data
+                try:
+                    chunk = key.fileobj.recv(65536)
+                except BlockingIOError:
+                    continue
+                if not chunk:
+                    raise RuntimeError("gold conn died")
+                data["dec"].feed(chunk)
+                while True:
+                    body = data["dec"].next_frame()
+                    if body is None:
+                        break
+                    reply = unpack_frame(body)
+                    trail = reply.get("timing")
+                    if len(lat) < cap:
+                        lat.append(time.perf_counter() - data["t0"])
+                        if trail and trail[2] and trail[5]:
+                            res.append((trail[5] - trail[2]) * 1e-9)
+                    got += 1
+        if got < len(batch):
+            raise RuntimeError(f"gold probes stalled ({got})")
+    for s in socks:
+        s.close()
+    print("RES " + " ".join(f"{v:.9f}" for v in res), flush=True)
+    print("E2E " + " ".join(f"{v:.9f}" for v in lat), flush=True)
+    return 0
+
+
+def _tail_bench(endpoint: str, nclients: int, rt, hk, hm) -> dict:
+    """mode=tail body (docs/serving.md "tail"; bench.py ``bench_tail``).
+
+    A mixed-tenant load against one epoll reactor with
+    ``-qos_inflight_max`` armed — the GOLD tenant probes from a child
+    process (client-side GIL isolation), the BULK herd storms from this
+    one:
+
+    - **gold-alone phase** — the gold child probes an idle reactor →
+      baseline p50/p99/p99.9;
+    - **herd phase** — a continuous bulk Get storm across the whole
+      herd (one outstanding Get per socket, re-fired on every reply;
+      sheds tallied) while the gold child re-probes →
+      ``tail_qos_isolation`` = gold p99 under the herd / alone
+      (acceptance: < 2x — the bulk herd must not starve gold);
+    - **hedge phase** — a seeded ``apply_delay`` straggler on the
+      server while a gold ``HedgedReader`` row-reads a hot row set →
+      ``tail_hedge_win_rate`` (> 0 under the straggler);
+    - **deadline phase** — gets stamped with a 1 ns budget must shed at
+      dequeue (``tail_deadline_shed`` > 0, named by the in-band
+      scrape);
+    - **overhead phase** — interleaved best-of-5 paced probes stamped
+      vs unstamped on a quiet reactor → ``tail_overhead_pct`` (the
+      QoS/deadline stamp's cost on the unhedged fast path; < 1%).
+    """
+    import json
+
+    from multiverso_tpu.serve.hedge import HedgedReader
+    from multiverso_tpu.serve.wire import AnonServeClient
+
+    host, port = endpoint.rsplit(":", 1)
+    nclients = _fd_budget(nclients)
+    bulk_n = max(16, nclients - 64)   # gold lives in the 64-sock child
+    budget_ns = 30_000_000_000        # the storm's propagated deadline
+
+    sel = selectors.DefaultSelector()
+    bulk = []
+    for i in range(bulk_n):
+        s = socket.socket()
+        s.connect((host, int(port)))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.setblocking(False)
+        sel.register(s, selectors.EVENT_READ,
+                     {"dec": FrameDecoder(), "id": i, "t0": 0.0})
+        bulk.append(s)
+    out = {"clients": float(bulk_n + 64), "bulk_clients": float(bulk_n),
+           "gold_clients": 64.0}
+    mid = [0]
+
+    def fire(s):
+        """One bulk Get, tolerant of a full send buffer (at 10k socks
+        the kernel pushes back; a client that cannot send this round
+        simply rejoins on its next reply)."""
+        mid[0] += 1
+        try:
+            s.send(pack_frame(MSG["RequestGet"], 0, mid[0],
+                              qos=(0, budget_ns)))
+            return True
+        except (BlockingIOError, InterruptedError):
+            return False
+
+    # The shed contract IS the pacing (docs/serving.md): a ReplyBusy
+    # means "retry after backoff", so a shed bulk client re-fires after
+    # a backoff window while a served one re-polls sooner.  A herd that
+    # busy-looped on sheds instead would measure host-CPU starvation
+    # (client and server share the machine), not admission isolation.
+    BUSY_BACKOFF_S = 2.0
+    SERVED_BACKOFF_S = 0.5
+
+    def pct(arr, q):
+        return float(np.percentile(arr, q)) if len(arr) else 0.0
+
+    # --- phase A: gold alone -------------------------------------------
+    gold = _GoldProber(endpoint)
+    time.sleep(3.0)
+    alone_res, alone_e2e = gold.stop()
+    out["gold_p50_ms"] = pct(alone_res, 50)
+    out["gold_alone_p99_ms"] = pct(alone_res, 99)
+    out["gold_alone_p999_ms"] = pct(alone_res, 99.9)
+    out["gold_alone_e2e_p99_ms"] = pct(alone_e2e, 99)
+
+    # --- phase B: the bulk herd arrives --------------------------------
+    import heapq
+
+    gold = _GoldProber(endpoint)
+    tally = {}
+    bulk_lat = []
+    due = []                      # (when, seq, sock) re-fire heap
+    seq = [0]
+
+    def schedule(s, delay):
+        seq[0] += 1
+        heapq.heappush(due, (time.perf_counter() + delay, seq[0], s))
+
+    for s in bulk:
+        sel.get_key(s).data["t0"] = time.perf_counter()
+        fire(s)
+    storm_stop = time.perf_counter() + 6.0
+    refire = True
+    while True:
+        now = time.perf_counter()
+        if refire and now >= storm_stop:
+            refire = False
+            herd_res, herd_e2e = gold.stop()  # gold sampled the storm
+            drain_stop = now + 5.0
+        if not refire and (time.perf_counter() >= drain_stop):
+            break
+        if refire:
+            while due and due[0][0] <= now:
+                _, _, s = heapq.heappop(due)
+                sel.get_key(s).data["t0"] = time.perf_counter()
+                fire(s)
+        events = sel.select(timeout=0.05)
+        if not events and not refire:
+            break
+        for key, _ in events:
+            data = key.data
+            try:
+                chunk = key.fileobj.recv(65536)
+            except BlockingIOError:
+                continue
+            if not chunk:
+                raise RuntimeError(f"bulk conn {data['id']} died")
+            data["dec"].feed(chunk)
+            while True:
+                body = data["dec"].next_frame()
+                if body is None:
+                    break
+                reply = unpack_frame(body)
+                tally[reply["type_name"]] = \
+                    tally.get(reply["type_name"], 0) + 1
+                served_reply = reply["type_name"] == "ReplyGet"
+                if served_reply:
+                    bulk_lat.append(time.perf_counter() - data["t0"])
+                if refire:
+                    schedule(key.fileobj, SERVED_BACKOFF_S if served_reply
+                             else BUSY_BACKOFF_S)
+    # Gated on SERVER RESIDENCY (the serve tier's contribution to a
+    # gold read — mailbox wait + apply + reactor, one clock): on a
+    # shared host the client-observed e2e includes the experiment's
+    # own CPU contention, which no admission gate can remove.
+    out["gold_p99_ms"] = pct(herd_res, 99)
+    out["gold_p999_ms"] = pct(herd_res, 99.9)
+    out["gold_e2e_p99_ms"] = pct(herd_e2e, 99)
+    out["gold_e2e_p999_ms"] = pct(herd_e2e, 99.9)
+    bulk_ms = np.asarray(bulk_lat) * 1e3
+    out["bulk_p99_ms"] = pct(bulk_ms, 99)
+    out["bulk_p999_ms"] = pct(bulk_ms, 99.9)
+    served = tally.get("ReplyGet", 0)
+    shed = tally.get("ReplyBusy", 0)
+    out["bulk_served"] = float(served)
+    out["bulk_shed"] = float(shed)
+    out["bulk_shed_rate"] = shed / max(1.0, float(served + shed))
+    out["qos_isolation"] = (out["gold_p99_ms"]
+                            / max(out["gold_alone_p99_ms"], 1e-6))
+
+    # --- phase C: hedged reads under a seeded straggler ----------------
+    hot = list(range(8))  # rank 0's shard owns the low rows
+    reader = HedgedReader(endpoint, hm, MCOLS, qos_class="gold",
+                          hedge_min_us=2000, timeout=30.0)
+    for _ in range(60):          # warm the SpaceSaving top-K + tracker
+        reader.get_rows(hot)
+    rt.kv_add(hk, "arm_delay", 1.0)      # rank 0 seeds apply_delay
+    while rt.kv_get(hk, "delay_armed") < 1.0:
+        time.sleep(0.02)
+    for _ in range(240):
+        reader.get_rows(hot)
+    rt.kv_add(hk, "disarm_delay", 1.0)
+    st = reader.stats()
+    reader.close()
+    out["hedge_issued"] = float(st["issued"])
+    out["hedge_won"] = float(st["won"])
+    out["hedge_wasted"] = float(st["wasted"])
+    out["hedge_win_rate"] = st["win_rate"]
+
+    # --- phase D: deadline sheds ---------------------------------------
+    probe = AnonServeClient(endpoint, timeout=10.0)
+    for i in range(20):
+        # 1 ns budget: expired by the time the actor dequeues it — the
+        # server must drop it, never burn an apply slot.  No reply
+        # comes back; the probe socket stays healthy for the scrape.
+        probe.send_raw(pack_frame(MSG["RequestGet"], 0,
+                                  1_000_000 + i, qos=(0, 1)))
+    deadline = time.time() + 10
+    sheds = 0
+    while time.time() < deadline:
+        rep = json.loads(probe.ops_report("latency"))
+        sheds = (rep.get("qos") or {}).get("deadline_shed", 0)
+        if sheds >= 20:
+            break
+        time.sleep(0.05)
+    out["deadline_shed"] = float(sheds)
+    probe.close()
+
+    # --- phase E: stamp overhead on the unhedged fast path -------------
+    # Paced probes over 64 quiet sockets, interleaved best-of-5 per arm
+    # (the bench_audit discipline: loopback QPS noise is one-sided, so
+    # max-vs-max under interleaving is what can resolve a <1% bar).
+    # Frames are PRE-PACKED outside the timed loop: the bar measures
+    # what the stamp costs the WIRE + SERVER path, and on a shared host
+    # every extra client-side pack cycle would also steal server time
+    # (version probes ignore msg_id uniqueness, so one frame per arm
+    # serves every probe).
+    esocks = bulk[:64]
+    frame_plain = pack_frame(MSG["RequestVersion"], 0, 1)  # mvlint: disable=MV016 — the unstamped A/B baseline arm
+    frame_qos = pack_frame(MSG["RequestVersion"], 0, 1,
+                           qos=(0, budget_ns))
+
+    def sweep(qos):
+        frame = frame_qos if qos else frame_plain
+        done = 0
+        window = 8
+        t0 = time.perf_counter()
+        for _ in range(6):
+            for base in range(0, len(esocks), window):
+                batch = esocks[base:base + window]
+                for s in batch:
+                    s.sendall(frame)
+                got = 0
+                deadline = time.time() + 60
+                while got < len(batch) and time.time() < deadline:
+                    for key, _ in sel.select(timeout=1.0):
+                        data = key.data
+                        try:
+                            chunk = key.fileobj.recv(65536)
+                        except BlockingIOError:
+                            continue
+                        if not chunk:
+                            raise RuntimeError("probe conn died")
+                        data["dec"].feed(chunk)
+                        while data["dec"].next_frame() is not None:
+                            got += 1
+                if got < len(batch):
+                    raise RuntimeError("overhead probes stalled")
+                done += got
+        return done / (time.perf_counter() - t0)
+
+    sweep(qos=False)                            # warm
+    stamped_qps, plain_qps = [], []
+    for _ in range(5):
+        plain_qps.append(sweep(qos=False))
+        stamped_qps.append(sweep(qos=True))
+    base = max(plain_qps)
+    out["overhead_pct"] = (max(0.0, (base - max(stamped_qps))
+                           / base * 100.0) if base else 0.0)
+    out["probe_qps"] = max(stamped_qps)
+
+    for s in bulk:
+        sel.unregister(s)
+        s.close()
+    return out
+
+
 def _herd(endpoint: str, nclients: int, scrape: bool = False) -> dict:
     host, port = endpoint.rsplit(":", 1)
     _raise_fd_limit(nclients + 256)
@@ -406,7 +813,8 @@ def _herd(endpoint: str, nclients: int, scrape: bool = False) -> dict:
         batch = socks[base:base + window]
         for j, s in enumerate(batch):
             sel.get_key(s).data["t0"] = time.perf_counter()
-            s.sendall(pack_frame(MSG["RequestVersion"], 0, base + j))
+            s.sendall(pack_frame(MSG["RequestVersion"], 0, base + j,
+                                 qos=(0, 60_000_000_000)))
 
         def note(data, reply):
             lat.append(time.perf_counter() - data["t0"])
@@ -433,7 +841,8 @@ def _herd(endpoint: str, nclients: int, scrape: bool = False) -> dict:
     # --- overload phase: every client fires a Get at once ---------------
     counts = {"ReplyGet": 0, "ReplyBusy": 0}
     for i, s in enumerate(socks):
-        s.sendall(pack_frame(MSG["RequestGet"], 0, 10000 + i))
+        s.sendall(pack_frame(MSG["RequestGet"], 0, 10000 + i,
+                             qos=(0, 120_000_000_000)))
 
     def tally(_data, reply):
         counts[reply["type_name"]] = counts.get(reply["type_name"], 0) + 1
@@ -454,17 +863,26 @@ def main() -> int:
     inflight_max = int(sys.argv[4]) if len(sys.argv) > 4 else 8
     chaos = int(sys.argv[5]) if len(sys.argv) > 5 else 0
     mode = sys.argv[6] if len(sys.argv) > 6 else ""
-    rt = nat.NativeRuntime(args=[
+    args = [
         f"-machine_file={mf}", f"-rank={rank}", "-log_level=error",
         "-rpc_timeout_ms=60000", "-barrier_timeout_ms=120000",
         f"-server_inflight_max={inflight_max}",
-        "-net_arena_bytes=8192", "-send_retries=3", "-send_backoff_ms=20"])
+        "-net_arena_bytes=8192", "-send_retries=3", "-send_backoff_ms=20"]
+    if mode == "tail":
+        # Tail plane (docs/serving.md "tail"): per-class weighted
+        # admission armed — bulk owns ~1/9 of the read slots, gold the
+        # rest, spare capacity borrowed in weight proportion.
+        args += ["-qos_classes=bulk:1,gold:8", "-qos_inflight_max=32"]
+    rt = nat.NativeRuntime(args=args)
     assert rt.net_engine() == "epoll", rt.net_engine()
     h = rt.new_array_table(SIZE)
     hk = rt.new_kv_table()
+    hm = rt.new_matrix_table(MROWS, MCOLS)
     rt.barrier()
     if rank == 0:
         rt.array_add(h, np.ones(SIZE, np.float32))
+        rt.matrix_add_rows(hm, list(range(MROWS)),
+                           np.ones((MROWS, MCOLS), np.float32))
     rt.barrier()
 
     out = {}
@@ -478,16 +896,32 @@ def main() -> int:
                 rt.array_add(h, np.ones(SIZE, np.float32))
             rt.clear_faults()
             assert rt.query_monitor("net.retries") >= CHAOS_ADDS
-        # Hold the serve tier up until the herd reports done.
+        # Hold the serve tier up until the herd reports done; mode=tail
+        # additionally arms/disarms the seeded apply_delay straggler on
+        # the herd's kv signal (the hedge phase's chaos ingredient).
+        armed = False
         deadline = time.time() + 600
         while rt.kv_get(hk, "herd_done") < 1.0:
+            if mode == "tail":
+                if not armed and rt.kv_get(hk, "arm_delay") > 0:
+                    rt.set_fault_seed(1234)
+                    rt.set_fault("apply_delay", 0.05)
+                    armed = True
+                    rt.kv_add(hk, "delay_armed", 1.0)
+                elif armed and rt.kv_get(hk, "disarm_delay") > 0:
+                    rt.clear_faults()
+                    armed = False
             if time.time() > deadline:
                 raise RuntimeError("herd never finished")
             time.sleep(0.05)
+        if armed:
+            rt.clear_faults()
     else:
         eps = [ln.strip() for ln in open(mf) if ln.strip()]
         if mode == "latency":
             out = _latency_herd(eps[0], nclients, rt)
+        elif mode == "tail":
+            out = _tail_bench(eps[0], nclients, rt, hk, hm)
         elif mode == "audit":
             out = _audit_bench(eps[0], nclients, rt, h)
         elif mode == "ops":
@@ -537,4 +971,6 @@ def main() -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "scrape":
         sys.exit(_scrape_child(sys.argv[2]))
+    if len(sys.argv) > 1 and sys.argv[1] == "gold_probe":
+        sys.exit(_gold_probe_child(sys.argv[2], int(sys.argv[3])))
     sys.exit(main())

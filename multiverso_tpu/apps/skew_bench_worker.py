@@ -98,7 +98,8 @@ class Herd:
                 lo = (base + j) * IDS_PER_REQ
                 blob = ids[lo:lo + IDS_PER_REQ].tobytes()
                 s.sendall(pack_frame(MSG["RequestGet"], table_id,
-                                     self._mid, blobs=[blob]))
+                                     self._mid, blobs=[blob],
+                                     qos=(0, 60_000_000_000)))
             need = got + batch
             while got < need and time.time() < deadline:
                 for key, _ in self.sel.select(timeout=1.0):

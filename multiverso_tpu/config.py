@@ -274,6 +274,36 @@ define_int("replica_max_staleness", 0,
            "last observed apply (native-flag parity); 0 = a row older "
            "than any later observed add misses")
 
+# --- tail-at-scale serve tier (docs/serving.md "tail") ---------------------
+define_int("serve_timeout_ms", 30000,
+           "AnonServeClient's default connect/read timeout — ONE source "
+           "of truth for the serve deadline: the same budget is stamped "
+           "into every request's QoS wire header (deadline propagation), "
+           "so a server drops a read whose caller already gave up "
+           "(serve.deadline.shed) instead of burning an apply slot")
+define_string("qos_classes", "bulk:1,gold:8",
+              "tenant classes + weights ('name:weight,...'; wire class "
+              "ids are POSITIONAL indices into this list — native-flag "
+              "parity).  Weights split -qos_inflight_max into per-class "
+              "guaranteed read budgets at the reactor")
+define_int("qos_inflight_max", 0,
+           "per-class weighted admission over anonymous serve reads at "
+           "the reactor (native-flag parity): a class at its share "
+           "answers ReplyBusy while others keep flowing; adds are never "
+           "shed.  0 (default) disables the gate")
+define_string("qos_class", "bulk",
+              "the tenant class this process's requests declare "
+              "(native-flag parity; a name from -qos_classes)")
+define_bool("wire_deadline", True,
+            "deadline propagation (native-flag parity): stamp requests "
+            "with their remaining timeout budget; receivers drop a read "
+            "already past its deadline at dequeue.  Adds never shed")
+define_double("hedge_min_us", 1000.0,
+              "hedged-read delay floor: HedgedReader re-issues a read "
+              "after max(observed p95, this) — hedging earlier than the "
+              "tail re-issues healthy traffic for nothing "
+              "(docs/serving.md \"tail\")")
+
 define_double("version_lease_ms", 50.0,
               "how long a learned server version stays trusted before "
               "a cached read pays a header-only version probe; 0 = "

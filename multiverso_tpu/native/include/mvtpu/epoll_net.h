@@ -62,6 +62,7 @@ class EpollNet : public RankTransport {
   int size() const override { return static_cast<int>(endpoints_.size()); }
   const char* engine() const override { return "epoll"; }
   FanInStats FanIn() const override;
+  void SettleClient(int client_rank) override;
 
  private:
   struct PendingFrame;
@@ -69,6 +70,12 @@ class EpollNet : public RankTransport {
   struct Shard;
 
   void ReactorLoop(Shard* s);
+  // Adopt pending connection registrations + write-queue arms.  Called
+  // at the top of every reactor cycle AND whenever the wake eventfd is
+  // drained mid-batch — consuming a wake without re-adopting would
+  // strand the sender's hand-off for a full epoll_wait cycle (the
+  // lost-wakeup tail spike the latency plane attributed to wire_back).
+  void AdoptHandoffs(Shard* s);
   void HandleAccept(Shard* s);
   void HandleReadable(Shard* s, const std::shared_ptr<Conn>& c);
   // Drain the write queue as far as the socket accepts.  Returns false

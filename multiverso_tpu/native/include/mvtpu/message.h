@@ -54,6 +54,14 @@ enum class MsgType : int32_t {
   // staleness bound misses).  Sheddable like a Get — never blocks adds.
   RequestReplica = 11,
   ReplyReplica = 12,
+  // Hedge-cancel token (docs/serving.md "tail"): fire-and-forget notice
+  // that the sender no longer wants the answer to (src, msg_id) — the
+  // LOSER of a hedged read race.  Consumed AT THE REACTOR (never the
+  // actor mailbox, so it overtakes the FIFO the loser is parked in);
+  // the server actor drops a cancelled Get at dequeue instead of
+  // burning an apply slot on an answer nobody is waiting for.  Only
+  // reads are ever cancelled; there is no reply.
+  RequestCancel = 13,
   // SSP clock announcement (msg_id = the worker's new clock).  Rides
   // each worker->server connection BEHIND that clock's adds (FIFO), so
   // "min worker clock >= c" implies every rank's adds through clock c
@@ -123,6 +131,12 @@ inline constexpr int32_t kHasTiming = 1 << 3;
 // never stamp ship/parse the old layout, and replies carry a stamp
 // only when the request did.
 inline constexpr int32_t kHasAudit = 1 << 4;
+// Tenant QoS + deadline stamp (docs/serving.md "tail"): a QosStamp
+// follows the WireHeader (after the AuditStamp when both bits are
+// set).  Version-tolerant exactly like kHasTiming/kHasAudit: peers
+// that never stamp ship/parse the old layout byte-identically, and a
+// flagged-but-short frame is malformed, never a misparse.
+inline constexpr int32_t kHasQos = 1 << 5;
 }  // namespace msgflag
 
 // Wire-stamped request-lifecycle timing trail (docs/observability.md):
@@ -160,6 +174,23 @@ struct TimingTrail {
 struct AuditStamp {
   int64_t seq_lo = 0;
   int64_t seq_hi = 0;
+};
+
+// Tenant QoS + deadline-propagation stamp (docs/serving.md "tail").
+// `klass` is the sender's tenant class — a POSITIONAL index into the
+// server's `-qos_classes` list (both sides must agree on the list, the
+// same contract as codec negotiation); the reactor's weighted admission
+// gate budgets inflight reads per class.  `budget_ns` is the REMAINING
+// deadline budget at client send time (0 = no deadline): the receiver
+// converts it to a local-clock deadline at frame receipt — correcting
+// for wire time via the PR 11 clock-offset estimate when one exists —
+// and drops a read that is already past it at dequeue instead of
+// burning an apply slot on an answer nobody is waiting for.  Adds are
+// never deadline-shed.
+struct QosStamp {
+  int32_t klass = 0;
+  int32_t pad = 0;
+  int64_t budget_ns = 0;
 };
 
 // Fixed-size wire header — ONE definition shared by Message::Serialize
@@ -212,10 +243,18 @@ struct Message {
   // carry the covered seq range, the server's ReplyAdd ack echoes it
   // so the client ledger can advance its acked watermark.
   AuditStamp audit;
+  // Tenant QoS + deadline stamp — on the wire ONLY when flags carries
+  // kHasQos (docs/serving.md "tail"): read requests carry their class
+  // and remaining deadline budget; replies never carry one.
+  QosStamp qos;
+  // NOT serialized: the local-monotonic-clock deadline adopted from
+  // `qos.budget_ns` at frame receipt (qos::AdoptDeadline).  0 = none.
+  int64_t qos_deadline_ns = 0;
   std::vector<Blob> data;
 
   bool has_timing() const { return (flags & msgflag::kHasTiming) != 0; }
   bool has_audit() const { return (flags & msgflag::kHasAudit) != 0; }
+  bool has_qos() const { return (flags & msgflag::kHasQos) != 0; }
 
   // Header <-> message field marshalling (shared by Serialize and the
   // transport's scatter-gather framing).

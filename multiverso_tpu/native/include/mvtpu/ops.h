@@ -50,6 +50,14 @@ std::string LocalReport(const std::string& kind);
 // query's first blob).  Routing fields (src/dst) are the caller's job.
 void BuildReply(const Message& query, Message* reply);
 
+// Fill `reply` as the ReplyReplica to an anonymous RequestReplica —
+// the shard's hot-key top-K snapshot (docs/serving.md "tail"): a
+// bounded read under the shard lock, safe from the reactor thread like
+// the table-stats scrape, which is what lets a hedged read win while a
+// straggling apply clogs the actor mailbox.  Routing fields (src/dst)
+// are the caller's job; a table with no local shard answers empty.
+void BuildReplicaReply(const Message& query, Message* reply);
+
 // Prometheus-sanitized metric name (mirrors metrics.py _prom_name).
 std::string PromName(const std::string& name);
 

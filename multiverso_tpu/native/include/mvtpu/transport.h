@@ -52,6 +52,12 @@ class Net {
                                    // per-client admission gate
   };
   virtual FanInStats FanIn() const { return {}; }
+
+  // Settle one per-client admission slot for an anonymous client whose
+  // request was DROPPED server-side (deadline-expired or hedge-
+  // cancelled read: no reply will ever route back to release it).
+  // No-op on engines without anonymous clients.
+  virtual void SettleClient(int client_rank) { (void)client_rank; }
 };
 
 namespace transport {

@@ -137,6 +137,12 @@ class Zoo {
   // retryable ReplyBusy (no table work) and return true.  Gets and
   // version probes only — adds are never shed ("no lost adds").
   bool ShedIfOverloaded(MessagePtr& msg);
+  // Tail plane (docs/serving.md "tail"): true when `msg` is a read
+  // that was hedge-cancelled or is past its propagated deadline — the
+  // caller drops it at dequeue (counted serve.hedge.cancelled /
+  // serve.deadline.shed; an anonymous client's reactor admission slots
+  // settle through the transport).  Reads only — never call for adds.
+  bool DropServeRead(MessagePtr& msg);
 
   // Deliver to a LOCAL actor's mailbox.
   void SendTo(const std::string& actor_name, MessagePtr msg);

@@ -346,6 +346,40 @@ void RegisterDefaults() {
               "rank beside the canonical latest dump (a second "
               "trigger no longer overwrites the first dump's "
               "evidence); a manifest lists the retained dumps");
+    DefineString("qos_classes", "bulk:1,gold:8",
+                 "tail-at-scale QoS (docs/serving.md \"tail\"): tenant "
+                 "classes and weights, 'name:weight,...'.  Class ids on "
+                 "the wire are POSITIONAL indices into this list (both "
+                 "sides must agree, like codec negotiation); weights "
+                 "split -qos_inflight_max into guaranteed per-class "
+                 "read budgets and set the borrow ratio for spare "
+                 "capacity");
+    DefineInt("qos_inflight_max", 0,
+              "per-class weighted admission over anonymous serve reads "
+              "at the reactor: total inflight read slots split across "
+              "-qos_classes by weight (deficit-round-robin borrowing "
+              "of spare capacity); a class at its share answers "
+              "ReplyBusy while other classes keep flowing.  Adds and "
+              "flushes are never shed.  0 (default) disables the gate "
+              "(per-class counters still accrue)");
+    DefineString("qos_class", "bulk",
+                 "the tenant class THIS process's worker requests "
+                 "declare in their QoS wire stamp (a name from "
+                 "-qos_classes; unknown names map to class 0)");
+    DefineBool("wire_deadline", true,
+               "deadline propagation (docs/serving.md \"tail\"): stamp "
+               "worker requests with their remaining -rpc_timeout_ms "
+               "budget behind a version-tolerant wire flag; receivers "
+               "drop a read already past its deadline at dequeue "
+               "(serve.deadline.shed) instead of burning an apply slot. "
+               "Adds are never deadline-shed.  false stamps nothing");
+    DefineBool("replica_serve_reactor", true,
+               "answer ANONYMOUS hot-key replica pulls (RequestReplica) "
+               "at the epoll reactor instead of the actor mailbox — a "
+               "bounded snapshot read under the shard lock, so a hedged "
+               "read can win against a straggling apply clogging the "
+               "mailbox (docs/serving.md \"tail\").  Rank-peer replica "
+               "refreshes keep the mailbox path either way");
     DefineInt("shed_storm_threshold", 0,
               "flight-recorder trigger: this many CONSECUTIVE busy-sheds "
               "(-server_inflight_max) dump the black box once per storm "

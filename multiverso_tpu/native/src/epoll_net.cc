@@ -27,6 +27,7 @@
 #include "mvtpu/log.h"
 #include "mvtpu/net.h"
 #include "mvtpu/ops.h"
+#include "mvtpu/qos.h"
 
 namespace mvtpu {
 
@@ -145,6 +146,8 @@ struct EpollNet::PendingFrame {
     // Delivery-audit stamp rides after the trail (same Serialize
     // order); head.frame_len counts it via WireBytes().
     if (msg.has_audit()) push(&msg.audit, sizeof(AuditStamp));
+    // QoS/deadline stamp rides after the audit stamp (same order).
+    if (msg.has_qos()) push(&msg.qos, sizeof(QosStamp));
     for (size_t i = 0; i < msg.data.size(); ++i) {
       push(&lens[i], sizeof(int64_t));
       push(msg.data[i].data(), msg.data[i].size());
@@ -178,6 +181,11 @@ struct EpollNet::Conn {
   // Per-client admission (reactor increments on forwarded requests;
   // Send decrements when the reply goes out).
   std::atomic<long long> inflight{0};
+  // Tenant class (docs/serving.md "tail"): latched from the first
+  // frame carrying a QoS stamp (-1 until declared; effective class 0 =
+  // the first -qos_classes entry).  A connection property so replies
+  // can settle the right class budget without carrying the stamp back.
+  std::atomic<int> qos_class{-1};
 
   Mutex mu;
   CondVar can_write;  // backpressure + drain-on-stop waiters
@@ -288,6 +296,32 @@ void EpollNet::WakeShard(Shard* s) {
   (void)n;  // EAGAIN means a wake is already pending — good enough
 }
 
+void EpollNet::AdoptHandoffs(Shard* s) {
+  std::vector<std::shared_ptr<Conn>> regs, arms;
+  {
+    MutexLock lk(s->mu);
+    regs.swap(s->to_register);
+    arms.swap(s->to_arm);
+  }
+  for (auto& c : regs) {
+    s->conns[c->fd] = c;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = c->fd;
+    ::epoll_ctl(s->epfd, EPOLL_CTL_ADD, c->fd, &ev);
+  }
+  for (auto& c : arms) {
+    auto it = s->conns.find(c->fd);
+    if (it == s->conns.end() || it->second != c) continue;
+    bool empty = true;
+    if (!DrainWrites(c, &empty)) {
+      CloseConn(s, c, "write error");
+      continue;
+    }
+    if (!empty) ArmWrite(c);  // EPOLLOUT resumes the drain
+  }
+}
+
 void EpollNet::ReactorLoop(Shard* s) {
   constexpr int kMaxEvents = 128;
   epoll_event events[kMaxEvents];
@@ -300,29 +334,7 @@ void EpollNet::ReactorLoop(Shard* s) {
     }
     // Adopt hand-offs first so a just-connected peer's events register
     // before we sleep again.
-    std::vector<std::shared_ptr<Conn>> regs, arms;
-    {
-      MutexLock lk(s->mu);
-      regs.swap(s->to_register);
-      arms.swap(s->to_arm);
-    }
-    for (auto& c : regs) {
-      s->conns[c->fd] = c;
-      epoll_event ev{};
-      ev.events = EPOLLIN;
-      ev.data.fd = c->fd;
-      ::epoll_ctl(s->epfd, EPOLL_CTL_ADD, c->fd, &ev);
-    }
-    for (auto& c : arms) {
-      auto it = s->conns.find(c->fd);
-      if (it == s->conns.end() || it->second != c) continue;
-      bool empty = true;
-      if (!DrainWrites(c, &empty)) {
-        CloseConn(s, c, "write error");
-        continue;
-      }
-      if (!empty) ArmWrite(c);  // EPOLLOUT resumes the drain
-    }
+    AdoptHandoffs(s);
     for (int i = 0; i < n; ++i) {
       int fd = events[i].data.fd;
       uint32_t what = events[i].events;
@@ -330,6 +342,13 @@ void EpollNet::ReactorLoop(Shard* s) {
         uint64_t junk;
         while (::read(s->wake_fd, &junk, sizeof(junk)) > 0) {
         }
+        // Re-adopt AFTER draining the eventfd: a sender that enqueued
+        // between this batch's top-of-loop adoption and the drain just
+        // had its wake CONSUMED — without this, its frame would sit in
+        // the hand-off queue for a full epoll_wait cycle (a ~200 ms
+        // tail spike on quiet paced traffic; the tail bench caught it
+        // as a wire_back stage stall).
+        AdoptHandoffs(s);
         continue;
       }
       if (fd == listen_fd_.load()) {
@@ -489,6 +508,9 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
   // Latency trail: frame-complete AT THE REACTOR — the stamp the
   // mailbox stage starts from (docs/observability.md).
   latency::StampRecv(&m);
+  // Deadline propagation (docs/serving.md "tail"): convert the wire
+  // budget into a local-clock deadline while the recv boundary is hot.
+  qos::AdoptDeadline(&m);
 
   int peer = c->peer.load();
   if (c->accepted && peer < 0) {
@@ -517,6 +539,16 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
   // forwarded upstream (stray Hellos on an identified connection are
   // dropped the same way).
   if (m.type == MsgType::Hello) return true;
+  if (m.type == MsgType::RequestCancel) {
+    // Hedge-cancel token (docs/serving.md "tail"): consumed AT THE
+    // REACTOR like Hello/OpsQuery — never the mailbox, so it OVERTAKES
+    // the FIFO the loser read is parked in.  Fire-and-forget:
+    // uncounted by admission, no reply.
+    qos::NoteCancel(transport::IsClientRank(peer) ? peer : m.src,
+                    m.msg_id);
+    Dashboard::Record("serve.hedge.cancel_noted", 0.0);
+    return true;
+  }
   if (m.type == MsgType::OpsQuery) {
     // Introspection scrape (docs/observability.md): answered AT THE
     // REACTOR, exactly like a synthesized busy reply — it must never
@@ -545,19 +577,19 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
   if (transport::IsClientRank(peer)) {
     // Anonymous client: the pseudo-rank IS the reply address.
     m.src = peer;
+    // Tenant class declaration (docs/serving.md "tail"): latched from
+    // the first QoS-stamped frame; later stamps may retarget it.
+    if (m.has_qos()) c->qos_class.store(m.qos.klass);
+    int qc = c->qos_class.load();
+    if (qc < 0) qc = 0;  // undeclared = the first -qos_classes entry
     bool counted =
         m.type == MsgType::RequestGet || m.type == MsgType::RequestVersion ||
         m.type == MsgType::RequestReplica ||
         m.type == MsgType::RequestFlush ||
         (m.type == MsgType::RequestAdd && m.msg_id >= 0);
-    int64_t cap = FlagOr("client_inflight_max", 64);
-    if (cap > 0 && counted && m.type != MsgType::RequestAdd &&
-        m.type != MsgType::RequestFlush &&
-        c->inflight.load() >= cap) {
-      // Per-client admission on top of -server_inflight_max: shed
-      // Gets/probes (never adds) without touching the actor mailbox.
-      client_shed_.fetch_add(1);
-      Dashboard::Record("serve.client_shed", 0.0);
+    bool readlike = counted && m.type != MsgType::RequestAdd &&
+                    m.type != MsgType::RequestFlush;
+    auto reply_busy = [&]() {
       Message busy;
       busy.type = MsgType::ReplyBusy;
       busy.table_id = m.table_id;
@@ -570,6 +602,42 @@ bool EpollNet::FinishFrame(Shard* s, const std::shared_ptr<Conn>& c) {
       latency::StampSend(&busy);
       // Reactor thread: never block on our own write queue.
       return Enqueue(c, busy, /*may_block=*/false);
+    };
+    // Deadline shed (docs/serving.md "tail"): a read that arrives
+    // already past its propagated budget is dropped outright — the
+    // caller stopped waiting, so neither a mailbox slot nor a busy
+    // reply is owed.  Adds/flushes are never deadline-shed.
+    if (readlike && qos::ShedExpired(m)) return true;
+    int64_t cap = FlagOr("client_inflight_max", 64);
+    if (cap > 0 && readlike && c->inflight.load() >= cap) {
+      // Per-client admission on top of -server_inflight_max: shed
+      // Gets/probes (never adds) without touching the actor mailbox.
+      client_shed_.fetch_add(1);
+      Dashboard::Record("serve.client_shed", 0.0);
+      return reply_busy();
+    }
+    // Per-tenant weighted admission (docs/serving.md "tail"): reads
+    // compete for per-class inflight budgets — a bulk herd at its
+    // share answers ReplyBusy here while gold reads keep flowing.
+    if (readlike && !qos::TryAdmit(qc)) return reply_busy();
+    // Hedge fast path: answer an anonymous hot-key replica pull AT THE
+    // REACTOR — a bounded snapshot read under the shard lock, so a
+    // hedged read can win while a straggling apply clogs the actor
+    // mailbox.  The admission slot settles synchronously (the reply is
+    // queued before we return); per-client inflight never counts it,
+    // matching the may_block=false no-settle rule in Enqueue.
+    if (m.type == MsgType::RequestReplica &&
+        (!mvtpu::configure::Has("replica_serve_reactor") ||
+         mvtpu::configure::GetBool("replica_serve_reactor"))) {
+      Message reply;
+      ops::BuildReplicaReply(m, &reply);
+      reply.src = rank_;
+      reply.dst = peer;
+      latency::StampDequeue(&m);
+      latency::StampReply(m, &reply);
+      latency::StampSend(&reply);
+      qos::Release(qc);
+      return Enqueue(c, reply, /*may_block=*/false);
     }
     if (counted) c->inflight.fetch_add(1);
   }
@@ -777,6 +845,12 @@ bool EpollNet::Enqueue(const std::shared_ptr<Conn>& c, const Message& msg,
        msg.type == MsgType::ReplyError)) {
     long long now = c->inflight.fetch_add(-1);
     if (now <= 0) c->inflight.fetch_add(1);  // floor at zero
+    // A read reply also settles its tenant-class admission slot (adds/
+    // flushes were never class-admitted; Release floors per class).
+    if (msg.type != MsgType::ReplyAdd && msg.type != MsgType::ReplyFlush) {
+      int qc = c->qos_class.load();
+      qos::Release(qc < 0 ? 0 : qc);
+    }
   }
   const int64_t cap = FlagOr("net_writeq_bytes", 64 << 20);
   const int64_t timeout_ms = FlagOr("io_timeout_ms", 30000);
@@ -886,6 +960,24 @@ bool EpollNet::Send(int dst_rank, const Message& msg) {
   Log::Error("EpollNet: send to rank %d failed after %d attempt(s)",
              dst_rank, retries + 1);
   return false;
+}
+
+void EpollNet::SettleClient(int client_rank) {
+  // An anonymous client's read was DROPPED server-side (deadline shed /
+  // hedge cancel): no reply will route back through Enqueue, so the
+  // per-client and per-class slots settle here instead of leaking
+  // until the client is permanently shed at cap.
+  std::shared_ptr<Conn> c;
+  {
+    MutexLock lk(conns_mu_);
+    auto it = client_conns_.find(client_rank);
+    if (it == client_conns_.end()) return;  // client gone: slots died too
+    c = it->second;
+  }
+  long long now = c->inflight.fetch_add(-1);
+  if (now <= 0) c->inflight.fetch_add(1);  // floor at zero
+  int qc = c->qos_class.load();
+  qos::Release(qc < 0 ? 0 : qc);
 }
 
 Net::FanInStats EpollNet::FanIn() const {

@@ -34,6 +34,7 @@ int64_t Message::WireBytes() const {
   int64_t total = static_cast<int64_t>(sizeof(WireHeader));
   if (has_timing()) total += static_cast<int64_t>(sizeof(TimingTrail));
   if (has_audit()) total += static_cast<int64_t>(sizeof(AuditStamp));
+  if (has_qos()) total += static_cast<int64_t>(sizeof(QosStamp));
   for (const auto& b : data)
     total += static_cast<int64_t>(sizeof(int64_t) + b.size());
   return total;
@@ -53,6 +54,10 @@ Blob Message::Serialize() const {
   if (has_audit()) {
     std::memcpy(p, &audit, sizeof(audit));
     p += sizeof(audit);
+  }
+  if (has_qos()) {
+    std::memcpy(p, &qos, sizeof(qos));
+    p += sizeof(qos);
   }
   for (const auto& b : data) {
     int64_t len = static_cast<int64_t>(b.size());
@@ -74,6 +79,8 @@ bool Message::DeserializeView(std::shared_ptr<std::vector<char>> slab,
   out->data.clear();
   out->timing = TimingTrail{};
   out->audit = AuditStamp{};
+  out->qos = QosStamp{};
+  out->qos_deadline_ns = 0;
   size_t pos = sizeof(h);
   // Optional latency trail (docs/observability.md): present iff the
   // sender set kHasTiming — an old-header frame parses exactly as
@@ -90,6 +97,13 @@ bool Message::DeserializeView(std::shared_ptr<std::vector<char>> slab,
     if (len < pos + sizeof(AuditStamp)) return false;
     std::memcpy(&out->audit, base + pos, sizeof(AuditStamp));
     pos += sizeof(AuditStamp);
+  }
+  // Optional tenant QoS/deadline stamp (docs/serving.md "tail"): same
+  // version-tolerance discipline as the trail and audit stamp.
+  if (out->has_qos()) {
+    if (len < pos + sizeof(QosStamp)) return false;
+    std::memcpy(&out->qos, base + pos, sizeof(QosStamp));
+    pos += sizeof(QosStamp);
   }
   // num_blobs comes off the wire: bound it against the frame BEFORE the
   // reserve — each blob costs at least its 8-byte length prefix, so a
@@ -139,6 +153,10 @@ Message Message::Deserialize(const Blob& buf) {
   if (m.has_audit()) {
     std::memcpy(&m.audit, p, sizeof(m.audit));
     p += sizeof(m.audit);
+  }
+  if (m.has_qos()) {
+    std::memcpy(&m.qos, p, sizeof(m.qos));
+    p += sizeof(m.qos);
   }
   m.data.reserve(static_cast<size_t>(h.num_blobs));
   for (int32_t i = 0; i < h.num_blobs; ++i) {

@@ -10,6 +10,7 @@
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/latency.h"
+#include "mvtpu/qos.h"
 #include "mvtpu/log.h"
 
 namespace mvtpu {
@@ -282,6 +283,7 @@ void MpiNet::ProbeLoop() {
       Dashboard::Record("net.bytes.recv", static_cast<double>(buf.size()));
       Message m = Message::Deserialize(buf);
       latency::StampRecv(&m);  // frame-complete on the MPI wire
+      qos::AdoptDeadline(&m);  // tail plane: deadline adopted at recv
       inbound_(std::move(m));  // outside the MPI lock
     } else
       std::this_thread::sleep_for(std::chrono::microseconds(200));

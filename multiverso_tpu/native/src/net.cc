@@ -19,6 +19,7 @@
 #include "mvtpu/dashboard.h"
 #include "mvtpu/fault.h"
 #include "mvtpu/latency.h"
+#include "mvtpu/qos.h"
 #include "mvtpu/log.h"
 
 namespace mvtpu {
@@ -166,6 +167,9 @@ bool TcpNet::SendFramed(int fd, const Message& msg) {
   if (msg.has_audit())
     iov.push_back({const_cast<AuditStamp*>(&msg.audit),
                    sizeof(AuditStamp)});
+  // QoS/deadline stamp rides after the audit stamp (same order).
+  if (msg.has_qos())
+    iov.push_back({const_cast<QosStamp*>(&msg.qos), sizeof(QosStamp)});
   for (size_t i = 0; i < msg.data.size(); ++i) {
     lens[i] = static_cast<int64_t>(msg.data[i].size());
     iov.push_back({&lens[i], sizeof(int64_t)});
@@ -449,6 +453,8 @@ void TcpNet::ReadLoop(int fd) {
     // Latency trail: frame-complete stamp (the reader thread is this
     // engine's "reactor" boundary) — requests only, stamp-if-zero.
     latency::StampRecv(&m);
+    // Tail plane: adopt the propagated deadline at the recv boundary.
+    qos::AdoptDeadline(&m);
     if (inbound_) inbound_(std::move(m));
   }
 }

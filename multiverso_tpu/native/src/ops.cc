@@ -13,6 +13,7 @@
 #include "mvtpu/log.h"
 #include "mvtpu/mutex.h"
 #include "mvtpu/profiler.h"
+#include "mvtpu/qos.h"
 #include "mvtpu/zoo.h"
 
 namespace mvtpu {
@@ -238,6 +239,10 @@ std::string LatencyJson() {
   if (!total_json.empty()) os << ",\"total\":" << total_json;
   os << ",\"offsets\":" << latency::OffsetsJson();
   os << ",\"profiler\":" << profiler::StatusJson();
+  // Tail plane (docs/serving.md "tail"): per-class admission ledger +
+  // deadline sheds + hedge cancels, so mvtop --qos and latdoctor's
+  // shed-dominance note ride the same scrape as the stage histograms.
+  os << ",\"qos\":" << qos::Json();
   os << "}";
   return os.str();
 }
@@ -297,6 +302,16 @@ void BuildReply(const Message& query, Message* reply) {
   reply->version = query.version;  // echo the scope
   reply->data.clear();
   reply->data.emplace_back(text.data(), text.size());
+}
+
+void BuildReplicaReply(const Message& query, Message* reply) {
+  reply->type = MsgType::ReplyReplica;
+  reply->table_id = query.table_id;
+  reply->msg_id = query.msg_id;
+  reply->trace_id = query.trace_id;
+  reply->data.clear();
+  auto* st = Zoo::Get()->server_table(query.table_id);
+  if (st) st->BuildReplica(reply);
 }
 
 // ---- flight recorder -------------------------------------------------

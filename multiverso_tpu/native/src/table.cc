@@ -20,6 +20,7 @@
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/latency.h"
+#include "mvtpu/qos.h"
 #include "mvtpu/log.h"
 #include "mvtpu/ops.h"
 #include "mvtpu/zoo.h"
@@ -974,6 +975,10 @@ MessagePtr MakeReq(MsgType type, int32_t table_id, int64_t msg_id,
   // Latency trail (docs/observability.md): the enqueue stamp opens the
   // client queue stage; the reply's trail closes the whole breakdown.
   latency::StampEnqueue(req.get());
+  // Tail plane (docs/serving.md "tail"): tenant class + remaining
+  // deadline budget ride the same header so the server can drop a
+  // request whose caller already gave up.
+  qos::StampRequest(req.get());
   return req;
 }
 

@@ -18,6 +18,7 @@
 #include "mvtpu/profiler.h"
 #include "mvtpu/mpi_net.h"
 #include "mvtpu/ops.h"
+#include "mvtpu/qos.h"
 #include "mvtpu/sketch.h"
 #include "mvtpu/waiter.h"
 
@@ -129,6 +130,10 @@ class ServerActor : public Actor {
                    m->table_id);
         return;
       }
+      // Tail plane (docs/serving.md "tail"): a deadline-expired or
+      // hedge-cancelled get is dropped at dequeue — nobody is waiting
+      // for the answer, so it must not burn an apply slot.
+      if (Zoo::Get()->DropServeRead(m)) return;
       // Serve backpressure: shed BEFORE any table work so an overloaded
       // server drains its backlog at ReplyBusy speed (docs/serving.md).
       if (Zoo::Get()->ShedIfOverloaded(m)) return;
@@ -173,6 +178,7 @@ class ServerActor : public Actor {
                    m->table_id);
         return;
       }
+      if (Zoo::Get()->DropServeRead(m)) return;
       if (Zoo::Get()->ShedIfOverloaded(m)) return;
       auto reply = std::make_unique<Message>();
       reply->type = MsgType::ReplyVersion;
@@ -200,6 +206,7 @@ class ServerActor : public Actor {
                    m->table_id);
         return;
       }
+      if (Zoo::Get()->DropServeRead(m)) return;
       if (Zoo::Get()->ShedIfOverloaded(m)) return;
       auto reply = std::make_unique<Message>();
       reply->type = MsgType::ReplyReplica;
@@ -480,6 +487,10 @@ bool Zoo::Start(int argc, const char* const* argv) {
   // Delivery-audit plane (docs/observability.md "audit plane"): -audit
   // latches the seq stamping + server books; MV_SetAudit toggles live.
   audit::Arm(configure::GetBool("audit"));
+  // Tail plane (docs/serving.md "tail"): latch the tenant classes,
+  // per-class admission budgets, and deadline-stamp switch.
+  qos::Configure();
+  qos::Reset();
   // Latency plane (docs/observability.md): -wire_timing latches the
   // header-trail stamping; -profile_hz boots the SIGPROF sampler.
   latency::Arm(configure::GetBool("wire_timing"));
@@ -1022,6 +1033,22 @@ void Zoo::SetRoles(const std::vector<int>& roles) {
 int Zoo::ServeQueueDepth() {
   MutexLock lk(mu_);
   return server_actor_ ? static_cast<int>(server_actor_->QueueSize()) : 0;
+}
+
+bool Zoo::DropServeRead(MessagePtr& msg) {
+  // Tail plane (docs/serving.md "tail"): reads only — the two dequeue
+  // drop reasons that mean "nobody is waiting for this answer".
+  bool cancelled = qos::Cancelled(msg->src, msg->msg_id);
+  bool expired = !cancelled && qos::ShedExpired(*msg);
+  if (!cancelled && !expired) return false;
+  Log::Debug("serve: dropping %s read from %d at dequeue (msg %lld)",
+             cancelled ? "cancelled" : "deadline-expired", msg->src,
+             static_cast<long long>(msg->msg_id));
+  // An anonymous client's dropped read settles its reactor admission
+  // slots here — no reply will ever route back to release them.
+  if (transport::IsClientRank(msg->src) && net_)
+    net_->SettleClient(msg->src);
+  return true;
 }
 
 bool Zoo::ShedIfOverloaded(MessagePtr& msg) {
@@ -1578,6 +1605,12 @@ void Zoo::RouteInbound(Message&& m) {
     // server must still answer its scrape.  (On the epoll engine the
     // reactor already answered local-scope queries before inbound_;
     // only fleet-scope queries and fan-out replies reach here.)
+    // Hedge-cancel token (docs/serving.md "tail"): consumed at the
+    // transport layer, never the mailbox — on the epoll engine the
+    // reactor already ate it; this is the blocking/MPI engines' path.
+    case MsgType::RequestCancel:
+      qos::NoteCancel(msg->src, msg->msg_id);
+      break;
     case MsgType::OpsQuery:
       HandleOpsQuery(std::move(msg));
       break;

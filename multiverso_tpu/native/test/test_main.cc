@@ -28,6 +28,7 @@
 #include "mvtpu/mpi_net.h"
 #include "mvtpu/mt_queue.h"
 #include "mvtpu/net.h"
+#include "mvtpu/qos.h"
 #include "mvtpu/sketch.h"
 #include "mvtpu/table.h"
 #include "mvtpu/updater.h"
@@ -1055,6 +1056,125 @@ static int TestReplica() {
                         nullptr) == 0);
   CHECK(hits > hits0);
   CHECK(MV_SetHotKeyReplica(0) == 0);
+  return 0;
+}
+
+static int TestQos() {
+  // ---- wire format: stamp rides only when flagged -------------------
+  mvtpu::Message plain;
+  plain.type = mvtpu::MsgType::RequestGet;
+  float payload[2] = {1.0f, 2.0f};
+  plain.data.emplace_back(payload, sizeof(payload));
+  int64_t plain_bytes = plain.WireBytes();
+  mvtpu::Message req = plain;
+  req.flags |= mvtpu::msgflag::kHasQos;
+  req.qos.klass = 1;
+  req.qos.budget_ns = 5'000'000'000ll;
+  CHECK(req.WireBytes() ==
+        plain_bytes + static_cast<int64_t>(sizeof(mvtpu::QosStamp)));
+  mvtpu::Message back = mvtpu::Message::Deserialize(req.Serialize());
+  CHECK(back.has_qos());
+  CHECK(back.qos.klass == 1 && back.qos.budget_ns == 5'000'000'000ll);
+  // Old-header frame (no flag) parses byte-identically, no stamp.
+  mvtpu::Message old_back = mvtpu::Message::Deserialize(plain.Serialize());
+  CHECK(!old_back.has_qos());
+  CHECK(old_back.data.size() == 1 && old_back.data[0].count<float>() == 2);
+  // Trail + audit + qos compose in Serialize order.
+  mvtpu::latency::Arm(true);
+  mvtpu::latency::StampEnqueue(&req);
+  req.flags |= mvtpu::msgflag::kHasAudit;
+  req.audit = {3, 4};
+  mvtpu::Blob w = req.Serialize();
+  auto slab = std::make_shared<std::vector<char>>(w.data(),
+                                                  w.data() + w.size());
+  mvtpu::Message view;
+  CHECK(mvtpu::Message::DeserializeView(slab, 0, slab->size(), &view));
+  CHECK(view.has_timing() && view.has_audit() && view.has_qos());
+  CHECK(view.qos.klass == 1 && view.qos.budget_ns == 5'000'000'000ll);
+  CHECK(view.audit.seq_lo == 3 && view.data[0].count<float>() == 2);
+  // A flagged frame too short for the stamp is malformed, not misread.
+  auto runt = std::make_shared<std::vector<char>>(
+      slab->begin(), slab->begin() + sizeof(mvtpu::WireHeader));
+  mvtpu::Message bad;
+  CHECK(!mvtpu::Message::DeserializeView(runt, 0, runt->size(), &bad));
+
+  // ---- weighted deficit admission -----------------------------------
+  mvtpu::configure::RegisterDefaults();
+  mvtpu::configure::Set("qos_classes", "gold:8,bulk:1");
+  mvtpu::configure::Set("qos_inflight_max", "9");
+  mvtpu::qos::Configure();
+  mvtpu::qos::Reset();
+  CHECK(mvtpu::qos::NumClasses() == 2);
+  CHECK(mvtpu::qos::ClassId("gold") == 0);
+  CHECK(mvtpu::qos::ClassId("bulk") == 1);
+  CHECK(mvtpu::qos::ClassId("nope") == -1);
+  CHECK(mvtpu::qos::ClassName(1) == "bulk");
+  // Guaranteed shares: gold 8 slots, bulk 1 (cap * w / sum).
+  CHECK(mvtpu::qos::TryAdmit(1));            // bulk's guaranteed slot
+  for (int i = 0; i < 8; ++i) CHECK(mvtpu::qos::TryAdmit(0));  // gold
+  CHECK(!mvtpu::qos::TryAdmit(1));           // at cap: bulk sheds
+  CHECK(!mvtpu::qos::TryAdmit(0));           // at cap: even gold sheds
+  mvtpu::qos::Release(0);
+  // One spare slot: bulk borrows only after deficit credit accrues in
+  // weight proportion (one admit per max-weight failed passes).
+  int admitted = 0;
+  for (int i = 0; i < 8; ++i) admitted += mvtpu::qos::TryAdmit(1) ? 1 : 0;
+  CHECK(admitted == 1);
+  // Gold borrows the next spare immediately (weight == quantum).
+  mvtpu::qos::Release(1);
+  CHECK(mvtpu::qos::TryAdmit(0));
+  std::string j = mvtpu::qos::Json();
+  CHECK(j.find("\"name\":\"gold\"") != std::string::npos);
+  CHECK(j.find("\"inflight_max\":9") != std::string::npos);
+
+  // ---- deadline adoption + dequeue shed -----------------------------
+  mvtpu::Message dm;
+  dm.flags |= mvtpu::msgflag::kHasQos;
+  dm.qos.budget_ns = 1;                      // expires immediately
+  mvtpu::qos::AdoptDeadline(&dm);
+  CHECK(dm.qos_deadline_ns != 0);
+  std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  CHECK(mvtpu::qos::ShedExpired(dm));
+  CHECK(mvtpu::qos::DeadlineSheds() >= 1);
+  mvtpu::Message fresh;
+  fresh.flags |= mvtpu::msgflag::kHasQos;
+  fresh.qos.budget_ns = 60'000'000'000ll;    // a minute: never expires here
+  mvtpu::qos::AdoptDeadline(&fresh);
+  CHECK(!mvtpu::qos::ShedExpired(fresh));
+  mvtpu::Message unstamped;                  // no budget: never shed
+  mvtpu::qos::AdoptDeadline(&unstamped);
+  CHECK(unstamped.qos_deadline_ns == 0);
+  CHECK(!mvtpu::qos::ShedExpired(unstamped));
+
+  // ---- request stamping follows -wire_deadline / -qos_class ---------
+  mvtpu::configure::Set("qos_class", "bulk");
+  mvtpu::configure::Set("rpc_timeout_ms", "250");
+  mvtpu::qos::Configure();
+  mvtpu::Message stamped;
+  mvtpu::qos::StampRequest(&stamped);
+  CHECK(stamped.has_qos());
+  CHECK(stamped.qos.klass == 1);             // bulk's positional id
+  CHECK(stamped.qos.budget_ns == 250'000'000ll);
+  mvtpu::configure::Set("wire_deadline", "false");
+  mvtpu::qos::Configure();
+  mvtpu::Message unflagged;
+  mvtpu::qos::StampRequest(&unflagged);
+  CHECK(!unflagged.has_qos());
+
+  // ---- hedge-cancel registry: consume-once --------------------------
+  mvtpu::qos::NoteCancel(5, 42);
+  CHECK(mvtpu::qos::Cancelled(5, 42));
+  CHECK(!mvtpu::qos::Cancelled(5, 42));      // consumed
+  CHECK(!mvtpu::qos::Cancelled(5, 43));      // never noted
+
+  // Restore defaults so later cases see a clean slate.
+  mvtpu::configure::Set("qos_classes", "bulk:1,gold:8");
+  mvtpu::configure::Set("qos_inflight_max", "0");
+  mvtpu::configure::Set("wire_deadline", "true");
+  mvtpu::configure::Set("qos_class", "bulk");
+  mvtpu::configure::Set("rpc_timeout_ms", "30000");
+  mvtpu::qos::Configure();
+  mvtpu::qos::Reset();
   return 0;
 }
 
@@ -2749,6 +2869,7 @@ int main(int argc, char** argv) {
       {"configure", TestConfigure}, {"message", TestMessage},
       {"latency", TestLatencyTrail},
       {"audit", TestAudit},
+      {"qos", TestQos},
       {"codec", TestCodec},
       {"dashboard", TestDashboard},
       {"updater", TestUpdater},   {"array", TestArray},

@@ -23,7 +23,13 @@ parts in the tradition of parameter-server client caches:
   ANONYMOUS client speaking the serve protocol (RequestVersion /
   RequestGet / ReplyBusy) straight to a server rank's epoll reactor:
   no rank, no native library — the external-read-tier entry point
-  (docs/transport.md).
+  (docs/transport.md).  Declares a tenant QoS class + deadline budget
+  per request (docs/serving.md "tail").
+- :class:`~multiverso_tpu.serve.hedge.HedgedReader` — tail-at-scale
+  hedged row reads over two anonymous connections: past a p95-derived
+  delay the read re-issues against the reactor-served hot-key replica
+  (or a second connection), first answer wins, the loser is cancelled
+  with a RequestCancel token (docs/serving.md "tail").
 
 The JAX-plane tables wear the same cache/coalescer directly (see
 ``tables/base.py``: ``-serve_cache_entries`` arms it); there the
@@ -36,7 +42,9 @@ from __future__ import annotations
 from .cache import VersionedLRUCache
 from .client import ServeClient
 from .coalescer import Coalescer
+from .hedge import HedgedReader, LatencyTracker
 from .wire import AnonServeClient, FrameDecoder, ServeBusy
 
-__all__ = ["AnonServeClient", "Coalescer", "FrameDecoder", "ServeBusy",
-           "ServeClient", "VersionedLRUCache"]
+__all__ = ["AnonServeClient", "Coalescer", "FrameDecoder", "HedgedReader",
+           "LatencyTracker", "ServeBusy", "ServeClient",
+           "VersionedLRUCache"]

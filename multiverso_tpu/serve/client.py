@@ -63,8 +63,17 @@ class ServeClient:
                  max_batch: Optional[int] = None,
                  lease_ms: Optional[float] = None,
                  row_cache: Optional[bool] = None,
-                 retry: Optional[fault.RetryPolicy] = None):
+                 retry: Optional[fault.RetryPolicy] = None,
+                 hedge=None):
         self.rt = rt
+        # Tail-at-scale hedging (docs/serving.md "tail"): an optional
+        # serve.hedge.HedgedReader the row-cache MISS path fetches
+        # through instead of the runtime — past the p95-derived delay
+        # the read re-issues against the reactor-served hot-key replica
+        # and the loser is cancelled.  Single-shard scope: the reader
+        # targets one endpoint, so arm it only when that shard owns the
+        # rows this client reads (the DLRM serve shape).
+        self.hedge = hedge
         self.max_staleness = int(_flag(max_staleness, "max_staleness"))
         entries = int(_flag(cache_entries, "serve_cache_entries"))
         self.cache = VersionedLRUCache(max(entries, 1))
@@ -260,6 +269,12 @@ class ServeClient:
 
                 def wire():
                     fault.inject("serve.busy")
+                    if self.hedge is not None:
+                        # Hedged miss (docs/serving.md "tail"): the
+                        # wire fetch races the hot-key replica past the
+                        # hedge delay; serve.hedge.{issued,won,wasted}
+                        # count the outcome.
+                        return self.hedge.get_rows(union)
                     return self.rt.matrix_get_rows(handle, union, cols)
                 fetched = self.retry.run(wire)
                 return [fetched[np.searchsorted(union, it)]

@@ -45,7 +45,8 @@ import numpy as np
 
 __all__ = ["AnonServeClient", "MSG", "pack_frame", "unpack_frame",
            "HEADER", "TIMING", "FLAG_TIMING", "AUDIT", "FLAG_AUDIT",
-           "STAGES",
+           "QOS", "FLAG_QOS", "QOS_CLASSES", "qos_id",
+           "STAGES", "default_timeout_ms",
            "stage_durations", "ntp_sample", "OffsetEstimator",
            "OPS_SCOPE_LOCAL", "OPS_SCOPE_FLEET"]
 
@@ -62,7 +63,46 @@ FLAG_TIMING = 1 << 3  # msgflag::kHasTiming
 # the delivery-audit identity (docs/observability.md "audit plane").
 AUDIT = struct.Struct("<2q")
 FLAG_AUDIT = 1 << 4  # msgflag::kHasAudit
+# QosStamp (mvtpu/message.h): tenant class (a POSITIONAL index into the
+# server's -qos_classes list) + remaining deadline budget in ns,
+# following the header (after the audit stamp when both flags are set)
+# when FLAG_QOS is set — the tail-at-scale stamp (docs/serving.md
+# "tail").  The reactor budgets inflight reads per class and drops a
+# read already past its deadline at dequeue.
+QOS = struct.Struct("<2iq")
+FLAG_QOS = 1 << 5  # msgflag::kHasQos
 _LEN = struct.Struct("<q")
+
+# The default -qos_classes list (positional ids — both sides must agree
+# on the list, the same contract as codec negotiation).
+QOS_CLASSES = ("bulk", "gold")
+
+# AnonServeClient's default connect/read timeout when the caller passes
+# none.  Mirrors the -serve_timeout_ms flag (multiverso_tpu/config.py);
+# kept as a module constant so this file stays vendorable stdlib.
+DEFAULT_TIMEOUT_MS = 30000
+
+
+def default_timeout_ms() -> float:
+    """The -serve_timeout_ms flag when multiverso_tpu.config is
+    importable, else :data:`DEFAULT_TIMEOUT_MS` — one source of truth
+    for the serve tier's deadline budget (docs/serving.md "tail")."""
+    try:  # pragma: no cover - import guard keeps the module vendorable
+        from multiverso_tpu import config
+        return float(config.get("serve_timeout_ms"))
+    except Exception:
+        return float(DEFAULT_TIMEOUT_MS)
+
+
+def qos_id(klass, classes=QOS_CLASSES) -> int:
+    """Class name (or already-an-id) -> positional wire id."""
+    if isinstance(klass, int):
+        return klass
+    try:
+        return classes.index(klass)
+    except ValueError:
+        raise ValueError(f"unknown QoS class {klass!r} "
+                         f"(declared classes: {classes})") from None
 
 # MsgType values used by the serve protocol (mvtpu/message.h).
 MSG = {
@@ -77,6 +117,12 @@ MSG = {
     # them as a local hot-row side table consulted before RequestGet.
     "RequestReplica": 11,
     "ReplyReplica": 12,
+    # Hedge-cancel token (docs/serving.md "tail"): fire-and-forget
+    # notice that the sender no longer wants (this connection, msg_id)'s
+    # answer — the LOSER of a hedged read.  Consumed at the reactor (it
+    # overtakes the mailbox FIFO); the actor drops the cancelled read at
+    # dequeue.  No reply.
+    "RequestCancel": 13,
     # Introspection plane (docs/observability.md): in-band scrape.  The
     # request's first blob names the report kind; `version` carries the
     # scope (OPS_SCOPE_LOCAL / OPS_SCOPE_FLEET).  Local-scope queries
@@ -94,7 +140,7 @@ _ACCEPT_RAW = 1  # msgflag::kAcceptRaw
 
 def pack_frame(msg_type: int, table_id: int, msg_id: int, *,
                version: int = -1, blobs=(), timing: bool = False,
-               audit=None) -> bytes:
+               audit=None, qos=None) -> bytes:
     """One wire frame.  ``src=-1`` is what makes the connection
     anonymous: the reactor sees no valid rank in the first frame and
     assigns a pseudo-rank instead.  ``timing=True`` stamps a latency
@@ -102,9 +148,14 @@ def pack_frame(msg_type: int, table_id: int, msg_id: int, *,
     server echoes and extends it, and the reply's trail attributes the
     round trip per stage (docs/observability.md "latency plane").
     ``audit=(seq_lo, seq_hi)`` stamps a delivery-audit seq range after
-    the trail (docs/observability.md "audit plane")."""
+    the trail (docs/observability.md "audit plane").
+    ``qos=(class_id, budget_ns)`` stamps the tenant class + remaining
+    deadline budget after the audit stamp (docs/serving.md "tail") —
+    the reactor budgets reads per class and drops a read already past
+    its deadline at dequeue instead of burning an apply slot."""
     flags = (_ACCEPT_RAW | (FLAG_TIMING if timing else 0)
-             | (FLAG_AUDIT if audit is not None else 0))
+             | (FLAG_AUDIT if audit is not None else 0)
+             | (FLAG_QOS if qos is not None else 0))
     body = HEADER.pack(-1, -1, msg_type, table_id, msg_id, 0, version,
                        0, flags, len(blobs), 0)
     if timing:
@@ -112,6 +163,8 @@ def pack_frame(msg_type: int, table_id: int, msg_id: int, *,
         body += TIMING.pack(now, now, 0, 0, 0, 0)
     if audit is not None:
         body += AUDIT.pack(int(audit[0]), int(audit[1]))
+    if qos is not None:
+        body += QOS.pack(int(qos[0]), 0, int(qos[1]))
     for b in blobs:
         body += _LEN.pack(len(b)) + bytes(b)
     return _LEN.pack(len(body)) + body
@@ -131,6 +184,11 @@ def unpack_frame(body: bytes) -> dict:
     if flags & FLAG_AUDIT:
         audit = AUDIT.unpack_from(body, pos)
         pos += AUDIT.size
+    qos = None
+    if flags & FLAG_QOS:
+        klass, _pad2, budget_ns = QOS.unpack_from(body, pos)
+        qos = (klass, budget_ns)
+        pos += QOS.size
     for _ in range(num_blobs):
         (blen,) = _LEN.unpack_from(body, pos)
         pos += _LEN.size
@@ -140,7 +198,7 @@ def unpack_frame(body: bytes) -> dict:
             "type_name": _TYPE_NAME.get(mtype, str(mtype)),
             "table_id": table_id, "msg_id": msg_id, "trace_id": trace_id,
             "version": version, "codec": codec, "flags": flags,
-            "timing": timing, "audit": audit, "blobs": blobs}
+            "timing": timing, "audit": audit, "qos": qos, "blobs": blobs}
 
 
 # Stage names, in trail order (docs/observability.md "latency plane").
@@ -232,10 +290,24 @@ class AnonServeClient:
     (docs/observability.md "latency plane").  A pre-trail server (or
     ``timing=False``) simply leaves both untouched: the old header
     round-trips exactly as before.
+
+    ``timeout=None`` (the new default) reads ``-serve_timeout_ms`` —
+    one source of truth for the serve deadline, because the SAME budget
+    is propagated on the wire (docs/serving.md "tail"): every request
+    carries a QoS stamp with this client's tenant class (``qos_class``,
+    a name from the default class list or a raw positional id) and its
+    remaining deadline budget, so a server drops a read whose caller
+    already gave up instead of burning an apply slot.  ``qos_class=
+    None`` stamps nothing — the pre-13 frame, byte-identical.
     """
 
-    def __init__(self, endpoint: str, timeout: Optional[float] = 30.0,
-                 timing: bool = True):
+    def __init__(self, endpoint: str, timeout: Optional[float] = None,
+                 timing: bool = True, qos_class=None,
+                 qos_classes=QOS_CLASSES):
+        # Satellite discipline (docs/serving.md "tail"): the old
+        # hard-coded 30 s default is now the -serve_timeout_ms flag.
+        if timeout is None:
+            timeout = default_timeout_ms() * 1e-3
         host, port = endpoint.rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)),
                                              timeout=timeout)
@@ -243,12 +315,24 @@ class AnonServeClient:
         self._decoder = FrameDecoder()
         self._msg_id = 0
         self.timing = timing
+        self.timeout = timeout
+        self.qos_class = (None if qos_class is None
+                          else qos_id(qos_class, qos_classes))
         self.offset = OffsetEstimator()
         self.last_stages: Optional[dict] = None
         # Optional observer fn(stages_dict) — multiverso_tpu.latency
         # wires this to the metrics registry (lat.stage.* histograms);
         # kept as a plain callable so this module stays stdlib-only.
         self.stage_hook = None
+
+    def _qos(self):
+        """Per-request QoS stamp: (class id, remaining budget ns) from
+        this client's declared class + socket timeout; None when no
+        class was declared (the pre-13 frame)."""
+        if self.qos_class is None:
+            return None
+        budget = self.timeout if self.timeout else 0.0
+        return (self.qos_class, int(budget * 1e9))
 
     # ------------------------------------------------------------- low level
     def send_raw(self, data: bytes) -> None:
@@ -286,7 +370,7 @@ class AnonServeClient:
         :class:`ServeBusy`."""
         mid = self._next_id()
         self.send_raw(pack_frame(MSG["RequestVersion"], table_id, mid,
-                                 timing=self.timing))
+                                 timing=self.timing, qos=self._qos()))
         reply = self.recv_reply()
         _check(reply, mid, "ReplyVersion")
         return reply["version"]
@@ -301,7 +385,7 @@ class AnonServeClient:
         mid = self._next_id()
         self.send_raw(pack_frame(MSG["OpsQuery"], -1, mid, version=scope,
                                  blobs=[kind.encode()],
-                                 timing=self.timing))
+                                 timing=self.timing, qos=self._qos()))
         reply = self.recv_reply()
         _check(reply, mid, "OpsReply")
         return reply["blobs"][0].decode() if reply["blobs"] else ""
@@ -319,10 +403,36 @@ class AnonServeClient:
         their own boundary."""
         mid = self._next_id()
         self.send_raw(pack_frame(MSG["RequestGet"], table_id, mid,
-                                 timing=self.timing))
+                                 timing=self.timing, qos=self._qos()))
         reply = self.recv_reply()
         _check(reply, mid, "ReplyGet")
         return np.frombuffer(reply["blobs"][0], dtype=np.float32)
+
+    def get_rows(self, table_id: int, row_ids, cols: int) -> np.ndarray:
+        """Row-subset read of a matrix table (RequestGet with an int32
+        GLOBAL-row-id blob, the same request shape rank workers send):
+        the contacted shard answers its rows in request order —
+        mis-routed/out-of-range ids read as zeros, so callers aim at
+        the shard that owns their rows.  Returns a read-only
+        ``(k, cols)`` float32 view over the reply bytes."""
+        ids = np.ascontiguousarray(row_ids, dtype=np.int32)
+        mid = self._next_id()
+        self.send_raw(pack_frame(MSG["RequestGet"], table_id, mid,
+                                 blobs=[ids.tobytes()],
+                                 timing=self.timing, qos=self._qos()))
+        reply = self.recv_reply()
+        _check(reply, mid, "ReplyGet")
+        out = np.frombuffer(reply["blobs"][0], dtype=np.float32)
+        return out.reshape(ids.size, cols) if ids.size else out
+
+    def cancel(self, table_id: int, msg_id: int) -> None:
+        """Fire-and-forget hedge-cancel token (docs/serving.md "tail"):
+        tell the server this connection no longer wants ``msg_id``'s
+        answer.  Consumed at the reactor — if the read is still parked
+        in the actor mailbox it is dropped at dequeue
+        (serve.hedge.cancelled) instead of burning an apply slot.  No
+        reply ever comes back (the caller must NOT wait for one)."""
+        self.send_raw(pack_frame(MSG["RequestCancel"], table_id, msg_id))
 
     def get_replica(self, table_id: int) -> dict:
         """Hot-key replica pull (RequestReplica, docs/embedding.md):
@@ -334,7 +444,7 @@ class AnonServeClient:
         ``-hotkey_enabled=false``."""
         mid = self._next_id()
         self.send_raw(pack_frame(MSG["RequestReplica"], table_id, mid,
-                                 timing=self.timing))
+                                 timing=self.timing, qos=self._qos()))
         reply = self.recv_reply()
         _check(reply, mid, "ReplyReplica")
         out: dict = {"_version": reply["version"]}

@@ -1,0 +1,85 @@
+"""Tail-plane fleet holder for the QoS/hedge/deadline tests (not a
+pytest module; docs/serving.md "tail").
+
+Run as ``python tail_worker.py <machine_file> <rank> [extra flags...]``:
+joins a 2-rank native epoll fleet, registers ArrayTable 0 (64 ones),
+MatrixTable 1 (32x4, row ``i`` filled with ``i + 1`` — distinct values
+so a hedged read's answer is checkable), and KVTable 2, rendezvouses,
+prints ``SERVE_READY`` — then serves stdin COMMANDS until ``done``:
+
+- ``fault <kind> <n>``       arm a deterministic fault budget
+- ``fault_rate <kind> <r>``  arm a probabilistic fault
+- ``clear``                  clear every fault
+- ``add <value>``            one acked ArrayTable add of ``value`` ones
+- ``mon <name>``             print ``MON <name>=<count>``
+
+Every command is acknowledged with an ``OK <cmd>`` line so the pytest
+side can sequence without sleeps.  On ``done`` it prints the fan-in
+counters, rendezvouses, and exits with ``SERVE_WORKER_OK <rank>``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from multiverso_tpu import native as nat  # noqa: E402
+
+SIZE = 64
+MROWS = 32
+MCOLS = 4
+
+
+def main() -> int:
+    mf, rank = sys.argv[1], int(sys.argv[2])
+    extra = sys.argv[3:]
+    rt = nat.NativeRuntime(args=[f"-machine_file={mf}", f"-rank={rank}",
+                                 "-log_level=error",
+                                 "-rpc_timeout_ms=30000",
+                                 "-barrier_timeout_ms=60000", *extra])
+    assert rt.net_engine() == "epoll", rt.net_engine()
+    h = rt.new_array_table(SIZE)
+    hm = rt.new_matrix_table(MROWS, MCOLS)
+    hk = rt.new_kv_table()
+    assert (h, hm, hk) == (0, 1, 2), (h, hm, hk)
+    rt.barrier()
+    if rank == 0:
+        rt.set_fault_seed(1234)
+        rt.array_add(h, np.ones(SIZE, np.float32))
+        rows = np.repeat(np.arange(1, MROWS + 1, dtype=np.float32),
+                         MCOLS).reshape(MROWS, MCOLS)
+        rt.matrix_add_rows(hm, list(range(MROWS)), rows)
+    rt.barrier()
+    print("SERVE_READY", flush=True)
+    for line in sys.stdin:
+        parts = line.split()
+        if not parts or parts[0] == "done":
+            break
+        cmd = parts[0]
+        if cmd == "fault":
+            rt.set_fault_n(parts[1], int(parts[2]))
+        elif cmd == "fault_rate":
+            rt.set_fault(parts[1], float(parts[2]))
+        elif cmd == "clear":
+            rt.clear_faults()
+        elif cmd == "add":
+            rt.array_add(h, float(parts[1]) * np.ones(SIZE, np.float32))
+        elif cmd == "mon":
+            print(f"MON {parts[1]}={rt.query_monitor(parts[1])}",
+                  flush=True)
+        print(f"OK {cmd}", flush=True)
+    st = rt.fanin_stats()
+    print(f"FANIN accepted={st['accepted_total']} "
+          f"active={st['active_clients']} shed={st['client_shed']}",
+          flush=True)
+    rt.barrier()
+    rt.shutdown()
+    print(f"SERVE_WORKER_OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,40 @@ def test_gate_guards_ops_keys(tmp_path):
     assert "ops_overhead_pct" in out, out
 
 
+def test_gate_guards_tail_keys(tmp_path):
+    """bench_tail acceptance bars (docs/serving.md "tail"): gold
+    residency p99 degrading into the broken-admission regime when the
+    bulk herd arrives (QoS isolation lost — e.g. the lost-wakeup
+    regression read 50x+), a zero hedge-win rate under the seeded
+    straggler (hedge path dead), zero deadline sheds (propagation
+    broken), or stamp overhead past its band must all fail the gate."""
+    line = {"extras": {"tail_qos_isolation": 60.0,     # broken-gate regime
+                       "tail_hedge_win_rate": 0.0,     # hedge never won
+                       "tail_deadline_shed": 0.0,      # nothing shed
+                       "tail_overhead_pct": 6.0}}      # way past band
+    p = tmp_path / "tail_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "tail_qos_isolation" in out and "FAIL" in out, out
+    assert "tail_hedge_win_rate" in out, out
+    assert "tail_deadline_shed" in out, out
+    assert "tail_overhead_pct" in out, out
+
+
+def test_gate_passes_in_band_tail_line(tmp_path):
+    line = {"extras": {"tail_qos_isolation": 20.0,
+                       "tail_hedge_win_rate": 0.8,
+                       "tail_deadline_shed": 20.0,
+                       "tail_gold_p999_ms": 4.0,
+                       "tail_bulk_p999_ms": 400.0,
+                       "tail_overhead_pct": 1.5}}
+    p = tmp_path / "tail_ok.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
 def test_gate_guards_latency_keys(tmp_path):
     """bench_latency acceptance bars (docs/observability.md "latency
     plane"): profiler overhead past the always-on 1% bar, a stage sum

@@ -673,6 +673,68 @@ def test_mv015_out_of_scope_and_suppressible(tmp_path):
     assert _lint_src(lib, suppressed) == []
 
 
+def test_mv016_fires_on_serve_read_without_deadline(tmp_path):
+    """A serve-protocol read minted without a qos= deadline stamp
+    bypasses deadline propagation (docs/serving.md "tail") — the
+    server cannot shed it once the caller gave up."""
+    rules = _lint_src(tmp_path, """\
+        from multiverso_tpu.serve.wire import MSG, pack_frame
+
+        def bad_probe(sock):
+            sock.sendall(pack_frame(MSG["RequestVersion"], 0, 1))  # BAD
+
+        def bad_get(sock, ids):
+            sock.sendall(pack_frame(MSG["RequestGet"], 0, 2,
+                                    blobs=[ids]))                  # BAD
+
+        def bad_replica(sock):
+            sock.sendall(pack_frame(MSG["RequestReplica"], 1, 3))  # BAD
+        """)
+    assert [r for r, _ in rules] == ["MV016"] * 3, rules
+
+
+def test_mv016_stamped_cancel_and_ops_are_legal(tmp_path):
+    rules = _lint_src(tmp_path, """\
+        from multiverso_tpu.serve.wire import MSG, pack_frame
+
+        def fine_stamped(sock):
+            sock.sendall(pack_frame(MSG["RequestGet"], 0, 1,
+                                    qos=(1, 5_000_000_000)))
+
+        def fine_cancel(sock):
+            # Not a read: the cancel token never stamps a deadline.
+            sock.sendall(pack_frame(MSG["RequestCancel"], 0, 1))
+
+        def fine_ops(sock):
+            # Scrapes are reactor-answered, not apply-slot reads.
+            sock.sendall(pack_frame(MSG["OpsQuery"], -1, 2,
+                                    blobs=[b"health"]))
+
+        def fine_client_stamp(client, mid):
+            client.send_raw(pack_frame(MSG["RequestVersion"], 0, mid,
+                                       qos=client._qos()))
+        """)
+    assert rules == [], rules
+
+
+def test_mv016_out_of_scope_and_suppressible(tmp_path):
+    src = """\
+        from multiverso_tpu.serve.wire import MSG, pack_frame
+
+        def f(sock):
+            sock.sendall(pack_frame(MSG["RequestGet"], 0, 1))
+        """
+    assert [r for r, _ in _lint_src(tmp_path, src)] == ["MV016"]
+    # Tests are out of scope: version-tolerance suites legitimately
+    # mint the unstamped pre-13 frame.
+    assert _lint_src(tmp_path, src, name="test_pre13.py") == []
+    suppressed = src.replace(
+        "sock.sendall(pack_frame(MSG[\"RequestGet\"], 0, 1))",
+        "sock.sendall(pack_frame(MSG[\"RequestGet\"], 0, 1))"
+        "  # mvlint: disable=MV016 — pre-13 frame on purpose")
+    assert _lint_src(tmp_path, suppressed) == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

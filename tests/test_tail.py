@@ -1,0 +1,438 @@
+"""Tail-at-scale serve-tier tests (docs/serving.md "tail").
+
+Covers the three tentpole layers end to end on a live 2-rank epoll
+fleet plus the pure-Python mirrors:
+
+- QoS wire stamp: pack/unpack round trip, composition with the timing
+  trail + audit stamp, and version tolerance (an unstamped frame is
+  byte-identical to the pre-13 layout);
+- per-tenant weighted admission: a bulk herd at its class budget is
+  shed with ReplyBusy at the reactor while gold reads keep flowing
+  (per-class counters prove which gate fired);
+- deadline propagation: a 1 ns-budget get is dropped (no reply, no
+  apply slot) and counted serve.deadline.shed;
+- hedged reads: under a seeded ``apply_delay`` straggler the replica
+  hedge wins at the reactor, the loser's cancel token drops it at
+  dequeue, values are exact, and the PR 12 audit plane confirms zero
+  lost or duplicated acked adds — plus the disarmed-hedge control;
+- the RLIMIT_NOFILE degrade satellite, the -serve_timeout_ms satellite,
+  and the mvtop --qos / latdoctor deadline-note surfaces.
+"""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from multiverso_tpu.serve.hedge import HedgedReader, LatencyTracker  # noqa: E402
+from multiverso_tpu.serve.wire import (AnonServeClient,  # noqa: E402
+                                       FLAG_QOS, HEADER, MSG,
+                                       pack_frame, qos_id, unpack_frame)
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+# ------------------------------------------------------------- pure mirrors
+
+def test_qos_stamp_roundtrip():
+    frame = pack_frame(MSG["RequestGet"], 3, 17, qos=(1, 250_000_000))
+    body = frame[8:]
+    reply = unpack_frame(body)
+    assert reply["flags"] & FLAG_QOS
+    assert reply["qos"] == (1, 250_000_000)
+    assert reply["table_id"] == 3 and reply["msg_id"] == 17
+
+
+def test_qos_composes_with_timing_and_audit():
+    frame = pack_frame(MSG["RequestGet"], 0, 5, timing=True,
+                       audit=(7, 12), qos=(0, 999), blobs=[b"abcd"])
+    reply = unpack_frame(frame[8:])
+    assert reply["timing"] is not None
+    assert reply["audit"] == (7, 12)
+    assert reply["qos"] == (0, 999)
+    assert reply["blobs"] == [b"abcd"]
+
+
+def test_unstamped_frame_is_pre13_byte_identical():
+    """Version tolerance: no qos kwarg -> the exact pre-13 layout."""
+    frame = pack_frame(MSG["RequestVersion"], 2, 9)
+    expected = HEADER.pack(-1, -1, MSG["RequestVersion"], 2, 9, 0, -1,
+                           0, 1, 0, 0)
+    assert frame[8:] == expected
+    assert unpack_frame(frame[8:])["qos"] is None
+
+
+def test_qos_id_mapping():
+    assert qos_id("bulk") == 0
+    assert qos_id("gold") == 1
+    assert qos_id(3) == 3
+    with pytest.raises(ValueError):
+        qos_id("platinum")
+
+
+def test_latency_tracker_hedge_delay():
+    t = LatencyTracker()
+    assert t.hedge_delay(0.002) == 0.002      # no samples: the floor
+    for ms in range(1, 101):
+        t.observe(ms * 1e-3)
+    assert 0.090 <= t.hedge_delay(0.002) <= 0.101   # ~p95
+    assert t.hedge_delay(0.5) == 0.5          # floor still wins
+
+
+def test_serve_timeout_flag_drives_default(monkeypatch):
+    """Satellite: AnonServeClient's default timeout is the
+    -serve_timeout_ms flag, not a hard-coded 30 s."""
+    from multiverso_tpu import config
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    def accept_one():
+        try:
+            srv.accept()
+        except OSError:
+            pass  # listener closed at teardown before/while accepting
+
+    t = threading.Thread(target=accept_one, daemon=True)
+    t.start()
+    old = config.get("serve_timeout_ms")
+    try:
+        config.set_flag("serve_timeout_ms", 5000)
+        c = AnonServeClient(f"127.0.0.1:{port}")
+        assert c.sock.gettimeout() == pytest.approx(5.0)
+        assert c.timeout == pytest.approx(5.0)
+        c.close()
+    finally:
+        config.set_flag("serve_timeout_ms", old)
+        srv.close()
+
+
+def test_fd_budget_degrades_with_reason(monkeypatch, capsys):
+    """Satellite: a low-ulimit host degrades the herd (10k -> what
+    fits) with a logged reason instead of dying with EMFILE."""
+    import resource
+
+    from multiverso_tpu.apps import fanin_bench_worker as fw
+
+    monkeypatch.setattr(resource, "getrlimit", lambda _r: (1024, 1024))
+
+    def deny(_r, _lim):
+        raise ValueError("hard limit exceeded")
+
+    monkeypatch.setattr(resource, "setrlimit", deny)
+    got = fw._fd_budget(10000)
+    assert got == 1024 - 256
+    out = capsys.readouterr().out
+    assert "degrading herd" in out and "10000" in out
+    # A limit that already covers the ask passes through untouched.
+    monkeypatch.setattr(resource, "getrlimit", lambda _r: (65536, 65536))
+    assert fw._fd_budget(10000) == 10000
+
+
+# ------------------------------------------------------------ fleet harness
+
+def _machine_file(tmp_path, n=2):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = tmp_path / "machines.txt"
+    mf.write_text("".join(e + "\n" for e in eps))
+    return str(mf), eps
+
+
+class TailFleet:
+    """Two epoll ranks running tests/tail_worker.py: table 0 = 64 ones,
+    table 1 = a 32x4 matrix with row i == i+1, stdin command channel."""
+
+    def __init__(self, tmp_path, extra=(), env_extra=None):
+        from multiverso_tpu import native as nat
+
+        nat.ensure_built()
+        self.mf, self.endpoints = _machine_file(tmp_path, 2)
+        worker = os.path.join(REPO, "tests", "tail_worker.py")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO
+        env.update(env_extra or {})
+        self.procs = [
+            subprocess.Popen(
+                [sys.executable, worker, self.mf, str(r), *extra],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, env=env)
+            for r in range(2)
+        ]
+        for p in self.procs:
+            line = p.stdout.readline()
+            assert "SERVE_READY" in line, line
+
+    def cmd(self, text, rank=0) -> str:
+        """Send one command to a rank; returns the lines before its OK
+        ack (e.g. the MON answer)."""
+        p = self.procs[rank]
+        p.stdin.write(text + "\n")
+        p.stdin.flush()
+        out = []
+        while True:
+            line = p.stdout.readline()
+            assert line, "worker died"
+            if line.startswith("OK "):
+                return "".join(out)
+            out.append(line)
+
+    def monitor(self, name, rank=0) -> int:
+        ans = self.cmd(f"mon {name}", rank=rank)
+        return int(ans.split("=", 1)[1])
+
+    def release(self):
+        outs = []
+        for p in self.procs:
+            try:
+                p.stdin.write("done\n")
+                p.stdin.flush()
+            except OSError:
+                pass
+        for p in self.procs:
+            outs.append(p.communicate(timeout=120)[0])
+        for r, (p, out) in enumerate(zip(self.procs, outs)):
+            assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+            assert f"SERVE_WORKER_OK {r}" in out, out[-2000:]
+        return outs
+
+    def kill(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+
+
+# --------------------------------------------------- QoS weighted admission
+
+def test_qos_admission_sheds_bulk_keeps_gold(tmp_path):
+    """The tentpole acceptance shape: with -qos_inflight_max=8 and a
+    sleeping apply (every get naps), a bulk client pushing past its
+    1-slot class budget is answered ReplyBusy AT THE REACTOR while a
+    gold client's reads are all admitted and served."""
+    fleet = TailFleet(tmp_path,
+                      extra=("-qos_classes=bulk:1,gold:8",
+                             "-qos_inflight_max=8"),
+                      env_extra={"MVTPU_FAULT_DELAY_MS": "60"})
+    try:
+        ep = fleet.endpoints[0]
+        # Every get naps 60 ms at apply: admitted reads pile up inflight
+        # so the class budgets actually bind.
+        fleet.cmd("fault_rate apply_delay 1.0")
+        bulk = AnonServeClient(ep, timeout=30.0, qos_class="bulk")
+        gold = AnonServeClient(ep, timeout=30.0, qos_class="gold")
+        # 6 concurrent bulk gets: 1 guaranteed slot + deficit borrowing
+        # (weight 1 of quantum 8) cannot cover them.
+        for i in range(6):
+            bulk.send_raw(pack_frame(MSG["RequestGet"], 0, 100 + i,
+                                     qos=bulk._qos()))
+        # 4 concurrent gold gets: inside gold's 7-slot guaranteed share.
+        for i in range(4):
+            gold.send_raw(pack_frame(MSG["RequestGet"], 0, 200 + i,
+                                     qos=gold._qos()))
+        counts = {"bulk": {}, "gold": {}}
+        for name, client, want in (("bulk", bulk, 6), ("gold", gold, 4)):
+            for _ in range(want):
+                reply = client.recv_reply()
+                counts[name][reply["type_name"]] = \
+                    counts[name].get(reply["type_name"], 0) + 1
+        fleet.cmd("clear")
+        # Gold never shed; bulk shed at the reactor.
+        assert counts["gold"] == {"ReplyGet": 4}, counts
+        assert counts["bulk"].get("ReplyBusy", 0) >= 1, counts
+        assert fleet.monitor("serve.qos.shed.bulk") >= 1
+        assert fleet.monitor("serve.qos.admit.gold") >= 4
+        assert fleet.monitor("serve.qos.shed.gold") == 0
+        bulk.close()
+        gold.close()
+        fleet.release()
+    finally:
+        fleet.kill()
+
+
+# ------------------------------------------------------ deadline propagation
+
+def test_deadline_expired_get_sheds_at_dequeue(tmp_path):
+    """A get whose propagated budget is 1 ns is dropped — no reply, no
+    apply slot — and counted serve.deadline.shed; an unstamped get on
+    the same connection (the pre-13 frame) still round-trips."""
+    fleet = TailFleet(tmp_path)
+    try:
+        ep = fleet.endpoints[0]
+        with AnonServeClient(ep, timeout=15.0) as c:
+            for i in range(5):
+                c.send_raw(pack_frame(MSG["RequestGet"], 0, 300 + i,
+                                      qos=(0, 1)))
+            # The healthy, unstamped control round-trips normally...
+            mid = c._next_id()
+            c.send_raw(pack_frame(MSG["RequestGet"], 0, mid))
+            reply = c.recv_reply()
+            assert reply["type_name"] == "ReplyGet"
+            assert reply["msg_id"] == mid  # ...and the 5 shed gets
+            # produced no replies at all (FIFO: theirs would have come
+            # first).
+            assert c._decoder.next_frame() is None
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if fleet.monitor("serve.deadline.shed") >= 5:
+                break
+            time.sleep(0.05)
+        assert fleet.monitor("serve.deadline.shed") >= 5
+        # The in-band latency scrape names them per class too.
+        with AnonServeClient(ep, timeout=15.0) as c:
+            rep = json.loads(c.ops_report("latency"))
+        assert rep["qos"]["deadline_shed"] >= 5
+        assert any(k["deadline_sheds"] >= 5
+                   for k in rep["qos"]["classes"]), rep["qos"]
+        fleet.release()
+    finally:
+        fleet.kill()
+
+
+# ------------------------------------------------------------- hedged reads
+
+HOT = [0, 1, 2, 3]
+EXPECT = np.repeat(np.arange(1.0, 5.0, dtype=np.float32), 4).reshape(4, 4)
+
+
+def _warm(reader, n=60):
+    for _ in range(n):
+        got = reader.get_rows(HOT)
+        np.testing.assert_allclose(got, EXPECT)
+
+
+def test_hedge_cancel_on_first_win_zero_dup_adds(tmp_path):
+    """The satellite chaos acceptance: a seeded apply_delay straggler
+    on the primary read is WON by the replica hedge (answered at the
+    reactor while the primary sits behind the sleeping apply), the
+    loser's cancel token drops it at dequeue, the answer is exact, and
+    the PR 12 audit plane proves zero lost or duplicated acked adds."""
+    fleet = TailFleet(tmp_path,
+                      env_extra={"MVTPU_FAULT_DELAY_MS": "400"})
+    try:
+        ep = fleet.endpoints[0]
+        fleet.cmd("add 1.0")          # acked adds bracketing the chaos
+        reader = HedgedReader(ep, 1, 4, qos_class="gold",
+                              hedge_min_us=5000, timeout=20.0)
+        _warm(reader)                 # SpaceSaving top-K now holds HOT
+        assert reader.stats()["issued"] == 0  # healthy: no hedges fired
+        # ONE get eats the 400 ms nap: a decoy occupies the server
+        # actor, so the hedged read's primary parks in the mailbox.
+        fleet.cmd("fault apply_delay 1")
+        decoy = AnonServeClient(ep, timeout=15.0)
+        decoy.send_raw(pack_frame(MSG["RequestGet"], 0, 7777))
+        time.sleep(0.05)              # decoy reaches the nap first
+        t0 = time.monotonic()
+        got = reader.get_rows(HOT)
+        hedged_s = time.monotonic() - t0
+        np.testing.assert_allclose(got, EXPECT)
+        st = reader.stats()
+        assert st["issued"] == 1 and st["won"] == 1, st
+        assert hedged_s < 0.35, hedged_s   # beat the 400 ms straggler
+        # The decoy (and nothing else) comes back on its socket.
+        assert decoy.recv_reply()["msg_id"] == 7777
+        decoy.close()
+        # The cancelled loser was dropped at dequeue, counted.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if fleet.monitor("serve.hedge.cancelled") >= 1:
+                break
+            time.sleep(0.05)
+        assert fleet.monitor("serve.hedge.cancelled") >= 1
+        assert fleet.monitor("serve.hedge.cancel_noted") >= 1
+        fleet.cmd("clear")
+        fleet.cmd("add 1.0")
+        reader.close()
+
+        # Audit plane: zero lost, zero duplicated acked adds.
+        from multiverso_tpu.ops.audit import diff_fleet
+
+        with AnonServeClient(ep, timeout=15.0) as c:
+            doc = json.loads(c.ops_report("audit", scope=1))
+        problems = [f for f in diff_fleet(doc)
+                    if f["kind"] in ("lost", "dup")]
+        assert problems == [], problems
+
+        # Disarmed-hedge control: same straggler shape, no hedge — the
+        # caller waits out the full nap and the counters stay zero.
+        control = HedgedReader(ep, 1, 4, qos_class="gold",
+                               hedge_min_us=5000, enabled=False,
+                               timeout=20.0)
+        _warm(control, n=5)
+        fleet.cmd("fault apply_delay 1")
+        t0 = time.monotonic()
+        got = control.get_rows(HOT)   # this primary IS the straggler
+        waited = time.monotonic() - t0
+        np.testing.assert_allclose(got, EXPECT)
+        st = control.stats()
+        assert st["issued"] == 0 and st["won"] == 0, st
+        assert waited >= 0.3, waited  # ate the nap: no hedge to save it
+        fleet.cmd("clear")
+        control.close()
+        fleet.release()
+    finally:
+        fleet.kill()
+
+
+# --------------------------------------------------------------- tool views
+
+def _canned_qos_report(rank="0"):
+    return {rank: {"armed": True, "stages": {}, "qos": {
+        "inflight_max": 32,
+        "classes": [
+            {"name": "bulk", "weight": 1, "budget": 3, "inflight": 2,
+             "admits": 900, "sheds": 400, "deadline_sheds": 60},
+            {"name": "gold", "weight": 8, "budget": 29, "inflight": 1,
+             "admits": 5000, "sheds": 0, "deadline_sheds": 0},
+        ],
+        "deadline_shed": 60, "cancels_noted": 9, "cancelled": 7}}}
+
+
+def test_mvtop_qos_rows_and_rate_discipline():
+    import mvtop
+
+    rows = mvtop.qos_rows(_canned_qos_report())
+    by_class = {r["class"]: r for r in rows}
+    assert by_class["bulk"]["sheds"] == 400
+    assert by_class["gold"]["admits"] == 5000
+    # Watch mode: '-' before two scrapes exist, real rates after.
+    tracker = mvtop.RateTracker()
+    rows = mvtop.qos_rows(_canned_qos_report(), tracker=tracker, now=10.0)
+    assert rows[0]["admit/s"] == "-"
+    second = _canned_qos_report()
+    second["0"]["qos"]["classes"][0]["admits"] = 1000   # +100 in 2 s
+    rows = mvtop.qos_rows(second, tracker=tracker, now=12.0)
+    bulk = [r for r in rows if r["class"] == "bulk"][0]
+    assert bulk["admit/s"] == "50.0"
+
+
+def test_latdoctor_deadline_note():
+    import latdoctor
+
+    report = _canned_qos_report()["0"]
+    note = latdoctor.deadline_note(report)
+    assert note is not None and "bulk" in note
+    healthy = _canned_qos_report()["0"]
+    for k in healthy["qos"]["classes"]:
+        k["deadline_sheds"] = 0
+    healthy["qos"]["deadline_shed"] = 0
+    assert latdoctor.deadline_note(healthy) is None

@@ -41,6 +41,35 @@ _STAGE_ORDER = ("queue", "wire_out", "mailbox", "apply", "reactor",
                 "wire_back")
 _QUANTILES = ("p50_ms", "p95_ms", "p99_ms")
 
+# A class whose deadline sheds reach this fraction of its admits has a
+# tail the stage table cannot explain: the slow requests were DROPPED,
+# never measured — the note below says so (docs/serving.md "tail").
+_DEADLINE_DOMINANCE = 0.05
+
+
+def deadline_note(report: dict):
+    """One-line warning when deadline sheds dominate a class's tail,
+    or None.  A shed request produces NO reply trail, so a class
+    shedding 5%+ of its admitted reads has a p99 that reflects only the
+    SURVIVORS — the real tail is in serve.deadline.shed, not the stage
+    histograms."""
+    q = report.get("qos") or {}
+    worst = None
+    for c in q.get("classes") or []:
+        sheds = c.get("deadline_sheds", 0) or 0
+        admits = max(1, c.get("admits", 0) or 0)
+        if sheds and sheds / admits >= _DEADLINE_DOMINANCE:
+            if worst is None or sheds > worst[1]:
+                worst = (c.get("name", "?"), sheds, admits)
+    if worst is None:
+        return None
+    name, sheds, admits = worst
+    return (f"note: deadline sheds dominate class '{name}' "
+            f"({sheds} shed vs {admits} admitted) — its p99 reflects "
+            f"only surviving reads; the dropped tail never reports a "
+            f"trail.  Raise the caller budget or shed earlier at the "
+            f"reactor.")
+
 
 def render_rank(rank: str, report: dict) -> str:
     """Human-readable per-rank breakdown (one string, many lines)."""
@@ -93,6 +122,9 @@ def render_rank(rank: str, report: dict) -> str:
                f"{'running' if prof.get('running') else 'stopped'} "
                f"hz={prof.get('hz', 0)} "
                f"samples={prof.get('samples', 0)}")
+    note = deadline_note(report)
+    if note:
+        out.append("  " + note)
     return "\n".join(out)
 
 

@@ -141,6 +141,17 @@ Rules (docs/static_analysis.md has the full rationale):
   re-raises, returns, falls back, or otherwise *handles*.  Suppress a
   deliberate drop with the standard marker and a reason.
 
+- **MV016 serve-read-without-deadline** — a serve-protocol READ minted
+  without a deadline stamp: ``pack_frame(MSG["RequestGet" |
+  "RequestVersion" | "RequestReplica"], ...)`` with no ``qos=`` kwarg
+  bypasses deadline propagation (docs/serving.md "tail") — the server
+  cannot drop the read once its caller has given up, so an abandoned
+  request still burns an apply slot at exactly the moment the tier is
+  drowning.  Stamp ``qos=(class_id, budget_ns)`` (``AnonServeClient``
+  does it for you when a class is declared); suppress only where an
+  unstamped pre-13 frame is the point (version-tolerance tests, the
+  stamp-overhead A/B baseline).  Tests are out of scope.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -862,6 +873,48 @@ def check_wall_clock_interval(tree, path):
     return out
 
 
+# ---------------------------------------------------------------- MV016
+# Serve-protocol read types whose requests must carry a deadline stamp.
+SERVE_READ_TYPES = {"RequestGet", "RequestVersion", "RequestReplica"}
+
+
+def check_serve_read_without_deadline(tree, path):
+    """MV016: a serve-path read minted without a deadline/QoS stamp —
+    the budget-stamping entry points (AnonServeClient / HedgedReader)
+    exist so the server can shed a read whose caller already gave up;
+    a bare ``pack_frame(MSG["RequestGet"], ...)`` bypasses them."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name != "pack_frame" or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Subscript)
+                and isinstance(first.value, ast.Name)
+                and first.value.id == "MSG"):
+            continue
+        sl = first.slice
+        key = (sl.value if isinstance(sl, ast.Constant)
+               else getattr(getattr(sl, "value", None), "value", None))
+        if key not in SERVE_READ_TYPES:
+            continue
+        if any(kw.arg == "qos" for kw in node.keywords):
+            continue
+        out.append(Finding(
+            path, node.lineno, "MV016",
+            f"serve read {key} minted without a deadline/QoS stamp: "
+            "pass qos=(class_id, budget_ns) so the server can drop it "
+            "once the caller's budget is blown instead of burning an "
+            "apply slot (deadline propagation, docs/serving.md "
+            "\"tail\"); suppress only where the unstamped pre-13 "
+            "frame is deliberate"))
+    return out
+
+
 # ---------------------------------------------------------------- MV015
 # Native/wire/table call evidence: a try block touching any of these is
 # on a delivery path whose failures must not vanish into `except: pass`.
@@ -1031,6 +1084,10 @@ def lint_file(path):
                 or os.path.basename(path).startswith("test_"))
     if not in_tests:
         findings += check_unbounded_retry(tree, path)
+        # MV016: serve reads must carry a deadline stamp — runtime +
+        # tools scope (version-tolerance TESTS legitimately mint the
+        # pre-13 frame without one).
+        findings += check_serve_read_without_deadline(tree, path)
         # MV012: bridge copy churn — runtime code only (tests build
         # ad-hoc arrays, and the seeded-violation suite must be able
         # to spell the violation).

@@ -26,6 +26,13 @@ kind): one row per table per rank ranked by bucket-load skew ratio,
 with the space-saving top-K hot keys, observed staleness, and NaN/Inf
 update-health sentinels.
 
+``--qos`` switches to the tail-plane tenant view (the ``"latency"``
+OpsQuery kind's ``qos`` section, docs/serving.md "tail"): one row per
+(rank, tenant class) with its weight, guaranteed budget, live inflight,
+admit/shed totals, and deadline sheds, plus the rank's hedge-cancel
+ledger; under ``--watch`` two-scrape ``admit/s``/``shed/s`` rate
+columns join under the same ``-``-before-two-scrapes discipline.
+
 Usage::
 
     python tools/mvtop.py HOST:PORT [HOST:PORT ...]       # one snapshot
@@ -65,6 +72,10 @@ _HOTKEY_COLS = ("rank", "table", "gets", "adds", "skew", "stale~",
 _AUDIT_COLS = ("rank", "table", "origin", "applied", "acked", "lag",
                "dups", "reorders", "pending", "gap")
 _AUDIT_RATE_COLS = ("dup/s",)
+
+_QOS_COLS = ("rank", "class", "weight", "budget", "inflight", "admits",
+             "sheds", "dl_shed", "cancelled")
+_QOS_RATE_COLS = ("admit/s", "shed/s")
 
 _SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
 
@@ -145,6 +156,11 @@ class RateTracker:
         # Audit view's rate column rides the same two-scrape state.
         if "dups" in counters:
             cols["dup/s"] = fmt("dups")
+        # QoS view's per-class rate columns (docs/serving.md "tail").
+        if "admits" in counters:
+            cols["admit/s"] = fmt("admits")
+        if "sheds" in counters:
+            cols["shed/s"] = fmt("sheds")
         return cols
 
 
@@ -257,6 +273,64 @@ def hotkey_rows(endpoints: list, fleet: bool, timeout: float) -> list:
     return rows
 
 
+def qos_rows(per_rank: dict, tracker: "RateTracker" = None,
+             now: float = None) -> list:
+    """One row per (rank, tenant class) from ``{rank: latency-report}``
+    (docs/serving.md "tail").  With a tracker (watch mode) two-scrape
+    admit/s + shed/s columns are derived — '-' before two scrapes
+    exist, never a fake zero."""
+    rows = []
+    for rank in sorted(per_rank, key=str):
+        rep = per_rank[rank] or {}
+        q = rep.get("qos") or {}
+        for c in q.get("classes") or []:
+            row = {
+                "rank": rank,
+                "class": c.get("name", "?"),
+                "weight": c.get("weight", "-"),
+                "budget": c.get("budget", "-"),
+                "inflight": c.get("inflight", "-"),
+                "admits": c.get("admits", 0),
+                "sheds": c.get("sheds", 0),
+                "dl_shed": c.get("deadline_sheds", 0),
+                "cancelled": q.get("cancelled", 0),
+            }
+            if tracker is not None:
+                rates = tracker.update(
+                    f"{rank}/{row['class']}",
+                    {"vmax": 0, "admits": row["admits"],
+                     "sheds": row["sheds"]}, now=now)
+
+                def fmt(key, rates=rates):
+                    return rates.get(key, "-")
+
+                row["admit/s"] = fmt("admit/s")
+                row["shed/s"] = fmt("shed/s")
+            rows.append(row)
+    return rows
+
+
+def collect_qos(endpoints: list, fleet: bool, timeout: float,
+                tracker: "RateTracker" = None) -> list:
+    """Fetch per-rank latency reports (their qos sections) and render
+    the tenant rows."""
+    per_rank = {}
+    if fleet:
+        with OpsClient(endpoints[0], timeout=timeout) as c:
+            doc = c.latency(fleet=True)
+        for rank, rep in (doc.get("ranks") or {}).items():
+            per_rank[str(rank)] = rep
+    else:
+        for ep in endpoints:
+            try:
+                with OpsClient(ep, timeout=timeout) as c:
+                    rep = c.latency()
+                per_rank[str(rep.get("rank", ep))] = rep
+            except (ConnectionError, OSError, TimeoutError):
+                per_rank[str(ep)] = None
+    return qos_rows(per_rank, tracker=tracker)
+
+
 def collect_audit(endpoints: list, fleet: bool, timeout: float,
                   tracker: "RateTracker" = None) -> list:
     """One row per (server rank, table, origin) from the fleet audit
@@ -318,6 +392,10 @@ def main(argv=None) -> int:
                     help="workload view: tables ranked by bucket-load "
                          "skew ratio, with top-K hot keys and NaN/Inf "
                          "health sentinels")
+    ap.add_argument("--qos", action="store_true",
+                    help="tail-plane tenant view: per-class admission "
+                         "budgets, admit/shed totals, deadline sheds "
+                         "and hedge cancels (docs/serving.md \"tail\")")
     ap.add_argument("--watch", type=float, default=0.0, metavar="SEC",
                     help="refresh every SEC seconds until interrupted "
                          "(adds two-scrape rate columns + sparklines)")
@@ -336,6 +414,14 @@ def main(argv=None) -> int:
             cols = _AUDIT_COLS + (_AUDIT_RATE_COLS if t else ())
             stamp = time.strftime("%H:%M:%S")
             print(f"mvtop --audit @ {stamp} — {len(rows)} stream(s)")
+            print(render(rows, cols))
+        elif args.qos:
+            t = tracker if args.watch > 0 else None
+            rows = collect_qos(args.endpoints, args.fleet, args.timeout,
+                               tracker=t)
+            cols = _QOS_COLS + (_QOS_RATE_COLS if t else ())
+            stamp = time.strftime("%H:%M:%S")
+            print(f"mvtop --qos @ {stamp} — {len(rows)} class row(s)")
             print(render(rows, cols))
         elif args.hotkeys:
             rows = hotkey_rows(args.endpoints, args.fleet, args.timeout)
