@@ -146,10 +146,21 @@ audit-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/audit_demo.py
 
+# Replication/failover smoke (docs/replication.md): a 3-server
+# replicated fleet under an anonymous read herd — SIGKILL the middle
+# rank, the backup detects the expired lease on its own (symmetric
+# watching), promotes inside the lease window, broadcasts the
+# routing-epoch flip, CRC beacons on the promoted shard match the
+# dead primary's last audited state, survivors converge EXACTLY, and
+# mvaudit --settle proves zero lost acked adds.
+failover-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/failover_demo.py
+
 # Demo umbrella: every acceptance smoke in sequence (each target builds
 # the native runtime once; later builds are no-ops).
 demos: metrics-demo serve-demo wire-demo fanin-demo ops-demo skew-demo \
-       embedding-demo bridge-demo latency-demo audit-demo
+       embedding-demo bridge-demo latency-demo audit-demo failover-demo
 
 # Continuous perf gate (docs/PERF.md): diff the newest bench JSON line
 # against the committed BENCH_BASELINE.json with per-key noise bands;
@@ -163,5 +174,5 @@ clean:
 
 .PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
         serve-demo wire-demo fanin-demo ops-demo skew-demo \
-        embedding-demo bridge-demo latency-demo audit-demo demos \
-        bench-gate clean
+        embedding-demo bridge-demo latency-demo audit-demo \
+        failover-demo demos bench-gate clean

@@ -223,7 +223,7 @@ def bench_lr(batch: int = 8192, features: int = 784, classes: int = 10):
 
 
 def _spawn_native_workers(script_name: str, procs: int, marker: str,
-                          extra_args=()):
+                          extra_args=(), exempt_ranks=()):
     """Spawn ``procs`` copies of a native-wire worker script over a fresh
     loopback machine file; returns every rank's stdout (raises naming
     the rank that failed).  The low-level half shared by the LR/w2v
@@ -267,7 +267,9 @@ def _spawn_native_workers(script_name: str, procs: int, marker: str,
         for p in children:
             if p.poll() is None:
                 p.kill()
-    for p, out in zip(children, outs):
+    for r, (p, out) in enumerate(zip(children, outs)):
+        if r in exempt_ranks:
+            continue  # a scripted victim (SIGKILLs itself mid-run)
         if p.returncode != 0 or marker not in out:
             raise RuntimeError(
                 f"{script_name} worker failed:\n{out[-2000:]}")
@@ -710,6 +712,42 @@ def bench_audit(nclients: int = 1000):
             if key == "rank":
                 continue
             name = key if key.startswith("audit_") else f"audit_{key}"
+            res[name] = float(m.group(2))
+            if key.endswith("_ms") and float(m.group(2)) >= 0:
+                _observe_iter(float(m.group(2)) * 1e-3)
+    return res
+
+
+def bench_failover():
+    """Shard replication + lease-triggered failover (docs/
+    replication.md; schema 18): a 3-rank replicated fleet
+    (``-replication_factor=1``, sync forwarding, 400 ms symmetric
+    leases) whose middle rank SIGKILLs itself under a live blocking-add
+    loop — ``failover_detect_ms`` (blackout start → lease expiry seen
+    by a survivor), ``failover_promote_ms`` (→ shard 1 routed at its
+    promoted backup), ``failover_p99_blip_ms`` (the widest gap between
+    consecutive successful adds: the caller-visible outage, bounded by
+    one rpc deadline + the lease window), ``failover_lost_acked_adds``
+    (the fleet ``"audit"`` diff with the promoted shard's book
+    answering for the dead rank — MUST be 0: sync replication makes
+    "acked" mean applied on both replicas), and ``repl_overhead_pct``
+    (anonymous read-herd QPS armed vs disarmed, interleaved arms per
+    the PR 12 discipline; reads never forward, acceptance < 3%).
+    Fleet lives in ``apps/failover_bench_worker.py``; rank 1 is the
+    victim and is exempt from the marker check."""
+    import re
+
+    outs = _spawn_native_workers("failover_bench_worker.py", 3,
+                                 "FAILOVER_BENCH_OK", (),
+                                 exempt_ranks=(1,))
+    res = {}
+    for out in outs:
+        for m in re.finditer(r"(\w+)=(-?[0-9.]+)", out):
+            key = m.group(1)
+            if key in ("rank", "promotions", "applied"):
+                continue
+            name = key if key.startswith(
+                ("failover_", "repl_")) else f"failover_{key}"
             res[name] = float(m.group(2))
             if key.endswith("_ms") and float(m.group(2)) >= 0:
                 _observe_iter(float(m.group(2)) * 1e-3)
@@ -1600,7 +1638,8 @@ def bench_lightlda_mh(num_docs: int = 2048, vocab: int = 10000,
 _SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_w2v_native8,
              bench_wire_micro, bench_ssp, bench_serve, bench_serve_fanin,
              bench_tail,
-             bench_ops, bench_latency, bench_audit, bench_skew,
+             bench_ops, bench_latency, bench_audit, bench_failover,
+             bench_skew,
              bench_embedding,
              bench_bridge,
              bench_add_get,
@@ -1629,7 +1668,7 @@ def main() -> None:
     # Schema/partial line FIRST — before any JAX-touching import — so
     # even a backend-init hang killed by `timeout` leaves one parseable
     # line on stdout.
-    results = {"bench_schema": 17}
+    results = {"bench_schema": 18}
     errors = []
     _emit(results, errors)
 

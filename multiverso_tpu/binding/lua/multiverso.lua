@@ -104,6 +104,16 @@ int MV_SetFaultN(const char* kind, long long n);
 int MV_SetFaultSeed(long long seed);
 int MV_ClearFaults(void);
 int MV_DeadPeerCount(void);
+int MV_SetReplication(int on);
+long long MV_RoutingEpoch(void);
+int MV_ShardOwner(int shard_idx);
+int MV_BackupShard(void);
+int MV_PromoteBackup(int dead_rank);
+int MV_ReplJoin(int shard_idx);
+int MV_ReplicationStats(long long* forwards, long long* acks,
+                        long long* applied, long long* outstanding,
+                        long long* promotions, long long* epoch_flips,
+                        long long* dup_skips, long long* catchups);
 int MV_SetTableCodec(int32_t handle, const char* codec);
 int MV_FlushAdds(int32_t handle);
 int MV_WireStats(long long* sent_bytes, long long* recv_bytes,
@@ -265,8 +275,50 @@ end
 
 function mv.clear_faults() check(C.MV_ClearFaults(), "MV_ClearFaults") end
 
---- Peers with expired heartbeat leases (rank 0 under -heartbeat_ms).
+--- Peers with expired heartbeat leases on THIS rank (-heartbeat_ms;
+--- lease watching is symmetric — docs/replication.md).
 function mv.dead_peer_count() return C.MV_DeadPeerCount() end
+
+--- Shard replication + failover (docs/replication.md).
+function mv.set_replication(on)
+  check(C.MV_SetReplication(on and 1 or 0), "MV_SetReplication")
+end
+
+function mv.routing_epoch() return tonumber(C.MV_RoutingEpoch()) end
+
+--- Rank currently serving shard `idx` per the routed map (-1 = bad).
+function mv.shard_owner(idx) return C.MV_ShardOwner(idx) end
+
+--- The shard index this rank backs (-1 = none).
+function mv.backup_shard() return C.MV_BackupShard() end
+
+--- Operator-driven promotion of this rank's backup shard(s) for a
+--- dead rank; returns the number of shards promoted.
+function mv.promote_backup(dead_rank)
+  return C.MV_PromoteBackup(dead_rank)
+end
+
+--- Elastic join: become shard `idx`'s backup (announce + catch-up).
+function mv.repl_join(idx) check(C.MV_ReplJoin(idx), "MV_ReplJoin") end
+
+--- Replication ledger: {forwards, acks, applied, outstanding,
+--- promotions, epoch_flips, dup_skips, catchups}.
+function mv.replication_stats()
+  local f = ffi.new("long long[1]")
+  local a = ffi.new("long long[1]")
+  local ap = ffi.new("long long[1]")
+  local o = ffi.new("long long[1]")
+  local p = ffi.new("long long[1]")
+  local e = ffi.new("long long[1]")
+  local d = ffi.new("long long[1]")
+  local c = ffi.new("long long[1]")
+  check(C.MV_ReplicationStats(f, a, ap, o, p, e, d, c),
+        "MV_ReplicationStats")
+  return {forwards = tonumber(f[0]), acks = tonumber(a[0]),
+          applied = tonumber(ap[0]), outstanding = tonumber(o[0]),
+          promotions = tonumber(p[0]), epoch_flips = tonumber(e[0]),
+          dup_skips = tonumber(d[0]), catchups = tonumber(c[0])}
+end
 
 --- Wire data plane (docs/wire_compression.md): retarget one table's
 --- payload codec — "raw" | "1bit" (sign bits + scales with worker-side

@@ -199,6 +199,29 @@ define_int("audit_grace_ms", 2000,
 define_int("audit_ring", 64,
            "delivery-audit anomaly ring capacity per server table "
            "(native-flag parity)")
+
+# --- shard replication + failover (docs/replication.md) --------------------
+define_int("replication_factor", 0,
+           "shard replication: 0 = off (a dead server rank is fatal "
+           "for its shard); 1 = every shard gets a backup rank "
+           "(chained: shard i's backup is server i+1 mod n) fed by a "
+           "primary->backup delta stream, with lease-triggered "
+           "promotion and routing-epoch re-pointing "
+           "(native-flag parity)")
+define_bool("repl_sync", True,
+            "sync replication: park the client's add ack until the "
+            "backup confirmed the forwarded apply — 'acked' means "
+            "applied on BOTH replicas, zero lost acked adds across a "
+            "failover by construction (native-flag parity)")
+define_int("repl_lag_max", 64,
+           "async replication lag bound (-repl_sync=false): stall the "
+           "apply path while this many forwards are unacked by the "
+           "backup; measured by the repl.lag histogram "
+           "(native-flag parity)")
+define_bool("promote_auto", True,
+            "lease-triggered promotion: a backup whose primary's "
+            "heartbeat lease expires promotes automatically; false = "
+            "operator-driven only (native-flag parity)")
 define_int("blackbox_keep", 4,
            "flight-recorder dump rotation: timestamped "
            "blackbox_rank<r>.<ts>.<n>.json archives retained per rank "

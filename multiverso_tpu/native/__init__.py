@@ -248,6 +248,21 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_ClearFaults.restype = ctypes.c_int
     lib.MV_DeadPeerCount.argtypes = []
     lib.MV_DeadPeerCount.restype = ctypes.c_int
+    lib.MV_SetReplication.argtypes = [ctypes.c_int]
+    lib.MV_SetReplication.restype = ctypes.c_int
+    lib.MV_RoutingEpoch.argtypes = []
+    lib.MV_RoutingEpoch.restype = ctypes.c_longlong
+    lib.MV_ShardOwner.argtypes = [ctypes.c_int]
+    lib.MV_ShardOwner.restype = ctypes.c_int
+    lib.MV_BackupShard.argtypes = []
+    lib.MV_BackupShard.restype = ctypes.c_int
+    lib.MV_PromoteBackup.argtypes = [ctypes.c_int]
+    lib.MV_PromoteBackup.restype = ctypes.c_int
+    lib.MV_ReplJoin.argtypes = [ctypes.c_int]
+    lib.MV_ReplJoin.restype = ctypes.c_int
+    lib.MV_ReplicationStats.argtypes = \
+        [ctypes.POINTER(ctypes.c_longlong)] * 8
+    lib.MV_ReplicationStats.restype = ctypes.c_int
     lib.MV_NetEngine.argtypes = []
     lib.MV_NetEngine.restype = ctypes.c_void_p
     lib.MV_FanInStats.argtypes = [ctypes.POINTER(ctypes.c_longlong)] * 3
@@ -952,8 +967,55 @@ class NativeRuntime:
         self._check(self.lib.MV_ClearFaults(), "MV_ClearFaults")
 
     def dead_peer_count(self) -> int:
-        """Peers with expired heartbeat leases (rank 0, -heartbeat_ms)."""
+        """Peers with expired heartbeat leases on THIS rank
+        (-heartbeat_ms; lease watching is symmetric — every rank
+        tracks every peer, docs/replication.md)."""
         return self.lib.MV_DeadPeerCount()
+
+    # ---------------------------------- replication (docs/replication.md)
+    def set_replication(self, on: bool = True) -> None:
+        """Live toggle for the primary->backup forward stream (the
+        armed-vs-disarmed overhead A/B); the chained backup assignment
+        is latched from ``-replication_factor`` at init."""
+        self._check(self.lib.MV_SetReplication(1 if on else 0),
+                    "MV_SetReplication")
+
+    def routing_epoch(self) -> int:
+        """Current fleet routing epoch (0 = registration-time map;
+        every promotion/join bumps and broadcasts it)."""
+        return int(self.lib.MV_RoutingEpoch())
+
+    def shard_owner(self, shard_idx: int) -> int:
+        """Rank currently serving ``shard_idx`` per the routed map."""
+        return self.lib.MV_ShardOwner(shard_idx)
+
+    def backup_shard(self) -> int:
+        """Shard index this rank backs (chained or joined), -1 none."""
+        return self.lib.MV_BackupShard()
+
+    def promote_backup(self, dead_rank: int) -> int:
+        """Operator-driven promotion of this rank's backup shard(s)
+        for ``dead_rank``; returns the number of shards promoted (the
+        lease-expiry path minus the corpse)."""
+        return self.lib.MV_PromoteBackup(dead_rank)
+
+    def repl_join(self, shard_idx: int) -> None:
+        """Elastic join: become ``shard_idx``'s backup — announce via
+        a routing-epoch flip, then pull whole-shard catch-up snapshots
+        (blocking; idempotent, chaos re-runs re-pull)."""
+        self._check(self.lib.MV_ReplJoin(shard_idx), "MV_ReplJoin")
+
+    def replication_stats(self) -> dict:
+        """Replication ledger: forwards/acks (primary), applied
+        (backup), outstanding forwards, promotions, epoch flips,
+        post-failover dup-skipped replays, catch-up installs."""
+        vals = [ctypes.c_longlong(0) for _ in range(8)]
+        self._check(
+            self.lib.MV_ReplicationStats(*[ctypes.byref(v) for v in vals]),
+            "MV_ReplicationStats")
+        keys = ("forwards", "acks", "applied", "outstanding",
+                "promotions", "epoch_flips", "dup_skips", "catchups")
+        return {k: v.value for k, v in zip(keys, vals)}
 
     # ------------------------------------------------- transport
     def net_engine(self) -> str:

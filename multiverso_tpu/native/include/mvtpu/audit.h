@@ -35,6 +35,7 @@
 #include <cstdint>
 #include <map>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "mvtpu/mutex.h"
@@ -84,6 +85,31 @@ class DeliveryBook {
   // in anomaly records / the audit_gap trigger reason.
   void NoteApply(int origin, int64_t seq_lo, int64_t seq_hi,
                  int32_t table_id);
+
+  // ---- replication / failover support (docs/replication.md) ---------
+  // True when [seq_lo, seq_hi] was already applied here (entirely
+  // below the watermark or inside a parked out-of-order range).  With
+  // replication armed the server consults this BEFORE ProcessAdd: a
+  // post-failover replay of an already-forwarded add must ack without
+  // re-applying — stamped adds become idempotent end-to-end, which is
+  // what lets workers retry through a promotion without double-counts.
+  bool Covers(int origin, int64_t seq_lo, int64_t seq_hi) const;
+  // Book a dup that was SKIPPED (not re-applied): counts the anomaly
+  // so the auditor still names it, but applied/covered stay honest.
+  void NoteDupSkipped(int origin, int64_t seq_lo, int64_t seq_hi);
+  // Current applied watermark for one origin (0 = none booked) — the
+  // value an add ack echoes as its acked bound (docs/replication.md):
+  // under the per-connection FIFO this equals the request's seq_hi,
+  // but across a failover a hole (an attempt that died with the old
+  // primary) must never be covered by a later ack — the book's
+  // watermark is the truth, the FIFO rule was only its proxy.
+  int64_t Watermark(int origin) const;
+  // Snapshot/restore the per-origin applied watermarks — rides the
+  // ShardSnapshot catch-up payload so a joining backup's book agrees
+  // with the primary's at the snapshot version (mvaudit's diff then
+  // holds across primary AND backup).
+  std::vector<std::pair<int, int64_t>> ExportWatermarks() const;
+  void ImportWatermarks(const std::vector<std::pair<int, int64_t>>& w);
 
   // Grace sweep: fire the audit_gap flight-recorder trigger for any
   // origin whose pending set outlived `-audit_grace_ms` (also run

@@ -336,9 +336,42 @@ int MV_SetFaultN(const char* kind, long long n);
 int MV_SetFaultSeed(long long seed);
 int MV_ClearFaults(void);
 
-// Heartbeat failure detection (rank 0 with `-heartbeat_ms`): number of
-// peers whose liveness lease is currently expired.  0 elsewhere.
+// Heartbeat failure detection (`-heartbeat_ms`): number of peers whose
+// liveness lease is currently expired ON THIS RANK.  Lease watching is
+// SYMMETRIC (docs/replication.md): every rank tracks every peer, so a
+// backup can self-trigger promotion even when rank 0 is the corpse.
 int MV_DeadPeerCount(void);
+
+// ---- shard replication + failover (docs/replication.md) --------------
+// Live toggle for the primary->backup forward stream (the bench's
+// armed-vs-disarmed overhead A/B); the chained backup assignment
+// itself is latched from -replication_factor at MV_Init.
+int MV_SetReplication(int on);
+// Current fleet routing epoch (0 = the registration-time shard map;
+// every promotion/join bumps and broadcasts it).
+long long MV_RoutingEpoch(void);
+// The rank currently serving shard `shard_idx` per the routed map, or
+// -1 when out of range.
+int MV_ShardOwner(int shard_idx);
+// The shard index this rank BACKS (chained or joined), -1 for none.
+int MV_BackupShard(void);
+// Promote this rank's backup shard(s) for `dead_rank` into serving —
+// the operator-driven twin of lease-triggered auto-promotion.
+// Returns the number of shards promoted.
+int MV_PromoteBackup(int dead_rank);
+// Elastic join: become shard `shard_idx`'s backup — creates backup
+// instances, announces via a routing-epoch flip, and pulls whole-shard
+// catch-up snapshots (blocking; idempotent, so chaos re-runs re-pull).
+// 0 on success, -1 not started / refused, -3 catch-up failed.
+int MV_ReplJoin(int shard_idx);
+// Replication ledger: forwards/acks (primary side), applied (backup
+// side), currently outstanding forwards, promotions + epoch flips,
+// post-failover dup-skipped replays, and catch-up snapshot installs.
+// Any output pointer may be NULL.
+int MV_ReplicationStats(long long* forwards, long long* acks,
+                        long long* applied, long long* outstanding,
+                        long long* promotions, long long* epoch_flips,
+                        long long* dup_skips, long long* catchups);
 
 // ---- transport (docs/transport.md) -----------------------------------
 // Active wire engine name: "tcp" | "epoll" | "mpi", or "local" for a

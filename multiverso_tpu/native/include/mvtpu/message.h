@@ -92,6 +92,37 @@ enum class MsgType : int32_t {
   // otherwise).
   OpsQuery = 23,
   OpsReply = 24,
+  // ---- shard replication + failover (docs/replication.md) ------------
+  // Primary→backup delta stream: after a primary shard applies a
+  // RequestAdd it re-ships the DECODED payload to its backup rank as a
+  // ReplForward.  `version` carries the ORIGIN worker rank (the backup
+  // books the same per-origin audit watermark the primary did), `shard`
+  // names the shard stream, and the AuditStamp rides along when the
+  // original add carried one.  `msg_id` is the forward's ack token:
+  // the backup answers every forward with a ReplAck echoing it, which
+  // is how the primary bounds replication lag (`-repl_lag_max`) and,
+  // in sync mode, when it releases the client's parked ReplyAdd.
+  ReplForward = 25,
+  ReplAck = 26,
+  // Whole-shard catch-up (the PR 10 replica machinery generalized from
+  // top-K rows to the full shard): a (re)joining backup asks the
+  // primary for a snapshot of one table's shard — request has no data
+  // blobs; the reply carries [serialized shard state][exported audit
+  // watermarks] with the snapshot's table version in `version`.
+  // Served by the primary's server actor, so it serializes against
+  // ProcessAdd: every delta after the snapshot reaches the backup as a
+  // ReplForward BEHIND the reply on the same connection (FIFO).
+  ShardSnapshot = 27,
+  // Versioned routing-epoch broadcast: blobs = [int32 owner ranks per
+  // shard][int32 backup ranks per shard], msg_id = the epoch.  Receivers
+  // adopt iff newer (max-merge), re-pointing Zoo::server_rank() so every
+  // in-flight retry re-routes to the promoted/new primary without a
+  // fleet restart.
+  RoutingEpoch = 28,
+  // Operator/controller-initiated promotion nudge: asks the receiving
+  // rank to promote its backup shard(s) for the rank in `version` (the
+  // same path lease expiry triggers automatically).
+  Promote = 29,
   Exit = 64,
 };
 
@@ -205,7 +236,14 @@ struct WireHeader {
   int32_t codec;      // Codec of data.back() (kRaw when data is empty)
   int32_t flags;      // msgflag:: accept bits for the reply
   int32_t num_blobs;
-  int32_t pad = 0;    // keep sizeof a multiple of 8 explicitly
+  // Shard routing hint (docs/replication.md), BIASED BY ONE so the
+  // pre-replication wire value 0 still means "no hint": requests stamp
+  // the target shard index + 1 and replies echo it.  After a failover
+  // one rank can serve TWO shards of a table, so neither the dst rank
+  // (on requests) nor the src rank (on replies) names the shard any
+  // more — the hint does.  Was the `pad` byte-alignment field; old
+  // peers ship 0 here and parse as hint -1, the pre-epoch routing.
+  int32_t shard_hint = 0;
 };
 
 struct Message {
@@ -247,6 +285,12 @@ struct Message {
   // kHasQos (docs/serving.md "tail"): read requests carry their class
   // and remaining deadline budget; replies never carry one.
   QosStamp qos;
+  // Shard routing hint (docs/replication.md): the target shard index a
+  // request addresses / the shard a reply answers for, or -1 (no hint —
+  // the pre-replication wire, where dst/src ranks named shards
+  // uniquely).  Rides the header's shard_hint slot biased by one, so
+  // old frames stay byte-identical.
+  int32_t shard = -1;
   // NOT serialized: the local-monotonic-clock deadline adopted from
   // `qos.budget_ns` at frame receipt (qos::AdoptDeadline).  0 = none.
   int64_t qos_deadline_ns = 0;

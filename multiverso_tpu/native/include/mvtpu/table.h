@@ -214,6 +214,25 @@ class ServerTable {
   // whose post-mortem needs the recent ring, not a silent poisoning).
   void NoteAddHealth(const float* delta, size_t n);
 
+ public:
+  // Replication catch-up (docs/replication.md): adopt a primary's
+  // snapshot version (max-merge, every bucket) so a freshly installed
+  // backup's reply stamps never run BEHIND versions clients already
+  // observed from the old primary.
+  void AdvanceVersionTo(int64_t v) {
+    int64_t cur = version_.load(std::memory_order_acquire);
+    while (cur < v &&
+           !version_.compare_exchange_weak(cur, v,
+                                           std::memory_order_acq_rel)) {
+    }
+    for (auto& b : bucket_versions_) {
+      int64_t bv = b.load(std::memory_order_acquire);
+      while (bv < v &&
+             !b.compare_exchange_weak(bv, v, std::memory_order_acq_rel)) {
+      }
+    }
+  }
+
  protected:
   // bucket < 0 stamps EVERY bucket (whole-table adds).
   void BumpVersion(int64_t bucket = -1) {

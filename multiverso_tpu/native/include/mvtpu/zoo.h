@@ -64,9 +64,53 @@ class Zoo {
   // role (matches the reference's worker_id/server_id semantics).
   int worker_id() const { return IndexIn(worker_ranks_, rank_); }
   int server_id() const { return IndexIn(server_ranks_, rank_); }
-  // shard index <-> global rank translation for the table layer.
-  int server_rank(int idx) const { return server_ranks_[idx]; }
+  // shard index -> global rank translation for the table layer.  With
+  // replication armed this consults the VERSIONED ROUTING TABLE
+  // (docs/replication.md): promotion/join bump the routing epoch and
+  // re-point shards, so every request minted after the flip routes to
+  // the live owner — the pre-replication behavior (server_ranks_[idx])
+  // is the epoch-0 route.
+  int server_rank(int idx) const;
+  // Inverse over the ORIGINAL (registration-time) shard assignment —
+  // the fallback attribution for replies carrying no shard hint.
   int server_index(int rank) const { return IndexIn(server_ranks_, rank); }
+
+  // ---- shard replication + failover (docs/replication.md) ------------
+  // Monotonic fleet routing epoch (0 = the registration-time route).
+  int64_t RoutingEpoch() const {
+    return routing_epoch_.load(std::memory_order_acquire);
+  }
+  std::vector<int> RouteOwners() const;
+  std::vector<int> RouteBackups() const;
+  // The shard index this rank BACKS (chained: server j backs shard
+  // j-1 mod n), or -1 when replication is off / this rank backs none.
+  int BackupShard() const;
+  // The serving table instance for an inbound data-plane message: this
+  // rank's own shard unless the message's shard hint names the shard
+  // this rank backs (hedged backup reads pre-promotion, all traffic
+  // post-promotion).
+  ServerTable* RoutedServerTable(const Message& msg);
+  ServerTable* backup_table(int32_t id);
+  // Forward an applied add to the shard's backup rank (ReplForward).
+  // Sync mode parks `*reply` (the client's prepared ReplyAdd) until
+  // the backup's ReplAck and returns true — the caller must NOT send
+  // it; async mode stalls at `-repl_lag_max` outstanding forwards.
+  bool ForwardAddToBackup(const Message& req, MessagePtr* reply);
+  void OnReplForward(MessagePtr msg);   // backup side, server actor
+  void OnReplAck(MessagePtr msg);       // primary side, transport thread
+  void OnShardSnapshot(MessagePtr msg); // both sides, server actor
+  void OnRoutingEpoch(MessagePtr msg);  // transport thread, max-merge
+  // Promote this rank's backup shard into serving for every shard
+  // `dead_rank` owns; bumps + broadcasts the routing epoch.  Returns
+  // the number of shards promoted (0 = this rank backs none of them).
+  int PromoteFor(int dead_rank);
+  // Elastic join: become shard `shard_idx`'s backup — create backup
+  // tables from the registration specs, announce (epoch flip), then
+  // pull whole-shard catch-up snapshots; deltas stream in behind the
+  // snapshot on the same connection (FIFO).  Blocking; idempotent
+  // (chaos re-runs re-pull the snapshots).
+  bool JoinAsBackup(int shard_idx);
+  std::string OpsReplicationJson();  // the "replication" OpsQuery kind
 
   // Blocks until every rank arrived; false when `-barrier_timeout_ms`
   // (default: infinite) expired or the barrier authority is unreachable.
@@ -162,6 +206,20 @@ class Zoo {
  private:
   template <typename WorkerT>
   int32_t RegisterMatrixTableImpl(int64_t rows, int64_t cols);
+
+  // Registration-time shape record: backup shards (chained at
+  // registration or created by a live JoinAsBackup) are built from the
+  // same spec with the PRIMARY's shard index, so ShardOf ranges agree.
+  struct TableSpec {
+    enum Kind { kArray, kMatrix, kSparseMatrix, kKV };
+    Kind kind;
+    int64_t rows = 0, cols = 0;
+  };
+  std::unique_ptr<ServerTable> MakeShard(const TableSpec& spec, int sid,
+                                         int nservers);
+  // Append the spec + (when replication is armed) the chained backup
+  // instance for one newly registered table.  Caller holds tables_mu_.
+  void RegisterBackupShard(const TableSpec& spec) REQUIRES(tables_mu_);
 
  public:
   int32_t RegisterKVTable();
@@ -301,12 +359,73 @@ class Zoo {
 
   // Heartbeat/lease state.  The loop thread is started by Start (when
   // enabled) and joined by the Stop latch winner before actors die.
+  // SYMMETRIC (docs/replication.md): every rank renews to every peer
+  // and every rank scans its own lease table — a backup can trigger
+  // promotion even when the corpse is rank 0 itself.
   void HeartbeatLoop();
   std::thread hb_thread_;
   std::atomic<bool> hb_running_{false};
   Mutex hb_mu_;
-  std::vector<int64_t> hb_last_seen_ GUARDED_BY(hb_mu_);  // ms, rank 0
+  std::vector<int64_t> hb_last_seen_ GUARDED_BY(hb_mu_);  // ms, all ranks
   std::vector<bool> hb_dead_ GUARDED_BY(hb_mu_);
+
+  // ---- shard replication + failover state (docs/replication.md) ------
+  // Versioned routing table: shard idx -> serving rank / backup rank.
+  // Initialized from server_ranks_ at Start (epoch 0); promotion and
+  // elastic joins mutate it under route_mu_ and broadcast the new map
+  // tagged with the bumped epoch (receivers max-merge).
+  std::atomic<int64_t> routing_epoch_{0};
+  mutable Mutex route_mu_;
+  std::vector<int> route_owner_ GUARDED_BY(route_mu_);
+  std::vector<int> route_backup_ GUARDED_BY(route_mu_);
+  int backup_shard_ GUARDED_BY(route_mu_) = -1;  // shard this rank backs
+  std::vector<bool> promoted_ GUARDED_BY(route_mu_);  // by shard idx
+  // Backup shard instances, parallel to server_tables_ (nullptr when
+  // this rank backs nothing / the table predates a join).
+  std::vector<std::unique_ptr<ServerTable>> backup_tables_
+      GUARDED_BY(tables_mu_);
+  std::vector<TableSpec> table_specs_ GUARDED_BY(tables_mu_);
+  // Sync replication: client acks parked until the backup's ReplAck
+  // (fwd msg_id -> prepared ReplyAdd), deadline-bounded so a dying
+  // backup degrades to async acking instead of wedging clients.
+  Mutex repl_mu_;
+  struct ParkedAck {
+    int64_t deadline_ms;
+    MessagePtr reply;
+  };
+  std::unordered_map<int64_t, ParkedAck> parked_acks_ GUARDED_BY(repl_mu_);
+  std::atomic<long long> repl_outstanding_{0};
+  // Catch-up rendezvous: ShardSnapshot request msg_id -> waiter.
+  std::unordered_map<int64_t, std::shared_ptr<Waiter>> snapshot_pending_
+      GUARDED_BY(repl_mu_);
+  // Collision-free epoch allocation: epochs advance in strides of
+  // kEpochStride with the bumping rank in the low bits, so two ranks
+  // reacting to the same failure concurrently (a promotion here, a
+  // backup-drop there) can never mint EQUAL epochs that then reject
+  // each other's broadcast — the ordering is total and rank-salted.
+  static constexpr int64_t kEpochStride = 1024;
+  int64_t NextEpochLocked() REQUIRES(route_mu_) {
+    int64_t e = (routing_epoch_.load(std::memory_order_relaxed) /
+                     kEpochStride +
+                 1) *
+                    kEpochStride +
+                rank_;
+    routing_epoch_.store(e, std::memory_order_release);
+    return e;
+  }
+  // Broadcast the current route map under `epoch` to every peer.
+  void BroadcastRoutingEpoch(int64_t epoch, const std::vector<int>& owners,
+                             const std::vector<int>& backups);
+  // Drop serve-layer caches on a route flip (the epoch's clock-boundary
+  // analog): snapshot under tables_mu_, invalidate outside it.
+  void InvalidateWorkerCaches();
+  // Release parked sync acks whose deadline passed (or all of them,
+  // when the backup's lease expired) — the client must not wedge on a
+  // dead backup; replication degrades, it never blocks the primary.
+  void ReleaseParkedAcks(bool all);
+  // Lease-expiry reaction: promote if the corpse owned our backed
+  // shard; stop forwarding to it if it was our backup.
+  void OnPeerDead(int rank);
 };
 
 }  // namespace mvtpu

@@ -164,6 +164,56 @@ void DeliveryBook::CheckGapsLocked(int32_t table_id, int64_t now_ms) {
   }
 }
 
+int64_t DeliveryBook::Watermark(int origin) const {
+  MutexLock lk(mu_);
+  auto it = origins_.find(origin);
+  return it == origins_.end() ? 0 : it->second.watermark;
+}
+
+bool DeliveryBook::Covers(int origin, int64_t seq_lo,
+                          int64_t seq_hi) const {
+  if (seq_lo <= 0 || seq_hi < seq_lo) return false;
+  MutexLock lk(mu_);
+  auto it = origins_.find(origin);
+  if (it == origins_.end()) return false;
+  const OriginState& st = it->second;
+  if (seq_hi <= st.watermark) return true;
+  // Parked out-of-order range fully containing [lo, hi] also counts:
+  // that delivery happened, it just arrived ahead of a hole.
+  for (const auto& [plo, phi] : st.pending)
+    if (plo <= seq_lo && seq_hi <= phi) return true;
+  return false;
+}
+
+void DeliveryBook::NoteDupSkipped(int origin, int64_t seq_lo,
+                                  int64_t seq_hi) {
+  if (!Armed()) return;
+  MutexLock lk(mu_);
+  OriginState& st = origins_[origin];
+  ++st.dups;
+  Dashboard::Record("audit.dup", 0.0);
+  RecordAnomaly(Anomaly::kDup, origin, seq_lo, seq_hi);
+}
+
+std::vector<std::pair<int, int64_t>> DeliveryBook::ExportWatermarks()
+    const {
+  MutexLock lk(mu_);
+  std::vector<std::pair<int, int64_t>> out;
+  out.reserve(origins_.size());
+  for (const auto& [origin, st] : origins_)
+    out.emplace_back(origin, st.watermark);
+  return out;
+}
+
+void DeliveryBook::ImportWatermarks(
+    const std::vector<std::pair<int, int64_t>>& w) {
+  MutexLock lk(mu_);
+  for (const auto& [origin, mark] : w) {
+    OriginState& st = origins_[origin];
+    if (mark > st.watermark) st.watermark = mark;
+  }
+}
+
 void DeliveryBook::CheckGaps(int32_t table_id) {
   if (!Armed()) return;
   MutexLock lk(mu_);

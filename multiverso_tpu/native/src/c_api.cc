@@ -13,6 +13,7 @@
 #include "mvtpu/host_arena.h"
 #include "mvtpu/latency.h"
 #include "mvtpu/profiler.h"
+#include "mvtpu/repl.h"
 #include "mvtpu/mutex.h"
 #include "mvtpu/ops.h"
 #include "mvtpu/sketch.h"
@@ -583,6 +584,52 @@ int MV_ClearFaults(void) {
 }
 
 int MV_DeadPeerCount(void) { return Zoo::Get()->DeadPeerCount(); }
+
+// ---- shard replication + failover (docs/replication.md) --------------
+
+int MV_SetReplication(int on) {
+  mvtpu::repl::Arm(on != 0);
+  return 0;
+}
+
+long long MV_RoutingEpoch(void) { return Zoo::Get()->RoutingEpoch(); }
+
+int MV_ShardOwner(int shard_idx) {
+  if (RequireStarted()) return -1;
+  if (shard_idx < 0 || shard_idx >= Zoo::Get()->num_servers()) return -1;
+  return Zoo::Get()->server_rank(shard_idx);
+}
+
+int MV_BackupShard(void) {
+  if (RequireStarted()) return -1;
+  return Zoo::Get()->BackupShard();
+}
+
+int MV_PromoteBackup(int dead_rank) {
+  if (RequireStarted()) return -1;
+  return Zoo::Get()->PromoteFor(dead_rank);
+}
+
+int MV_ReplJoin(int shard_idx) {
+  if (RequireStarted()) return -1;
+  return Zoo::Get()->JoinAsBackup(shard_idx) ? 0 : -3;
+}
+
+int MV_ReplicationStats(long long* forwards, long long* acks,
+                        long long* applied, long long* outstanding,
+                        long long* promotions, long long* epoch_flips,
+                        long long* dup_skips, long long* catchups) {
+  auto st = mvtpu::repl::GetStats();
+  if (forwards) *forwards = st.forwards;
+  if (acks) *acks = st.acks;
+  if (applied) *applied = st.applied;
+  if (outstanding) *outstanding = st.forwards - st.acks;
+  if (promotions) *promotions = st.promotions;
+  if (epoch_flips) *epoch_flips = st.epoch_flips;
+  if (dup_skips) *dup_skips = st.dup_skips;
+  if (catchups) *catchups = st.catchups;
+  return 0;
+}
 
 // ---- transport (docs/transport.md) -----------------------------------
 

@@ -331,6 +331,31 @@ void RegisterDefaults() {
                "serve the \"audit\" OpsQuery kind.  false compiles "
                "every site down to one relaxed atomic load "
                "(MV_SetAudit toggles live — the overhead A/B)");
+    DefineInt("replication_factor", 0,
+              "shard replication (docs/replication.md): 0 (default) = "
+              "off — a dead server rank is fatal for its shard; 1 = "
+              "every shard gets a backup rank (chained: shard i's "
+              "backup is server i+1 mod n) fed by a primary->backup "
+              "ReplForward delta stream, with lease-triggered "
+              "promotion and routing-epoch re-pointing on failure");
+    DefineBool("repl_sync", true,
+               "sync replication: park the client's add ack until the "
+               "backup's ReplAck, so \"acked\" means applied on BOTH "
+               "replicas — zero lost acked adds across a failover by "
+               "construction.  false = ack immediately and only bound "
+               "the forward/ack gap at -repl_lag_max (faster, a "
+               "just-acked add can die with the primary)");
+    DefineInt("repl_lag_max", 64,
+              "async replication lag bound: with -repl_sync=false, "
+              "stall the apply path while this many forwards are "
+              "unacked by the backup (measured by the repl.lag "
+              "histogram; <=0 = unbounded)");
+    DefineBool("promote_auto", true,
+               "lease-triggered promotion: when a watched peer's "
+               "heartbeat lease expires and this rank backs a shard "
+               "the corpse owned, promote it automatically (false = "
+               "operator-driven via MV_PromoteBackup / MsgType::"
+               "Promote only)");
     DefineInt("audit_grace_ms", 2000,
               "delivery-audit gap grace window: an out-of-order "
               "pending range older than this fires the audit_gap "

@@ -15,7 +15,7 @@ void Message::FillWireHeader(WireHeader* h) const {
                   static_cast<int32_t>(codec),
                   flags,
                   static_cast<int32_t>(data.size()),
-                  0};
+                  shard + 1};  // biased: wire 0 = no hint (old peers)
 }
 
 void Message::AdoptWireHeader(const WireHeader& h) {
@@ -28,6 +28,7 @@ void Message::AdoptWireHeader(const WireHeader& h) {
   version = h.version;
   codec = static_cast<Codec>(h.codec);
   flags = h.flags;
+  shard = h.shard_hint - 1;
 }
 
 int64_t Message::WireBytes() const {

@@ -287,6 +287,10 @@ std::string LocalReport(const std::string& kind) {
   // anomalies, bucket checksums.  Fleet scope free via the JSON merge;
   // tools/mvaudit.py diffs acked-vs-applied across the fleet.
   if (kind == "audit") return Zoo::Get()->OpsAuditJson();
+  // Replication plane (docs/replication.md): routing epoch + shard
+  // map, backup identity, and the forward/ack/promotion ledger.
+  // Fleet scope rides the generic JSON merge for free.
+  if (kind == "replication") return Zoo::Get()->OpsReplicationJson();
   return "{\"error\":\"unknown ops kind '" + JsonEscape(kind) + "'\"}";
 }
 

@@ -806,7 +806,10 @@ void WorkerTable::Notify(int64_t msg_id, const Message& reply) {
   // distinction between "never acked" and "lost" the auditor draws).
   if (reply.type == MsgType::ReplyAdd && reply.has_audit() &&
       audit::Armed()) {
-    int shard = Zoo::Get()->server_index(reply.src);
+    // Shard hint first (docs/replication.md): a promoted rank acks for
+    // a shard its src rank never owned at registration time.
+    int shard = reply.shard >= 0 ? reply.shard
+                                 : Zoo::Get()->server_index(reply.src);
     if (shard >= 0) ack_ledger_.Ack(shard, reply.audit.seq_hi);
   }
   // Serve layer: every reply's version stamp refreshes the free local
@@ -971,6 +974,12 @@ MessagePtr MakeReq(MsgType type, int32_t table_id, int64_t msg_id,
   // apply — worker op and server apply share one id across ranks.
   req->trace_id = Dashboard::ThreadTraceId();
   req->src = Zoo::Get()->rank();
+  // Routed through the VERSIONED shard map (docs/replication.md): a
+  // promotion or join re-points the shard, so a retry minted after the
+  // epoch flip lands on the live owner.  The shard hint rides the wire
+  // because the owning rank no longer names the shard uniquely — a
+  // promoted rank serves two — and replies echo it for reassembly.
+  req->shard = shard_idx;
   req->dst = Zoo::Get()->server_rank(shard_idx);
   // Latency trail (docs/observability.md): the enqueue stamp opens the
   // client queue stage; the reply's trail closes the whole breakdown.
@@ -992,10 +1001,19 @@ struct GatherDest {
   int64_t stride;    // floats per partitioned element (1 or cols)
 };
 
+// Reassembly key for a reply: its echoed shard hint when present (a
+// post-failover rank serves two shards, so src alone is ambiguous),
+// falling back to the registration-time src→shard map for replies
+// from pre-hint peers.
+int ReplyShard(const Message& reply) {
+  return reply.shard >= 0 ? reply.shard
+                          : Zoo::Get()->server_index(reply.src);
+}
+
 void GatherReply(void* arg, const Message& reply) {
   auto* d = static_cast<GatherDest*>(arg);
   if (reply.data.empty()) return;
-  int shard = Zoo::Get()->server_index(reply.src);
+  int shard = ReplyShard(reply);
   if (shard < 0) return;  // reply from a rank that owns no shard
   ShardRange rg = ShardOf(d->global, shard, d->servers);
   size_t off = static_cast<size_t>(rg.begin * d->stride);
@@ -1016,7 +1034,7 @@ struct RowsDest {
 void ScatterRowsReply(void* arg, const Message& reply) {
   auto* d = static_cast<RowsDest*>(arg);
   if (reply.data.empty()) return;
-  int shard = Zoo::Get()->server_index(reply.src);
+  int shard = ReplyShard(reply);
   if (shard < 0) return;
   const auto& pos = (*d->positions)[static_cast<size_t>(shard)];
   const float* src = reply.data[0].As<float>();
@@ -1661,7 +1679,7 @@ struct KVDest {
 void ScatterKVReply(void* arg, const Message& reply) {
   auto* d = static_cast<KVDest*>(arg);
   if (reply.data.empty()) return;
-  int shard = Zoo::Get()->server_index(reply.src);
+  int shard = ReplyShard(reply);
   if (shard < 0) return;
   const auto& pos = (*d->positions)[static_cast<size_t>(shard)];
   const float* src = reply.data[0].As<float>();

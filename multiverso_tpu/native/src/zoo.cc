@@ -7,6 +7,8 @@
 #include <functional>
 #include <map>
 #include <sstream>
+#include <tuple>
+#include <type_traits>
 
 #include "mvtpu/audit.h"
 #include "mvtpu/codec.h"
@@ -18,6 +20,7 @@
 #include "mvtpu/profiler.h"
 #include "mvtpu/mpi_net.h"
 #include "mvtpu/ops.h"
+#include "mvtpu/repl.h"
 #include "mvtpu/qos.h"
 #include "mvtpu/sketch.h"
 #include "mvtpu/waiter.h"
@@ -25,6 +28,15 @@
 namespace mvtpu {
 
 namespace {
+
+std::string JoinInts(const std::vector<int>& v) {
+  std::string out;
+  for (int x : v) {
+    if (!out.empty()) out += ',';
+    out += std::to_string(x);
+  }
+  return out;
+}
 
 // Adopt a wire message's trace id as this thread's span context for the
 // scope (restored on exit).  No-op when tracing is off or id == 0.
@@ -124,7 +136,11 @@ class ServerActor : public Actor {
       // the mailbox stage — taken BEFORE the shed/SSP checks so a shed
       // or park is attributed to the mailbox, not the apply.
       latency::StampDequeue(m.get());
-      auto* table = Zoo::Get()->server_table(m->table_id);
+      // Shard-hint routing (docs/replication.md): a promoted rank
+      // serves TWO shards of a table; reads whose hint names the
+      // backed shard are also served pre-promotion (the hedge's true
+      // backup target).
+      auto* table = Zoo::Get()->RoutedServerTable(*m);
       if (!table) {  // misrouted: this rank has no server role/shard
         Log::Error("RequestGet for table %d on non-server rank",
                    m->table_id);
@@ -145,6 +161,7 @@ class ServerActor : public Actor {
       reply->table_id = m->table_id;
       reply->msg_id = m->msg_id;
       reply->trace_id = m->trace_id;  // span id rides the full round trip
+      reply->shard = m->shard;  // reassembly key: src rank is ambiguous
       reply->src = Zoo::Get()->rank();
       reply->dst = m->src;
       // Adopt the requester's span id for the handler's duration so the
@@ -172,7 +189,7 @@ class ServerActor : public Actor {
       // Serve-layer probe: answer with the current table (or bucket)
       // version — a header-only reply, no payload, no table lock.
       latency::StampDequeue(m.get());
-      auto* table = Zoo::Get()->server_table(m->table_id);
+      auto* table = Zoo::Get()->RoutedServerTable(*m);
       if (!table) {
         Log::Error("RequestVersion for table %d on non-server rank",
                    m->table_id);
@@ -185,6 +202,7 @@ class ServerActor : public Actor {
       reply->table_id = m->table_id;
       reply->msg_id = m->msg_id;
       reply->trace_id = m->trace_id;
+      reply->shard = m->shard;
       reply->src = Zoo::Get()->rank();
       reply->dst = m->src;
       reply->version = m->version >= 0
@@ -200,7 +218,7 @@ class ServerActor : public Actor {
       // read, so it sheds under backpressure exactly like a Get —
       // never competes with adds.
       latency::StampDequeue(m.get());
-      auto* table = Zoo::Get()->server_table(m->table_id);
+      auto* table = Zoo::Get()->RoutedServerTable(*m);
       if (!table) {
         Log::Error("RequestReplica for table %d on non-server rank",
                    m->table_id);
@@ -213,6 +231,7 @@ class ServerActor : public Actor {
       reply->table_id = m->table_id;
       reply->msg_id = m->msg_id;
       reply->trace_id = m->trace_id;
+      reply->shard = m->shard;
       reply->src = Zoo::Get()->rank();
       reply->dst = m->src;
       TraceScope scope(m->trace_id);
@@ -225,7 +244,7 @@ class ServerActor : public Actor {
     });
     RegisterHandler(MsgType::RequestAdd, [](MessagePtr& m) {
       latency::StampDequeue(m.get());
-      auto* table = Zoo::Get()->server_table(m->table_id);
+      auto* table = Zoo::Get()->RoutedServerTable(*m);
       if (!table) {
         Log::Error("RequestAdd for table %d on non-server rank",
                    m->table_id);
@@ -257,16 +276,35 @@ class ServerActor : public Actor {
           return;
         }
       }
-      table->ProcessAdd(*m);
-      // Delivery audit: book the applied seq range AFTER the apply so
-      // the watermark never runs ahead of table state.
-      table->NoteAuditApply(*m);
+      // Replication makes stamped adds IDEMPOTENT (docs/replication.md):
+      // a post-failover retry of a seq the promoted shard already
+      // received as a ReplForward must ack without re-applying — the
+      // retried delta would otherwise double-count.  Only with
+      // replication armed: the base contract keeps dup deliveries
+      // visible as dup-applies (docs/observability.md "audit plane").
+      bool dup_skip =
+          repl::Armed() && audit::Armed() && m->has_audit() &&
+          table->audit_book().Covers(m->src, m->audit.seq_lo,
+                                     m->audit.seq_hi);
+      if (dup_skip) {
+        table->audit_book().NoteDupSkipped(m->src, m->audit.seq_lo,
+                                           m->audit.seq_hi);
+        repl::NoteDupSkip();
+        Dashboard::Record("repl.dup_skip", 0.0);
+      } else {
+        table->ProcessAdd(*m);
+        // Delivery audit: book the applied seq range AFTER the apply so
+        // the watermark never runs ahead of table state.
+        table->NoteAuditApply(*m);
+      }
+      MessagePtr reply;
       if (m->msg_id >= 0) {  // blocking add wants an ack
-        auto reply = std::make_unique<Message>();
+        reply = std::make_unique<Message>();
         reply->type = MsgType::ReplyAdd;
         reply->table_id = m->table_id;
         reply->msg_id = m->msg_id;
         reply->trace_id = m->trace_id;
+        reply->shard = m->shard;
         reply->src = Zoo::Get()->rank();
         reply->dst = m->src;
         // The ack carries the post-apply version: a write-through
@@ -274,13 +312,35 @@ class ServerActor : public Actor {
         reply->version = table->version();
         // Echo the audit stamp so the origin's acked-add ledger can
         // advance its watermark (docs/observability.md "audit plane").
+        // The acked BOUND is the book's per-origin watermark, not the
+        // request's seq_hi: under per-connection FIFO they are equal,
+        // but across a failover a hole — an attempt that died with
+        // the old primary — must never be covered by a later ack, or
+        // the auditor would read a real (benign) gap as a LOST ACKED
+        // ADD (docs/replication.md).
         if (m->has_audit()) {
           reply->flags |= msgflag::kHasAudit;
           reply->audit = m->audit;
+          if (audit::Armed()) {
+            int64_t wm = table->audit_book().Watermark(m->src);
+            reply->audit.seq_hi = wm;
+          }
         }
         latency::StampReply(*m, reply.get());
-        Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
       }
+      // Primary→backup delta stream (docs/replication.md): re-ship the
+      // decoded add; sync mode parks the ack until the backup's
+      // ReplAck, making "acked" mean "applied on both replicas".  An
+      // already-applied dup is not re-forwarded (the backup saw it).
+      if (!dup_skip && Zoo::Get()->ForwardAddToBackup(*m, &reply))
+        return;  // ack parked; OnReplAck releases it
+      if (reply) Zoo::Get()->Deliver(actor::kWorker, std::move(reply));
+    });
+    RegisterHandler(MsgType::ReplForward, [](MessagePtr& m) {
+      Zoo::Get()->OnReplForward(std::move(m));
+    });
+    RegisterHandler(MsgType::ShardSnapshot, [](MessagePtr& m) {
+      Zoo::Get()->OnShardSnapshot(std::move(m));
     });
     RegisterHandler(MsgType::RequestFlush, [](MessagePtr& m) {
       // Reaching here means every earlier message on the requester's
@@ -309,16 +369,18 @@ class ControllerActor : public Actor {
       Zoo::Get()->OnBarrierRelease(m->msg_id);
     });
     RegisterHandler(MsgType::Heartbeat, [](MessagePtr& m) {
-      // Rank 0 never announces, so src==0 means this is rank 0's ECHO
-      // of our own timed heartbeat — an NTP sample for the rank-0
+      // Symmetric leases (docs/replication.md): every rank renews to
+      // every peer, so src==0 is now ambiguous — rank 0's own renewal
+      // ships WITHOUT a trail; a trail-carrying src==0 frame is rank
+      // 0's ECHO of our timed heartbeat, an NTP sample for the rank-0
       // clock offset (docs/observability.md), nothing lease-related.
-      if (m->src == 0) {
+      if (m->src == 0 && m->has_timing() && Zoo::Get()->rank() != 0) {
         latency::OnReply(*m, 0);
         return;
       }
       latency::StampDequeue(m.get());
       Zoo::Get()->OnHeartbeat(m->src);
-      if (m->has_timing()) {
+      if (m->has_timing() && Zoo::Get()->rank() == 0) {
         // Echo the trail back so the announcing rank can close the
         // NTP round trip over the heartbeat RTT (PR 2's lease wire).
         auto echo = std::make_unique<Message>();
@@ -328,6 +390,11 @@ class ControllerActor : public Actor {
         latency::StampReply(*m, echo.get());
         Zoo::Get()->Deliver(actor::kController, std::move(echo));
       }
+    });
+    RegisterHandler(MsgType::Promote, [](MessagePtr& m) {
+      // Operator/controller promotion nudge (docs/replication.md):
+      // the same path lease expiry triggers automatically.
+      Zoo::Get()->PromoteFor(static_cast<int>(m->version));
     });
   }
 };
@@ -487,6 +554,30 @@ bool Zoo::Start(int argc, const char* const* argv) {
   // Delivery-audit plane (docs/observability.md "audit plane"): -audit
   // latches the seq stamping + server books; MV_SetAudit toggles live.
   audit::Arm(configure::GetBool("audit"));
+  // Shard replication (docs/replication.md): -replication_factor arms
+  // the primary→backup forward stream (factor 1, chained assignment);
+  // meaningful only with >1 server rank.  The routing table starts at
+  // epoch 0 = the registration-time shard map.
+  repl::Arm(configure::GetInt("replication_factor") > 0 &&
+            num_servers() > 1);
+  repl::ArmSync(configure::GetBool("repl_sync"));
+  {
+    MutexLock rlk(route_mu_);
+    routing_epoch_.store(0, std::memory_order_release);
+    route_owner_ = server_ranks_;
+    route_backup_.assign(server_ranks_.size(), -1);
+    promoted_.assign(server_ranks_.size(), false);
+    backup_shard_ = -1;
+    int n = static_cast<int>(server_ranks_.size());
+    if (repl::Armed() && n > 1) {
+      // Chained assignment: shard i's backup is server i+1 mod n, so
+      // server j backs shard j-1 mod n.
+      for (int i = 0; i < n; ++i)
+        route_backup_[i] = server_ranks_[(i + 1) % n];
+      int sid = server_id();
+      if (sid >= 0) backup_shard_ = (sid - 1 + n) % n;
+    }
+  }
   // Tail plane (docs/serving.md "tail"): latch the tenant classes,
   // per-class admission budgets, and deadline-stamp switch.
   qos::Configure();
@@ -573,7 +664,23 @@ void Zoo::Stop() {
     MutexLock tlk(tables_mu_);
     server_tables_.clear();
     worker_tables_.clear();
+    backup_tables_.clear();
+    table_specs_.clear();
   }
+  {
+    MutexLock rlk(route_mu_);
+    route_owner_.clear();
+    route_backup_.clear();
+    promoted_.clear();
+    backup_shard_ = -1;
+    routing_epoch_.store(0, std::memory_order_release);
+  }
+  {
+    MutexLock plk(repl_mu_);
+    parked_acks_.clear();
+    snapshot_pending_.clear();
+  }
+  repl_outstanding_.store(0);
   rank_ = 0;
   size_ = 1;
   worker_ranks_ = {0};
@@ -612,9 +719,16 @@ bool Zoo::FlushPipelines() {
   // the invariant Barrier's BSP guarantee stands on.
   FlushWorkerAdds();
   if (!net_) return true;
+  // Targets follow the ROUTED shard map (docs/replication.md): after a
+  // promotion the dead rank owns nothing, so the flush drains the live
+  // owners instead of latching barrier_failed_ on a corpse forever.
   std::vector<int> targets;
-  for (int s : server_ranks_)
-    if (s != rank_) targets.push_back(s);
+  for (int s = 0; s < num_servers(); ++s) {
+    int r = server_rank(s);
+    if (r != rank_ &&
+        std::find(targets.begin(), targets.end(), r) == targets.end())
+      targets.push_back(r);
+  }
   if (targets.empty()) return true;
   int64_t id = NextMsgId();
   auto waiter = std::make_shared<Waiter>(static_cast<int>(targets.size()));
@@ -746,8 +860,25 @@ void Zoo::OnBarrierArrive(int src_rank, int64_t round) {
     // round must not double-count toward the quorum.
     if (barrier_arrived_[src_rank]) return;
     barrier_arrived_[src_rank] = true;
-    for (bool a : barrier_arrived_)
-      if (!a) return;
+    // Elastic membership (docs/replication.md): with replication armed
+    // a peer whose heartbeat lease is expired is EXCUSED from the
+    // quorum — the fleet rendezvouses without the corpse instead of
+    // timing out, which is what lets survivors keep running (and shut
+    // down cleanly) after a failover.  Without replication the old
+    // strict quorum stands: a silent rank is an error, not a member
+    // change.
+    for (int r = 0; r < size_; ++r) {
+      if (barrier_arrived_[r]) continue;
+      if (repl::Armed()) {
+        MutexLock hlk(hb_mu_);
+        if (r < static_cast<int>(hb_dead_.size()) && hb_dead_[r]) {
+          Log::Info("Zoo::Barrier: excusing dead-leased rank %d from "
+                    "the quorum", r);
+          continue;
+        }
+      }
+      return;
+    }
     barrier_arrived_.assign(size_, false);
     for (int r = 0; r < size_; ++r)
       release.emplace_back(r, barrier_rounds_[r]);
@@ -789,36 +920,59 @@ void Zoo::HeartbeatLoop() {
   const int64_t interval = configure::GetInt("heartbeat_ms");
   int64_t timeout = configure::GetInt("heartbeat_timeout_ms");
   if (timeout <= 0) timeout = 5 * interval;
+  // SYMMETRIC lease renewal (docs/replication.md): every rank —
+  // rank 0 included — announces to EVERY peer, so every survivor can
+  // detect any corpse, rank 0 itself included (the old rank-0-only
+  // watch left a backup blind exactly when the lease authority was
+  // the one that died).  ONE SENDER THREAD PER PEER: a send to a dead
+  // peer blocks in the transport's reconnect/backoff for whole lease
+  // windows, and a single shared sender stalling there would starve
+  // the renewals every LIVE peer's lease depends on — the mutual
+  // false-dead cascade the failover chaos scenario caught.  The
+  // rank→0 renewal keeps its timing trail: rank 0's echo closes an
+  // NTP offset sample (docs/observability.md); renewals to other
+  // peers ship bare.  A failed send is already logged by the
+  // transport; the lease simply expires on the peer's side.
+  std::vector<std::thread> senders;
+  for (int peer = 0; peer < size_; ++peer) {
+    if (peer == rank_) continue;
+    senders.emplace_back([this, peer, interval] {
+      while (hb_running_) {
+        for (int64_t slept = 0; slept < interval && hb_running_;
+             slept += 20)
+          std::this_thread::sleep_for(std::chrono::milliseconds(
+              std::min<int64_t>(20, interval - slept)));
+        if (!hb_running_) break;
+        Message hb;
+        hb.type = MsgType::Heartbeat;
+        hb.src = rank_;
+        hb.dst = peer;
+        if (peer == 0) {
+          latency::StampEnqueue(&hb);
+          latency::StampSend(&hb);
+        }
+        if (net_) net_->Send(peer, hb);
+      }
+    });
+  }
   while (hb_running_) {
     // Sleep in small steps so Stop never waits a full interval.
     for (int64_t slept = 0; slept < interval && hb_running_; slept += 20)
       std::this_thread::sleep_for(std::chrono::milliseconds(
           std::min<int64_t>(20, interval - slept)));
     if (!hb_running_) break;
-    if (rank_ != 0) {
-      // Lease renewal.  A failed send is already logged by the
-      // transport; the lease simply expires on rank 0's side.
-      Message hb;
-      hb.type = MsgType::Heartbeat;
-      hb.src = rank_;
-      hb.dst = 0;
-      // Timed lease renewal: the echo closes an NTP offset sample for
-      // rank 0 (docs/observability.md), so every heartbeat interval
-      // refreshes the cross-rank clock estimate for free.
-      latency::StampEnqueue(&hb);
-      latency::StampSend(&hb);
-      if (net_) net_->Send(0, hb);
-      continue;
-    }
-    // Rank 0: scan the leases.  A peer transitions to dead ONCE per
-    // outage (hb.missed counts outages, not scans) and recovers when a
-    // late heartbeat arrives — report-only, the reference's missing
-    // failure detector; eviction/replacement stays the operator's call.
+    // Scan the leases (every rank, not just rank 0).  A peer
+    // transitions to dead ONCE per outage (hb.missed counts outages,
+    // not scans) and recovers when a late heartbeat arrives.  With
+    // replication armed the expiry is no longer report-only: the
+    // backup promotes (docs/replication.md); otherwise eviction/
+    // replacement stays the operator's call.
     int64_t now = NowMs();
     std::vector<int> newly_dead;
     {
       MutexLock lk(hb_mu_);
-      for (int r = 1; r < size_; ++r) {
+      for (int r = 0; r < size_; ++r) {
+        if (r == rank_) continue;
         bool silent = now - hb_last_seen_[r] > timeout;
         if (silent && !hb_dead_[r]) {
           hb_dead_[r] = true;
@@ -832,10 +986,16 @@ void Zoo::HeartbeatLoop() {
     }
     // Blackbox dump OUTSIDE hb_mu_ (it reads zoo state): a dead peer is
     // a first-class failure trigger (docs/observability.md).
-    for (int r : newly_dead)
+    for (int r : newly_dead) {
       ops::BlackboxTrigger("dead_peer: rank " + std::to_string(r) +
                            " silent past the heartbeat lease");
+      OnPeerDead(r);
+    }
+    // Sync-replication hygiene: a parked ack whose backup never
+    // answered must not wedge the client past its deadline.
+    ReleaseParkedAcks(/*all=*/false);
   }
+  for (auto& t : senders) t.join();
 }
 
 void Zoo::OnHeartbeat(int src_rank) {
@@ -862,6 +1022,649 @@ std::vector<int> Zoo::DeadPeers() {
   for (size_t r = 0; r < hb_dead_.size(); ++r)
     if (hb_dead_[r]) out.push_back(static_cast<int>(r));
   return out;
+}
+
+// ---- shard replication + failover (docs/replication.md) ---------------
+
+int Zoo::server_rank(int idx) const {
+  MutexLock lk(route_mu_);
+  if (idx >= 0 && idx < static_cast<int>(route_owner_.size()))
+    return route_owner_[idx];
+  return (idx >= 0 && idx < static_cast<int>(server_ranks_.size()))
+             ? server_ranks_[idx]
+             : 0;
+}
+
+std::vector<int> Zoo::RouteOwners() const {
+  MutexLock lk(route_mu_);
+  return route_owner_;
+}
+
+std::vector<int> Zoo::RouteBackups() const {
+  MutexLock lk(route_mu_);
+  return route_backup_;
+}
+
+int Zoo::BackupShard() const {
+  MutexLock lk(route_mu_);
+  return backup_shard_;
+}
+
+ServerTable* Zoo::backup_table(int32_t id) {
+  MutexLock lk(tables_mu_);
+  return (id >= 0 && id < static_cast<int32_t>(backup_tables_.size()))
+             ? backup_tables_[id].get()
+             : nullptr;
+}
+
+ServerTable* Zoo::RoutedServerTable(const Message& msg) {
+  // LOCK ORDER: route_mu_ is released before the table registry lookup
+  // (never nest tables_mu_ under it).
+  int hint = msg.shard;
+  if (hint >= 0 && hint != server_id()) {
+    bool backed;
+    {
+      MutexLock lk(route_mu_);
+      backed = backup_shard_ == hint ||
+               (hint < static_cast<int>(promoted_.size()) &&
+                promoted_[hint]);
+    }
+    if (backed) {
+      ServerTable* bt = backup_table(msg.table_id);
+      if (bt) return bt;
+    }
+  }
+  return server_table(msg.table_id);
+}
+
+bool Zoo::ForwardAddToBackup(const Message& m, MessagePtr* reply) {
+  if (!repl::Armed()) return false;
+  int shard = m.shard >= 0 ? m.shard : server_id();
+  int backup = -1;
+  {
+    MutexLock lk(route_mu_);
+    if (shard < 0 || shard >= static_cast<int>(route_backup_.size()))
+      return false;
+    if (route_owner_[shard] != rank_) return false;  // not the primary
+    backup = route_backup_[shard];
+  }
+  if (backup < 0 || backup == rank_ || !net_) return false;
+  // Lease check (defense in depth): a stale adopted map may still name
+  // a dead backup — forwarding there would park the apply thread in
+  // the transport's reconnect backoff for whole lease windows.
+  {
+    MutexLock lk(hb_mu_);
+    if (backup < static_cast<int>(hb_dead_.size()) && hb_dead_[backup])
+      return false;
+  }
+  // Bounded-lag backpressure (async mode): the apply thread stalls
+  // while the forward/ack gap exceeds -repl_lag_max, deadline-bounded
+  // so a dying backup degrades instead of wedging the shard.  Sync
+  // mode needs no gap bound — every client add parks on its own ack.
+  int64_t lag_max = configure::GetInt("repl_lag_max");
+  if (!repl::Sync() && lag_max > 0 &&
+      repl_outstanding_.load() >= lag_max) {
+    repl::NoteLagWait();
+    Dashboard::Record("repl.lag_wait", 0.0);
+    int64_t deadline = NowMs() + 2000;
+    while (repl_outstanding_.load() >= lag_max && NowMs() < deadline)
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  int64_t fwd_id = NextMsgId();
+  Message fwd;
+  fwd.type = MsgType::ReplForward;
+  fwd.table_id = m.table_id;
+  fwd.msg_id = fwd_id;
+  fwd.trace_id = m.trace_id;
+  fwd.shard = shard;
+  fwd.version = m.src;  // ORIGIN rank: the backup books its watermark
+  fwd.src = rank_;
+  fwd.dst = backup;
+  if (m.has_audit()) {
+    fwd.flags |= msgflag::kHasAudit;
+    fwd.audit = m.audit;
+  }
+  fwd.data = m.data;  // decoded payload; shallow blob copies share bytes
+  bool parked = false;
+  if (reply && *reply && repl::Sync()) {
+    // Park BEFORE the send so a lightning-fast ReplAck can never race
+    // an unparked reply; a failed send takes it right back out.
+    int64_t t = configure::GetInt("rpc_timeout_ms");
+    MutexLock lk(repl_mu_);
+    parked_acks_[fwd_id] =
+        ParkedAck{NowMs() + (t > 0 ? t / 2 : 2000), std::move(*reply)};
+    parked = true;
+    repl::NoteParked();
+  }
+  repl_outstanding_.fetch_add(1);
+  repl::NoteForward();
+  // Replication-lag ledger on the µs-bucket ladder (1 unit = 1
+  // outstanding forward) — the bounded-lag gauge the staleness
+  // histogram discipline measures (docs/observability.md).
+  Dashboard::Record("repl.lag",
+                    static_cast<double>(repl_outstanding_.load()) * 1e-6);
+  Dashboard::Record("repl.forward", 0.0);
+  if (!net_->Send(backup, fwd)) {
+    repl_outstanding_.fetch_add(-1);
+    if (parked) {
+      MutexLock lk(repl_mu_);
+      auto it = parked_acks_.find(fwd_id);
+      if (it != parked_acks_.end()) {
+        *reply = std::move(it->second.reply);
+        parked_acks_.erase(it);
+        parked = false;
+      }
+    }
+  }
+  return parked;
+}
+
+void Zoo::OnReplForward(MessagePtr msg) {
+  latency::StampDequeue(msg.get());
+  int primary = msg->src;
+  int origin = static_cast<int>(msg->version);
+  ServerTable* bt = nullptr;
+  {
+    bool mine;
+    {
+      MutexLock lk(route_mu_);
+      mine = backup_shard_ == msg->shard;
+    }
+    if (mine) bt = backup_table(msg->table_id);
+  }
+  if (!bt) {
+    Dashboard::Record("repl.forward_orphan", 0.0);
+    Log::Error("ReplForward for table %d shard %d: no backup instance",
+               msg->table_id, msg->shard);
+    return;
+  }
+  TraceScope scope(msg->trace_id);
+  // Apply under the ORIGIN's identity so the backup's delivery book
+  // carries the same per-origin watermark the primary's does — what
+  // lets mvaudit diff primary vs backup and post-failover retries
+  // dedup against the promoted shard.
+  msg->src = origin;
+  bt->ProcessAdd(*msg);
+  bt->NoteAuditApply(*msg);
+  repl::NoteApplied();
+  Dashboard::Record("repl.apply", 0.0);
+  if (!net_) return;
+  Message ack;
+  ack.type = MsgType::ReplAck;
+  ack.table_id = msg->table_id;
+  ack.msg_id = msg->msg_id;
+  ack.shard = msg->shard;
+  ack.src = rank_;
+  ack.dst = primary;
+  net_->Send(primary, ack);
+}
+
+void Zoo::OnReplAck(MessagePtr msg) {
+  repl_outstanding_.fetch_add(-1);
+  repl::NoteAck();
+  MessagePtr parked;
+  {
+    MutexLock lk(repl_mu_);
+    auto it = parked_acks_.find(msg->msg_id);
+    if (it != parked_acks_.end()) {
+      parked = std::move(it->second.reply);
+      parked_acks_.erase(it);
+    }
+  }
+  // Sync replication: "acked" now means applied on BOTH replicas.
+  // Runs ON THE REACTOR THREAD (RouteInbound): never Deliver at a
+  // lease-dead destination from here — the transport's reconnect
+  // backoff would stall the reactor for whole lease windows, starving
+  // heartbeat receipt into false-positive expiries (observed as a
+  // live peer's lease flapping right after a real kill).
+  if (!parked) return;
+  int dst = parked->dst;
+  {
+    MutexLock lk(hb_mu_);
+    if (dst >= 0 && dst < static_cast<int>(hb_dead_.size()) &&
+        hb_dead_[dst])
+      return;  // the client is a corpse; nothing waits for this ack
+  }
+  Deliver(actor::kWorker, std::move(parked));
+}
+
+void Zoo::OnShardSnapshot(MessagePtr msg) {
+  latency::StampDequeue(msg.get());
+  if (msg->data.empty()) {
+    // Request: serve a whole-shard snapshot of the shard we own under
+    // this hint.  Runs on the server actor, so it serializes against
+    // ProcessAdd — every later delta reaches the requester as a
+    // ReplForward BEHIND this reply on the same connection (FIFO).
+    auto* table = RoutedServerTable(*msg);
+    if (!table) {
+      Log::Error("ShardSnapshot request for table %d on non-server rank",
+                 msg->table_id);
+      return;
+    }
+    repl::MemStream ms;
+    if (!table->Store(&ms)) {
+      Log::Error("ShardSnapshot: Store failed for table %d",
+                 msg->table_id);
+      return;
+    }
+    auto marks = table->audit_book().ExportWatermarks();
+    std::vector<int64_t> wm;
+    wm.reserve(marks.size() * 2);
+    for (const auto& [o, mark] : marks) {
+      wm.push_back(o);
+      wm.push_back(mark);
+    }
+    auto reply = std::make_unique<Message>();
+    reply->type = MsgType::ShardSnapshot;
+    reply->table_id = msg->table_id;
+    reply->msg_id = msg->msg_id;
+    reply->trace_id = msg->trace_id;
+    reply->shard = msg->shard;
+    reply->version = table->version();
+    reply->src = rank_;
+    reply->dst = msg->src;
+    reply->data.emplace_back(ms.bytes().data(), ms.bytes().size());
+    if (!wm.empty())
+      reply->data.emplace_back(wm.data(), wm.size() * sizeof(int64_t));
+    repl::NoteSnapshot();
+    Dashboard::Record("repl.snapshot", 0.0);
+    Deliver(actor::kServer, std::move(reply));
+    return;
+  }
+  // Reply: install the snapshot into our backup instance.  Forwards
+  // already applied before the install are INSIDE the snapshot (the
+  // primary serialized it after them); forwards sent after it arrive
+  // behind this frame — either way the bytes converge.
+  bool mine;
+  {
+    MutexLock lk(route_mu_);
+    mine = backup_shard_ == msg->shard;
+  }
+  ServerTable* bt = mine ? backup_table(msg->table_id) : nullptr;
+  if (!bt) {
+    Log::Error("ShardSnapshot reply for table %d shard %d: no backup "
+               "instance", msg->table_id, msg->shard);
+  } else {
+    repl::MemStream ms(
+        std::string(msg->data[0].data(), msg->data[0].size()));
+    if (!bt->Load(&ms)) {
+      Log::Error("ShardSnapshot: install failed for table %d",
+                 msg->table_id);
+    } else {
+      if (msg->data.size() > 1) {
+        const int64_t* wm = msg->data[1].As<int64_t>();
+        size_t n = msg->data[1].count<int64_t>() / 2;
+        std::vector<std::pair<int, int64_t>> marks;
+        marks.reserve(n);
+        for (size_t i = 0; i < n; ++i)
+          marks.emplace_back(static_cast<int>(wm[2 * i]), wm[2 * i + 1]);
+        bt->audit_book().ImportWatermarks(marks);
+      }
+      // Adopt the primary's version so post-promotion reply stamps
+      // never run BEHIND what clients already observed (stale cache
+      // hits would otherwise look fresh).
+      bt->AdvanceVersionTo(msg->version);
+      repl::NoteCatchup();
+      Dashboard::Record("repl.catchup", 0.0);
+    }
+  }
+  std::shared_ptr<Waiter> w;
+  {
+    MutexLock lk(repl_mu_);
+    auto it = snapshot_pending_.find(msg->msg_id);
+    if (it != snapshot_pending_.end()) w = it->second;
+  }
+  if (w) w->Notify();
+}
+
+void Zoo::BroadcastRoutingEpoch(int64_t epoch,
+                                const std::vector<int>& owners,
+                                const std::vector<int>& backups) {
+  if (!net_) return;
+  std::vector<int32_t> own(owners.begin(), owners.end());
+  std::vector<int32_t> bak(backups.begin(), backups.end());
+  for (int r = 0; r < size_; ++r) {
+    if (r == rank_) continue;
+    Message m;
+    m.type = MsgType::RoutingEpoch;
+    m.msg_id = epoch;
+    m.src = rank_;
+    m.dst = r;
+    m.data.emplace_back(own.data(), own.size() * sizeof(int32_t));
+    m.data.emplace_back(bak.data(), bak.size() * sizeof(int32_t));
+    net_->Send(r, m);  // a dead peer's failure is already logged
+  }
+}
+
+void Zoo::OnRoutingEpoch(MessagePtr msg) {
+  if (msg->data.size() < 2) return;
+  int64_t epoch = msg->msg_id;
+  const int32_t* own = msg->data[0].As<int32_t>();
+  size_t n = msg->data[0].count<int32_t>();
+  const int32_t* bak = msg->data[1].As<int32_t>();
+  if (msg->data[1].count<int32_t>() < n || n == 0) return;
+  bool adopted = false;
+  {
+    MutexLock lk(route_mu_);
+    // Max-merge: only a NEWER epoch flips the route (stale broadcasts
+    // from slow paths are dropped, the PR 4 version-gate discipline).
+    if (epoch > routing_epoch_.load(std::memory_order_relaxed)) {
+      route_owner_.assign(own, own + n);
+      route_backup_.assign(bak, bak + n);
+      // Local lease knowledge beats the adopted map: never re-instate
+      // a backup this rank already watched die (forwarding there would
+      // wedge the apply thread in reconnect backoff).
+      {
+        MutexLock hlk(hb_mu_);
+        for (size_t s = 0; s < route_backup_.size(); ++s) {
+          int b = route_backup_[s];
+          if (b >= 0 && b < static_cast<int>(hb_dead_.size()) &&
+              hb_dead_[b])
+            route_backup_[s] = -1;
+        }
+      }
+      if (promoted_.size() < n) promoted_.resize(n, false);
+      // Recompute local identity from the map (a join may have moved
+      // the backup slot); a shard we PROMOTED stays ours regardless.
+      backup_shard_ = -1;
+      for (size_t s = 0; s < n; ++s)
+        if (bak[s] == rank_) backup_shard_ = static_cast<int>(s);
+      if (backup_shard_ < 0)
+        for (size_t s = 0; s < promoted_.size(); ++s)
+          if (promoted_[s]) backup_shard_ = static_cast<int>(s);
+      routing_epoch_.store(epoch, std::memory_order_release);
+      adopted = true;
+    }
+  }
+  if (adopted) {
+    repl::NoteEpochFlip();
+    Dashboard::Record("repl.epoch_flip", 0.0);
+    Log::Info("replication: adopted routing epoch %lld from rank %d",
+              static_cast<long long>(epoch), msg->src);
+    // The flip is a cache boundary: worker-side serve caches may hold
+    // rows stamped by the dead primary — drop them like a clock tick.
+    InvalidateWorkerCaches();
+  }
+}
+
+int Zoo::PromoteFor(int dead) {
+  if (!repl::Armed()) return 0;
+  std::vector<int> owners, backups, shards;
+  int64_t epoch = 0;
+  {
+    MutexLock lk(route_mu_);
+    for (size_t s = 0; s < route_owner_.size(); ++s) {
+      if (route_owner_[s] == dead && route_backup_[s] == rank_) {
+        route_owner_[s] = rank_;
+        route_backup_[s] = -1;  // chain repair = a future JoinAsBackup
+        if (promoted_.size() <= s) promoted_.resize(s + 1, false);
+        promoted_[s] = true;
+        shards.push_back(static_cast<int>(s));
+      }
+    }
+    if (shards.empty()) return 0;
+    epoch = NextEpochLocked();
+    owners = route_owner_;
+    backups = route_backup_;
+  }
+  for (int s : shards) {
+    repl::NotePromotion();
+    Dashboard::Record("repl.promoted", 0.0);
+    Log::Info("replication: promoted shard %d (rank %d dead) at epoch "
+              "%lld", s, dead, static_cast<long long>(epoch));
+    ops::BlackboxEvent(
+        "replication", "promote: shard " + std::to_string(s) +
+                           " after rank " + std::to_string(dead) +
+                           " lease expiry, epoch " + std::to_string(epoch));
+  }
+  BroadcastRoutingEpoch(epoch, owners, backups);
+  InvalidateWorkerCaches();
+  return static_cast<int>(shards.size());
+}
+
+void Zoo::InvalidateWorkerCaches() {
+  // The Barrier/Clock snapshot discipline: pointers copied OUT of
+  // tables_mu_ before the hooks run (they take per-table locks).
+  std::vector<WorkerTable*> snapshot;
+  {
+    MutexLock lk(tables_mu_);
+    for (auto& t : worker_tables_)
+      if (t) snapshot.push_back(t.get());
+  }
+  for (auto* t : snapshot) t->OnClockInvalidate();
+}
+
+void Zoo::ReleaseParkedAcks(bool all) {
+  std::vector<MessagePtr> release;
+  int64_t now = NowMs();
+  {
+    MutexLock lk(repl_mu_);
+    for (auto it = parked_acks_.begin(); it != parked_acks_.end();) {
+      if (all || now >= it->second.deadline_ms) {
+        release.push_back(std::move(it->second.reply));
+        it = parked_acks_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (auto& r : release) {
+    // Degraded ack: the backup never confirmed, but the add IS applied
+    // on the primary — the client must not wedge on a dying backup.
+    // The replication report carries the degradation instead.  A
+    // lease-dead client's ack is dropped outright: delivering it
+    // would park THIS thread in the transport's reconnect backoff.
+    Dashboard::Record("repl.park_timeout", 0.0);
+    int dst = r->dst;
+    {
+      MutexLock lk(hb_mu_);
+      if (dst >= 0 && dst < static_cast<int>(hb_dead_.size()) &&
+          hb_dead_[dst])
+        continue;
+    }
+    Deliver(actor::kWorker, std::move(r));
+  }
+}
+
+void Zoo::OnPeerDead(int r) {
+  if (!repl::Armed()) return;
+  // Confirm the corpse before the (irreversible) route surgery: a
+  // transient stall can expire a LIVE peer's lease for one beat, and
+  // promoting on a flap would mint a split-brain epoch.  One extra
+  // heartbeat interval of silence is cheap against the lease window;
+  // a recovered peer clears hb_dead_ on its next renewal and we walk
+  // away.
+  int64_t confirm = configure::GetInt("heartbeat_ms");
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds(std::max<int64_t>(confirm, 50)));
+  {
+    MutexLock lk(hb_mu_);
+    if (r >= 0 && r < static_cast<int>(hb_dead_.size()) && !hb_dead_[r])
+      return;  // lease recovered: a flap, not a corpse
+  }
+  // ONE route pass, ONE epoch bump, ONE broadcast: clearing the
+  // corpse's backup slots and promoting its shards must ship as a
+  // single map — a promote-only broadcast would re-instate the dead
+  // rank as a backup on every adopter, and primaries would then block
+  // their apply threads forwarding at a corpse.
+  bool promote = configure::GetBool("promote_auto");
+  std::vector<int> owners, backups, shards;
+  bool dropped_mine = false, changed = false;
+  int64_t epoch = 0;
+  {
+    MutexLock lk(route_mu_);
+    for (size_t s = 0; s < route_backup_.size(); ++s) {
+      if (route_backup_[s] == r) {
+        route_backup_[s] = -1;  // never forward at a corpse
+        if (route_owner_[s] == rank_) dropped_mine = true;
+        changed = true;
+      }
+    }
+    if (promote) {
+      for (size_t s = 0; s < route_owner_.size(); ++s) {
+        if (route_owner_[s] == r && backup_shard_ == static_cast<int>(s)) {
+          route_owner_[s] = rank_;
+          route_backup_[s] = -1;  // chain repair = a future join
+          if (promoted_.size() <= s) promoted_.resize(s + 1, false);
+          promoted_[s] = true;
+          shards.push_back(static_cast<int>(s));
+          changed = true;
+        }
+      }
+    }
+    if (!changed) return;
+    epoch = NextEpochLocked();
+    owners = route_owner_;
+    backups = route_backup_;
+  }
+  for (int s : shards) {
+    repl::NotePromotion();
+    Dashboard::Record("repl.promoted", 0.0);
+    Log::Info("replication: promoted shard %d (rank %d dead) at epoch "
+              "%lld", s, r, static_cast<long long>(epoch));
+    ops::BlackboxEvent(
+        "replication", "promote: shard " + std::to_string(s) +
+                           " after rank " + std::to_string(r) +
+                           " lease expiry, epoch " + std::to_string(epoch));
+  }
+  if (dropped_mine) {
+    Log::Error("replication: backup rank %d dead — shard unreplicated "
+               "until a new backup joins", r);
+    ReleaseParkedAcks(/*all=*/true);
+  }
+  BroadcastRoutingEpoch(epoch, owners, backups);
+  InvalidateWorkerCaches();
+}
+
+bool Zoo::JoinAsBackup(int shard) {
+  if (!started_.load() || size_ <= 1 || !repl::Armed() || !net_)
+    return false;
+  int primary = -1;
+  int64_t epoch = 0;
+  std::vector<int> owners, backups;
+  {
+    MutexLock lk(route_mu_);
+    if (shard < 0 || shard >= static_cast<int>(route_owner_.size()))
+      return false;
+    if (backup_shard_ >= 0 && backup_shard_ != shard)
+      return false;  // factor 1: one backed shard per rank
+    primary = route_owner_[shard];
+    if (primary == rank_) return false;
+    route_backup_[shard] = rank_;
+    backup_shard_ = shard;
+    epoch = NextEpochLocked();
+    owners = route_owner_;
+    backups = route_backup_;
+  }
+  // Backup instances first (a forward must never find no table), then
+  // the announce (the primary starts forwarding on adoption), then the
+  // snapshots — deltas between announce and snapshot are either inside
+  // the snapshot or arrive behind it (FIFO), so the bytes converge.
+  int32_t ntables;
+  {
+    MutexLock lk(tables_mu_);
+    ntables = static_cast<int32_t>(table_specs_.size());
+    if (backup_tables_.size() < table_specs_.size())
+      backup_tables_.resize(table_specs_.size());
+    for (size_t i = 0; i < table_specs_.size(); ++i) {
+      if (!backup_tables_[i]) {
+        backup_tables_[i] =
+            MakeShard(table_specs_[i], shard, num_servers());
+        if (backup_tables_[i])
+          backup_tables_[i]->set_table_id(static_cast<int32_t>(i));
+      }
+    }
+  }
+  BroadcastRoutingEpoch(epoch, owners, backups);
+  bool ok = true;
+  for (int32_t id = 0; id < ntables; ++id) {
+    int64_t mid = NextMsgId();
+    auto waiter = std::make_shared<Waiter>(1);
+    {
+      MutexLock lk(repl_mu_);
+      snapshot_pending_[mid] = waiter;
+    }
+    Message req;
+    req.type = MsgType::ShardSnapshot;
+    req.table_id = id;
+    req.msg_id = mid;
+    req.shard = shard;
+    req.src = rank_;
+    req.dst = primary;
+    bool sent = net_->Send(primary, req);
+    if (!sent || !waiter->WaitFor(configure::GetInt("rpc_timeout_ms")))
+      ok = false;
+    MutexLock lk(repl_mu_);
+    snapshot_pending_.erase(mid);
+  }
+  if (ok)
+    ops::BlackboxEvent("replication",
+                       "join: rank " + std::to_string(rank_) +
+                           " now backs shard " + std::to_string(shard) +
+                           ", epoch " + std::to_string(epoch));
+  return ok;
+}
+
+std::string Zoo::OpsReplicationJson() {
+  auto owners = RouteOwners();
+  auto backups = RouteBackups();
+  std::vector<int> promoted;
+  {
+    MutexLock lk(route_mu_);
+    for (size_t s = 0; s < promoted_.size(); ++s)
+      if (promoted_[s]) promoted.push_back(static_cast<int>(s));
+  }
+  auto st = repl::GetStats();
+  std::ostringstream os;
+  os << "{\"rank\":" << rank_ << ",\"armed\":"
+     << (repl::Armed() ? "true" : "false") << ",\"sync\":"
+     << (repl::Sync() ? "true" : "false") << ",\"epoch\":"
+     << RoutingEpoch() << ",\"backup_shard\":" << BackupShard();
+  os << ",\"owners\":[" << JoinInts(owners) << "]";
+  os << ",\"backups\":[" << JoinInts(backups) << "]";
+  os << ",\"promoted\":[" << JoinInts(promoted) << "]";
+  os << ",\"outstanding\":" << repl_outstanding_.load();
+  os << ",\"stats\":{\"forwards\":" << st.forwards << ",\"acks\":"
+     << st.acks << ",\"applied\":" << st.applied << ",\"parked\":"
+     << st.parked << ",\"lag_waits\":" << st.lag_waits
+     << ",\"snapshots\":" << st.snapshots << ",\"catchups\":"
+     << st.catchups << ",\"promotions\":" << st.promotions
+     << ",\"epoch_flips\":" << st.epoch_flips << ",\"dup_skips\":"
+     << st.dup_skips << "}}";
+  return os.str();
+}
+
+std::unique_ptr<ServerTable> Zoo::MakeShard(const TableSpec& spec,
+                                            int sid, int nservers) {
+  switch (spec.kind) {
+    case TableSpec::kArray:
+      return std::make_unique<ArrayServerTable>(spec.rows, updater_type_,
+                                                sid, nservers);
+    case TableSpec::kMatrix:
+    case TableSpec::kSparseMatrix:
+      // Both matrix kinds share the server shard (the sparse flavor is
+      // a worker-side cache, zoo.cc registration note).
+      return std::make_unique<MatrixServerTable>(
+          spec.rows, spec.cols, updater_type_, sid, nservers);
+    case TableSpec::kKV:
+      return std::make_unique<KVServerTable>(updater_type_);
+  }
+  return nullptr;
+}
+
+void Zoo::RegisterBackupShard(const TableSpec& spec) {
+  int32_t id = static_cast<int32_t>(table_specs_.size());
+  table_specs_.push_back(spec);
+  int bs = -1;
+  {
+    MutexLock lk(route_mu_);
+    bs = backup_shard_;
+  }
+  std::unique_ptr<ServerTable> bt;
+  if (repl::Armed() && bs >= 0)
+    bt = MakeShard(spec, bs, num_servers());
+  if (bt) bt->set_table_id(id);
+  backup_tables_.push_back(std::move(bt));
 }
 
 void Zoo::Clock() {
@@ -1087,17 +1890,6 @@ bool Zoo::ShedIfOverloaded(MessagePtr& msg) {
 }
 
 // ---- introspection plane (docs/observability.md) ----------------------
-
-namespace {
-std::string JoinInts(const std::vector<int>& v) {
-  std::string out;
-  for (int x : v) {
-    if (!out.empty()) out += ',';
-    out += std::to_string(x);
-  }
-  return out;
-}
-}  // namespace
 
 std::string Zoo::OpsHealthJson() {
   std::ostringstream os;
@@ -1360,19 +2152,32 @@ std::string Zoo::OpsHotKeysJson(int32_t id) {
 std::string Zoo::OpsAuditJson() {
   // Snapshot pointers under tables_mu_, read books OUTSIDE it (the
   // accessors take per-book locks; tables never unregister).
-  std::vector<std::pair<WorkerTable*, ServerTable*>> snapshot;
+  std::vector<std::tuple<WorkerTable*, ServerTable*, ServerTable*>>
+      snapshot;
   {
     MutexLock lk(tables_mu_);
     for (size_t i = 0; i < worker_tables_.size(); ++i)
       snapshot.emplace_back(
           worker_tables_[i].get(),
-          i < server_tables_.size() ? server_tables_[i].get() : nullptr);
+          i < server_tables_.size() ? server_tables_[i].get() : nullptr,
+          i < backup_tables_.size() ? backup_tables_[i].get() : nullptr);
   }
+  int bshard = BackupShard();
   std::ostringstream os;
   os << "{\"rank\":" << rank_ << ",\"armed\":"
-     << (audit::Armed() ? "true" : "false") << ",\"tables\":[";
+     << (audit::Armed() ? "true" : "false")
+     << ",\"backup_shard\":" << bshard << ",\"tables\":[";
+  auto emit_sums = [&os](ServerTable* t) {
+    os << "[";
+    auto sums = t->BucketChecksums();
+    for (size_t b = 0; b < sums.size(); ++b) {
+      if (b) os << ',';
+      os << sums[b];
+    }
+    os << "]";
+  };
   for (size_t i = 0; i < snapshot.size(); ++i) {
-    auto [wt, st] = snapshot[i];
+    auto [wt, st, bt] = snapshot[i];
     if (i) os << ',';
     os << "{\"id\":" << i;
     if (wt) os << ",\"worker\":" << wt->AuditLedgerJson();
@@ -1381,15 +2186,19 @@ std::string Zoo::OpsAuditJson() {
       // deadline — the scrape IS the periodic sweep.
       st->audit_book().CheckGaps(static_cast<int32_t>(i));
       os << ",\"server\":" << st->audit_book().Json();
-      os << ",\"checksums\":[";
-      auto sums = st->BucketChecksums();
-      for (size_t b = 0; b < sums.size(); ++b) {
-        if (b) os << ',';
-        os << sums[b];
-      }
-      os << "]";
+      os << ",\"checksums\":";
+      emit_sums(st);
     } else {
       os << ",\"server\":null";
+    }
+    if (bt) {
+      // Replication plane (docs/replication.md): the backed shard's
+      // book + beacons, so mvaudit can diff primary vs backup —
+      // identical rows must report identical bucket checksums.
+      bt->audit_book().CheckGaps(static_cast<int32_t>(i));
+      os << ",\"backup\":" << bt->audit_book().Json();
+      os << ",\"backup_checksums\":";
+      emit_sums(bt);
     }
     os << "}";
   }
@@ -1611,6 +2420,24 @@ void Zoo::RouteInbound(Message&& m) {
     case MsgType::RequestCancel:
       qos::NoteCancel(msg->src, msg->msg_id);
       break;
+    // Replication plane (docs/replication.md): forwards + snapshots go
+    // through the server actor (serialized with applies); acks and
+    // routing-epoch flips are consumed at the transport layer so a
+    // primary's apply thread waiting on its backup can always make
+    // progress, and promotions are controller-plane.
+    case MsgType::ReplForward:
+    case MsgType::ShardSnapshot:
+      SendTo(actor::kServer, std::move(msg));
+      break;
+    case MsgType::ReplAck:
+      OnReplAck(std::move(msg));
+      break;
+    case MsgType::RoutingEpoch:
+      OnRoutingEpoch(std::move(msg));
+      break;
+    case MsgType::Promote:
+      SendTo(actor::kController, std::move(msg));
+      break;
     case MsgType::OpsQuery:
       HandleOpsQuery(std::move(msg));
       break;
@@ -1645,6 +2472,7 @@ int32_t Zoo::RegisterArrayTable(int64_t size) {
               : std::make_unique<ArrayServerTable>(size, updater_type_,
                                                    sid, num_servers()));
   if (server_tables_.back()) server_tables_.back()->set_table_id(id);
+  RegisterBackupShard(TableSpec{TableSpec::kArray, size, 0});
   worker_tables_.push_back(
       std::make_unique<ArrayWorkerTable>(id, size, num_servers()));
   worker_tables_.back()->set_codec(DefaultCodec());
@@ -1665,6 +2493,11 @@ int32_t Zoo::RegisterMatrixTableImpl(int64_t rows, int64_t cols) {
               : std::make_unique<MatrixServerTable>(
                     rows, cols, updater_type_, sid, num_servers()));
   if (server_tables_.back()) server_tables_.back()->set_table_id(id);
+  RegisterBackupShard(TableSpec{
+      std::is_same<WorkerT, SparseMatrixWorkerTable>::value
+          ? TableSpec::kSparseMatrix
+          : TableSpec::kMatrix,
+      rows, cols});
   worker_tables_.push_back(
       std::make_unique<WorkerT>(id, rows, cols, num_servers()));
   worker_tables_.back()->set_codec(DefaultCodec());
@@ -1687,6 +2520,7 @@ int32_t Zoo::RegisterKVTable() {
       sid < 0 ? nullptr
               : std::make_unique<KVServerTable>(updater_type_));
   if (server_tables_.back()) server_tables_.back()->set_table_id(id);
+  RegisterBackupShard(TableSpec{TableSpec::kKV, 0, 0});
   worker_tables_.push_back(
       std::make_unique<KVWorkerTable>(id, num_servers()));
   worker_tables_.back()->set_codec(DefaultCodec());

@@ -29,6 +29,7 @@
 #include "mvtpu/mt_queue.h"
 #include "mvtpu/net.h"
 #include "mvtpu/qos.h"
+#include "mvtpu/repl.h"
 #include "mvtpu/sketch.h"
 #include "mvtpu/table.h"
 #include "mvtpu/updater.h"
@@ -2798,6 +2799,302 @@ static int ChaosQuietChild(const char* machine_file, const char* rank) {
   return 0;
 }
 
+static int TestRepl() {
+  using mvtpu::Message;
+  using mvtpu::MsgType;
+  // ---- shard-hint wire round trip (version-tolerant bias) -----------
+  {
+    Message m;
+    m.type = MsgType::RequestGet;
+    m.table_id = 2;
+    m.msg_id = 9;
+    m.shard = 3;
+    Message back = Message::Deserialize(m.Serialize());
+    CHECK(back.shard == 3);
+    Message unhinted;
+    unhinted.type = MsgType::RequestGet;
+    Message back2 = Message::Deserialize(unhinted.Serialize());
+    CHECK(back2.shard == -1);  // old wire value 0 = no hint
+    // Zero-copy parse adopts the hint too.
+    mvtpu::Blob frame = m.Serialize();
+    auto slab = std::make_shared<std::vector<char>>(
+        frame.data(), frame.data() + frame.size());
+    Message viewed;
+    CHECK(Message::DeserializeView(slab, 0, slab->size(), &viewed));
+    CHECK(viewed.shard == 3);
+  }
+  // ---- MemStream: the snapshot wire form ----------------------------
+  {
+    mvtpu::repl::MemStream ms;
+    int64_t vals[3] = {7, -1, 42};
+    CHECK(ms.Write(vals, sizeof(vals)) == sizeof(vals));
+    mvtpu::repl::MemStream in(ms.bytes());
+    int64_t got[3] = {0, 0, 0};
+    CHECK(in.Read(got, sizeof(got)) == sizeof(got));
+    CHECK(got[0] == 7 && got[1] == -1 && got[2] == 42);
+    char extra;
+    CHECK(in.Read(&extra, 1) == 0);  // drained
+  }
+  // ---- whole-shard catch-up: Store -> Load, beacons converge --------
+  {
+    mvtpu::MatrixServerTable primary(8, 4, mvtpu::UpdaterType::kDefault,
+                                     /*rank=*/0, /*size=*/2);
+    mvtpu::MatrixServerTable backup(8, 4, mvtpu::UpdaterType::kDefault,
+                                    /*rank=*/0, /*size=*/2);
+    Message add;
+    add.type = MsgType::RequestAdd;
+    mvtpu::AddOption opt;
+    std::vector<int32_t> ids = {0, 2, 3};
+    std::vector<float> delta(3 * 4, 1.5f);
+    add.data.emplace_back(&opt, sizeof(opt));
+    add.data.emplace_back(ids.data(), ids.size() * sizeof(int32_t));
+    add.data.emplace_back(delta.data(), delta.size() * sizeof(float));
+    primary.ProcessAdd(add);
+    CHECK(primary.BucketChecksums() != backup.BucketChecksums());
+    mvtpu::repl::MemStream snap;
+    CHECK(primary.Store(&snap));
+    mvtpu::repl::MemStream in(snap.bytes());
+    CHECK(backup.Load(&in));
+    CHECK(primary.BucketChecksums() == backup.BucketChecksums());
+    // Version adoption: the installed backup must never stamp BEHIND
+    // what clients already saw from the primary.
+    backup.AdvanceVersionTo(primary.version());
+    CHECK(backup.version() >= primary.version());
+    // Delta forwarding after the snapshot keeps them converged.
+    primary.ProcessAdd(add);
+    backup.ProcessAdd(add);
+    CHECK(primary.BucketChecksums() == backup.BucketChecksums());
+  }
+  // ---- idempotent stamped replay: Covers + NoteDupSkipped -----------
+  {
+    mvtpu::audit::DeliveryBook book;
+    mvtpu::audit::Arm(true);
+    book.NoteApply(/*origin=*/1, 1, 3, /*table_id=*/0);
+    CHECK(book.Covers(1, 1, 3));
+    CHECK(book.Covers(1, 2, 2));
+    CHECK(!book.Covers(1, 3, 4));   // hi past the watermark
+    CHECK(!book.Covers(2, 1, 1));   // unseen origin
+    book.NoteApply(1, 6, 6, 0);     // parked ahead of the 4..5 hole
+    CHECK(book.Covers(1, 6, 6));    // pending ranges count as seen
+    CHECK(!book.Covers(1, 4, 5));
+    book.NoteDupSkipped(1, 1, 3);
+    CHECK(book.Json().find("\"dups\":1") != std::string::npos);
+    // Watermark export/import: the catch-up payload's book half.
+    mvtpu::audit::DeliveryBook joined;
+    joined.ImportWatermarks(book.ExportWatermarks());
+    CHECK(joined.Covers(1, 1, 3));
+  }
+  return 0;
+}
+
+static int FailoverChild(const char* machine_file, const char* rank,
+                         const char* engine) {
+  // Replication + lease-triggered failover chaos (docs/replication.md):
+  // a 3-rank fleet with -replication_factor=1 (shard i backed by
+  // server i+1 mod 3).  After a converged warm phase rank 1 is
+  // CRASHED (no goodbye); rank 2 — shard 1's backup — detects the
+  // expired lease on its own (symmetric watching), promotes, and
+  // broadcasts the routing-epoch flip; rank 0's retried adds re-route
+  // and the fleet converges to the exact expected values with zero
+  // lost acked adds (sync replication: an acked add is on both
+  // replicas by construction).
+  std::string mf = std::string("-machine_file=") + machine_file;
+  std::string rk = std::string("-rank=") + rank;
+  std::string eng = std::string("-net_engine=") + engine;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), eng.c_str(),
+                         "-updater_type=default", "-log_level=error",
+                         "-rpc_timeout_ms=2000",
+                         "-barrier_timeout_ms=8000",
+                         "-heartbeat_ms=100", "-heartbeat_timeout_ms=400",
+                         "-replication_factor=1", "-repl_sync=true",
+                         "-promote_auto=true", "-send_retries=2",
+                         "-send_backoff_ms=20", "-connect_retry_ms=500"};
+  CHECK(MV_Init(15, argv2) == 0);
+  int me = MV_WorkerId();
+  constexpr int64_t kN = 12;  // 3 shards of 4
+  int32_t h;
+  CHECK(MV_NewArrayTable(kN, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+
+  std::vector<float> ones(kN, 1.0f), out(kN, -1.0f);
+  // Warm phase: every rank lands one acked add — with sync replication
+  // the ack certifies BOTH replicas applied it.
+  CHECK(MV_AddArrayTable(h, ones.data(), kN) == 0);
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), kN) == 0);
+  for (float v : out) CHECK(v == 3.0f);
+  long long fwd = 0, acks = 0;
+  CHECK(MV_ReplicationStats(&fwd, &acks, nullptr, nullptr, nullptr,
+                            nullptr, nullptr, nullptr) == 0);
+  CHECK(fwd >= 1);  // this rank forwarded its shard's applies
+  CHECK(MV_Barrier() == 0);
+
+  // Dup-idempotence probe: with replication armed, a re-delivered
+  // stamped frame (injected dup — the same wire-retry shape) must be
+  // SKIPPED, not re-applied, so post-failover replays cannot double
+  // count.  Rank 0 dups exactly one of its three shard sends; the
+  // exact value proves the second delivery was dropped by the
+  // Covers() gate (without it, one shard's slice would read +2).
+  if (me == 0) {
+    CHECK(MV_SetFaultSeed(17) == 0);
+    CHECK(MV_SetFaultN("dup", 1) == 0);
+    CHECK(MV_AddArrayTable(h, ones.data(), kN) == 0);
+    CHECK(MV_ClearFaults() == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), kN) == 0);
+  for (float v : out) CHECK(v == 4.0f);
+  CHECK(MV_Barrier() == 0);
+
+  if (me == 1) _exit(0);  // SIGKILL stand-in: no shutdown, no goodbye
+
+  // Lease expiry detected by each SURVIVOR on its own (symmetric
+  // watching — rank 0 is not special; the same path covers rank 0
+  // itself being the corpse).
+  int dead = 0;
+  for (int tries = 0; tries < 300 && dead == 0; ++tries) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    dead = MV_DeadPeerCount();
+  }
+  CHECK(dead >= 1);
+  // Promotion within the lease window: shard 1's routed owner
+  // converges on global rank 2 (the promoted backup broadcasts the
+  // epoch flip; rank 0 adopts it without restarting).
+  int owner = -1;
+  for (int tries = 0; tries < 300; ++tries) {
+    owner = MV_ShardOwner(1);
+    if (owner == 2) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  CHECK(owner == 2);
+  CHECK(MV_RoutingEpoch() >= 1);
+  if (me == 2) {
+    long long promos = 0;
+    CHECK(MV_ReplicationStats(nullptr, nullptr, nullptr, nullptr,
+                              &promos, nullptr, nullptr, nullptr) == 0);
+    CHECK(promos >= 1);
+    CHECK(MV_BackupShard() == 1);
+  }
+  // Post-promotion traffic: blocking adds through the flipped route —
+  // the promoted shard takes rank 1's slice without a fleet restart.
+  // (The retry loop guards the adoption race; a whole-array add is
+  // only exactness-safe once every shard routes to a live rank.)
+  int failures = 0;
+  for (int i = 0; i < 2; ++i) {
+    int rc = -1;
+    for (int tries = 0; tries < 100 && rc != 0; ++tries) {
+      rc = MV_AddArrayTable(h, ones.data(), kN);
+      if (rc != 0) {
+        ++failures;
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      }
+    }
+    CHECK(rc == 0);
+  }
+  // Survivor rendezvous: the dead-leased rank is EXCUSED from the
+  // barrier quorum (elastic membership) — then prove exact
+  // convergence: 4 (warm + dup probe) + 2 rounds from each of the 2
+  // survivors = 8 everywhere, the promoted shard included.
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_GetArrayTable(h, out.data(), kN) == 0);
+  for (float v : out) CHECK(v == 8.0f);
+  CHECK(MV_ShutDown() == 0);
+  printf("FAILOVER_OK %d failures=%d\n", me, failures);
+  return 0;
+}
+
+static int JoinChild(const char* ctrl, const char* port, const char* role,
+                     const char* num, const char* is_ctrl) {
+  // Elastic-join scenario (docs/replication.md): three dynamically
+  // registered processes — controller (role all, rank 0), a
+  // server-only node, and a WORKER-ONLY node that joins the
+  // replication set live: MV_ReplJoin(0) creates backup instances,
+  // announces via a routing-epoch flip (the primary starts
+  // forwarding), and pulls a whole-shard catch-up snapshot.  The
+  // joiner then takes shard 0 over through an operator-driven
+  // promotion (MV_PromoteBackup) — traffic re-routes with no fleet
+  // restart, and exact values prove the snapshot + delta stream
+  // delivered the full shard (a join is replication + an epoch flip).
+  std::string a_ctrl = std::string("-controller_endpoint=") + ctrl;
+  std::string a_port = std::string("-port=") + port;
+  std::string a_role = std::string("-role=") + role;
+  std::string a_num = std::string("-num_nodes=") + num;
+  std::string a_isc = std::string("-is_controller=") + is_ctrl;
+  const char* argv2[] = {a_ctrl.c_str(), a_port.c_str(), a_role.c_str(),
+                         a_num.c_str(),  a_isc.c_str(),
+                         "-updater_type=default", "-log_level=error",
+                         "-rpc_timeout_ms=20000",
+                         "-barrier_timeout_ms=30000",
+                         "-replication_factor=1", "-repl_sync=true",
+                         "-promote_auto=false"};
+  CHECK(MV_Init(12, argv2) == 0);
+  int wid = MV_WorkerId(), sid = MV_ServerId();
+  bool joiner = std::string(role) == "worker";
+  constexpr int64_t kN = 8;  // 2 server shards of 4
+  int32_t h;
+  CHECK(MV_NewArrayTable(kN, &h) == 0);
+  CHECK(MV_Barrier() == 0);
+
+  std::vector<float> ones(kN, 1.0f), out(kN, -1.0f);
+  if (wid >= 0) CHECK(MV_AddArrayTable(h, ones.data(), kN) == 0);
+  CHECK(MV_Barrier() == 0);
+  if (wid >= 0) {
+    CHECK(MV_GetArrayTable(h, out.data(), kN) == 0);
+    for (float v : out) CHECK(v == 2.0f);  // two worker-role ranks
+  }
+  CHECK(MV_Barrier() == 0);
+
+  if (joiner) {
+    CHECK(MV_BackupShard() == -1);  // worker-only: backs nothing yet
+    CHECK(MV_ReplJoin(0) == 0);     // live join: announce + catch-up
+    // Chaos re-run (the kill-mid-catch-up recovery path): the second
+    // pull re-installs the snapshot idempotently.
+    CHECK(MV_ReplJoin(0) == 0);
+    CHECK(MV_BackupShard() == 0);
+    long long catchups = 0;
+    CHECK(MV_ReplicationStats(nullptr, nullptr, nullptr, nullptr,
+                              nullptr, nullptr, nullptr,
+                              &catchups) == 0);
+    CHECK(catchups >= 1);
+  }
+  CHECK(MV_Barrier() == 0);
+  // Post-join writes stream to the joiner as forwards.
+  if (wid >= 0) CHECK(MV_AddArrayTable(h, ones.data(), kN) == 0);
+  CHECK(MV_Barrier() == 0);
+
+  if (joiner) {
+    // Operator-driven handover: promote the joined backup into
+    // serving shard 0 (the lease-expiry path minus the corpse).
+    CHECK(MV_PromoteBackup(0) == 1);
+    CHECK(MV_ShardOwner(0) != 0);
+  }
+  // Every rank adopts the epoch flip: shard 0's owner leaves rank 0.
+  int owner = 0;
+  for (int tries = 0; tries < 300; ++tries) {
+    owner = MV_ShardOwner(0);
+    if (owner != 0) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  CHECK(owner != 0);
+  CHECK(MV_RoutingEpoch() >= 1);
+  CHECK(MV_Barrier() == 0);
+  // Traffic lands on the promoted joiner; exact values prove the
+  // catch-up snapshot + forwarded deltas delivered the whole shard
+  // (no torn read: 2 warm + 2 post-join + 2 post-promotion).
+  if (wid >= 0) {
+    CHECK(MV_AddArrayTable(h, ones.data(), kN) == 0);
+  }
+  CHECK(MV_Barrier() == 0);
+  if (wid >= 0) {
+    CHECK(MV_GetArrayTable(h, out.data(), kN) == 0);
+    for (float v : out) CHECK(v == 6.0f);
+  }
+  CHECK(MV_Barrier() == 0);
+  CHECK(MV_ShutDown() == 0);
+  printf("JOIN_OK %s wid=%d sid=%d\n", role, wid, sid);
+  return 0;
+}
+
 // masking the CHECK diagnostic — _exit skips teardown and keeps rc=1.
 static int ScenarioExit(int rc) {
   fflush(stdout);
@@ -2854,6 +3151,12 @@ int main(int argc, char** argv) {
     return ScenarioExit(DeadPeerChild(argv[2], argv[3]));
   if (argc == 4 && std::string(argv[1]) == "dead_server")
     return ScenarioExit(DeadServerChild(argv[2], argv[3]));
+  if ((argc == 4 || argc == 5) && std::string(argv[1]) == "failover_child")
+    return ScenarioExit(FailoverChild(argv[2], argv[3],
+                                      argc == 5 ? argv[4] : "epoll"));
+  if (argc == 7 && std::string(argv[1]) == "join_child")
+    return ScenarioExit(
+        JoinChild(argv[2], argv[3], argv[4], argv[5], argv[6]));
   if (argc == 2 && std::string(argv[1]) == "mpi_self")
     return ScenarioExit(MpiSelfScenario());
   if (argc == 2 && std::string(argv[1]) == "mpi_zoo")
@@ -2880,6 +3183,7 @@ int main(int argc, char** argv) {
       {"serve", TestServeVersions},
       {"workload", TestWorkload},
       {"replica", TestReplica},
+      {"repl", TestRepl},
       {"multiblob_add", TestMultiBlobAdd},
   };
   int failures = 0;

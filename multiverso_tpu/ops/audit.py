@@ -48,6 +48,38 @@ def _tables(rank_doc: Optional[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return rank_doc.get("tables") or []
 
 
+def _shard_watermark(ranks: Dict[str, Any], shard: int, table_id,
+                     origin: int) -> Optional[int]:
+    """The applied watermark covering (shard, table, origin) — the
+    shard's registration-time rank first, then any rank whose BACKUP
+    instance backs the shard (docs/replication.md): after a failover
+    the promoted backup's book is the shard's book, so a dead primary
+    does not blind the lost-acked-add check exactly when it matters."""
+    def find(doc, book_key):
+        for st in _tables(doc):
+            if st.get("id") != table_id:
+                continue
+            book = st.get(book_key)
+            if not isinstance(book, dict):
+                return None
+            for o in book.get("origins") or []:
+                if o.get("origin") == origin:
+                    return o.get("watermark", 0)
+            return 0  # book exists, origin unseen
+        return None
+
+    sdoc = ranks.get(str(shard))
+    mark = find(sdoc, "server") if sdoc else None
+    if mark is not None:
+        return mark
+    for doc in ranks.values():
+        if isinstance(doc, dict) and doc.get("backup_shard") == shard:
+            mark = find(doc, "backup")
+            if mark is not None:
+                return mark
+    return None
+
+
 def diff_fleet(fleet: Dict[str, Any]) -> List[Dict[str, Any]]:
     """Diff one fleet audit report into a finding list, most severe
     first.  Every finding names its table, origin, and seq range —
@@ -118,22 +150,16 @@ def diff_fleet(fleet: Dict[str, Any]) -> List[Dict[str, Any]]:
                                                "worker) — NOT lost"})
                 if acked <= 0:
                     continue
-                sdoc = ranks.get(str(shard))
-                if sdoc is None:
-                    continue  # silent server: already a finding above
-                watermark = None
-                for st in _tables(sdoc):
-                    if st.get("id") != t.get("id"):
-                        continue
-                    server = st.get("server")
-                    if not isinstance(server, dict):
-                        break
-                    for o in server.get("origins") or []:
-                        if o.get("origin") == int(orank):
-                            watermark = o.get("watermark", 0)
-                            break
-                    break
+                # The shard's book: its registration-time rank, or —
+                # after a failover — the backup holder's backed book
+                # (docs/replication.md).
+                watermark = _shard_watermark(ranks, shard, t.get("id"),
+                                             int(orank))
                 if watermark is None:
+                    if ranks.get(str(shard)) is None:
+                        # Dead primary AND no backup book: silent, not
+                        # provably lossy — already a finding above.
+                        continue
                     watermark = 0  # acked but the server has no book
                 if acked > watermark:
                     findings.append({**base, "kind": "lost",

@@ -136,6 +136,15 @@ class OpsClient:
         reorder."""
         return json.loads(self.report("audit", fleet=fleet))
 
+    def replication(self, fleet: bool = False):
+        """Replication report (docs/replication.md): the routing epoch
+        + shard→owner/backup maps, this rank's backed shard, promoted
+        shards, and the forward/ack/promotion ledger (forwards, acks,
+        applied, parked sync acks, catch-up installs, dup-skipped
+        replays).  Fleet scope returns the usual ``{"ranks": {...}}``
+        wrapper — ``tools/mvtop.py --replication`` renders it."""
+        return json.loads(self.report("replication", fleet=fleet))
+
     def metrics(self, fleet: bool = False) -> Tuple[
             Dict[str, float], Dict[str, Dict[str, str]]]:
         """(values, exemplars) of the scraped exposition text."""

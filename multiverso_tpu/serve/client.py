@@ -94,6 +94,28 @@ class ServeClient:
         # Version-knowledge lease per handle: (version, monotonic ts).
         # Bounded by the process's table-handle count, not by data.
         self._known: dict = {}  # mvlint: disable=MV007 — one entry per table handle
+        # Fleet routing epoch last observed (docs/replication.md):
+        # re-checked before every cached read — a promotion/join flip
+        # voids cached entries and version leases, whose stamps came
+        # from a shard owner that may no longer serve.
+        self._route_epoch = 0
+
+    def _check_routing_epoch(self) -> None:
+        """Re-check the fleet routing epoch before serving from cache
+        (docs/replication.md): cached values and version leases were
+        stamped under the PREVIOUS shard→rank map; after a promotion
+        or join flip they must be dropped and re-resolved against the
+        new owner, never served on the stale route."""
+        try:
+            epoch = int(self.rt.routing_epoch())
+        except Exception:
+            return  # pre-replication runtime / stub: epoch-less
+        if epoch == self._route_epoch:
+            return
+        self._route_epoch = epoch
+        self.cache.invalidate()
+        self._known.clear()
+        metrics.counter("serve.route_flip").inc()
 
     # ------------------------------------------------ version knowledge
     def _note(self, handle: int) -> None:
@@ -128,6 +150,7 @@ class ServeClient:
         new — stamping with a post-fetch ``last_version`` instead could
         over-stamp (a concurrent add's ack landing between fetch and
         stamp would mark pre-add data post-add fresh)."""
+        self._check_routing_epoch()
         if not self._cache_on:
             return None
         return self._server_version(handle)

@@ -88,7 +88,9 @@ class HedgedReader:
                  qos_class="gold", qos_classes=QOS_CLASSES,
                  hedge_min_us: Optional[float] = None,
                  enabled: bool = True,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 backup_endpoint: Optional[str] = None,
+                 backup_shard: int = -1):
         self.table_id = int(table_id)
         self.cols = int(cols)
         self.enabled = bool(enabled)
@@ -100,6 +102,22 @@ class HedgedReader:
         self.secondary = AnonServeClient(endpoint, timeout=timeout,
                                          timing=False, qos_class=qos_class,
                                          qos_classes=qos_classes)
+        # True-backup hedge (docs/replication.md): with replication
+        # armed, the shard has a REAL second copy — the backup rank's
+        # serve port answers reads of `backup_shard` from its backed
+        # instance (bounded behind the primary only by the forward
+        # stream; exact under -repl_sync).  Unlike the hot-key replica
+        # it holds EVERY row, so a hedge against it never falls back
+        # to re-asking the straggling primary.  The shard hint routes
+        # the read at a rank that serves two shards of the table.
+        self.backup = None
+        self.backup_shard = int(backup_shard)
+        self.backup_wins = 0
+        if backup_endpoint:
+            self.backup = AnonServeClient(backup_endpoint, timeout=timeout,
+                                          timing=False,
+                                          qos_class=qos_class,
+                                          qos_classes=qos_classes)
         self.tracker = LatencyTracker()
         # epoll-backed readiness (NOT select.select: at 10k-connection
         # scale this process's fds exceed FD_SETSIZE and select raises).
@@ -187,17 +205,29 @@ class HedgedReader:
             self.tracker.observe(time.monotonic() - t0)
             return self._rows_from_reply(reply, ids)
 
-        # --- hedge: replica first (reactor-served, mailbox-free) -------
+        # --- hedge: true backup shard first (docs/replication.md),
+        # else the hot-key replica (reactor-served, mailbox-free) ------
         self.issued += 1
         self._note("serve.hedge.issued")
-        replica = self.secondary.get_replica(self.table_id)
         hedge_rows = None
-        if all(int(i) in replica for i in ids):
-            hedge_rows = np.stack([replica[int(i)][1] for i in ids])
-        elif ids.size:
-            # Replica cold for these rows: second-connection hedge.
-            hedge_rows = self.secondary.get_rows(self.table_id, ids,
-                                                 self.cols)
+        hit_backup = False
+        if self.backup is not None and ids.size:
+            # The backup holds the WHOLE shard — a complete answer
+            # regardless of key temperature, and a straggling primary's
+            # clogged mailbox is not in its path at all.
+            hedge_rows = self.backup.get_rows(self.table_id, ids,
+                                              self.cols,
+                                              shard=self.backup_shard)
+            hit_backup = True
+            self._note("serve.hedge.backup")
+        if hedge_rows is None:
+            replica = self.secondary.get_replica(self.table_id)
+            if all(int(i) in replica for i in ids):
+                hedge_rows = np.stack([replica[int(i)][1] for i in ids])
+            elif ids.size:
+                # Replica cold for these rows: second-connection hedge.
+                hedge_rows = self.secondary.get_rows(self.table_id, ids,
+                                                     self.cols)
         # First answer wins: one nonblocking look at the primary.
         late = self._poll_reply(self.primary, mid, 0.0)
         if late is not None:
@@ -207,6 +237,9 @@ class HedgedReader:
             return self._rows_from_reply(late, ids)
         self.won += 1
         self._note("serve.hedge.won")
+        if hit_backup:
+            self.backup_wins += 1
+            self._note("serve.hedge.backup.won")
         # Cancel the loser: a fire-and-forget token that overtakes the
         # mailbox FIFO; its late reply (if the apply already ran) is
         # discarded via the stale set.
@@ -219,6 +252,7 @@ class HedgedReader:
     def stats(self) -> dict:
         return {"issued": self.issued, "won": self.won,
                 "wasted": self.wasted, "cancelled": self.cancelled,
+                "backup_wins": self.backup_wins,
                 "win_rate": self.won / self.issued if self.issued else 0.0,
                 "samples": self.tracker.samples}
 
@@ -230,6 +264,8 @@ class HedgedReader:
         self._psel.close()
         self.primary.close()
         self.secondary.close()
+        if self.backup is not None:
+            self.backup.close()
 
     def __enter__(self):
         return self

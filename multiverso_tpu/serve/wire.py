@@ -140,7 +140,7 @@ _ACCEPT_RAW = 1  # msgflag::kAcceptRaw
 
 def pack_frame(msg_type: int, table_id: int, msg_id: int, *,
                version: int = -1, blobs=(), timing: bool = False,
-               audit=None, qos=None) -> bytes:
+               audit=None, qos=None, shard: int = -1) -> bytes:
     """One wire frame.  ``src=-1`` is what makes the connection
     anonymous: the reactor sees no valid rank in the first frame and
     assigns a pseudo-rank instead.  ``timing=True`` stamps a latency
@@ -152,12 +152,17 @@ def pack_frame(msg_type: int, table_id: int, msg_id: int, *,
     ``qos=(class_id, budget_ns)`` stamps the tenant class + remaining
     deadline budget after the audit stamp (docs/serving.md "tail") —
     the reactor budgets reads per class and drops a read already past
-    its deadline at dequeue instead of burning an apply slot."""
+    its deadline at dequeue instead of burning an apply slot.
+    ``shard`` stamps the target shard index (docs/replication.md): a
+    post-failover rank serves TWO shards of a table, so the shard hint
+    — not the connected rank — names which one this read wants; it
+    rides the old header pad slot biased by one (-1 = no hint, the
+    pre-replication wire, byte-identical)."""
     flags = (_ACCEPT_RAW | (FLAG_TIMING if timing else 0)
              | (FLAG_AUDIT if audit is not None else 0)
              | (FLAG_QOS if qos is not None else 0))
     body = HEADER.pack(-1, -1, msg_type, table_id, msg_id, 0, version,
-                       0, flags, len(blobs), 0)
+                       0, flags, len(blobs), int(shard) + 1)
     if timing:
         now = time.monotonic_ns()
         body += TIMING.pack(now, now, 0, 0, 0, 0)
@@ -173,7 +178,7 @@ def pack_frame(msg_type: int, table_id: int, msg_id: int, *,
 def unpack_frame(body: bytes) -> dict:
     """Decode one frame body (the bytes after the length prefix)."""
     (src, dst, mtype, table_id, msg_id, trace_id, version, codec, flags,
-     num_blobs, _pad) = HEADER.unpack_from(body, 0)
+     num_blobs, shard_hint) = HEADER.unpack_from(body, 0)
     blobs = []
     pos = HEADER.size
     timing = None
@@ -198,6 +203,7 @@ def unpack_frame(body: bytes) -> dict:
             "type_name": _TYPE_NAME.get(mtype, str(mtype)),
             "table_id": table_id, "msg_id": msg_id, "trace_id": trace_id,
             "version": version, "codec": codec, "flags": flags,
+            "shard": shard_hint - 1,
             "timing": timing, "audit": audit, "qos": qos, "blobs": blobs}
 
 
@@ -408,18 +414,23 @@ class AnonServeClient:
         _check(reply, mid, "ReplyGet")
         return np.frombuffer(reply["blobs"][0], dtype=np.float32)
 
-    def get_rows(self, table_id: int, row_ids, cols: int) -> np.ndarray:
+    def get_rows(self, table_id: int, row_ids, cols: int,
+                 shard: int = -1) -> np.ndarray:
         """Row-subset read of a matrix table (RequestGet with an int32
         GLOBAL-row-id blob, the same request shape rank workers send):
         the contacted shard answers its rows in request order —
         mis-routed/out-of-range ids read as zeros, so callers aim at
-        the shard that owns their rows.  Returns a read-only
-        ``(k, cols)`` float32 view over the reply bytes."""
+        the shard that owns their rows.  ``shard`` stamps the shard
+        hint (docs/replication.md): required when reading a BACKUP or
+        promoted shard, whose host rank serves two shards of the
+        table.  Returns a read-only ``(k, cols)`` float32 view over
+        the reply bytes."""
         ids = np.ascontiguousarray(row_ids, dtype=np.int32)
         mid = self._next_id()
         self.send_raw(pack_frame(MSG["RequestGet"], table_id, mid,
                                  blobs=[ids.tobytes()],
-                                 timing=self.timing, qos=self._qos()))
+                                 timing=self.timing, qos=self._qos(),
+                                 shard=shard))
         reply = self.recv_reply()
         _check(reply, mid, "ReplyGet")
         out = np.frombuffer(reply["blobs"][0], dtype=np.float32)

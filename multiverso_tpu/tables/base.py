@@ -210,6 +210,9 @@ class Table:
         self._serve_version = 0
         self._serve_buckets = None              # lazily [SERVE_BUCKETS]
         self._serve_ver_lock = threading.Lock()
+        # Fleet routing epoch last adopted (docs/replication.md): a
+        # promotion/join flip voids the serve cache via note_routing_epoch.
+        self._routing_epoch = 0
         self._serve_staleness = int(
             config.get("max_staleness") if max_staleness is None
             else max_staleness)
@@ -632,6 +635,31 @@ class Table:
                                               np.int64)
             idx = np.asarray(list(buckets), np.int64) % self.SERVE_BUCKETS
             self._serve_buckets[idx] = v
+
+    def note_routing_epoch(self, epoch: int) -> None:
+        """Adopt a fleet routing-epoch observation (docs/replication.md).
+
+        Callers bridging this table to the native serve plane (demo
+        drivers, apps gluing both planes) feed the epoch from
+        ``NativeRuntime.routing_epoch()`` / an ops ``"replication"``
+        scrape here; a FLIP means a shard was promoted or joined, so
+        every cached serve entry — stamped under the previous shard
+        owner's version timeline — is voided by a whole-table bump.
+        Monotonic: stale observations are ignored (the PR 4 max-merge
+        discipline).  MV017's rule in one line: never carry a cached
+        shard-routing decision across a wire call without re-checking
+        this epoch."""
+        with self._serve_ver_lock:
+            if epoch <= self._routing_epoch:
+                return
+            self._routing_epoch = int(epoch)
+        self._serve_bump()  # route flip = cached reads are void
+
+    @property
+    def routing_epoch(self) -> int:
+        """Last adopted fleet routing epoch (0 = registration map)."""
+        with self._serve_ver_lock:
+            return self._routing_epoch
 
     def _serve_current_many(self, buckets):
         """Per-bucket version estimates for a batch of reads — ONE lock

@@ -271,6 +271,40 @@ def test_gate_passes_in_band_audit_line(tmp_path):
     assert rc == 0, out
 
 
+def test_gate_guards_failover_keys(tmp_path):
+    """bench_failover acceptance bars (docs/replication.md): detection
+    or promotion drifting past seconds, a caller-visible blackout past
+    the rpc-deadline+lease bound, ANY lost acked add (zero tolerance —
+    the sync-replication contract), or replication read overhead past
+    the 3% bar must all fail the gate."""
+    line = {"extras": {"failover_detect_ms": 9000.0,      # lease blind
+                       "failover_promote_ms": 12000.0,    # stuck epoch
+                       "failover_p99_blip_ms": 30000.0,   # outage
+                       "failover_lost_acked_adds": 1.0,   # THE violation
+                       "repl_overhead_pct": 8.0}}         # > 3% bar
+    p = tmp_path / "failover_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "failover_detect_ms" in out and "FAIL" in out, out
+    assert "failover_promote_ms" in out, out
+    assert "failover_p99_blip_ms" in out, out
+    assert "failover_lost_acked_adds" in out, out
+    assert "repl_overhead_pct" in out, out
+
+
+def test_gate_passes_in_band_failover_line(tmp_path):
+    line = {"extras": {"failover_detect_ms": 1600.0,
+                       "failover_promote_ms": 1700.0,
+                       "failover_p99_blip_ms": 1800.0,
+                       "failover_lost_acked_adds": 0.0,
+                       "repl_overhead_pct": 0.5}}
+    p = tmp_path / "failover_ok.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
 def test_last_parseable_line_wins(tmp_path):
     """Schema-7 cumulative emission: the LAST line is the freshest
     cumulative state and must shadow earlier partials."""

@@ -1,0 +1,445 @@
+"""Tier-1 gate for shard replication + lease-triggered failover +
+elastic membership (docs/replication.md): the shard-hint wire mirror,
+the routing-epoch cache discipline (ServeClient + JAX-plane Table),
+mvtop's replication view from a canned scrape, the native chaos
+scenarios on BOTH wire engines (SIGKILL a server under load → backup
+promoted inside the lease window, exact convergence, dup-idempotent
+replays) and the live elastic join, the Python fleet acceptance
+(SIGKILL + mvaudit zero lost acked adds + CRC beacon convergence on
+the promoted shard), the symmetric-lease regression (rank 0 is the
+corpse, a survivor detects and promotes), and the true-backup hedge
+under a seeded apply_delay straggler."""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "multiverso_tpu", "native")
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+# ---------------------------------------------------------- wire mirror
+
+def test_shard_hint_frame_roundtrip():
+    """The shard hint rides the old pad slot biased by one: stamped
+    frames round-trip it, unstamped frames stay byte-identical to the
+    pre-replication wire and parse as hint -1."""
+    from multiverso_tpu.serve.wire import MSG, pack_frame, unpack_frame
+
+    msg = unpack_frame(pack_frame(MSG["RequestGet"], 0, 7, shard=3)[8:])
+    assert msg["shard"] == 3
+    old = unpack_frame(pack_frame(MSG["RequestGet"], 0, 7)[8:])
+    assert old["shard"] == -1
+    # The unhinted frame is bit-identical to the pre-replication one.
+    assert pack_frame(MSG["RequestGet"], 0, 7, shard=-1) == \
+        pack_frame(MSG["RequestGet"], 0, 7)
+
+
+def test_shard_hint_composes_with_stamps():
+    from multiverso_tpu.serve.wire import MSG, pack_frame, unpack_frame
+
+    msg = unpack_frame(pack_frame(MSG["RequestGet"], 1, 2,
+                                  blobs=[b"payload8"], timing=True,
+                                  audit=(5, 5), qos=(1, 10), shard=2)[8:])
+    assert msg["shard"] == 2 and msg["audit"] == (5, 5)
+    assert msg["qos"] == (1, 10) and msg["blobs"] == [b"payload8"]
+
+
+# ------------------------------------------- routing-epoch cache rules
+
+class _StubRt:
+    """Minimal runtime for ServeClient: versioned array serving with a
+    mutable routing epoch."""
+
+    def __init__(self):
+        self.value = np.arange(4, dtype=np.float32)
+        self.version = 1
+        self.epoch = 0
+        self.fetches = 0
+
+    def routing_epoch(self):
+        return self.epoch
+
+    def last_version(self, handle):
+        return self.version
+
+    def table_version(self, handle):
+        return self.version
+
+    def array_get(self, handle, size):
+        self.fetches += 1
+        return self.value.copy()
+
+
+def test_serve_client_drops_cache_on_epoch_flip():
+    """A routing-epoch flip voids the serve cache and version leases:
+    cached entries were stamped under the previous shard owner's
+    version timeline (docs/replication.md)."""
+    from multiverso_tpu.serve.client import ServeClient
+
+    rt = _StubRt()
+    c = ServeClient(rt, cache_entries=8, max_staleness=10,
+                    window_us=0.0, lease_ms=60000.0)
+    a = c.array_get(0, 4)
+    b = c.array_get(0, 4)
+    assert rt.fetches == 1 and np.allclose(a, b)  # second read: cache hit
+    rt.epoch = 1                                  # promotion happened
+    rt.value = rt.value + 100.0                   # new owner's bytes
+    got = c.array_get(0, 4)
+    assert rt.fetches == 2, "epoch flip must force a re-fetch"
+    assert np.allclose(got, rt.value)
+    # Stable epoch: caching resumes.
+    c.array_get(0, 4)
+    assert rt.fetches == 2
+
+
+def test_table_note_routing_epoch_is_monotonic_and_invalidating():
+    from multiverso_tpu.tables.base import Table
+
+    t = Table.__new__(Table)
+    import threading
+
+    t._serve_version = 0
+    t._serve_buckets = None
+    t._serve_ver_lock = threading.Lock()
+    t._routing_epoch = 0
+    t._workload = None
+    t._serve_cache = {}  # truthy: _serve_bump must bump the version
+    v0 = t._serve_version
+    t.note_routing_epoch(5)
+    assert t.routing_epoch == 5
+    assert t._serve_version > v0   # flip voided every cached entry
+    v1 = t._serve_version
+    t.note_routing_epoch(3)        # stale observation: ignored
+    assert t.routing_epoch == 5 and t._serve_version == v1
+
+
+# ------------------------------------------------- mvtop canned scrape
+
+def test_mvtop_replication_rows_from_canned_scrape():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import mvtop
+
+    doc = {"ranks": {
+        "0": {"rank": 0, "armed": True, "sync": True, "epoch": 1026,
+              "backup_shard": 2, "owners": [0, 2, 2], "backups": [-1, -1, 0],
+              "promoted": [], "outstanding": 1,
+              "stats": {"forwards": 9, "acks": 8, "applied": 4,
+                        "promotions": 0, "epoch_flips": 1,
+                        "dup_skips": 0, "catchups": 0}},
+        "2": {"rank": 2, "armed": True, "sync": True, "epoch": 1026,
+              "backup_shard": 1, "owners": [0, 2, 2], "backups": [-1, -1, 0],
+              "promoted": [1], "outstanding": 0,
+              "stats": {"forwards": 3, "acks": 3, "applied": 9,
+                        "promotions": 1, "epoch_flips": 0,
+                        "dup_skips": 2, "catchups": 0}},
+    }, "silent": [1]}
+    rows = mvtop.repl_rows(doc)
+    by_rank = {r["rank"]: r for r in rows}
+    assert by_rank["0"]["epoch"] == 1026 and by_rank["0"]["fwd"] == 9
+    assert by_rank["2"]["promoted"] == "1"
+    assert by_rank["2"]["dup_skip"] == 2
+    assert by_rank[1]["armed"] == "SILENT"
+
+
+# ------------------------------------------------ native chaos (tier-1)
+
+def _binary():
+    subprocess.run(["make", "-C", NATIVE_DIR, "-j4", "all"], check=True,
+                   capture_output=True)
+    return os.path.join(NATIVE_DIR, "build", "mvtpu_test")
+
+
+def _machine_file(tmp_path, n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = os.path.join(str(tmp_path), "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+    return mf, eps
+
+
+@needs_gxx
+@pytest.mark.parametrize("engine", ["epoll", "tcp"])
+def test_native_failover_scenario(tmp_path, engine):
+    """The chaos acceptance on BOTH wire engines: a 3-rank replicated
+    fleet crashes rank 1 mid-run — the survivors detect the expired
+    lease symmetrically, rank 2 promotes shard 1 and broadcasts the
+    epoch flip, re-routed adds land, the dup-idempotence gate keeps a
+    re-delivered stamped frame from double-applying, and the fleet
+    converges to EXACT values including the promoted shard."""
+    b = _binary()
+    mf, _ = _machine_file(tmp_path, 3)
+    procs = [subprocess.Popen([b, "failover_child", mf, str(r), engine],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(3)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r in (0, 2):
+        assert procs[r].returncode == 0, f"rank {r}:\n{outs[r][-4000:]}"
+        assert f"FAILOVER_OK {r}" in outs[r], outs[r][-2000:]
+
+
+@needs_gxx
+def test_native_elastic_join_scenario(tmp_path):
+    """Elastic membership: a worker-only rank joins the replication
+    set live (announce → whole-shard catch-up snapshot → forwarded
+    deltas), re-runs the catch-up idempotently (the kill-mid-catch-up
+    recovery path), then takes the shard over via an operator-driven
+    promotion — traffic re-routes with exact values, no restart."""
+    b = _binary()
+    _, eps = _machine_file(tmp_path, 3)
+    ctrl = eps[0]
+    ports = [ep.rsplit(":", 1)[1] for ep in eps]
+    specs = [("all", ports[0], "true"), ("server", ports[1], "false"),
+             ("worker", ports[2], "false")]
+    procs = []
+    for i, (role, port, is_ctrl) in enumerate(specs):
+        procs.append(subprocess.Popen(
+            [b, "join_child", ctrl, port, role, "3", is_ctrl],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        if i == 0:
+            time.sleep(0.3)  # the controller must be listening first
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for (role, _, _), p, out in zip(specs, procs, outs):
+        assert p.returncode == 0, f"{role}:\n{out[-4000:]}"
+        assert f"JOIN_OK {role}" in out, out[-2000:]
+
+
+# --------------------------------------------- Python fleet acceptance
+
+def _spawn_fleet(tmp_path, nranks=3, extra=()):
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    mf, eps = _machine_file(tmp_path, nranks)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "tests", "failover_worker.py"),
+             mf, str(r), *map(str, extra)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
+        for r in range(nranks)
+    ]
+    for p in procs:
+        line = p.stdout.readline()
+        assert "FAILOVER_READY" in line, line
+    return eps, procs
+
+
+def _send(p, cmd):
+    p.stdin.write(cmd + "\n")
+    p.stdin.flush()
+
+
+def _collect(p, cmd, reply_prefix=None):
+    reply = None
+    while True:
+        line = p.stdout.readline()
+        assert line, f"worker died mid-command {cmd!r}"
+        if reply_prefix and line.startswith(reply_prefix):
+            reply = line[len(reply_prefix):].strip()
+        if line.startswith("OK "):
+            return reply
+
+
+def _cmd(p, cmd, reply_prefix=None):
+    """Send one command; collect lines until its OK ack, returning the
+    reply line with ``reply_prefix`` (if any)."""
+    _send(p, cmd)
+    return _collect(p, cmd, reply_prefix)
+
+
+def _cmd_all(procs, cmd, reply_prefix=None):
+    """Issue one command to SEVERAL workers concurrently (collective
+    ops like barrier rendezvous across them — sequencing would
+    deadlock the quorum), then collect each reply."""
+    for p in procs:
+        _send(p, cmd)
+    return [_collect(p, cmd, reply_prefix) for p in procs]
+
+
+def _finish(procs, timeout=60):
+    outs = []
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.stdin.write("done\n")
+                p.stdin.flush()
+            except (BrokenPipeError, OSError):
+                pass
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=timeout)[0])
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs.append(p.communicate()[0])
+    return outs
+
+
+@needs_gxx
+def test_failover_fleet_zero_lost_acked_adds(tmp_path):
+    """The full acceptance: SIGKILL server rank 1 under a replicated
+    3-rank fleet — the backup promotes within the lease window, the
+    promoted shard's CRC beacons match the pre-kill primary's last
+    audited state, survivors' re-routed adds converge to exact values,
+    and ``ops.audit.diff_fleet`` over the survivor-assembled fleet
+    report proves ZERO lost acked adds and zero aged gaps."""
+    from multiverso_tpu.ops.audit import diff_fleet
+
+    eps, procs = _spawn_fleet(tmp_path)
+    try:
+        # The victim's last audited shard state (its OWN shard = 1).
+        pre = json.loads(_cmd(procs[1], "sums", "SUMS "))
+        assert pre["server"], pre
+
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait(timeout=30)
+
+        # Symmetric detection + promotion within the lease window,
+        # observed on BOTH survivors.
+        assert int(_cmd(procs[2], "waitdead 1", "DEAD ")) >= 1
+        assert _cmd(procs[2], "waitowner 1 2", "OWNER ") == "1=2"
+        assert _cmd(procs[0], "waitowner 1 2", "OWNER ") == "1=2"
+
+        # CRC beacons: the promoted (backup) shard instance on rank 2
+        # holds EXACTLY the dead primary's last audited bytes — sync
+        # replication made every acked add present on both replicas.
+        post = json.loads(_cmd(procs[2], "sums", "SUMS "))
+        assert post["backup_shard"] == 1
+        assert post["backup"] == pre["server"], (pre, post)
+
+        # Re-routed traffic: two more acked rounds from each survivor.
+        for p in (procs[0], procs[2]):
+            _cmd(p, "add 1")
+            _cmd(p, "add 1")
+        # Survivor rendezvous (concurrent — it is a collective): the
+        # dead-leased rank is excused from the quorum.
+        assert _cmd_all([procs[0], procs[2]], "barrier",
+                        "BARRIER ") == ["ok", "ok"]
+        vals = json.loads(_cmd(procs[0], "get", "VALUES "))
+        assert all(v == 7.0 for v in vals["array"]), vals  # 3 + 2*2
+        assert all(s == 7.0 * 4 for s in vals["row_sums"]), vals
+
+        # The auditor's verdict, assembled BY a survivor over the rank
+        # wire: zero lost acked adds, zero aged gaps (the dead rank is
+        # silent, not lossy — its books died with it).
+        fleet = json.loads(_cmd(procs[0], "audit_fleet", "AUDIT_FLEET "))
+        findings = diff_fleet(fleet)
+        lost = [f for f in findings if f["kind"] == "lost"]
+        aged = [f for f in findings
+                if f["kind"] == "gap" and f.get("aged")]
+        assert lost == [] and aged == [], findings
+
+        repl = json.loads(_cmd(procs[0], "repl_fleet", "REPL_FLEET "))
+        r2 = repl["ranks"]["2"]
+        assert r2["promoted"] == [1] and r2["epoch"] > 0, r2
+        assert r2["stats"]["promotions"] >= 1
+    finally:
+        outs = _finish(procs)
+    for r in (0, 2):
+        assert f"FAILOVER_WORKER_OK {r}" in outs[r], outs[r][-3000:]
+
+
+@needs_gxx
+def test_rank0_kill_detected_and_promoted_by_survivor(tmp_path):
+    """Symmetric lease watching (the satellite bugfix): rank 0 — the
+    old, only lease authority — is the corpse; a SURVIVOR detects the
+    expiry on its own (hb.missed counts there now), and shard 0's
+    backup (server 1 in the chain) promotes without rank 0's help."""
+    eps, procs = _spawn_fleet(tmp_path)
+    try:
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=30)
+
+        assert int(_cmd(procs[1], "waitdead 1", "DEAD ")) >= 1
+        assert int(_cmd(procs[2], "waitdead 1", "DEAD ")) >= 1
+        missed = _cmd(procs[1], "mon hb.missed", "MON ")
+        assert int(missed.split("=")[1]) >= 1, missed
+        # Chained assignment: shard 0's backup is server 1 — it
+        # self-triggers promotion with the lease authority dead.
+        assert _cmd(procs[1], "waitowner 0 1", "OWNER ") == "0=1"
+        repl = json.loads(_cmd(procs[1], "repl", "REPL "))
+        assert repl["stats"]["promotions"] >= 1, repl
+    finally:
+        # No barrier authority is left: hard exit, state already proven.
+        for p in procs[1:]:
+            if p.poll() is None:
+                try:
+                    p.stdin.write("exit_hard\n")
+                    p.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    pass
+        for p in procs:
+            try:
+                p.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+
+
+@needs_gxx
+def test_hedge_wins_against_true_backup_under_straggler(tmp_path):
+    """Satellite: serve/hedge.py hedges against the TRUE backup shard
+    when replication is armed — a seeded apply_delay straggler on the
+    primary naps every apply, the hedge re-issues at the backup rank
+    (shard hint routes it into the backed instance), values are exact,
+    and serve.hedge.backup wins are counted."""
+    from multiverso_tpu.serve.hedge import HedgedReader
+
+    eps, procs = _spawn_fleet(tmp_path, nranks=2)
+    try:
+        # Straggle rank 0 (primary of shard 0, rows 0..5): every apply
+        # naps 400 ms; the hedge should win LONG before that.
+        _cmd(procs[0], "fault_rate delay_ms 400")
+        _cmd(procs[0], "fault_rate apply_delay 1.0")
+
+        with HedgedReader(eps[0], table_id=1, cols=4, hedge_min_us=2000,
+                          backup_endpoint=eps[1], backup_shard=0,
+                          timeout=20.0) as reader:
+            t0 = time.monotonic()
+            rows = reader.get_rows([0, 1, 2, 3])
+            elapsed = time.monotonic() - t0
+            # Warm adds were 2 ranks x ones → every element exactly 2.
+            assert np.allclose(rows, 2.0), rows
+            st = reader.stats()
+            assert st["issued"] >= 1 and st["won"] >= 1, st
+            assert st["backup_wins"] >= 1, st
+            assert elapsed < 0.35, f"hedge should beat the 400ms nap " \
+                                   f"(took {elapsed:.3f}s)"
+        _cmd(procs[0], "clear")
+    finally:
+        outs = _finish(procs)
+    for r in range(2):
+        assert f"FAILOVER_WORKER_OK {r}" in outs[r], outs[r][-3000:]

@@ -373,7 +373,12 @@ def test_native_tsan_scenarios(native, tmp_path):
                                     # Borrowed arena sends under
                                     # drop/dup/delay (host_bridge.md).
                                     ("bridge_child", 2, ("epoll",)),
-                                    ("embed_child", 2, ("epoll",))]:
+                                    ("embed_child", 2, ("epoll",)),
+                                    # Replication forward + promotion
+                                    # race (docs/replication.md): the
+                                    # new hot surface — rank 1 dies
+                                    # mid-fleet, rank 2 promotes.
+                                    ("failover_child", 3, ("epoll",))]:
         mf = _machine_file(tmp_path, nprocs)  # rewritten per scenario
         procs = [subprocess.Popen([tsan_bin, scenario, mf, str(r), *extra],
                                   stdout=subprocess.PIPE,
@@ -424,7 +429,12 @@ def test_native_asan_scenarios(native, tmp_path):
                                     # drop/dup/delay: the use-after-
                                     # recycle class lives here.
                                     ("bridge_child", 2, ("epoll",)),
-                                    ("embed_child", 2, ("epoll",))]:
+                                    ("embed_child", 2, ("epoll",)),
+                                    # Replication forward + promotion
+                                    # race: a SIGKILLed rank's frames
+                                    # die mid-wire while its backup
+                                    # installs as serving.
+                                    ("failover_child", 3, ("epoll",))]:
         mf = _machine_file(tmp_path, nprocs)  # rewritten per scenario
         procs = [subprocess.Popen([asan_bin, scenario, mf, str(r), *extra],
                                   stdout=subprocess.PIPE,

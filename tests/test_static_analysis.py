@@ -735,6 +735,71 @@ def test_mv016_out_of_scope_and_suppressible(tmp_path):
     assert _lint_src(tmp_path, suppressed) == []
 
 
+def test_mv017_fires_on_cached_route_across_wire(tmp_path):
+    """A shard routing decision (modulo math or placement lookup)
+    carried across wire calls with no routing-epoch re-check: after a
+    failover the map flips and the cached route points at a corpse
+    (docs/replication.md)."""
+    rules = _lint_src(tmp_path, """\
+        def bad_modulo(client, table, ids, shards):
+            owner = ids[0] % shards                        # BAD: cached
+            for i in ids:
+                client.get_rows(table, [i], 4)
+            return owner
+
+        def bad_lookup(rt, table, shard):
+            rank = rt.shard_owner(shard)                   # BAD: cached
+            rt.array_get(table, 8)
+            return rank
+
+        def bad_attr_shards(self, client, row):
+            target = row % self.num_servers                # BAD: cached
+            client.send_raw(b"frame")
+            return target
+        """)
+    assert [r for r, _ in rules] == ["MV017"] * 3, rules
+
+
+def test_mv017_epoch_check_and_no_wire_are_legal(tmp_path):
+    rules = _lint_src(tmp_path, """\
+        def fine_rechecked(rt, client, table, ids, shards):
+            if rt.routing_epoch() != getattr(rt, "_seen", 0):
+                rt._seen = rt.routing_epoch()
+            owner = ids[0] % shards
+            client.get_rows(table, ids, 4)
+            return owner
+
+        def fine_no_wire(ids, shards):
+            # SPMD-plane shard math: no wire call, no staleness risk.
+            return [i % shards for i in ids]
+
+        def fine_route_after_wire(client, table, ids, shards):
+            # The wire call precedes the routing decision — nothing is
+            # carried across it.
+            client.get_rows(table, ids, 4)
+            return ids[0] % shards
+        """)
+    assert rules == [], rules
+
+
+def test_mv017_out_of_scope_and_suppressible(tmp_path):
+    src = """\
+        def f(client, table, ids, shards):
+            owner = ids[0] % shards
+            client.get_rows(table, ids, 4)
+            return owner
+        """
+    assert [r for r, _ in _lint_src(tmp_path, src)] == ["MV017"]
+    # Tests are out of scope: a regression test may pin a route on
+    # purpose (e.g. to prove the OLD route fails post-promotion).
+    assert _lint_src(tmp_path, src, name="test_pinned_route.py") == []
+    suppressed = src.replace(
+        "owner = ids[0] % shards",
+        "owner = ids[0] % shards"
+        "  # mvlint: disable=MV017 — pre-replication fixture")
+    assert _lint_src(tmp_path, suppressed) == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""failover-demo — acceptance smoke for shard replication +
+lease-triggered failover (docs/replication.md; ``make failover-demo``).
+
+Spawns a THREE-server replicated fleet (``-replication_factor=1``,
+sync forwarding, fast symmetric leases) and kills the middle of it:
+
+(a) **Warm + herd** — every rank lands acked adds (each ack certifies
+    BOTH replicas applied, by the sync contract) while an anonymous
+    raw-socket herd reads the survivors' shards throughout.
+(b) **SIGKILL the primary** — rank 1 dies mid-herd with no goodbye.
+    Its backup (rank 2, chained assignment) must detect the expired
+    lease ON ITS OWN (symmetric watching), promote shard 1, and
+    broadcast the routing-epoch flip — all inside a few lease windows.
+(c) **Beacons** — the promoted shard's per-bucket CRC32 checksums must
+    equal the dead primary's last audited state bit for bit.
+(d) **Converge** — survivors' re-routed adds land; the fleet barrier
+    excuses the corpse; final values are EXACT.
+(e) **Audit** — ``tools/mvaudit.py --settle`` over a survivor-scraped
+    fleet report must exit 0: zero lost acked adds, zero aged gaps.
+(f) **Ops** — ``mvtop --replication`` (fleet scope) shows the epoch
+    flip and the promoted shard on rank 2.
+
+Prints ``FAILOVER_DEMO_OK`` and exits 0 on success.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+HERD = 12
+
+
+def _cmd(p, cmd, reply_prefix=None):
+    p.stdin.write(cmd + "\n")
+    p.stdin.flush()
+    reply = None
+    while True:
+        line = p.stdout.readline()
+        assert line, f"worker died mid-command {cmd!r}"
+        if reply_prefix and line.startswith(reply_prefix):
+            reply = line[len(reply_prefix):].strip()
+        if line.startswith("OK "):
+            return reply
+
+
+def main() -> int:
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    from multiverso_tpu.serve.wire import AnonServeClient
+    import mvtop
+
+    socks = [socket.socket() for _ in range(3)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = os.path.join(tempfile.mkdtemp(prefix="mvtpu_failover_"),
+                      "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+
+    worker = os.path.join(REPO, "tests", "failover_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = [subprocess.Popen([sys.executable, worker, mf, str(r)],
+                              stdin=subprocess.PIPE,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              env=env)
+             for r in range(3)]
+    for p in procs:
+        assert "FAILOVER_READY" in p.stdout.readline()
+    print(f"fleet up: 3 replicated ranks @ {eps}")
+
+    # (a) anonymous herd against the survivors, running through the
+    # kill — live fan-in load is the acceptance condition's backdrop.
+    stop = threading.Event()
+    served = [0]
+
+    def herd(ep):
+        try:
+            c = AnonServeClient(ep, timeout=10.0, timing=False)
+            while not stop.is_set():
+                c.get_shard(0)
+                served[0] += 1
+        except (ConnectionError, OSError):
+            pass
+
+    threads = [threading.Thread(target=herd, args=(eps[r],), daemon=True)
+               for r in (0, 2) for _ in range(HERD // 2)]
+    for t in threads:
+        t.start()
+
+    pre = json.loads(_cmd(procs[1], "sums", "SUMS "))
+    assert pre["server"], pre
+
+    # (b) SIGKILL the primary of shard 1, mid-herd.
+    t_kill = time.monotonic()
+    procs[1].send_signal(signal.SIGKILL)
+    procs[1].wait(timeout=30)
+    print("rank 1 SIGKILLed mid-herd")
+
+    assert int(_cmd(procs[2], "waitdead 1", "DEAD ")) >= 1
+    t_detect = time.monotonic() - t_kill
+    assert _cmd(procs[2], "waitowner 1 2", "OWNER ") == "1=2"
+    t_promote = time.monotonic() - t_kill
+    assert _cmd(procs[0], "waitowner 1 2", "OWNER ") == "1=2"
+    print(f"lease expiry detected by the BACKUP in {t_detect * 1e3:.0f} "
+          f"ms; shard 1 promoted + epoch adopted in "
+          f"{t_promote * 1e3:.0f} ms")
+    assert t_promote < 10.0, "promotion must land within seconds"
+
+    # (c) CRC beacons: the promoted shard == the dead primary's last
+    # audited state.
+    post = json.loads(_cmd(procs[2], "sums", "SUMS "))
+    assert post["backup_shard"] == 1
+    assert post["backup"] == pre["server"], (pre, post)
+    print("CRC beacons on the promoted shard match the pre-kill "
+          "primary's last audited state")
+
+    # (d) converge through the flipped route.
+    for p in (procs[0], procs[2]):
+        _cmd(p, "add 1")
+    for p in (procs[0], procs[2]):
+        p.stdin.write("barrier\n")
+        p.stdin.flush()
+    for p in (procs[0], procs[2]):
+        while True:
+            line = p.stdout.readline()
+            if line.startswith("BARRIER "):
+                assert line.strip() == "BARRIER ok", line
+            if line.startswith("OK "):
+                break
+    vals = json.loads(_cmd(procs[0], "get", "VALUES "))
+    assert all(v == 5.0 for v in vals["array"]), vals  # 3 warm + 2
+    print(f"exact convergence through the promoted shard: "
+          f"array == {vals['array'][0]} everywhere")
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    print(f"anonymous herd served {served[0]} reads across the kill")
+
+    # (e) the auditor's verdict through a SURVIVOR.
+    import mvaudit
+
+    rc = mvaudit.main([eps[0], "--settle", "0.5"])
+    assert rc == 0, "mvaudit must prove zero lost acked adds"
+    print("mvaudit --settle: zero lost acked adds, zero aged gaps")
+
+    # (f) mvtop --replication shows the flip.
+    rows = mvtop.collect_replication([eps[0]], fleet=True, timeout=10)
+    by_rank = {str(r["rank"]): r for r in rows}
+    assert by_rank["2"]["promoted"] == "1", rows
+    assert int(by_rank["2"]["epoch"]) > 0, rows
+    print(mvtop.render(rows, mvtop._REPL_COLS))
+
+    for p in (procs[0], procs[2]):
+        p.stdin.write("done\n")
+        p.stdin.flush()
+    for r in (0, 2):
+        out = procs[r].communicate(timeout=60)[0]
+        assert f"FAILOVER_WORKER_OK {r}" in out, out[-2000:]
+
+    print("FAILOVER_DEMO_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -152,6 +152,20 @@ Rules (docs/static_analysis.md has the full rationale):
   unstamped pre-13 frame is the point (version-tolerance tests, the
   stamp-overhead A/B baseline).  Tests are out of scope.
 
+- **MV017 stale-shard-route** — code that computes a table→shard
+  routing decision (a rank/owner from ``row % shards``-style math or a
+  placement lookup like ``server_rank()`` / ``shard_owner()`` /
+  ``OwnerOf``) and then carries it across wire calls WITHOUT ever
+  re-checking the routing epoch: after a failover promotion or an
+  elastic join the shard→rank map flips (docs/replication.md), and a
+  cached pre-flip route sends traffic at a corpse — the retry storm
+  the epoch broadcast exists to prevent.  Consult
+  ``routing_epoch()`` / ``note_routing_epoch()`` /
+  ``_check_routing_epoch()`` in the same function (re-resolving per
+  call is also fine — then don't cache), or suppress genuinely
+  pre-replication sites with the marker and a reason.  Tests and the
+  SPMD collective plane (no wire) are out of scope.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -915,6 +929,90 @@ def check_serve_read_without_deadline(tree, path):
     return out
 
 
+# ---------------------------------------------------------------- MV017
+# Placement-lookup call names that mint a shard→rank routing decision.
+ROUTING_LOOKUPS = {"server_rank", "shard_owner", "owner_of", "OwnerOf",
+                   "shard_of", "ShardOf"}
+# Names whose presence anywhere in the function counts as an epoch
+# re-check (or adoption) — the discipline MV017 enforces.
+EPOCH_CHECKS = {"routing_epoch", "note_routing_epoch",
+                "_check_routing_epoch"}
+# Wire-surface call names a cached route must not be carried across:
+# the native-runtime / serve-client / raw-frame read-write entry
+# points (SPMD-plane shard math never reaches these).
+ROUTE_WIRE_CALLS = {"send_raw", "recv_reply", "get_shard", "get_rows",
+                    "get_replica", "table_version", "array_get",
+                    "array_add", "matrix_get_rows", "matrix_get_all",
+                    "add_rows", "matrix_add_rows", "kv_get", "kv_add"}
+
+
+def _shardish_name(node) -> bool:
+    name = None
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    return bool(name) and bool(
+        re.search(r"(?:^|_)(?:n(?:um)?_?)?(?:servers?|shards?)$", name))
+
+
+def _routing_decision(node) -> bool:
+    """An expression that derives a shard owner: `x % shards`-style
+    modulo against a shard/server count, or a placement lookup call."""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        return _shardish_name(node.right)
+    if isinstance(node, ast.Call):
+        return _call_name(node.func) in ROUTING_LOOKUPS
+    return False
+
+
+def check_stale_shard_route(tree, path):
+    """MV017: a routing decision cached across wire calls with no
+    routing-epoch re-check anywhere in the function."""
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # Any epoch consultation in the function satisfies the rule.
+        checked = False
+        for node in ast.walk(fn):
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            if name in EPOCH_CHECKS:
+                checked = True
+                break
+        if checked:
+            continue
+        route_lines = []   # assignments that CACHE a routing decision
+        wire_lines = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for sub in ast.walk(node.value):
+                    if _routing_decision(sub):
+                        route_lines.append(node.lineno)
+                        break
+            if isinstance(node, ast.Call) and \
+                    _call_name(node.func) in ROUTE_WIRE_CALLS:
+                wire_lines.append(node.lineno)
+        for rl in route_lines:
+            if any(wl > rl for wl in wire_lines):
+                out.append(Finding(
+                    path, rl, "MV017",
+                    "table→shard routing decision cached across a wire "
+                    "call with no routing-epoch re-check: after a "
+                    "failover promotion / elastic join the shard→rank "
+                    "map flips (docs/replication.md) and this route "
+                    "points at a corpse — consult routing_epoch() in "
+                    "this function (or re-resolve per call), or "
+                    "suppress a genuinely pre-replication site with a "
+                    "reason"))
+                break  # one finding per function is enough signal
+    return out
+
+
 # ---------------------------------------------------------------- MV015
 # Native/wire/table call evidence: a try block touching any of these is
 # on a delivery path whose failures must not vanish into `except: pass`.
@@ -1092,6 +1190,10 @@ def lint_file(path):
         # ad-hoc arrays, and the seeded-violation suite must be able
         # to spell the violation).
         findings += check_bridge_copy_churn(tree, path)
+        # MV017: shard routes cached across wire calls must re-check
+        # the routing epoch (docs/replication.md) — runtime + tools +
+        # apps scope; tests legitimately pin routes.
+        findings += check_stale_shard_route(tree, path)
     # App/model plane: the batched-row-call discipline (the serve/wire
     # layers amortize per CALL, so a per-row Python loop defeats every
     # one of them at once).

@@ -33,6 +33,13 @@ admit/shed totals, and deadline sheds, plus the rank's hedge-cancel
 ledger; under ``--watch`` two-scrape ``admit/s``/``shed/s`` rate
 columns join under the same ``-``-before-two-scrapes discipline.
 
+``--replication`` switches to the replication view (the
+``"replication"`` OpsQuery kind, docs/replication.md): one row per
+rank with the routing epoch, the shard→owner and shard→backup maps,
+which shard the rank backs, its promoted shards, and the
+forward/ack/catch-up ledger — the epoch flip after a failover reads
+directly off the ``epoch``/``owners``/``promoted`` columns.
+
 Usage::
 
     python tools/mvtop.py HOST:PORT [HOST:PORT ...]       # one snapshot
@@ -40,6 +47,7 @@ Usage::
     python tools/mvtop.py HOST:PORT ... --watch 2         # refresh loop
     python tools/mvtop.py HOST:PORT --hotkeys [--fleet]   # workload view
     python tools/mvtop.py HOST:PORT --audit [--fleet]     # delivery audit
+    python tools/mvtop.py HOST:PORT --replication [--fleet]  # repl view
     python tools/mvtop.py HOST:PORT --metrics [--fleet]   # raw Prometheus
 
 ``--fleet`` asks the FIRST endpoint to aggregate the whole fleet
@@ -76,6 +84,10 @@ _AUDIT_RATE_COLS = ("dup/s",)
 _QOS_COLS = ("rank", "class", "weight", "budget", "inflight", "admits",
              "sheds", "dl_shed", "cancelled")
 _QOS_RATE_COLS = ("admit/s", "shed/s")
+
+_REPL_COLS = ("rank", "armed", "sync", "epoch", "owners", "backups",
+              "backs", "promoted", "fwd", "acks", "applied", "lag",
+              "catchups", "dup_skip")
 
 _SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
 
@@ -364,6 +376,59 @@ def collect_audit(endpoints: list, fleet: bool, timeout: float,
     return rows
 
 
+def repl_rows(doc: dict) -> list:
+    """One row per rank from a fleet ``"replication"`` report
+    (docs/replication.md): the routed shard map, who backs what, and
+    the forward/ack/promotion ledger.  Pure so the canned-scrape test
+    can drive it without a fleet."""
+    rows = []
+    for rank in sorted(doc.get("ranks") or {}, key=str):
+        r = (doc["ranks"] or {}).get(rank)
+        if not r:
+            rows.append({c: "-" for c in _REPL_COLS} | {"rank": rank,
+                                                        "armed": "DEAD"})
+            continue
+        st = r.get("stats") or {}
+        rows.append({
+            "rank": rank,
+            "armed": "yes" if r.get("armed") else "no",
+            "sync": "yes" if r.get("sync") else "no",
+            "epoch": r.get("epoch", 0),
+            "owners": ",".join(str(x) for x in r.get("owners") or []),
+            "backups": ",".join(str(x) for x in r.get("backups") or []),
+            "backs": r.get("backup_shard", -1),
+            "promoted": ",".join(str(x) for x in r.get("promoted") or [])
+                        or "-",
+            "fwd": st.get("forwards", 0),
+            "acks": st.get("acks", 0),
+            "applied": st.get("applied", 0),
+            "lag": r.get("outstanding", 0),
+            "catchups": st.get("catchups", 0),
+            "dup_skip": st.get("dup_skips", 0),
+        })
+    for ep in doc.get("silent") or []:
+        rows.append({c: "-" for c in _REPL_COLS} | {"rank": ep,
+                                                    "armed": "SILENT"})
+    return rows
+
+
+def collect_replication(endpoints: list, fleet: bool,
+                        timeout: float) -> list:
+    if fleet:
+        with OpsClient(endpoints[0], timeout=timeout) as c:
+            doc = c.replication(fleet=True)
+    else:
+        doc = {"ranks": {}, "silent": []}
+        for ep in endpoints:
+            try:
+                with OpsClient(ep, timeout=timeout) as c:
+                    local = c.replication()
+                doc["ranks"][str(local.get("rank", ep))] = local
+            except (ConnectionError, OSError, TimeoutError):
+                doc["silent"].append(ep)
+    return repl_rows(doc)
+
+
 def render(rows: list, cols=_COLS) -> str:
     rows = [{c: r.get(c, "-") for c in cols} for r in rows]
     widths = {c: max(len(c), *(len(str(r[c])) for r in rows))
@@ -396,6 +461,11 @@ def main(argv=None) -> int:
                     help="tail-plane tenant view: per-class admission "
                          "budgets, admit/shed totals, deadline sheds "
                          "and hedge cancels (docs/serving.md \"tail\")")
+    ap.add_argument("--replication", action="store_true",
+                    help="replication view: routing epoch + shard "
+                         "owner/backup maps, promoted shards, and the "
+                         "forward/ack ledger per rank "
+                         "(docs/replication.md)")
     ap.add_argument("--watch", type=float, default=0.0, metavar="SEC",
                     help="refresh every SEC seconds until interrupted "
                          "(adds two-scrape rate columns + sparklines)")
@@ -423,6 +493,12 @@ def main(argv=None) -> int:
             stamp = time.strftime("%H:%M:%S")
             print(f"mvtop --qos @ {stamp} — {len(rows)} class row(s)")
             print(render(rows, cols))
+        elif args.replication:
+            rows = collect_replication(args.endpoints, args.fleet,
+                                       args.timeout)
+            stamp = time.strftime("%H:%M:%S")
+            print(f"mvtop --replication @ {stamp} — {len(rows)} rank(s)")
+            print(render(rows, _REPL_COLS))
         elif args.hotkeys:
             rows = hotkey_rows(args.endpoints, args.fleet, args.timeout)
             stamp = time.strftime("%H:%M:%S")
