@@ -146,6 +146,17 @@ audit-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/audit_demo.py
 
+# Capacity-plane smoke (docs/observability.md "capacity plane"): a
+# 3-rank fleet + zipf herd — the fleet capacity scrape shows skewed
+# bucket bytes (mined KV buckets) and skewed bucket load (the herd),
+# mvplan bin-packs a dry-run rebalance with projected per-shard spread
+# <= 2x, a big table + pinned arena buffer landing mid-run move the
+# scraped RSS and arena gauges, and the armed/disarmed A/B shows the
+# accounting is ~free with books matching ground truth within 10%.
+capacity-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/capacity_demo.py
+
 # Replication/failover smoke (docs/replication.md): a 3-server
 # replicated fleet under an anonymous read herd — SIGKILL the middle
 # rank, the backup detects the expired lease on its own (symmetric
@@ -160,7 +171,8 @@ failover-demo:
 # Demo umbrella: every acceptance smoke in sequence (each target builds
 # the native runtime once; later builds are no-ops).
 demos: metrics-demo serve-demo wire-demo fanin-demo ops-demo skew-demo \
-       embedding-demo bridge-demo latency-demo audit-demo failover-demo
+       embedding-demo bridge-demo latency-demo audit-demo \
+       capacity-demo failover-demo
 
 # Continuous perf gate (docs/PERF.md): diff the newest bench JSON line
 # against the committed BENCH_BASELINE.json with per-key noise bands;
@@ -175,4 +187,4 @@ clean:
 .PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
         serve-demo wire-demo fanin-demo ops-demo skew-demo \
         embedding-demo bridge-demo latency-demo audit-demo \
-        failover-demo demos bench-gate clean
+        capacity-demo failover-demo demos bench-gate clean

@@ -783,6 +783,37 @@ def bench_skew(nclients: int = 1000, rows: int = 2048, reqs: int = 2048):
     return res
 
 
+def bench_capacity(nclients: int = 256, rows: int = 2048,
+                   reqs: int = 512):
+    """Capacity plane (docs/observability.md "capacity plane"; schema
+    19): a 2-rank epoll fleet under a zipf row-get herd + fresh-key KV
+    insert stream, with the byte accounting toggled in INTERLEAVED
+    armed/disarmed sweeps (the PR 12 one-persistent-herd discipline) →
+    ``capacity_overhead_pct`` (what the always-on accounting costs;
+    acceptance < 1%), ``capacity_bytes_accuracy`` /
+    ``capacity_kv_accuracy`` (fleet-scraped resident bytes over the
+    ground-truth walk; within 10% of 1.0 — the re-arm resync covers
+    the disarmed sweeps' inserts), and ``mvplan_spread_after`` (the
+    placement advisor's projected per-shard weight spread over the
+    scraped fleet; acceptance <= 2x).  Fleet + herd live in
+    ``apps/capacity_bench_worker.py``."""
+    import re
+
+    outs = _spawn_native_workers("capacity_bench_worker.py", 2,
+                                 "CAPACITY_BENCH_OK",
+                                 (nclients, rows, reqs))
+    res = {}
+    for out in outs:
+        for m in re.finditer(r"(\w+)=(-?[0-9.]+)", out):
+            key = m.group(1)
+            if key == "rank":
+                continue
+            name = key if key.startswith(
+                ("capacity_", "mvplan_")) else f"capacity_{key}"
+            res[name] = float(m.group(2))
+    return res
+
+
 def bench_embedding(rows: int = 1 << 16, reqs: int = 512):
     """Sparse-embedding serving fast path (docs/embedding.md; schema
     14): a 2-rank epoll fleet holding one row-sharded embedding table
@@ -1639,7 +1670,7 @@ _SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_w2v_native8,
              bench_wire_micro, bench_ssp, bench_serve, bench_serve_fanin,
              bench_tail,
              bench_ops, bench_latency, bench_audit, bench_failover,
-             bench_skew,
+             bench_skew, bench_capacity,
              bench_embedding,
              bench_bridge,
              bench_add_get,
@@ -1668,7 +1699,7 @@ def main() -> None:
     # Schema/partial line FIRST — before any JAX-touching import — so
     # even a backend-init hang killed by `timeout` leaves one parseable
     # line on stdout.
-    results = {"bench_schema": 18}
+    results = {"bench_schema": 19}
     errors = []
     _emit(results, errors)
 

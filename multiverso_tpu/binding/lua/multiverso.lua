@@ -132,6 +132,8 @@ int MV_TableLoadStats(int32_t handle, long long* gets, long long* adds,
                       double* add_linf, long long* nan_count,
                       long long* inf_count);
 int MV_SetHotKeyTracking(int on);
+char* MV_CapacityReport(void);
+int MV_SetCapacityTracking(int on);
 int MV_SetHotKeyReplica(int on);
 int MV_ReplicaRefresh(int32_t handle);
 int MV_ReplicaStats(int32_t handle, long long* hits, long long* misses,
@@ -498,6 +500,24 @@ end
 --- Toggle the workload accounting live (boot value: -hotkey_enabled).
 function mv.set_hotkey_tracking(on)
   check(C.MV_SetHotKeyTracking(on and 1 or 0), "MV_SetHotKeyTracking")
+end
+
+--- Capacity plane (docs/observability.md "capacity plane"): this
+--- rank's capacity report as a JSON string — proc stats, arena /
+--- write-queue / registered byte gauges, per-table resident bytes per
+--- bucket and the bounded load-history ring (the in-band "capacity"
+--- OpsQuery payload; tools/mvplan.py plans over the fleet scrape).
+function mv.capacity_report()
+  local p = C.MV_CapacityReport()
+  local text = ffi.string(p)
+  C.MV_FreeString(p)
+  return text
+end
+
+--- Toggle the byte accounting live (boot value: -capacity_enabled);
+--- re-arming resyncs every shard's counters with an exact walk.
+function mv.set_capacity_tracking(on)
+  check(C.MV_SetCapacityTracking(on and 1 or 0), "MV_SetCapacityTracking")
 end
 
 --- Toggle the hot-key read replica live (docs/embedding.md; boot

@@ -297,6 +297,22 @@ define_int("replica_max_staleness", 0,
            "last observed apply (native-flag parity); 0 = a row older "
            "than any later observed add misses")
 
+# --- capacity plane (docs/observability.md "capacity plane") ---------------
+define_bool("capacity_enabled", True,
+            "fleet capacity accounting (native-flag parity): per-table "
+            "resident bytes per bucket/shard, arena + write-queue + "
+            "registered byte gauges, and the bounded load-history ring "
+            "behind the 'capacity' OpsQuery kind.  False reduces every "
+            "hot-path growth hook to one relaxed atomic check "
+            "(MV_SetCapacityTracking toggles live; re-arming resyncs)")
+define_int("capacity_history_ms", 250,
+           "minimum interval between capacity load-history windows "
+           "(native-flag parity): each 'capacity' scrape at least this "
+           "far from the last appends one (ts, gets, adds, bytes, "
+           "per-bucket load) window to the bounded 64-window ring — "
+           "one scrape then yields per-bucket load RATES, the "
+           "placement advisor's input.  <= 0 records every scrape")
+
 # --- tail-at-scale serve tier (docs/serving.md "tail") ---------------------
 define_int("serve_timeout_ms", 30000,
            "AnonServeClient's default connect/read timeout — ONE source "

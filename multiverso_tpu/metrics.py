@@ -687,6 +687,14 @@ class _Flusher(threading.Thread):
 
     def flush_once(self) -> None:
         try:
+            # Capacity plane (docs/observability.md): land every
+            # registered Python byte gauge as a capacity.<name> Gauge
+            # BEFORE the history point / render, so serve-cache bytes
+            # ride the same scrape (and time-series ring) as every
+            # other series.
+            from . import capacity as _capacity
+
+            _capacity.export_gauges()
             # One time-series point per flush: the ring holds the last
             # HISTORY_SNAPSHOTS flush snapshots, so rate()/delta() span
             # roughly interval_s * HISTORY_SNAPSHOTS of history.

@@ -215,6 +215,10 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_TableLoadStats.restype = ctypes.c_int
     lib.MV_SetHotKeyTracking.argtypes = [ctypes.c_int]
     lib.MV_SetHotKeyTracking.restype = ctypes.c_int
+    lib.MV_CapacityReport.argtypes = []
+    lib.MV_CapacityReport.restype = ctypes.c_void_p
+    lib.MV_SetCapacityTracking.argtypes = [ctypes.c_int]
+    lib.MV_SetCapacityTracking.restype = ctypes.c_int
     lib.MV_SetWireTiming.argtypes = [ctypes.c_int]
     lib.MV_SetWireTiming.restype = ctypes.c_int
     lib.MV_SetAudit.argtypes = [ctypes.c_int]
@@ -846,6 +850,30 @@ class NativeRuntime:
         ``hotkey_track_overhead_pct`` bench bar."""
         self._check(self.lib.MV_SetHotKeyTracking(1 if on else 0),
                     "MV_SetHotKeyTracking")
+
+    # ------------------------------------------------- capacity plane
+    def capacity_report(self) -> dict:
+        """This rank's capacity report (docs/observability.md
+        "capacity plane"), parsed: ``proc`` (RSS/VmHWM/open fds/
+        uptime), ``arena``/``net``/``gauges`` byte holders, and per
+        table the shard's ``resident_bytes``/``rows`` with per-bucket
+        byte + load arrays, the bounded load-history ring, and the
+        worker side tables (replica/agg/cache bytes) as their own
+        fields.  The same payload the in-band ``"capacity"`` OpsQuery
+        kind serves; ``tools/mvplan.py`` bin-packs placement proposals
+        over the fleet scrape."""
+        import json
+
+        return json.loads(self._dump_string(
+            lambda: self.lib.MV_CapacityReport(), "MV_CapacityReport"))
+
+    def set_capacity_tracking(self, on: bool = True) -> None:
+        """Toggle the byte accounting live (boot value: the
+        ``-capacity_enabled`` flag).  Disarmed, every hot-path growth
+        hook is one relaxed atomic check — the ``capacity_overhead_pct``
+        A/B; re-arming resyncs every shard's counters exactly."""
+        self._check(self.lib.MV_SetCapacityTracking(1 if on else 0),
+                    "MV_SetCapacityTracking")
 
     # ------------------------------------------- latency attribution
     def set_wire_timing(self, on: bool = True) -> None:

@@ -78,6 +78,8 @@ class DeliveryBook {
     int64_t pending_dropped = 0;  // ranges evicted from a full pending set
     int64_t pending_since_ms = -1;  // first out-of-order observed (-1 none)
     bool gap_fired = false;  // audit_gap blackbox latched this episode
+    // mvlint: MV018-exempt(bounded at kMaxPending ranges — the
+    // highest range evicts + counts pending_dropped when full)
     std::map<int64_t, int64_t> pending;  // lo -> hi, disjoint, sorted
   };
 

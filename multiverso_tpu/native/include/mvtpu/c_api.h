@@ -246,6 +246,23 @@ int MV_SetHotKeyTracking(int on);
 // "tables" | "hotkeys".  malloc'd; caller frees with MV_FreeString.
 char* MV_OpsFleetReport(const char* kind);
 
+// ---- capacity plane (docs/observability.md "capacity plane") ---------
+// This rank's capacity report as JSON — the same payload the in-band
+// `"capacity"` OpsQuery kind serves: /proc/self process stats (RSS,
+// VmHWM, open fds, uptime), arena + write-queue + registered byte
+// gauges, and per table the shard's resident bytes/rows per bucket,
+// per-bucket get/add load counters, the bounded load-history ring
+// (rate curves), worker-side replica/agg/cache bytes as their OWN
+// fields (never folded into shard counts), and backup-shard bytes.
+// tools/mvplan.py bin-packs placement proposals over the fleet scrape.
+// malloc'd; caller frees with MV_FreeString.
+char* MV_CapacityReport(void);
+// Toggle the byte accounting live (boot value: the `-capacity_enabled`
+// flag).  Disarmed, every hot-path growth hook is one relaxed atomic
+// check; re-arming resyncs every shard with an exact walk, so counters
+// are accurate whenever tracking is on.
+int MV_SetCapacityTracking(int on);
+
 // ---- latency attribution plane (docs/observability.md) ---------------
 // Toggle wire-header timing trails live (boot value: `-wire_timing`,
 // default ON).  Armed, every worker request carries six monotonic

@@ -63,6 +63,12 @@ class EpollNet : public RankTransport {
   const char* engine() const override { return "epoll"; }
   FanInStats FanIn() const override;
   void SettleClient(int client_rank) override;
+  // Capacity plane (docs/observability.md): bytes currently parked
+  // across every connection's bounded write queue — the
+  // `net.writeq_bytes` gauge of the "capacity" ops report.
+  long long QueuedBytes() const override {
+    return wq_bytes_total_.load(std::memory_order_relaxed);
+  }
 
  private:
   struct PendingFrame;
@@ -114,6 +120,9 @@ class EpollNet : public RankTransport {
   std::atomic<long long> accepted_total_{0};
   std::atomic<long long> active_clients_{0};
   std::atomic<long long> client_shed_{0};
+  // Engine-wide write-queue depth in bytes (sum of per-conn wq_bytes,
+  // maintained beside every wq mutation — QueuedBytes()).
+  std::atomic<long long> wq_bytes_total_{0};
 
   std::vector<std::unique_ptr<Shard>> shards_;
 

@@ -19,6 +19,7 @@
 #include <vector>
 
 #include "mvtpu/audit.h"
+#include "mvtpu/capacity.h"
 #include "mvtpu/codec.h"
 #include "mvtpu/message.h"
 #include "mvtpu/mutex.h"
@@ -82,6 +83,7 @@ class ServerTable {
     for (auto& b : bucket_versions_) b.store(0, std::memory_order_relaxed);
     for (auto& b : bucket_gets_) b.store(0, std::memory_order_relaxed);
     for (auto& b : bucket_adds_) b.store(0, std::memory_order_relaxed);
+    for (auto& b : bucket_bytes_) b.store(0, std::memory_order_relaxed);
   }
   virtual ~ServerTable() = default;
   // Fill reply blobs for a get request.
@@ -131,6 +133,73 @@ class ServerTable {
   };
   LoadStats Load() const;
   std::string HotKeysJson() const { return tracker_.Json(); }
+
+  // ---- capacity accounting (docs/observability.md "capacity plane") --
+  // Resident bytes/rows of THIS shard, per bucket and in total —
+  // migration's placement unit, measured.  Construction and snapshot
+  // Load recompute exactly (RecomputeCapacity: a full walk under the
+  // shard lock); growth on the hot path (KV key inserts — matrix/array
+  // shards are fixed-size) bumps the counters incrementally behind one
+  // relaxed capacity::Armed() load.  Re-arming via
+  // MV_SetCapacityTracking resyncs every table, so counters disarmed
+  // adds left stale heal the moment tracking turns back on.
+  struct CapacityUsage {
+    int64_t bytes = 0;  // resident payload + per-entry overhead
+    int64_t rows = 0;   // matrix rows / KV entries / array elements
+  };
+  CapacityUsage Capacity() const {
+    CapacityUsage u;
+    u.bytes = resident_bytes_.load(std::memory_order_relaxed);
+    u.rows = resident_rows_.load(std::memory_order_relaxed);
+    return u;
+  }
+  std::vector<int64_t> BucketBytes() const {
+    std::vector<int64_t> out(kVersionBuckets, 0);
+    for (int b = 0; b < kVersionBuckets; ++b)
+      out[b] = bucket_bytes_[b].load(std::memory_order_relaxed);
+    return out;
+  }
+  // Per-bucket get/add load counters (the rate-curve substrate the
+  // capacity history ring snapshots); both arrays kVersionBuckets long.
+  void BucketLoads(int64_t* gets, int64_t* adds) const {
+    for (int b = 0; b < kVersionBuckets; ++b) {
+      if (gets) gets[b] = bucket_gets_[b].load(std::memory_order_relaxed);
+      if (adds) adds[b] = bucket_adds_[b].load(std::memory_order_relaxed);
+    }
+  }
+  int64_t total_gets() const {
+    return total_gets_.load(std::memory_order_relaxed);
+  }
+  int64_t total_adds() const {
+    return total_adds_.load(std::memory_order_relaxed);
+  }
+  // Exact full walk under the shard lock; called at construction,
+  // after a successful snapshot Load, and on re-arm.
+  virtual void RecomputeCapacity() {}
+
+ protected:
+  // Zero + set the whole-shard counters (the Recompute entry).
+  void ResetCapacity(int64_t bytes, int64_t rows) {
+    resident_bytes_.store(bytes, std::memory_order_relaxed);
+    resident_rows_.store(rows, std::memory_order_relaxed);
+    for (auto& b : bucket_bytes_) b.store(0, std::memory_order_relaxed);
+  }
+  void ChargeBucketBytes(int bucket, int64_t bytes) {
+    if (bucket >= 0)
+      bucket_bytes_[bucket % kVersionBuckets].fetch_add(
+          bytes, std::memory_order_relaxed);
+  }
+  // Hot-path increment for one NEW resident entry (KV insert): one
+  // relaxed load disarmed, three relaxed bumps armed.  rows=0 for
+  // side-slot growth that adds bytes but no logical entry.
+  void NoteEntryBytes(int bucket, int64_t bytes, int64_t rows = 1) {
+    if (!capacity::Armed()) return;
+    resident_bytes_.fetch_add(bytes, std::memory_order_relaxed);
+    if (rows) resident_rows_.fetch_add(rows, std::memory_order_relaxed);
+    ChargeBucketBytes(bucket, bytes);
+  }
+
+ public:
   std::vector<workload::HotKeyTracker::Item> HotTopK() const {
     return tracker_.TopK();
   }
@@ -262,6 +331,11 @@ class ServerTable {
   workload::HotKeyTracker tracker_;
   std::atomic<int64_t> replica_pushes_{0};
   audit::DeliveryBook audit_book_;
+
+  // ---- capacity accounting state (docs/observability.md) -------------
+  std::atomic<int64_t> resident_bytes_{0};
+  std::atomic<int64_t> resident_rows_{0};
+  std::atomic<int64_t> bucket_bytes_[kVersionBuckets];
   mutable Mutex health_mu_;
   double add_l2sq_ GUARDED_BY(health_mu_) = 0.0;
   double add_linf_ GUARDED_BY(health_mu_) = 0.0;
@@ -279,6 +353,7 @@ class ArrayServerTable : public ServerTable {
   bool Store(Stream* out) const override;
   bool Load(Stream* in) override;
   std::vector<uint32_t> BucketChecksums() const override;
+  void RecomputeCapacity() override;
   int64_t size() const {
     MutexLock lk(mu_);
     return static_cast<int64_t>(data_.size());
@@ -305,6 +380,7 @@ class MatrixServerTable : public ServerTable {
   bool Store(Stream* out) const override;
   bool Load(Stream* in) override;
   std::vector<uint32_t> BucketChecksums() const override;
+  void RecomputeCapacity() override;
   int64_t rows() const { return range_.len(); }
   int64_t cols() const { return cols_; }
 
@@ -451,6 +527,12 @@ class WorkerTable {
     MutexLock lk(agg_mu_);
     return agg_count_;
   }
+  // Capacity plane (docs/observability.md): bytes currently held by
+  // the add-aggregation buffer (one delta-shaped float sum).
+  int64_t agg_bytes() {
+    MutexLock lk(agg_mu_);
+    return static_cast<int64_t>(agg_sum_.size() * sizeof(float));
+  }
 
  protected:
   // Subclass hook: ship `sum` (n elements) as one async add.
@@ -500,6 +582,8 @@ class WorkerTable {
     bool* failed;
     bool* busy = nullptr;  // set when a shard answered ReplyBusy
   };
+  // mvlint: MV018-exempt(one entry per in-flight round trip, drained
+  // by Notify/Wait — bounded by caller concurrency, never by traffic)
   std::unordered_map<int64_t, Pending> pending_ GUARDED_BY(mu_);
   std::atomic<int64_t> last_version_{0};
   audit::AckLedger ack_ledger_;
@@ -587,6 +671,11 @@ class MatrixWorkerTable : public WorkerTable {
   };
   ReplicaStats replica_stats() const;
   void OnClockInvalidate() override;  // clock boundary: replica is void
+  // Capacity plane (docs/observability.md): resident bytes of the
+  // replica side table (rows x cols floats + per-entry overhead) —
+  // reported as its OWN field so fleet capacity math never counts a
+  // replicated row into the table's shard bytes.
+  int64_t replica_bytes() const;
 
  protected:
   void SendAggregate(const float* sum, int64_t n,
@@ -625,6 +714,8 @@ class MatrixWorkerTable : public WorkerTable {
     std::vector<float> data;    // cols_ floats
   };
   mutable Mutex replica_mu_;
+  // capacity: replica_bytes() gauge — the "capacity" report's
+  // worker.replica_bytes field (rows bounded at 4x topk x shards)
   std::unordered_map<int32_t, ReplicaRow> replica_ GUARDED_BY(replica_mu_);
   int64_t replica_ts_ms_ GUARDED_BY(replica_mu_) = -1;  // -1: never
   std::atomic<long long> replica_hits_{0};
@@ -688,9 +779,11 @@ class KVServerTable : public ServerTable {
   bool Store(Stream* out) const override;
   bool Load(Stream* in) override;
   std::vector<uint32_t> BucketChecksums() const override;
+  void RecomputeCapacity() override;
   size_t size() const;
 
  private:
+  void RecomputeCapacityLocked() REQUIRES(mu_);
   mutable Mutex mu_;
   std::unordered_map<std::string, float> data_ GUARDED_BY(mu_);
   std::unordered_map<std::string, float> slot0_ GUARDED_BY(mu_);  // slots
@@ -714,10 +807,22 @@ class KVWorkerTable : public WorkerTable {
     MutexLock lk(cache_mu_);
     return cache_;
   }
+  // Capacity plane: resident bytes of the raw() mirror (keys + values
+  // + the KV entry-overhead constant the server books use).
+  int64_t cache_bytes() const {
+    MutexLock lk(cache_mu_);
+    int64_t bytes = 0;
+    for (const auto& kv : cache_)
+      bytes += static_cast<int64_t>(kv.first.size()) +
+               static_cast<int64_t>(sizeof(float)) +
+               capacity::kKVEntryOverhead;
+    return bytes;
+  }
 
  private:
   int servers_;
   mutable Mutex cache_mu_;
+  // capacity: cache_bytes() rides the "capacity" report's worker object
   std::unordered_map<std::string, float> cache_ GUARDED_BY(cache_mu_);
 };
 

@@ -58,6 +58,12 @@ class Net {
   // cancelled read: no reply will ever route back to release it).
   // No-op on engines without anonymous clients.
   virtual void SettleClient(int client_rank) { (void)client_rank; }
+
+  // Capacity plane (docs/observability.md): total bytes currently
+  // parked on this engine's outbound write queues.  Only the epoll
+  // engine queues frames (blocking engines hold none); the capacity
+  // report's `net.writeq_bytes` gauge reads this.
+  virtual long long QueuedBytes() const { return 0; }
 };
 
 namespace transport {

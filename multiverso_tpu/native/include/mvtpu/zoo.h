@@ -158,6 +158,15 @@ class Zoo {
   // watermark, dup/reorder/gap anomalies, pending out-of-order ranges)
   // plus per-bucket content checksums — the "audit" OpsQuery kind.
   std::string OpsAuditJson();
+  // Capacity plane (docs/observability.md "capacity plane"): host proc
+  // stats, arena/write-queue/registered byte gauges, and per-table
+  // resident bytes per bucket + the bounded load-history ring — the
+  // "capacity" OpsQuery kind, and tools/mvplan.py's input shape.
+  std::string OpsCapacityJson();
+  // Exact byte-accounting resync over every table shard (primary AND
+  // backup) — the re-arm hook behind MV_SetCapacityTracking(1): drift
+  // from disarmed inserts heals the moment tracking turns back on.
+  void RecomputeCapacityAll();
   // Run a fleet-scope aggregation SYNCHRONOUSLY from this rank (the
   // same bounded fan-out an inbound fleet OpsQuery triggers) — the
   // engine-agnostic entry point: on the blocking tcp engine, where no
@@ -335,6 +344,8 @@ class Zoo {
   // Outstanding pipeline flushes (msg_id → waiter); acks notify under
   // flush_mu_ so a timed-out flush cannot race its waiter's teardown.
   Mutex flush_mu_;
+  // mvlint: MV018-exempt(one waiter per outstanding FlushPipelines
+  // round — bounded by caller concurrency, acks/timeouts drain it)
   std::unordered_map<int64_t, std::shared_ptr<Waiter>> flush_pending_
       GUARDED_BY(flush_mu_);
 
@@ -350,6 +361,8 @@ class Zoo {
   std::string FleetCollect(const std::string& kind, int64_t trace_id,
                            int64_t id);
   Mutex ops_mu_;
+  // mvlint: MV018-exempt(bounded by -ops_inflight_max concurrent fleet
+  // queries; the deadline wait erases each entry)
   std::unordered_map<int64_t, std::shared_ptr<OpsPending>> ops_pending_
       GUARDED_BY(ops_mu_);
   std::atomic<int> ops_inflight_{0};
@@ -393,9 +406,13 @@ class Zoo {
     int64_t deadline_ms;
     MessagePtr reply;
   };
+  // mvlint: MV018-exempt(deadline-bounded: ReleaseParkedAcks sweeps
+  // expired parks every lease tick; outstanding count rides repl stats)
   std::unordered_map<int64_t, ParkedAck> parked_acks_ GUARDED_BY(repl_mu_);
   std::atomic<long long> repl_outstanding_{0};
   // Catch-up rendezvous: ShardSnapshot request msg_id -> waiter.
+  // mvlint: MV018-exempt(one waiter per in-flight catch-up pull —
+  // bounded by shard count, drained on reply/timeout)
   std::unordered_map<int64_t, std::shared_ptr<Waiter>> snapshot_pending_
       GUARDED_BY(repl_mu_);
   // Collision-free epoch allocation: epochs advance in strides of

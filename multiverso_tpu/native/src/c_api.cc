@@ -772,6 +772,23 @@ int MV_SetHotKeyTracking(int on) {
   return 0;
 }
 
+// ---- capacity plane (docs/observability.md "capacity plane") ---------
+
+char* MV_CapacityReport(void) {
+  return MallocString(Zoo::Get()->OpsCapacityJson());
+}
+
+int MV_SetCapacityTracking(int on) {
+  bool was = mvtpu::capacity::Armed();
+  mvtpu::capacity::Arm(on != 0);
+  // Re-arming RESYNCS every shard's byte counters with an exact walk:
+  // inserts that landed while disarmed left the incremental books
+  // stale, and "armed" must mean "accurate".
+  if (on && !was && Zoo::Get()->started())
+    Zoo::Get()->RecomputeCapacityAll();
+  return 0;
+}
+
 char* MV_OpsFleetReport(const char* kind) {
   return MallocString(
       Zoo::Get()->FleetReport(kind ? kind : "health"));

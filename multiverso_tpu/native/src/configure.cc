@@ -277,6 +277,21 @@ void RegisterDefaults() {
                "the server hot path.  false compiles every hook down to "
                "one relaxed atomic check (MV_SetHotKeyTracking toggles "
                "live for A/B overhead measurement)");
+    DefineBool("capacity_enabled", true,
+               "capacity plane (docs/observability.md \"capacity "
+               "plane\"): per-table resident-byte accounting (matrix "
+               "rows, KV entries + key bytes, array spans) per bucket "
+               "and per shard, recomputed incrementally on the hot "
+               "path.  false compiles every growth hook down to one "
+               "relaxed atomic check; MV_SetCapacityTracking toggles "
+               "live (re-arming resyncs every shard exactly)");
+    DefineInt("capacity_history_ms", 250,
+              "minimum interval between capacity load-history windows: "
+              "each \"capacity\" scrape at least this far from the "
+              "last appends one (ts, gets, adds, bytes, per-bucket "
+              "load) window to the bounded 64-window ring, so one "
+              "scrape yields per-bucket load RATES (the placement "
+              "advisor's input).  <= 0 records every scrape");
     DefineInt("hotkey_topk", 16,
               "capacity of the space-saving top-K hot-key sketch per "
               "server table (memory bound: this many monitored keys; "

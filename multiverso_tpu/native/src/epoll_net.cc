@@ -189,6 +189,8 @@ struct EpollNet::Conn {
 
   Mutex mu;
   CondVar can_write;  // backpressure + drain-on-stop waiters
+  // capacity: wq_bytes_total_ gauge — the "capacity" report's
+  // net.writeq_bytes field (bounded at -net_writeq_bytes per conn)
   std::deque<PendingFrame> wq GUARDED_BY(mu);
   int64_t wq_bytes GUARDED_BY(mu) = 0;
   bool want_out GUARDED_BY(mu) = false;  // EPOLLOUT armed
@@ -668,6 +670,7 @@ bool EpollNet::DrainWrites(const std::shared_ptr<Conn>& c, bool* empty) {
     // Frame fully on the wire: only now does the byte ledger count it.
     Dashboard::Record("net.bytes.sent", static_cast<double>(f.total));
     c->wq_bytes -= f.total;
+    wq_bytes_total_.fetch_add(-f.total, std::memory_order_relaxed);
     c->wq.pop_front();
     c->can_write.NotifyAll();
   }
@@ -699,6 +702,7 @@ void EpollNet::CloseConn(Shard* s, const std::shared_ptr<Conn>& c,
       Log::Error("EpollNet: dropping %zu queued frame(s) to peer %d (%s)",
                  c->wq.size(), peer, why);
     c->wq.clear();
+    wq_bytes_total_.fetch_add(-c->wq_bytes, std::memory_order_relaxed);
     c->wq_bytes = 0;
     c->can_write.NotifyAll();
   }
@@ -885,6 +889,8 @@ bool EpollNet::Enqueue(const std::shared_ptr<Conn>& c, const Message& msg,
     }
     c->wq.emplace_back(msg);
     c->wq_bytes += c->wq.back().total;
+    wq_bytes_total_.fetch_add(c->wq.back().total,
+                              std::memory_order_relaxed);
   }
   Shard* target = shards_[static_cast<size_t>(c->shard)].get();
   {
@@ -1038,6 +1044,7 @@ void EpollNet::Stop() {
     client_conns_.clear();
     rank_conns_.clear();
   }
+  wq_bytes_total_.store(0, std::memory_order_relaxed);
   for (auto& s : shards_) {
     ::close(s->epfd);
     ::close(s->wake_fd);

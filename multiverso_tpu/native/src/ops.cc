@@ -30,6 +30,8 @@ struct Event {
   std::string detail;
 };
 Mutex g_box_mu;
+// mvlint: MV018-exempt(bounded ring — BlackboxEvent pops the front
+// past -blackbox_events; the ring IS the black box, never traffic)
 std::deque<Event> g_events GUARDED_BY(g_box_mu);
 long long g_triggers GUARDED_BY(g_box_mu) = 0;
 
@@ -291,6 +293,11 @@ std::string LocalReport(const std::string& kind) {
   // map, backup identity, and the forward/ack/promotion ledger.
   // Fleet scope rides the generic JSON merge for free.
   if (kind == "replication") return Zoo::Get()->OpsReplicationJson();
+  // Capacity plane (docs/observability.md "capacity plane"): proc
+  // stats, arena/write-queue/registered byte gauges, per-table
+  // resident bytes per bucket + the load-history ring.  Fleet scope
+  // rides the generic JSON merge; tools/mvplan.py plans over it.
+  if (kind == "capacity") return Zoo::Get()->OpsCapacityJson();
   return "{\"error\":\"unknown ops kind '" + JsonEscape(kind) + "'\"}";
 }
 
@@ -329,6 +336,8 @@ namespace {
 // prunes the oldest — a second trigger on the same rank no longer
 // destroys the first dump's evidence.
 Mutex g_rot_mu;
+// mvlint: MV018-exempt(bounded at -blackbox_keep archive names —
+// RotateDump prunes the oldest past the keep bound)
 std::deque<std::string> g_archives GUARDED_BY(g_rot_mu);
 long long g_dump_seq GUARDED_BY(g_rot_mu) = 0;
 

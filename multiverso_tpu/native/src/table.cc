@@ -27,6 +27,11 @@
 
 namespace mvtpu {
 
+// The capacity history ring's bucket arrays mirror the version-bucket
+// map one to one (docs/observability.md "capacity plane").
+static_assert(capacity::kLoadBuckets == ServerTable::kVersionBuckets,
+              "capacity history buckets must match version buckets");
+
 namespace {
 
 // Flags may not be registered when tables are driven standalone.
@@ -140,6 +145,16 @@ ArrayServerTable::ArrayServerTable(int64_t global_size, UpdaterType updater,
     : range_(ShardOf(global_size, rank, size)),
       data_(static_cast<size_t>(range_.len()), 0.0f), updater_(updater) {
   if (NumSlots(updater_) > 0) slot0_.assign(data_.size(), 0.0f);
+  RecomputeCapacity();
+}
+
+void ArrayServerTable::RecomputeCapacity() {
+  // Arrays are whole-shard spans (whole-shard versioning, whole-shard
+  // checksum): shard bytes only, no per-bucket attribution.
+  MutexLock lk(mu_);
+  ResetCapacity(
+      static_cast<int64_t>((data_.size() + slot0_.size()) * sizeof(float)),
+      static_cast<int64_t>(data_.size()));
 }
 
 void ArrayServerTable::ProcessGet(const Message& req, Message* reply) {
@@ -190,6 +205,9 @@ bool ArrayServerTable::Load(Stream* in) {
       in->Read(slot0_.data(), n * sizeof(float)) !=
           static_cast<size_t>(n) * sizeof(float))
     return false;
+  ResetCapacity(
+      static_cast<int64_t>((data_.size() + slot0_.size()) * sizeof(float)),
+      static_cast<int64_t>(data_.size()));
   return true;
 }
 
@@ -206,6 +224,21 @@ MatrixServerTable::MatrixServerTable(int64_t rows, int64_t cols,
       data_(static_cast<size_t>(range_.len() * cols), 0.0f),
       updater_(updater) {
   if (NumSlots(updater_) > 0) slot0_.assign(data_.size(), 0.0f);
+  RecomputeCapacity();
+}
+
+void MatrixServerTable::RecomputeCapacity() {
+  // Dense row block: fixed bytes once constructed, attributed per
+  // bucket on the SAME global-row->bucket map the version stamps and
+  // CRC beacons use — a bucket's bytes are exactly what a bucket
+  // migration would move (docs/observability.md "capacity plane").
+  MutexLock lk(mu_);
+  int64_t row_bytes =
+      cols_ * static_cast<int64_t>(sizeof(float)) *
+      (slot0_.empty() ? 1 : 2);
+  ResetCapacity(range_.len() * row_bytes, range_.len());
+  for (int64_t r = 0; r < range_.len(); ++r)
+    ChargeBucketBytes(RowBucket(range_.begin + r), row_bytes);
 }
 
 void MatrixServerTable::ProcessGet(const Message& req, Message* reply) {
@@ -413,6 +446,12 @@ bool MatrixServerTable::Load(Stream* in) {
   size_t bytes = data_.size() * sizeof(float);
   if (in->Read(data_.data(), bytes) != bytes) return false;
   if (!slot0_.empty() && in->Read(slot0_.data(), bytes) != bytes) return false;
+  int64_t row_bytes =
+      cols_ * static_cast<int64_t>(sizeof(float)) *
+      (slot0_.empty() ? 1 : 2);
+  ResetCapacity(range_.len() * row_bytes, range_.len());
+  for (int64_t r = 0; r < range_.len(); ++r)
+    ChargeBucketBytes(RowBucket(range_.begin + r), row_bytes);
   return true;
 }
 
@@ -519,10 +558,25 @@ void KVServerTable::ProcessAdd(const Message& req) {
     BumpVersion(static_cast<int64_t>(KVHash(k.data(), k.size()) %
                                      kVersionBuckets));
   };
+  // Capacity accounting (docs/observability.md "capacity plane"): a
+  // NEW key grows the shard — one relaxed Armed() load per insert,
+  // charging key + value + entry-overhead bytes to the key's bucket
+  // (slot entries charge the same shape; Recompute uses one formula).
+  auto note_insert = [this](const std::string& k, int64_t rows) {
+    NoteEntryBytes(
+        static_cast<int>(KVHash(k.data(), k.size()) % kVersionBuckets),
+        static_cast<int64_t>(k.size()) +
+            static_cast<int64_t>(sizeof(float)) +
+            capacity::kKVEntryOverhead,
+        rows);
+  };
   MutexLock lk(mu_);
   if (!stateful) {
     for (size_t i = 0; i < keys.size(); ++i) {
-      ApplyUpdate(updater_, *opt, &data_[keys[i]], nullptr, deltas + i, 1);
+      auto ins = data_.try_emplace(keys[i], 0.0f);
+      if (ins.second) note_insert(keys[i], 1);
+      ApplyUpdate(updater_, *opt, &ins.first->second, nullptr, deltas + i,
+                  1);
       bump_key(keys[i]);
     }
     return;
@@ -532,7 +586,11 @@ void KVServerTable::ProcessAdd(const Message& req) {
   std::unordered_map<std::string, float> agg;
   for (size_t i = 0; i < keys.size(); ++i) agg[keys[i]] += deltas[i];
   for (auto& kv : agg) {
-    ApplyUpdate(updater_, *opt, &data_[kv.first], &slot0_[kv.first],
+    auto ins = data_.try_emplace(kv.first, 0.0f);
+    if (ins.second) note_insert(kv.first, 1);
+    auto slot = slot0_.try_emplace(kv.first, 0.0f);
+    if (slot.second) note_insert(kv.first, 0);  // slot bytes, no new entry
+    ApplyUpdate(updater_, *opt, &ins.first->second, &slot.first->second,
                 &kv.second, 1);
     bump_key(kv.first);
   }
@@ -541,6 +599,34 @@ void KVServerTable::ProcessAdd(const Message& req) {
 size_t KVServerTable::size() const {
   MutexLock lk(mu_);
   return data_.size();
+}
+
+void KVServerTable::RecomputeCapacity() {
+  MutexLock lk(mu_);
+  RecomputeCapacityLocked();
+}
+
+void KVServerTable::RecomputeCapacityLocked() {
+  // Exact walk under the shard lock — the resync entry (re-arm, Load):
+  // the SAME per-entry formula the incremental insert path charges, so
+  // armed counters and a ground-truth walk agree by construction.
+  int64_t bytes = 0;
+  std::vector<int64_t> per_bucket(kVersionBuckets, 0);
+  auto walk = [&](const std::unordered_map<std::string, float>& m) {
+    for (const auto& kv : m) {
+      int64_t b = static_cast<int64_t>(kv.first.size()) +
+                  static_cast<int64_t>(sizeof(float)) +
+                  capacity::kKVEntryOverhead;
+      bytes += b;
+      per_bucket[KVHash(kv.first.data(), kv.first.size()) %
+                 kVersionBuckets] += b;
+    }
+  };
+  walk(data_);
+  walk(slot0_);
+  ResetCapacity(bytes, static_cast<int64_t>(data_.size()));
+  for (int b = 0; b < kVersionBuckets; ++b)
+    ChargeBucketBytes(b, per_bucket[b]);
 }
 
 std::vector<uint32_t> KVServerTable::BucketChecksums() const {
@@ -603,6 +689,7 @@ bool KVServerTable::Load(Stream* in) {
     data_[key] = val;
     if (has_slots) slot0_[key] = slot;
   }
+  RecomputeCapacityLocked();
   return true;
 }
 
@@ -1319,6 +1406,16 @@ void MatrixWorkerTable::OnReplicaPush(const Message& reply) {
     r.data.assign(rows + i * cols_, rows + (i + 1) * cols_);
   }
   replica_ts_ms_ = SteadyNowMs();
+}
+
+int64_t MatrixWorkerTable::replica_bytes() const {
+  MutexLock lk(replica_mu_);
+  // rows x (cols floats + id/version/map-node overhead): the same
+  // entry-overhead constant the KV books use, so fleet capacity math
+  // speaks one unit.
+  return static_cast<int64_t>(replica_.size()) *
+         (cols_ * static_cast<int64_t>(sizeof(float)) +
+          capacity::kKVEntryOverhead);
 }
 
 MatrixWorkerTable::ReplicaStats MatrixWorkerTable::replica_stats() const {

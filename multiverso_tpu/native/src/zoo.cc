@@ -11,8 +11,10 @@
 #include <type_traits>
 
 #include "mvtpu/audit.h"
+#include "mvtpu/capacity.h"
 #include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
+#include "mvtpu/host_arena.h"
 #include "mvtpu/dashboard.h"
 #include "mvtpu/fault.h"
 #include "mvtpu/latency.h"
@@ -551,6 +553,21 @@ bool Zoo::Start(int argc, const char* const* argv) {
   // it live for armed-vs-disarmed overhead A/Bs).
   workload::Arm(configure::GetBool("hotkey_enabled"));
   workload::ArmReplica(configure::GetBool("hotkey_replica"));
+  // Capacity plane (docs/observability.md "capacity plane"): -capacity_
+  // enabled latches the byte accounting; MV_SetCapacityTracking toggles
+  // live (re-arming resyncs every shard's counters).
+  capacity::Arm(configure::GetBool("capacity_enabled"));
+  capacity::ResetHistory();
+  // Byte gauges into the shared registry (the "capacity" report's
+  // gauges object): the arena and the engine write queues are the two
+  // native non-table byte holders; Python-plane caches register into
+  // the metrics-side mirror (multiverso_tpu/capacity.py).
+  capacity::RegisterGauge("host_arena.bytes", [] {
+    return HostArena::Get()->GetStats().bytes;
+  });
+  capacity::RegisterGauge("net.writeq_bytes", [this]() -> long long {
+    return net_ ? net_->QueuedBytes() : 0;
+  });
   // Delivery-audit plane (docs/observability.md "audit plane"): -audit
   // latches the seq stamping + server books; MV_SetAudit toggles live.
   audit::Arm(configure::GetBool("audit"));
@@ -655,6 +672,11 @@ void Zoo::Stop() {
   if (server) server->Stop();
   if (controller) controller->Stop();
   if (net) net->Stop();
+  // Capacity gauges die with the runtime they read (a scrape after
+  // Stop must not chase a dead transport).
+  capacity::UnregisterGauge("net.writeq_bytes");
+  capacity::UnregisterGauge("host_arena.bytes");
+  capacity::ResetHistory();
   MutexLock lk(mu_);
   worker_actor_.reset();
   server_actor_.reset();
@@ -1917,6 +1939,19 @@ std::string Zoo::OpsHealthJson() {
   os << ",\"clients_accepted\":" << fanin.accepted_total;
   os << ",\"client_shed\":" << fanin.client_shed;
   os << ",\"blackbox_triggers\":" << ops::BlackboxTriggerCount();
+  // Host-level process stats (docs/observability.md "capacity plane"):
+  // RSS / peak RSS / open fds / uptime from /proc/self, so a health
+  // scrape answers "is this host running out of memory or fds" without
+  // a second probe.
+  {
+    capacity::ProcStats proc = capacity::Proc();
+    char num[64];
+    os << ",\"rss_bytes\":" << proc.rss_bytes;
+    os << ",\"vm_hwm_bytes\":" << proc.vm_hwm_bytes;
+    os << ",\"open_fds\":" << proc.open_fds;
+    std::snprintf(num, sizeof(num), "%.3f", proc.uptime_s);
+    os << ",\"uptime_s\":" << num;
+  }
   // Readiness: the runtime answers requests at all; health: it is not
   // drowning (queue within the shed bound) and, on the lease authority,
   // the fleet has no expired peers.
@@ -1947,8 +1982,21 @@ std::string Zoo::OpsTablesJson() {
       os << ",\"codec\":\"" << codec::Name(wt->wire_codec()) << "\"";
       os << ",\"last_version\":" << wt->last_version();
       os << ",\"agg_pending\":" << wt->agg_pending();
+      // Hot-key replica side-table entries are their OWN field, NEVER
+      // folded into the shard row count below: a replicated row is a
+      // COPY of a row some shard already owns, and capacity math that
+      // summed both would count it twice after a PR 10 replica install
+      // (the double-count bugfix; regression-tested with an armed
+      // replica in tests/test_capacity.py).
+      if (auto* mw = dynamic_cast<MatrixWorkerTable*>(wt))
+        os << ",\"replica_rows\":" << mw->replica_stats().rows;
     }
     if (st) {
+      // Shard-resident entries only (matrix rows / KV entries / array
+      // elements) — the capacity plane's row count.
+      auto cap = st->Capacity();
+      os << ",\"rows\":" << cap.rows;
+      os << ",\"resident_bytes\":" << cap.bytes;
       int64_t v = st->version();
       int64_t lo = v, hi = 0;
       for (int b = 0; b < ServerTable::kVersionBuckets; ++b) {
@@ -2204,6 +2252,112 @@ std::string Zoo::OpsAuditJson() {
   }
   os << "]}";
   return os.str();
+}
+
+std::string Zoo::OpsCapacityJson() {
+  // Snapshot pointers under tables_mu_, read stats OUTSIDE it (the
+  // accessors take per-table locks; tables never unregister).
+  std::vector<std::tuple<WorkerTable*, ServerTable*, ServerTable*>>
+      snapshot;
+  {
+    MutexLock lk(tables_mu_);
+    for (size_t i = 0; i < worker_tables_.size(); ++i)
+      snapshot.emplace_back(
+          worker_tables_[i].get(),
+          i < server_tables_.size() ? server_tables_[i].get() : nullptr,
+          i < backup_tables_.size() ? backup_tables_[i].get() : nullptr);
+  }
+  // History windows record at most once per -capacity_history_ms, all
+  // tables together (one shared clock keeps windows aligned), so a
+  // watch-mode scraper accumulates the rate curve as a side effect.
+  bool record = capacity::HistoryDue();
+  std::ostringstream os;
+  os << "{\"rank\":" << rank_;
+  os << ",\"armed\":" << (capacity::Armed() ? "true" : "false");
+  os << ",\"server_id\":" << server_id();
+  os << ",\"servers\":" << num_servers();
+  os << ",\"proc\":" << capacity::ProcJson();
+  {
+    HostArena::Stats a = HostArena::Get()->GetStats();
+    os << ",\"arena\":{\"buffers\":" << a.buffers
+       << ",\"free_buffers\":" << a.free_buffers
+       << ",\"bytes\":" << a.bytes << ",\"in_flight\":" << a.in_flight
+       << ",\"deferred\":" << a.deferred << "}";
+  }
+  os << ",\"net\":{\"engine\":\"" << net_engine()
+     << "\",\"writeq_bytes\":" << (net_ ? net_->QueuedBytes() : 0) << "}";
+  os << ",\"gauges\":" << capacity::GaugesJson();
+  os << ",\"tables\":[";
+  for (size_t i = 0; i < snapshot.size(); ++i) {
+    auto [wt, st, bt] = snapshot[i];
+    if (i) os << ',';
+    os << "{\"id\":" << i;
+    if (st) {
+      auto cap = st->Capacity();
+      int64_t bucket_gets[capacity::kLoadBuckets];
+      int64_t bucket_adds[capacity::kLoadBuckets];
+      st->BucketLoads(bucket_gets, bucket_adds);
+      os << ",\"shard\":{\"resident_bytes\":" << cap.bytes
+         << ",\"rows\":" << cap.rows;
+      os << ",\"gets\":" << st->total_gets()
+         << ",\"adds\":" << st->total_adds();
+      auto emit_i64 = [&os](const char* name, const int64_t* v, int n) {
+        os << ",\"" << name << "\":[";
+        for (int b = 0; b < n; ++b) {
+          if (b) os << ',';
+          os << v[b];
+        }
+        os << "]";
+      };
+      auto bb = st->BucketBytes();
+      emit_i64("bucket_bytes", bb.data(),
+               static_cast<int>(bb.size()));
+      emit_i64("bucket_gets", bucket_gets, capacity::kLoadBuckets);
+      emit_i64("bucket_adds", bucket_adds, capacity::kLoadBuckets);
+      os << "}";
+      if (record) {
+        int64_t load[capacity::kLoadBuckets];
+        for (int b = 0; b < capacity::kLoadBuckets; ++b)
+          load[b] = bucket_gets[b] + bucket_adds[b];
+        capacity::RecordHistory(static_cast<int32_t>(i),
+                                st->total_gets(), st->total_adds(),
+                                cap.bytes, load);
+      }
+      os << ",\"history\":"
+         << capacity::HistoryJson(static_cast<int32_t>(i));
+    } else {
+      os << ",\"shard\":null";
+    }
+    if (bt) os << ",\"backup_bytes\":" << bt->Capacity().bytes;
+    if (wt) {
+      os << ",\"worker\":{\"agg_bytes\":" << wt->agg_bytes();
+      // Side-table bytes are their OWN fields (never folded into the
+      // shard count — the replica double-count fix, PR 15).
+      if (auto* mw = dynamic_cast<MatrixWorkerTable*>(wt)) {
+        auto rs = mw->replica_stats();
+        os << ",\"replica_rows\":" << rs.rows
+           << ",\"replica_bytes\":" << mw->replica_bytes();
+      }
+      if (auto* kw = dynamic_cast<KVWorkerTable*>(wt))
+        os << ",\"cache_bytes\":" << kw->cache_bytes();
+      os << "}";
+    }
+    os << "}";
+  }
+  os << "]}";
+  return os.str();
+}
+
+void Zoo::RecomputeCapacityAll() {
+  std::vector<ServerTable*> tables;
+  {
+    MutexLock lk(tables_mu_);
+    for (auto& t : server_tables_)
+      if (t) tables.push_back(t.get());
+    for (auto& t : backup_tables_)
+      if (t) tables.push_back(t.get());
+  }
+  for (auto* t : tables) t->RecomputeCapacity();
 }
 
 std::string Zoo::FleetReport(const std::string& kind) {

@@ -19,6 +19,7 @@
 #include "mvtpu/audit.h"
 #include "mvtpu/blob.h"
 #include "mvtpu/c_api.h"
+#include "mvtpu/capacity.h"
 #include "mvtpu/codec.h"
 #include "mvtpu/configure.h"
 #include "mvtpu/dashboard.h"
@@ -1057,6 +1058,147 @@ static int TestReplica() {
                         nullptr) == 0);
   CHECK(hits > hits0);
   CHECK(MV_SetHotKeyReplica(0) == 0);
+  return 0;
+}
+
+// First integer after "\"key\":" in a JSON doc, or `dflt` when absent
+// (strstr-grade parsing, the house style for report assertions).
+static long long JsonIntAfter(const std::string& doc, const std::string& key,
+                              long long dflt = -1) {
+  size_t at = doc.find("\"" + key + "\":");
+  if (at == std::string::npos) return dflt;
+  return std::strtoll(doc.c_str() + at + key.size() + 3, nullptr, 10);
+}
+
+static int TestCapacity() {
+  using mvtpu::capacity::kKVEntryOverhead;
+
+  // ---- matrix shard bytes: exact at construction ---------------------
+  int32_t h;
+  CHECK(MV_NewMatrixTable(128, 4, &h) == 0);
+  char* rep = MV_CapacityReport();
+  CHECK(rep != nullptr);
+  std::string doc(rep);
+  MV_FreeString(rep);
+  // Single process: the shard is the whole table — 128 rows x 4 cols
+  // x 4 bytes (default updater: no slot plane).
+  size_t at = doc.find("\"id\":" + std::to_string(h) + ",");
+  CHECK(at != std::string::npos);
+  std::string entry = doc.substr(at);
+  CHECK(JsonIntAfter(entry, "resident_bytes") == 128 * 4 * 4);
+  CHECK(JsonIntAfter(entry, "rows") == 128);
+  // Per-bucket bytes sum back to the shard total (the 64-bucket map).
+  {
+    size_t bb = entry.find("\"bucket_bytes\":[");
+    CHECK(bb != std::string::npos);
+    const char* p = entry.c_str() + bb + 16;
+    long long sum = 0;
+    for (int i = 0; i < 64; ++i) {
+      char* end = nullptr;
+      sum += std::strtoll(p, &end, 10);
+      p = end + 1;
+    }
+    CHECK(sum == 128 * 4 * 4);
+  }
+  // Proc stats ride the health report (RSS / fds present).
+  rep = MV_OpsReport("health");
+  std::string health(rep);
+  MV_FreeString(rep);
+  CHECK(health.find("\"rss_bytes\":") != std::string::npos);
+  CHECK(health.find("\"open_fds\":") != std::string::npos);
+  CHECK(JsonIntAfter(health, "rss_bytes") > 0);
+  CHECK(JsonIntAfter(health, "open_fds") > 0);
+
+  // ---- KV incremental accounting vs the ground-truth walk ------------
+  int32_t hk;
+  CHECK(MV_NewKVTable(&hk) == 0);
+  long long expect = 0;
+  for (int i = 0; i < 20; ++i) {
+    std::string key = "cap-key-" + std::to_string(i);
+    CHECK(MV_AddKV(hk, key.c_str(), 1.0f) == 0);
+    expect += static_cast<long long>(key.size()) + 4 + kKVEntryOverhead;
+  }
+  rep = MV_CapacityReport();
+  doc.assign(rep);
+  MV_FreeString(rep);
+  at = doc.find("\"id\":" + std::to_string(hk) + ",");
+  CHECK(at != std::string::npos);
+  entry = doc.substr(at);
+  CHECK(JsonIntAfter(entry, "resident_bytes") == expect);
+  CHECK(JsonIntAfter(entry, "rows") == 20);
+  // Duplicate adds do not grow the books.
+  CHECK(MV_AddKV(hk, "cap-key-0", 1.0f) == 0);
+  rep = MV_CapacityReport();
+  doc.assign(rep);
+  MV_FreeString(rep);
+  entry = doc.substr(doc.find("\"id\":" + std::to_string(hk) + ","));
+  CHECK(JsonIntAfter(entry, "rows") == 20);
+
+  // ---- disarm: growth hooks freeze; re-arm resyncs exactly -----------
+  CHECK(MV_SetCapacityTracking(0) == 0);
+  CHECK(MV_AddKV(hk, "while-disarmed", 2.0f) == 0);
+  rep = MV_CapacityReport();
+  doc.assign(rep);
+  MV_FreeString(rep);
+  CHECK(doc.find("\"armed\":false") != std::string::npos);
+  entry = doc.substr(doc.find("\"id\":" + std::to_string(hk) + ","));
+  CHECK(JsonIntAfter(entry, "rows") == 20);  // stale while disarmed
+  CHECK(MV_SetCapacityTracking(1) == 0);     // re-arm RESYNCS
+  expect += static_cast<long long>(strlen("while-disarmed")) + 4 +
+            kKVEntryOverhead;
+  rep = MV_CapacityReport();
+  doc.assign(rep);
+  MV_FreeString(rep);
+  entry = doc.substr(doc.find("\"id\":" + std::to_string(hk) + ","));
+  CHECK(JsonIntAfter(entry, "rows") == 21);
+  CHECK(JsonIntAfter(entry, "resident_bytes") == expect);
+
+  // ---- history ring: bounded at 64 windows ---------------------------
+  CHECK(MV_SetFlag("capacity_history_ms", "0") == 0);
+  for (int i = 0; i < 70; ++i) {
+    rep = MV_CapacityReport();
+    MV_FreeString(rep);
+  }
+  rep = MV_CapacityReport();
+  doc.assign(rep);
+  MV_FreeString(rep);
+  long long windows = JsonIntAfter(doc, "windows");
+  CHECK(windows >= 2 && windows <= 64);
+  CHECK(doc.find("\"curve\":[") != std::string::npos);
+  CHECK(doc.find("\"bucket_rate\":[") != std::string::npos);
+  CHECK(MV_SetFlag("capacity_history_ms", "250") == 0);
+
+  // ---- replica rows are their OWN field (double-count regression) ----
+  // With an armed replica install, the "tables" report must keep the
+  // shard row count pure and report replica entries separately — a
+  // capacity sum over rows+replica_rows is the caller's CHOICE, never
+  // a baked-in double count.
+  std::vector<float> ones(2 * 4, 1.0f), out(2 * 4, 0.0f);
+  int32_t hot[2] = {1, 2};
+  CHECK(MV_AddMatrixTableByRows(h, ones.data(), hot, 2, 4) == 0);
+  for (int i = 0; i < 8; ++i)
+    CHECK(MV_GetMatrixTableByRows(h, out.data(), hot, 2, 4) == 0);
+  CHECK(MV_SetHotKeyReplica(1) == 0);
+  CHECK(MV_ReplicaRefresh(h) == 0);
+  rep = MV_OpsReport("tables");
+  doc.assign(rep);
+  MV_FreeString(rep);
+  entry = doc.substr(doc.find("\"id\":" + std::to_string(h) + ","));
+  CHECK(JsonIntAfter(entry, "rows") == 128);          // shard rows only
+  CHECK(JsonIntAfter(entry, "replica_rows") >= 2);    // own field
+  // The capacity report agrees: worker.replica_bytes > 0, and the
+  // shard's resident bytes did NOT absorb the replica copies.
+  rep = MV_CapacityReport();
+  doc.assign(rep);
+  MV_FreeString(rep);
+  entry = doc.substr(doc.find("\"id\":" + std::to_string(h) + ","));
+  CHECK(JsonIntAfter(entry, "resident_bytes") == 128 * 4 * 4);
+  CHECK(JsonIntAfter(entry, "replica_bytes") > 0);
+  CHECK(MV_SetHotKeyReplica(0) == 0);
+
+  // ---- gauges object carries the registered native gauges ------------
+  CHECK(doc.find("\"host_arena.bytes\":") != std::string::npos);
+  CHECK(doc.find("\"net.writeq_bytes\":") != std::string::npos);
   return 0;
 }
 
@@ -3182,6 +3324,7 @@ int main(int argc, char** argv) {
       {"kv", TestKV},             {"threads", TestThreads},
       {"serve", TestServeVersions},
       {"workload", TestWorkload},
+      {"capacity", TestCapacity},
       {"replica", TestReplica},
       {"repl", TestRepl},
       {"multiblob_add", TestMultiBlobAdd},

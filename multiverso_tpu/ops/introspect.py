@@ -145,6 +145,19 @@ class OpsClient:
         wrapper — ``tools/mvtop.py --replication`` renders it."""
         return json.loads(self.report("replication", fleet=fleet))
 
+    def capacity(self, fleet: bool = False):
+        """Capacity-plane report (docs/observability.md "capacity
+        plane"): per rank, /proc stats (RSS / VmHWM / open fds /
+        uptime), arena + write-queue + registered byte gauges, and per
+        table the shard's resident bytes/rows with per-bucket byte and
+        load arrays plus the bounded load-history ring (rate curves).
+        Worker-side replica/agg/cache bytes are their OWN fields, so
+        capacity sums never double-count a replicated row.  Fleet scope
+        returns the usual ``{"ranks": {...}}`` wrapper —
+        ``tools/mvplan.py`` bin-packs placement proposals over it and
+        ``tools/mvtop.py --capacity`` renders it."""
+        return json.loads(self.report("capacity", fleet=fleet))
+
     def metrics(self, fleet: bool = False) -> Tuple[
             Dict[str, float], Dict[str, Dict[str, str]]]:
         """(values, exemplars) of the scraped exposition text."""

@@ -18,13 +18,19 @@ caused by the version bound).
 
 from __future__ import annotations
 
+import itertools
 import threading
+import weakref
 from collections import OrderedDict
 from typing import Any, Hashable, Optional, Tuple
 
-from .. import metrics
+from .. import capacity, metrics
 
 __all__ = ["VersionedLRUCache"]
+
+# Distinguishes same-named caches in the capacity gauge registry (two
+# ServeClients both name theirs "serve").
+_GAUGE_SEQ = itertools.count()
 
 
 class VersionedLRUCache:
@@ -43,6 +49,28 @@ class VersionedLRUCache:
         self._lock = threading.Lock()
         self._entries: "OrderedDict[Hashable, Tuple[Any, int]]" = \
             OrderedDict()  # bounded: see store()'s popitem eviction
+        # Capacity plane (docs/observability.md): every serve-plane
+        # cache registers a byte gauge — MV018's contract.  Weakly
+        # bound: a dead cache prunes its own gauge at the next
+        # snapshot, so short-lived ServeClients never leak registry
+        # entries (that would be untracked growth in the tracker).
+        self._gauge_name = f"{name}.cache.{next(_GAUGE_SEQ)}"
+        ref = weakref.ref(self)
+
+        def _gauge(ref=ref, gname=self._gauge_name) -> int:
+            obj = ref()
+            if obj is None:
+                capacity.unregister_gauge(gname)
+                return 0
+            return obj.bytes()
+
+        capacity.register_gauge(self._gauge_name, _gauge)
+
+    def bytes(self) -> int:
+        """Resident bytes of the cached values (+ per-entry overhead,
+        the shared capacity unit)."""
+        with self._lock:
+            return capacity.container_bytes(self._entries)
 
     def _tick(self, what: str) -> None:
         metrics.counter(f"{self._name}.cache.{what}").inc()

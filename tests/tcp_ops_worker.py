@@ -43,6 +43,10 @@ def main() -> int:
     if rank == 0:
         print("FLEET_HOTKEYS " + rt.ops_fleet_report("hotkeys"),
               flush=True)
+        # Capacity plane (docs/observability.md "capacity plane"): the
+        # same engine-agnostic path must carry the "capacity" kind.
+        print("FLEET_CAPACITY " + rt.ops_fleet_report("capacity"),
+              flush=True)
     rt.barrier()
     rt.shutdown()
     print(f"TCP_OPS_OK {rank}", flush=True)

@@ -271,6 +271,37 @@ def test_gate_passes_in_band_audit_line(tmp_path):
     assert rc == 0, out
 
 
+def test_gate_guards_capacity_keys(tmp_path):
+    """bench_capacity acceptance bars (docs/observability.md "capacity
+    plane"): accounting overhead past the always-on 1% bar, resident-
+    byte books drifting under the ground truth (the advisor would plan
+    over a fiction), or a placement proposal whose projected spread
+    blows the 2x bar must all fail the gate."""
+    line = {"extras": {"capacity_overhead_pct": 3.0,      # > 1% bar
+                       "capacity_bytes_accuracy": 0.5,    # lost bytes
+                       "capacity_kv_accuracy": 0.4,       # resync broke
+                       "mvplan_spread_after": 4.0}}       # > 2x bar
+    p = tmp_path / "capacity_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "capacity_overhead_pct" in out and "FAIL" in out, out
+    assert "capacity_bytes_accuracy" in out, out
+    assert "capacity_kv_accuracy" in out, out
+    assert "mvplan_spread_after" in out, out
+
+
+def test_gate_passes_in_band_capacity_line(tmp_path):
+    line = {"extras": {"capacity_overhead_pct": 0.4,
+                       "capacity_bytes_accuracy": 1.0,
+                       "capacity_kv_accuracy": 0.98,
+                       "mvplan_spread_after": 1.1}}
+    p = tmp_path / "capacity_ok.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
 def test_gate_guards_failover_keys(tmp_path):
     """bench_failover acceptance bars (docs/replication.md): detection
     or promotion drifting past seconds, a caller-visible blackout past

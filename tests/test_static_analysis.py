@@ -800,6 +800,110 @@ def test_mv017_out_of_scope_and_suppressible(tmp_path):
     assert _lint_src(tmp_path, suppressed) == []
 
 
+def _lint_serve_src(tmp_path, src, name="snippet.py"):
+    """Write src into a serve-plane path (MV018's Python scope)."""
+    serve = tmp_path / "multiverso_tpu" / "serve"
+    serve.mkdir(parents=True, exist_ok=True)
+    p = serve / name
+    p.write_text(textwrap.dedent(src))
+    return [(f.rule, f.line) for f in mvlint.lint_file(str(p))]
+
+
+def test_mv018_fires_on_untracked_serve_growth(tmp_path):
+    """A serve-plane cache/queue with no registered capacity gauge is
+    invisible to the fleet capacity scrape — the placement advisor
+    plans over a fiction (docs/observability.md "capacity plane")."""
+    rules = _lint_serve_src(tmp_path, """\
+        from collections import OrderedDict, deque
+
+        class RowCache:
+            def __init__(self):
+                self._entries = OrderedDict()           # BAD
+
+        class Pipeline:
+            def __init__(self):
+                self.reply_queue = deque(maxlen=64)     # BAD: bounded
+                                                        # but invisible
+        """)
+    assert [r for r, _ in rules] == ["MV018"] * 2, rules
+
+
+def test_mv018_gauge_evidence_and_exemption_are_legal(tmp_path):
+    rules = _lint_serve_src(tmp_path, """\
+        from collections import OrderedDict, deque
+
+        from .. import capacity
+
+        class GaugedCache:
+            def __init__(self):
+                self._entries = OrderedDict()
+                capacity.register_gauge("gauged.cache", self.bytes)
+
+            def bytes(self):
+                return 0
+
+        class ExemptQueue:
+            def __init__(self):
+                self.q_ring = deque(  # mvlint: MV018-exempt(drained \
+synchronously inside one reactor turn — never holds bytes across calls)
+                    maxlen=8)
+        """)
+    assert rules == [], rules
+
+
+def test_mv018_native_member_needs_capacity_note(tmp_path):
+    """Native edition: a growth-named container member must name how
+    its bytes reach the "capacity" report (or carry a reasoned
+    exemption)."""
+    bad = tmp_path / "state.h"
+    bad.write_text(textwrap.dedent("""\
+        struct WorkerState {
+          std::deque<Frame> reply_queue_;
+        };
+        """))
+    rules = [(f.rule, f.line) for f in mvlint.lint_file(str(bad))]
+    assert [r for r, _ in rules] == ["MV018"], rules
+
+    good = tmp_path / "state_ok.h"
+    good.write_text(textwrap.dedent("""\
+        struct WorkerState {
+          // capacity: writeq_bytes gauge (the "capacity" report's
+          // net.writeq_bytes field)
+          std::deque<Frame> reply_queue_;
+          // mvlint: MV018-exempt(one entry per in-flight call)
+          std::unordered_map<int64_t, Pending> pending_;
+        };
+        """))
+    assert mvlint.lint_file(str(good)) == []
+    # An EMPTY exemption reason does not suppress — the why is the
+    # point of the marker.
+    empty = tmp_path / "state_empty.h"
+    empty.write_text(textwrap.dedent("""\
+        struct WorkerState {
+          // mvlint: MV018-exempt()
+          std::deque<Frame> reply_queue_;
+        };
+        """))
+    rules = [(f.rule, f.line) for f in mvlint.lint_file(str(empty))]
+    assert [r for r, _ in rules] == ["MV018"], rules
+
+
+def test_mv018_out_of_scope_paths(tmp_path):
+    """Python scope is the serve plane only; tests are exempt."""
+    src = """\
+        from collections import OrderedDict
+
+        class SideCache:
+            def __init__(self):
+                self._entries = OrderedDict()
+        """
+    assert [r for r, _ in _lint_serve_src(tmp_path, src)] == ["MV018"]
+    # Same class OUTSIDE the serve plane: MV018 stays quiet (MV007
+    # still polices unbounded growth there).
+    assert _lint_src(tmp_path, src) == []
+    assert _lint_serve_src(tmp_path, src, name="test_cache.py") == []
+
+
 def test_suppression_comment(tmp_path):
     rules = _lint_src(tmp_path, """\
         rt.flush_async(q)  # mvlint: disable=MV002 — fire-and-forget flush

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""capacity-demo — acceptance smoke for the capacity plane
+(docs/observability.md "capacity plane"; ``make capacity-demo``).
+
+Spawns a THREE-rank ``apps/capacity_bench_worker.py`` fleet (epoll
+engine, demo mode) and asserts the acceptance bars:
+
+(a) **Skewed bucket bytes surface** — keys mined into 8 of the 64
+    KVHash buckets leave the fleet capacity scrape showing a per-bucket
+    byte skew > 2x, and the zipf get herd leaves a per-bucket load skew
+    > 2x on the matrix table: the advisor's two inputs are real data.
+(b) **mvplan proposes a rebalance** — greedy bin-packing over
+    (bucket bytes x load rate) projects a per-shard spread <= 2x
+    (LPT sits near 1.0), even with a rank-0-only big table making the
+    OBSERVED spread read imbalanced.
+(c) **RSS and arena gauges move** — a ~2.8 MiB table shard plus a
+    4 MiB pinned arena buffer landing on rank 0 mid-run move the
+    scraped RSS and ``host_arena.bytes`` gauges by at least a
+    megabyte-class delta.
+(d) **Accounting stays honest under the toggle** — the interleaved
+    armed/disarmed sweeps report < 5% overhead locally and the
+    re-arm-resynced byte books match the ground truth within 10%.
+
+Prints ``CAPACITY_DEMO_OK`` and exits 0 on success.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NRANKS = 3
+NCLIENTS = 64
+ROWS = 2048
+REQS = 192
+
+
+def main() -> int:
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    tmp = tempfile.mkdtemp(prefix="mvtpu_capacity_demo_")
+    socks = [socket.socket() for _ in range(NRANKS)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    mf = os.path.join(tmp, "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+
+    worker = os.path.join(REPO, "multiverso_tpu", "apps",
+                          "capacity_bench_worker.py")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, mf, str(r), str(NCLIENTS), str(ROWS),
+         str(REQS), "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for r in range(NRANKS)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=600)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        if p.returncode != 0 or "CAPACITY_BENCH_OK" not in out:
+            raise RuntimeError(f"capacity worker failed:\n{out[-3000:]}")
+
+    kv = {}
+    for out in outs:
+        for m in re.finditer(r"(\w+)=(-?[0-9.]+)", out):
+            kv.setdefault(m.group(1), float(m.group(2)))
+
+    # (a) bucket byte + load skew are visible in the fleet scrape.
+    assert kv["demo_bytes_skew"] > 2.0, kv
+    assert kv["demo_load_skew"] > 2.0, kv
+    print(f"capacity-demo: bucket skew — bytes "
+          f"{kv['demo_bytes_skew']:.2f}x (mined KV buckets), load "
+          f"{kv['demo_load_skew']:.2f}x (zipf herd)")
+
+    # (b) the advisor's projected spread clears the 2x bar.
+    assert kv["mvplan_spread_after"] <= 2.0, kv
+    print(f"capacity-demo: mvplan projected per-shard spread "
+          f"{kv['mvplan_spread_after']:.2f}x (observed "
+          f"{kv.get('demo_observed_spread', 0.0):.2f}x before the "
+          f"proposed rebalance; {int(kv['mvplan_moves'])} bucket "
+          f"moves proposed on the herd table)")
+
+    # (c) RSS and arena gauges moved when the big table landed.
+    assert kv["demo_rss_delta"] > 1e6, kv
+    assert kv["demo_arena_delta"] >= (1 << 20), kv
+    print(f"capacity-demo: big-table load moved rank 0 RSS by "
+          f"{kv['demo_rss_delta'] / 1e6:.1f} MB and host_arena.bytes "
+          f"by {kv['demo_arena_delta'] / 1e6:.1f} MB")
+
+    # (d) the accounting is cheap and honest.
+    assert kv["capacity_overhead_pct"] < 5.0, kv
+    assert 0.9 <= kv["capacity_bytes_accuracy"] <= 1.1, kv
+    assert 0.9 <= kv["capacity_kv_accuracy"] <= 1.1, kv
+    print(f"capacity-demo: overhead {kv['capacity_overhead_pct']:.2f}% "
+          f"(armed vs disarmed), byte books at "
+          f"{kv['capacity_bytes_accuracy']:.3f}x / "
+          f"{kv['capacity_kv_accuracy']:.3f}x of ground truth")
+
+    print("CAPACITY_DEMO_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

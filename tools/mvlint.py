@@ -166,6 +166,22 @@ Rules (docs/static_analysis.md has the full rationale):
   pre-replication sites with the marker and a reason.  Tests and the
   SPMD collective plane (no wire) are out of scope.
 
+- **MV018 untracked-growth** — a cache/queue/ring added to native
+  server/worker state or the Python serve plane WITHOUT a registered
+  capacity gauge (docs/observability.md "capacity plane"): bytes held
+  outside the table shards are invisible to the fleet capacity scrape,
+  so the placement advisor (tools/mvplan.py) and mvtop --capacity plan
+  over a fiction.  Python scope: serve-plane library classes whose
+  container attribute (or class name) says cache/queue/ring must show
+  ``capacity.register_gauge(...)`` evidence.  Native scope: member
+  declarations of ``std::deque/map/unordered_map/...`` whose name says
+  cache/queue/ring/pending/parked/replica/archive/event must carry a
+  ``// capacity: <how it is accounted>`` note (naming its gauge or
+  report field) on the declaration or the lines just above.  Exempt a
+  genuinely bounded-by-protocol container with
+  ``mvlint: MV018-exempt(<why growth is bounded>)`` — the reason is
+  mandatory; an empty marker does not suppress.
+
 Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
 """
 
@@ -1091,6 +1107,105 @@ def check_swallowed_native_exception(tree, path):
     return out
 
 
+# ---------------------------------------------------------------- MV018
+# Untracked growth: containers whose NAME (or owning class name) says
+# they hold traffic-shaped state must be visible to the capacity plane
+# (docs/observability.md "capacity plane").
+_GROWTH_WORDS = ("cache", "queue", "ring")
+
+
+def _is_container_construction(value):
+    """`{}` / dict() / OrderedDict() / defaultdict() / deque(...) —
+    bounded or not: MV007 polices the bound, MV018 the VISIBILITY."""
+    if isinstance(value, ast.Dict) and not value.keys:
+        return True
+    if not isinstance(value, ast.Call):
+        return False
+    return _call_name(value.func) in ("dict", "OrderedDict",
+                                      "defaultdict", "deque")
+
+
+def check_untracked_growth(tree, path):
+    """MV018 (Python serve plane): a growth-named container attribute
+    in a class with no ``capacity.register_gauge`` evidence."""
+    out = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        evidence = any(
+            (isinstance(n, ast.Attribute) and n.attr == "register_gauge")
+            or (isinstance(n, ast.Name) and n.id == "register_gauge")
+            for n in ast.walk(cls))
+        if evidence:
+            continue
+        cls_growth = any(w in cls.name.lower() for w in _GROWTH_WORDS)
+        for node in ast.walk(cls):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            for t in targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                lname = t.attr.lower()
+                named = any(w in lname for w in _GROWTH_WORDS)
+                if not (named or cls_growth):
+                    continue
+                if _is_container_construction(value):
+                    out.append(Finding(
+                        path, node.lineno, "MV018",
+                        f"self.{t.attr} in {cls.name} holds serve-plane "
+                        f"state with no registered capacity gauge — the "
+                        f"fleet capacity scrape (and tools/mvplan.py) "
+                        f"cannot see these bytes; call "
+                        f"capacity.register_gauge(...) for the class or "
+                        f"mark the line `mvlint: MV018-exempt(reason)` "
+                        f"with why growth is bounded elsewhere"))
+    return out
+
+
+# Native member declarations of node-based containers whose name says
+# growth.  [^;=] crosses newlines, so multi-line declarations match;
+# the reported line is the NAME's line.
+_NATIVE_GROWTH = re.compile(
+    r"std::(?:deque|list|map|multimap|set|unordered_map|unordered_set)<"
+    r"[^;=]*>\s+(\w*(?:cache|queue|ring|pending|parked|replica|archive|"
+    r"event|wq)\w*)\s*(?:GUARDED_BY\s*\([^)]*\)\s*)?[;={]")
+# Evidence window above the declaration (comment lines).
+_MV018_LOOKBACK = 4
+_MV018_EXEMPT = re.compile(r"MV018-exempt\(\s*[^)\s]")
+
+
+def check_native_untracked_growth(path, src):
+    """MV018 (native server/worker state): growth-named container
+    members need a `// capacity:` accounting note or a reasoned
+    exemption marker within the declaration's comment block."""
+    out = []
+    for m in _NATIVE_GROWTH.finditer(src):
+        name_line = src.count("\n", 0, m.start(1)) + 1
+        lines = src.splitlines()
+        lo = max(0, src.count("\n", 0, m.start()) + 1 - 1 -
+                 _MV018_LOOKBACK)
+        window = "\n".join(lines[lo:name_line])
+        if "capacity:" in window:
+            continue
+        if _MV018_EXEMPT.search(window):
+            continue
+        out.append(Finding(
+            path, name_line, "MV018",
+            f"native member {m.group(1)} is growth-shaped state with "
+            f"no capacity accounting note — add `// capacity: <gauge "
+            f"or report field>` naming how the bytes reach the "
+            f"\"capacity\" report, or `mvlint: MV018-exempt(reason)` "
+            f"explaining why growth is bounded"))
+    return out
+
+
 # ---------------------------------------------------------------- MV009
 # Native reactor-context lint: the only non-Python rule.  A file opts in
 # with this marker (the epoll engine sources carry it); the rule then
@@ -1147,9 +1262,12 @@ def lint_native_file(path):
     except (OSError, UnicodeDecodeError) as exc:
         return [Finding(path, 0, "MV000",
                         f"unreadable: {exc.__class__.__name__}")]
-    if REACTOR_MARKER not in src:
-        return []
-    findings = lint_reactor_file(path, src)
+    findings = []
+    if REACTOR_MARKER in src:
+        findings += lint_reactor_file(path, src)
+    # MV018 runs over every native source: server/worker state is
+    # wherever a growth-named member lives.
+    findings += check_native_untracked_growth(path, src)
     lines = src.splitlines()
     return [f for f in findings
             if f"mvlint: disable={f.rule}" not in
@@ -1194,6 +1312,12 @@ def lint_file(path):
         # the routing epoch (docs/replication.md) — runtime + tools +
         # apps scope; tests legitimately pin routes.
         findings += check_stale_shard_route(tree, path)
+    # Serve-plane library code: growth must be visible to the capacity
+    # plane (MV018) — tests are out of scope (fixtures build throwaway
+    # containers on purpose).
+    norm = path.replace(os.sep, "/")
+    if "/serve/" in norm and not in_tests:
+        findings += check_untracked_growth(tree, path)
     # App/model plane: the batched-row-call discipline (the serve/wire
     # layers amortize per CALL, so a per-row Python loop defeats every
     # one of them at once).
@@ -1221,13 +1345,18 @@ def lint_file(path):
         if os.path.basename(path) != "metrics.py":
             findings += check_observability_bypass(tree, path)
             findings += check_label_cardinality(tree, path)
-    # Per-line suppressions.
+    # Per-line suppressions: the generic disable marker, or a rule's
+    # reasoned -exempt(...) form (the reason is mandatory — an empty
+    # marker does not suppress).
     lines = src.splitlines()
     kept = []
     for f in findings:
         line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
-        if f"mvlint: disable={f.rule}" not in line:
-            kept.append(f)
+        if f"mvlint: disable={f.rule}" in line:
+            continue
+        if re.search(rf"mvlint:\s*{f.rule}-exempt\(\s*[^)\s]", line):
+            continue
+        kept.append(f)
     return kept
 
 

@@ -33,6 +33,15 @@ admit/shed totals, and deadline sheds, plus the rank's hedge-cancel
 ledger; under ``--watch`` two-scrape ``admit/s``/``shed/s`` rate
 columns join under the same ``-``-before-two-scrapes discipline.
 
+``--capacity`` switches to the capacity view (the ``"capacity"``
+OpsQuery kind, docs/observability.md "capacity plane"): one row per
+(rank, table) with shard resident bytes/rows, the worker replica side
+table as its own column, agg-buffer bytes, and the rank's arena /
+write-queue / RSS gauges; under ``--watch`` two-scrape byte-growth
+columns (``b/s``, ``rss/s``) join under the ``-``-before-first-scrape
+discipline.  ``tools/mvplan.py`` turns the same scrape into a dry-run
+placement proposal.
+
 ``--replication`` switches to the replication view (the
 ``"replication"`` OpsQuery kind, docs/replication.md): one row per
 rank with the routing epoch, the shard→owner and shard→backup maps,
@@ -88,6 +97,10 @@ _QOS_RATE_COLS = ("admit/s", "shed/s")
 _REPL_COLS = ("rank", "armed", "sync", "epoch", "owners", "backups",
               "backs", "promoted", "fwd", "acks", "applied", "lag",
               "catchups", "dup_skip")
+
+_CAP_COLS = ("rank", "table", "res_bytes", "rows", "repl_rows",
+             "agg_B", "arena_B", "arena_def", "wq_B", "rss_MB")
+_CAP_RATE_COLS = ("b/s", "rss/s")
 
 _SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
 
@@ -168,6 +181,12 @@ class RateTracker:
         # Audit view's rate column rides the same two-scrape state.
         if "dups" in counters:
             cols["dup/s"] = fmt("dups")
+        # Capacity view's byte-growth columns (docs/observability.md
+        # "capacity plane") — '-' before two scrapes, never a fake 0.
+        if "res_bytes" in counters:
+            cols["b/s"] = fmt("res_bytes")
+        if "rss" in counters:
+            cols["rss/s"] = fmt("rss")
         # QoS view's per-class rate columns (docs/serving.md "tail").
         if "admits" in counters:
             cols["admit/s"] = fmt("admits")
@@ -376,6 +395,76 @@ def collect_audit(endpoints: list, fleet: bool, timeout: float,
     return rows
 
 
+def capacity_rows(per_rank: dict, tracker: "RateTracker" = None,
+                  now: float = None) -> list:
+    """One row per (rank, table) from ``{rank: capacity-report}``
+    (docs/observability.md "capacity plane"): shard resident bytes and
+    rows, the worker replica side table as its OWN column (never folded
+    into the shard count — the double-count fix), agg-buffer bytes, the
+    rank's arena/write-queue/RSS gauges, and — with a tracker (watch
+    mode) — two-scrape byte-growth columns (``b/s``/``rss/s``), '-'
+    before two scrapes exist, never a fake zero.  Pure, so the
+    canned-scrape tests drive it without a fleet."""
+    rows = []
+    for rank in sorted(per_rank, key=str):
+        doc = per_rank[rank]
+        if not doc:
+            rows.append({c: "-" for c in _CAP_COLS} | {"rank": rank})
+            continue
+        arena = doc.get("arena") or {}
+        proc = doc.get("proc") or {}
+        net = doc.get("net") or {}
+        rss = proc.get("rss_bytes", -1) or -1
+        for t in doc.get("tables") or []:
+            shard = t.get("shard")
+            if not shard:
+                continue
+            worker = t.get("worker") or {}
+            res = shard.get("resident_bytes", 0)
+            row = {
+                "rank": rank,
+                "table": t.get("id", "?"),
+                "res_bytes": res,
+                "rows": shard.get("rows", 0),
+                "repl_rows": worker.get("replica_rows", 0),
+                "agg_B": worker.get("agg_bytes", 0),
+                "arena_B": arena.get("bytes", 0),
+                "arena_def": arena.get("deferred", 0),
+                "wq_B": net.get("writeq_bytes", 0),
+                "rss_MB": f"{rss / 1e6:.1f}" if rss >= 0 else "-",
+            }
+            if tracker is not None:
+                rates = tracker.update(
+                    f"{rank}/{row['table']}",
+                    {"vmax": 0, "res_bytes": res,
+                     "rss": rss if rss >= 0 else None}, now=now)
+                row["b/s"] = rates.get("b/s", "-")
+                row["rss/s"] = rates.get("rss/s", "-")
+            rows.append(row)
+    return rows
+
+
+def collect_capacity(endpoints: list, fleet: bool, timeout: float,
+                     tracker: "RateTracker" = None) -> list:
+    per_rank = {}
+    if fleet:
+        with OpsClient(endpoints[0], timeout=timeout) as c:
+            doc = c.capacity(fleet=True)
+        for rank, rep in (doc.get("ranks") or {}).items():
+            per_rank[str(rank)] = rep
+        for rank in doc.get("silent") or []:
+            per_rank[str(rank)] = None
+    else:
+        for ep in endpoints:
+            try:
+                with OpsClient(ep, timeout=timeout) as c:
+                    rep = c.capacity()
+                per_rank[str(rep.get("rank", ep))] = rep
+            except (ConnectionError, OSError, TimeoutError):
+                per_rank[str(ep)] = None
+    return capacity_rows(per_rank, tracker=tracker)
+
+
 def repl_rows(doc: dict) -> list:
     """One row per rank from a fleet ``"replication"`` report
     (docs/replication.md): the routed shard map, who backs what, and
@@ -461,6 +550,12 @@ def main(argv=None) -> int:
                     help="tail-plane tenant view: per-class admission "
                          "budgets, admit/shed totals, deadline sheds "
                          "and hedge cancels (docs/serving.md \"tail\")")
+    ap.add_argument("--capacity", action="store_true",
+                    help="capacity view: per-(rank, table) resident "
+                         "bytes/rows, replica side-table rows, arena "
+                         "and write-queue gauges, RSS, and (--watch) "
+                         "two-scrape byte-growth rates "
+                         "(docs/observability.md \"capacity plane\")")
     ap.add_argument("--replication", action="store_true",
                     help="replication view: routing epoch + shard "
                          "owner/backup maps, promoted shards, and the "
@@ -492,6 +587,15 @@ def main(argv=None) -> int:
             cols = _QOS_COLS + (_QOS_RATE_COLS if t else ())
             stamp = time.strftime("%H:%M:%S")
             print(f"mvtop --qos @ {stamp} — {len(rows)} class row(s)")
+            print(render(rows, cols))
+        elif args.capacity:
+            t = tracker if args.watch > 0 else None
+            rows = collect_capacity(args.endpoints, args.fleet,
+                                    args.timeout, tracker=t)
+            cols = _CAP_COLS + (_CAP_RATE_COLS if t else ())
+            stamp = time.strftime("%H:%M:%S")
+            print(f"mvtop --capacity @ {stamp} — {len(rows)} "
+                  f"table row(s)")
             print(render(rows, cols))
         elif args.replication:
             rows = collect_replication(args.endpoints, args.fleet,
