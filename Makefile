@@ -28,10 +28,16 @@ analyze:
 mvlint:
 	$(PYTHON) tools/mvlint.py
 
+# Cross-language contract checker (docs/static_analysis.md): statically
+# diffs the wire schema, C-API/ctypes/Lua signatures, rc-code map, and
+# the configure.cc/config.py/docs flag surface — no build, no process.
+contract:
+	$(PYTHON) tools/mvcontract.py --strict
+
 # Umbrella: every static layer.  `make lint` green == what
-# tests/test_static_analysis.py enforces in tier-1 (mvlint always;
-# analyze when clang is present).
-lint: mvlint
+# tests/test_static_analysis.py + tests/test_contract.py enforce in
+# tier-1 (mvlint + mvcontract always; analyze when clang is present).
+lint: mvlint contract
 	@if command -v clang++ >/dev/null 2>&1; then \
 	  $(MAKE) -C $(NATIVE) analyze; \
 	else \
@@ -184,7 +190,7 @@ bench-gate:
 clean:
 	$(MAKE) -C $(NATIVE) clean
 
-.PHONY: all test tsan asan analyze mvlint lint chaos metrics-demo \
+.PHONY: all test tsan asan analyze mvlint contract lint chaos metrics-demo \
         serve-demo wire-demo fanin-demo ops-demo skew-demo \
         embedding-demo bridge-demo latency-demo audit-demo \
         capacity-demo failover-demo demos bench-gate clean

@@ -1024,7 +1024,7 @@ def bench_bridge(size: int = 16 * 1024 * 1024):
                 time.sleep(compute_s)          # "device step" (no state)
                 # Not a subprocess wait: the bridge wait is bounded by
                 # the native -rpc_timeout_ms deadline.
-                s = off.wait()  # mvlint: disable=MV004
+                s = off.wait()  # mvlint: MV004-exempt(bridge wait bounded by the native -rpc_timeout_ms deadline)
                 off.push(s, blocking=blocking)  # update + ship
                 if not blocking:
                     off.prefetch()

@@ -715,7 +715,7 @@ def _tail_bench(endpoint: str, nclients: int, rt, hk, hm) -> dict:
     # (version probes ignore msg_id uniqueness, so one frame per arm
     # serves every probe).
     esocks = bulk[:64]
-    frame_plain = pack_frame(MSG["RequestVersion"], 0, 1)  # mvlint: disable=MV016 — the unstamped A/B baseline arm
+    frame_plain = pack_frame(MSG["RequestVersion"], 0, 1)  # mvlint: MV016-exempt(the unstamped A/B baseline arm)
     frame_qos = pack_frame(MSG["RequestVersion"], 0, 1,
                            qos=(0, budget_ns))
 

@@ -16,6 +16,10 @@
 --
 -- Error convention: C rc < 0 raises a Lua error naming the call and rc
 -- (rc=-3 means an unreachable peer / expired deadline — see c_api.h).
+--
+-- Contract-checked: tools/mvcontract.py (`make contract`) diffs every
+-- prototype in the cdef block below against c_api.h (a deliberate
+-- subset, but each cdef'd signature must match exactly).
 
 local ffi = require("ffi")
 

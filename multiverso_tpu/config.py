@@ -141,6 +141,9 @@ def all_flags() -> Dict[str, Any]:
 
 # ---------------------------------------------------------------------------
 # Core flags — names match the reference CLI (SURVEY.md §2.20).
+# Contract-checked: tools/mvcontract.py (`make contract`) diffs these
+# registrations against configure.cc and the docs/*.md flag tables —
+# a flag shared with the native plane must keep the same default.
 # ---------------------------------------------------------------------------
 
 define_bool("sync", False, "BSP (True) vs ASP (False) training semantics")

@@ -361,7 +361,7 @@ class AsyncGet:
             return
         try:
             self._rt.lib.MV_CancelGet(self._ticket)
-        except Exception:  # mvlint: disable=MV015 — __del__ at
+        except Exception:  # mvlint: MV015-exempt(__del__ at teardown)
             # interpreter teardown: the lib may already be reclaimed,
             # and raising from a finalizer only aborts the teardown.
             pass
@@ -389,7 +389,7 @@ class HostArena:
 
     def __init__(self, rt: "NativeRuntime"):
         self._rt = rt
-        self._bases: dict = {}  # mvlint: disable=MV007 — one entry per live buffer, freed by release()
+        self._bases: dict = {}  # mvlint: MV007-exempt(one entry per live buffer, freed by release)
 
     def alloc(self, shape, dtype=np.float32) -> np.ndarray:
         shape = (int(shape),) if np.isscalar(shape) else tuple(shape)

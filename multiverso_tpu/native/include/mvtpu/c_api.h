@@ -17,6 +17,10 @@
 // retry can double-apply), and a timed-out Get's output buffer may be
 // partially filled.  Treat -3 as "state unknown": re-Get before
 // deciding whether to re-Add.
+// Contract-checked: tools/mvcontract.py (`make contract`) parses the
+// rc map above and every prototype below, and diffs them against the
+// ctypes binding and the Lua cdef — a new entry point must land with
+// its Python side or tier-1 fails.
 #pragma once
 
 #include <stdint.h>

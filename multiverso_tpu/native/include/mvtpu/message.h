@@ -1,5 +1,8 @@
 // Message — the wire/mailbox unit: routing header + blob payload.
 // Capability parity with include/multiverso/message.h (SURVEY.md §2.4).
+// Contract-checked: tools/mvcontract.py (`make contract`) statically
+// diffs the MsgType/msgflag values and the stamp struct layouts below
+// against serve/wire.py — change them together or tier-1 fails.
 #pragma once
 
 #include <cstdint>

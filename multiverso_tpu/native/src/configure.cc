@@ -134,6 +134,9 @@ void Reset() {
   for (auto& kv : Registry()) kv.second.value = kv.second.dflt;
 }
 
+// Contract-checked: tools/mvcontract.py (`make contract`) diffs these
+// registrations against config.py and the docs/*.md flag tables — a
+// flag shared with the Python plane must keep the same default.
 void RegisterDefaults() {
   static std::once_flag once;
   std::call_once(once, [] {

@@ -748,7 +748,7 @@ std::shared_ptr<EpollNet::Conn> EpollNet::ConnectToRank(int dst_rank) {
     // Pre-reactor blocking handshake: this runs on the SENDER's thread
     // (never the reactor); only the established socket enters the event
     // loop, nonblocking.
-    if (::connect(fd, res->ai_addr,  // mvlint: disable=MV009 (pre-reactor)
+    if (::connect(fd, res->ai_addr,  // mvlint: MV009-exempt(pre-reactor)
                   res->ai_addrlen) == 0)
       break;
     ::close(fd);
@@ -777,7 +777,7 @@ std::shared_ptr<EpollNet::Conn> EpollNet::ConnectToRank(int dst_rank) {
               hello_body.size());
   size_t hello_sent = 0;
   while (hello_sent < hello_wire.size()) {
-    ssize_t w = ::send(  // mvlint: disable=MV009 (pre-reactor handshake)
+    ssize_t w = ::send(  // mvlint: MV009-exempt(pre-reactor handshake)
         fd, hello_wire.data() + hello_sent, hello_wire.size() - hello_sent,
         MSG_NOSIGNAL);
     if (w <= 0) {

@@ -93,7 +93,7 @@ class ServeClient:
             retry_on=(BusyError,))
         # Version-knowledge lease per handle: (version, monotonic ts).
         # Bounded by the process's table-handle count, not by data.
-        self._known: dict = {}  # mvlint: disable=MV007 — one entry per table handle
+        self._known: dict = {}  # mvlint: MV007-exempt(one entry per table handle)
         # Fleet routing epoch last observed (docs/replication.md):
         # re-checked before every cached read — a promotion/join flip
         # voids cached entries and version leases, whose stamps came

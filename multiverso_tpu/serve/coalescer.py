@@ -61,7 +61,7 @@ class Coalescer:
         self._lock = threading.Lock()
         # At most one OPEN batch per key; sealed batches leave the dict
         # before executing, so this cannot grow past the live key set.
-        self._open: dict = {}  # mvlint: disable=MV007 — one entry per in-flight key, removed on seal
+        self._open: dict = {}  # mvlint: MV007-exempt(one entry per in-flight key, removed on seal)
 
     def submit(self, key: Hashable, item: Any,
                execute: Callable[[List[Any]], List[Any]]) -> Any:

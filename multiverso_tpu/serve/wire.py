@@ -17,7 +17,7 @@ Frame layout (one ``Message``, little-endian, matching
     WireHeader {                      # 56 bytes
         int32 src, dst, type, table_id
         int64 msg_id, trace_id, version
-        int32 codec, flags, num_blobs, pad
+        int32 codec, flags, num_blobs, shard_hint
     }
     num_blobs x { int64 len; bytes payload }
 
@@ -32,6 +32,11 @@ Prometheus metrics / health / table stats / hot-key workload reports,
 local- or fleet-scope.
 
 This module is pure stdlib + numpy so external tooling can vendor it.
+
+Contract-checked: tools/mvcontract.py (``make contract``) statically
+diffs the struct formats, ``FLAG_*`` constants, and ``MSG`` numbers
+below against ``mvtpu/message.h`` — change them together or tier-1
+fails.
 """
 
 from __future__ import annotations

@@ -196,7 +196,7 @@ class Table:
         # Jitted-apply memo, NOT a data cache: keyed by (AddOption,
         # shape/path) — bounded by call-site diversity (a handful of
         # compiled fns per table), never by traffic.
-        self._dense_cache: dict = {}  # mvlint: disable=MV007
+        self._dense_cache: dict = {}  # mvlint: MV007-exempt(jitted-apply memo keyed by call-site diversity, not traffic)
         self._compressor = None  # lazy OneBitCompressor (error feedback)
         self._closed = False
         # --- serve layer (docs/serving.md): versioned read cache -----------

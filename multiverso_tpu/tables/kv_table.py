@@ -74,7 +74,7 @@ class KVTable(Table):
         # exactly the keys the app Get()s, i.e. it tracks the store's
         # own key universe — not an eviction candidate without breaking
         # the reference raw() contract.
-        self._cache: Dict[Any, np.ndarray] = {}  # mvlint: disable=MV007
+        self._cache: Dict[Any, np.ndarray] = {}  # mvlint: MV007-exempt(tracks the store's own key universe — reference raw() contract)
         self._pending: List[Tuple[Dict[Any, np.ndarray],
                                   Optional[AddOption]]] = []
 

@@ -63,7 +63,7 @@ class MatrixTable(Table):
         self._pending_borrowed: set = set()
         # Jitted-apply memo keyed per AddOption — bounded by call-site
         # diversity, not data (see base._dense_cache).
-        self._rows_cache: Dict[AddOption, Any] = {}  # mvlint: disable=MV007
+        self._rows_cache: Dict[AddOption, Any] = {}  # mvlint: MV007-exempt(jitted-apply memo bounded by call-site diversity)
         # jax.jit caches per input shape internally; one gather fn suffices.
         self._gather_fn = jax.jit(lambda data, r: data[r])
 

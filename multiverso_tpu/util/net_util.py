@@ -37,7 +37,7 @@ def get_local_ips() -> List[str]:
                 ips.insert(0, primary)
         finally:
             s.close()
-    except OSError:  # mvlint: disable=MV015 — interface discovery
+    except OSError:  # mvlint: MV015-exempt(interface-discovery probe, not a delivery path)
         # probe, not a delivery path: no route just means the loopback
         # fallback below is the answer.
         pass

@@ -1,0 +1,374 @@
+"""tests/test_contract.py — mvcontract, the cross-language contract
+checker (tools/mvcontract.py, docs/static_analysis.md).
+
+Three layers:
+
+- extractor unit tests: each of the five surface extractors, run over
+  the REAL tree, must see the facts we know are true (MsgType values,
+  struct sizeofs, ctypes arities including the getattr-loop and
+  list-arithmetic forms, Lua cdef prototypes, flag defaults, docs
+  flag-table rows);
+- the clean-tree gate: the real tree diffs clean — this is what keeps
+  `make contract` (inside `make lint`) green in tier-1;
+- the seeded-drift matrix: every drift category the checker exists for
+  is seeded into a doctored copy of one surface and must produce a
+  finding that names the file and the surface pair, and `--strict`
+  must exit 1 on it.
+
+Everything here is static: no native build, no subprocess, no import
+of the checked modules.
+"""
+
+import os
+import shutil
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import mvcontract  # noqa: E402
+
+
+def _p(rel):
+    return os.path.join(REPO, rel)
+
+
+MESSAGE_H = _p("multiverso_tpu/native/include/mvtpu/message.h")
+C_API_H = _p("multiverso_tpu/native/include/mvtpu/c_api.h")
+WIRE_PY = _p("multiverso_tpu/serve/wire.py")
+BINDING_PY = _p("multiverso_tpu/native/__init__.py")
+LUA = _p("multiverso_tpu/binding/lua/multiverso.lua")
+CONFIGURE_CC = _p("multiverso_tpu/native/src/configure.cc")
+CONFIG_PY = _p("multiverso_tpu/config.py")
+
+
+def _seed(tmp_path, src, name, old, new):
+    """Copy `src` to tmp with `old` replaced by `new` (must occur)."""
+    text = open(src, "r", encoding="utf-8").read()
+    assert old in text, f"seed anchor missing from {src}: {old!r}"
+    out = tmp_path / name
+    out.write_text(text.replace(old, new))
+    return str(out)
+
+
+# ------------------------------------------------------ extractor: (a1)
+
+def test_message_header_extractor_msgtypes():
+    m = mvcontract.extract_message_header(MESSAGE_H)
+    types = {k: v[0] for k, v in m["msgtypes"].items()}
+    # Spot-check the span of the enum: serve protocol, control plane,
+    # replication, and the sentinel.
+    assert types["RequestGet"] == 1
+    assert types["RequestCancel"] == 13
+    assert types["ControlRegister"] == 16
+    assert types["ReplForward"] == 25
+    assert types["Exit"] == 64
+    assert m["codecs"] == {
+        k: m["codecs"][k] for k in ("kRaw", "kOneBit", "kSparse")}
+    assert {k: v[0] for k, v in m["codecs"].items()} == {
+        "kRaw": 0, "kOneBit": 1, "kSparse": 2}
+
+
+def test_message_header_extractor_msgflags():
+    m = mvcontract.extract_message_header(MESSAGE_H)
+    flags = {k: v[0] for k, v in m["msgflags"].items()}
+    assert flags["kAcceptRaw"] == 1
+    assert flags["kHasTiming"] == 8
+    assert flags["kHasAudit"] == 16
+    assert flags["kHasQos"] == 32
+
+
+def test_message_header_extractor_struct_layouts():
+    m = mvcontract.extract_message_header(MESSAGE_H)
+    s = m["structs"]
+    # WireHeader: 4xint32, 3xint64, 4xint32 = 56 bytes, no padding.
+    assert "".join(s["WireHeader"]["prims"]) == "iiiiqqqiiii"
+    assert s["WireHeader"]["sizeof"] == 56
+    # TimingTrail: int64_t t[kStamps] with kStamps resolved from the
+    # member enum — the brace initializer must not add fields.
+    assert "".join(s["TimingTrail"]["prims"]) == "qqqqqq"
+    assert s["TimingTrail"]["sizeof"] == 48
+    assert s["AuditStamp"]["sizeof"] == 16
+    assert "".join(s["QosStamp"]["prims"]) == "iiq"
+    assert s["QosStamp"]["sizeof"] == 16
+
+
+def test_c_sizeof_applies_alignment_rules():
+    # int32 followed by int64: the int64 is 8-aligned, so the struct
+    # carries a 4-byte hole and 8-byte tail alignment.
+    assert mvcontract._c_sizeof(["i", "q"]) == 16
+    assert mvcontract._c_sizeof(["i", "i", "q"]) == 16
+    assert mvcontract._c_sizeof(["q", "i"]) == 16  # tail padding
+    assert mvcontract._c_sizeof(["i"]) == 4
+
+
+# ------------------------------------------------------ extractor: (a2)
+
+def test_c_api_extractor_prototypes_and_rc():
+    capi = mvcontract.extract_c_api(C_API_H)
+    fns = capi["functions"]
+    assert len(fns) > 80  # the full C API, not a lucky subset
+    arity, ret, line = fns["MV_Init"]
+    assert (arity, ret) == (2, "int") and line > 0
+    # (void) parameter lists are arity 0; long long and char* returns
+    # normalize; multi-line prototypes parse.
+    assert fns["MV_RoutingEpoch"][:2] == (0, "longlong")
+    assert fns["MV_DashboardReport"][1] == "charp"
+    assert fns["MV_FreeString"][:2] == (1, "void")
+    # The documented rc map: 0 plus -1..-7.
+    assert capi["rc_codes"] == {-1, -2, -3, -4, -5, -6, -7}
+
+
+# ------------------------------------------------------- extractor: (b)
+
+def test_wire_extractor():
+    w = mvcontract.extract_wire(WIRE_PY)
+    assert w["structs"]["HEADER"]["fmt"] == "<4i3q4i"
+    assert w["structs"]["HEADER"]["size"] == 56
+    assert "".join(w["structs"]["TIMING"]["prims"]) == "qqqqqq"
+    assert {k: v[0] for k, v in w["flags"].items()} == {
+        "FLAG_TIMING": 8, "FLAG_AUDIT": 16, "FLAG_QOS": 32,
+        "_ACCEPT_RAW": 1}
+    msg = {k: v[0] for k, v in w["msg"].items()}
+    assert msg["RequestGet"] == 1
+    assert msg["OpsReply"] == 24
+    assert len(msg) >= 11
+
+
+# ------------------------------------------------------- extractor: (c)
+
+def test_ctypes_extractor_direct_and_loop_forms():
+    b = mvcontract.extract_ctypes_binding(BINDING_PY)
+    fns = b["functions"]
+    # Every bound symbol carries both an arity and a restype — the
+    # extractor handled every assignment form the binding uses.
+    assert len(fns) > 80
+    assert all(e["arity"] is not None and e["ret"] is not None
+               for e in fns.values())
+    # List-multiplication arity: [POINTER(c_longlong)] * 7.
+    assert fns["MV_ArenaStats"]["arity"] == 7
+    # Concat + continuation: [c_int32] + [...] * n.
+    assert fns["MV_ReplicationStats"]["arity"] == 8
+    # getattr-in-for-loop binding form.
+    assert fns["MV_TableVersion"]["arity"] == 2
+    # restype kinds.
+    assert fns["MV_FreeString"]["ret"] == "void"
+    assert fns["MV_DashboardReport"]["ret"] == "charp"
+    assert fns["MV_RoutingEpoch"]["ret"] == "longlong"
+
+
+def test_ctypes_extractor_rc_map():
+    b = mvcontract.extract_ctypes_binding(BINDING_PY)
+    # _check special-cases the shed and arena rc codes.
+    assert set(b["rc_handled"]) == {-6, -7}
+
+
+# ------------------------------------------------------- extractor: (d)
+
+def test_lua_extractor():
+    lua = mvcontract.extract_lua_cdef(LUA)
+    fns = lua["functions"]
+    assert len(fns) > 80
+    assert fns["MV_Init"][:2] == (2, "int")
+    assert fns["MV_RoutingEpoch"][:2] == (0, "longlong")
+    assert fns["MV_FreeString"][:2] == (1, "void")
+
+
+# ------------------------------------------------------- extractor: (e)
+
+def test_flag_extractors():
+    native = mvcontract.extract_native_flags(CONFIGURE_CC)
+    config = mvcontract.extract_config_flags(CONFIG_PY)
+    assert native["sync"][0] == "bool" and native["sync"][1] is False
+    # Quoted default containing commas must not split the match.
+    assert native["qos_classes"][1] == "bulk:1,gold:8"
+    assert config["serve_timeout_ms"][1] == 30000.0
+    # Dynamic default (os.environ.get) is extracted as unknown.
+    assert config["log_level"][1] is None
+    assert len(native) > 50 and len(config) > 40
+
+
+def test_docs_flag_table_extractor(tmp_path):
+    md = tmp_path / "x.md"
+    md.write_text(
+        "Prose.\n\n"
+        "| flag | plane | default | effect |\n"
+        "|------|-------|---------|--------|\n"
+        "| `-alpha` | both | 1 | a |\n"
+        "| `-beta=7` | Python | 7 | b |\n\n"
+        "| engine | readiness |\n|---|---|\n| epoll | level |\n")
+    rows = mvcontract.extract_docs_flags([str(md)])
+    assert [(r[2], r[3]) for r in rows] == [
+        ("alpha", "both"), ("beta", "python")]
+    real = mvcontract.extract_docs_flags(
+        [_p("docs/serving.md"), _p("docs/observability.md")])
+    assert any(name == "qos_classes" for _, _, name, _ in real)
+
+
+# ------------------------------------------------------ clean-tree gate
+
+def test_contract_repo_clean():
+    """The real tree diffs clean — the tier-1 mirror of
+    `make contract`."""
+    findings = mvcontract.diff_contract(mvcontract.build_contract(REPO))
+    assert findings == [], "\n" + "\n".join(str(f) for f in findings)
+
+
+def test_checker_is_pure_static():
+    """The acceptance bar: no subprocess, no native library load."""
+    src = open(mvcontract.__file__, "r", encoding="utf-8").read()
+    assert "subprocess" not in src
+    assert "CDLL" not in src and "cdll" not in src
+
+
+def test_main_strict_clean_exit():
+    assert mvcontract.main(["--root", REPO]) == 0
+    assert mvcontract.main(["--strict", "--root", REPO]) == 0
+    assert mvcontract.main(["--no-such-flag"]) == 2
+
+
+# -------------------------------------------------- seeded-drift matrix
+
+def _findings(**overrides):
+    return mvcontract.diff_contract(
+        mvcontract.build_contract(REPO, **overrides))
+
+
+def test_drift_renamed_msgtype(tmp_path):
+    p = _seed(tmp_path, MESSAGE_H, "m.h",
+              "RequestReplica = 11", "RequestReplicaPull = 11")
+    f = _findings(message_h=p)
+    assert len(f) == 1
+    assert f[0].pair == "message.h<->serve/wire.py"
+    assert "RequestReplica" in f[0].msg and f[0].path.endswith("wire.py")
+
+
+def test_drift_msgtype_value_mismatch(tmp_path):
+    p = _seed(tmp_path, MESSAGE_H, "m.h",
+              "RequestCancel = 13", "RequestCancel = 14")
+    f = _findings(message_h=p)
+    assert any("RequestCancel" in x.msg and "13" in x.msg for x in f)
+
+
+def test_drift_duplicate_msgtype_value(tmp_path):
+    p = _seed(tmp_path, MESSAGE_H, "m.h",
+              "Heartbeat = 21", "Heartbeat = 20")
+    f = _findings(message_h=p)
+    assert any("reuses wire value 20" in x.msg for x in f)
+
+
+def test_drift_wrong_struct_size(tmp_path):
+    p = _seed(tmp_path, WIRE_PY, "w.py", '"<6q"', '"<5q"')
+    f = _findings(wire_py=p)
+    assert any("sizeof(TimingTrail)" in x.msg and "48" in x.msg
+               for x in f)
+    assert all(x.pair == "message.h<->serve/wire.py" for x in f)
+
+
+def test_drift_struct_padding_hole(tmp_path):
+    # int32 pad -> int64 pad in QosStamp: layout AND sizeof drift (the
+    # C side would also misalign, which _c_sizeof models).
+    p = _seed(tmp_path, MESSAGE_H, "m.h",
+              "int32_t pad = 0;", "int64_t pad = 0;")
+    f = _findings(message_h=p)
+    assert any("QOS" in x.msg and "QosStamp" in x.msg for x in f)
+
+
+def test_drift_flag_constant(tmp_path):
+    p = _seed(tmp_path, WIRE_PY, "w.py",
+              "FLAG_QOS = 1 << 5", "FLAG_QOS = 1 << 6")
+    f = _findings(wire_py=p)
+    assert any("FLAG_QOS" in x.msg and "kHasQos" in x.msg for x in f)
+
+
+def test_drift_ctypes_arity(tmp_path):
+    p = _seed(tmp_path, BINDING_PY, "b.py",
+              "lib.MV_WaitGet.argtypes = [ctypes.c_int32]",
+              "lib.MV_WaitGet.argtypes = [ctypes.c_int32, "
+              "ctypes.c_int32]")
+    f = _findings(binding_py=p)
+    assert len(f) == 1
+    assert f[0].pair == "c_api.h<->ctypes-binding"
+    assert "MV_WaitGet" in f[0].msg and "arity 2" in f[0].msg
+
+
+def test_drift_unbound_c_api_function(tmp_path):
+    # A new C entry point with no Python side: the binding is the
+    # primary surface, so the header copy grows a function.
+    p = _seed(tmp_path, C_API_H, "c.h",
+              "int MV_ShutDown();",
+              "int MV_ShutDown();\nint MV_NewEntryPoint(int x);")
+    f = _findings(c_api_h=p)
+    assert any("MV_NewEntryPoint" in x.msg and "never bound" in x.msg
+               for x in f)
+
+
+def test_drift_ctypes_restype(tmp_path):
+    p = _seed(tmp_path, BINDING_PY, "b.py",
+              "lib.MV_FreeString.restype = None",
+              "lib.MV_FreeString.restype = ctypes.c_int")
+    f = _findings(binding_py=p)
+    assert any("MV_FreeString" in x.msg and "restype" in x.msg
+               for x in f)
+
+
+def test_drift_binding_rc_not_documented(tmp_path):
+    p = _seed(tmp_path, BINDING_PY, "b.py",
+              "rc == -6", "rc == -9")
+    f = _findings(binding_py=p)
+    assert any(x.pair == "c_api.h<->binding-rc-map" and "-9" in x.msg
+               for x in f)
+
+
+def test_drift_lua_arity(tmp_path):
+    p = _seed(tmp_path, LUA, "l.lua",
+              "int MV_WaitGet(int32_t wait_handle);",
+              "int MV_WaitGet(int32_t wait_handle, int32_t x);")
+    f = _findings(lua=p)
+    assert len(f) == 1
+    assert f[0].pair == "c_api.h<->lua-cdef"
+    assert "MV_WaitGet" in f[0].msg
+
+
+def test_drift_flag_missing_from_config(tmp_path):
+    # A flag the docs declare plane=both vanishes from config.py:
+    # present in C++, missing from Python.
+    p = _seed(tmp_path, CONFIG_PY, "c.py",
+              'define_bool("wire_timing"', 'define_bool("wire_timing_x"')
+    f = _findings(config_py=p)
+    assert any("wire_timing" in x.msg and "does not define it" in x.msg
+               and x.path.endswith(".md") for x in f)
+
+
+def test_drift_flag_default_mismatch(tmp_path):
+    p = _seed(tmp_path, CONFIG_PY, "c.py",
+              'define_bool("sync", False', 'define_bool("sync", True')
+    f = _findings(config_py=p)
+    assert any(x.pair == "configure.cc<->config.py"
+               and "-sync" in x.msg for x in f)
+
+
+def test_drift_docs_dead_flag(tmp_path):
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "stale.md").write_text(
+        "| flag | plane | default | effect |\n|---|---|---|---|\n"
+        "| `-retired_flag` | both | 0 | long gone |\n")
+    f = _findings(docs=str(docs))
+    assert len(f) == 1
+    assert "dead flag" in f[0].msg and f[0].path.endswith("stale.md")
+    assert f[0].line == 3
+
+
+def test_strict_exit_on_seeded_drift(tmp_path, capsys):
+    p = _seed(tmp_path, WIRE_PY, "w.py", '"<6q"', '"<5q"')
+    rc = mvcontract.main(
+        ["--strict", "--root", REPO, "--wire-py", p])
+    assert rc == 1
+    out = capsys.readouterr()
+    assert "TIMING" in out.out
+    # Without --strict the findings print but the exit stays 0 (report
+    # mode for triage).
+    assert mvcontract.main(["--root", REPO, "--wire-py", p]) == 0

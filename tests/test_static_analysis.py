@@ -914,3 +914,176 @@ def test_suppression_comment(tmp_path):
 def test_unparseable_file_is_reported(tmp_path):
     rules = _lint_src(tmp_path, "def broken(:\n")
     assert [r for r, _ in rules] == ["MV000"], rules
+
+
+# ------------------------------------------------- MV000 parse-failure
+
+def test_mv000_parse_failure_names_the_error(tmp_path):
+    """A file no rule could run over gets an EXPLICIT parse-failure
+    diagnostic (never a silent skip), naming the exception."""
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n    pass\n")
+    findings = mvlint.lint_file(str(bad))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "MV000"
+    assert "parse-failure" in f.msg and "SyntaxError" in f.msg
+    assert f.line == 1  # anchored at the syntax error, not line 0
+
+
+def test_mv000_parse_failure_on_undecodable_bytes(tmp_path):
+    bad = tmp_path / "mojibake.py"
+    bad.write_bytes(b"x = 1\n\xff\xfe garbage \xff\n")
+    findings = mvlint.lint_file(str(bad))
+    assert [f.rule for f in findings] == ["MV000"]
+    assert "parse-failure" in findings[0].msg
+    assert "UnicodeDecodeError" in findings[0].msg
+
+
+def test_mv000_parse_failure_on_undecodable_native_file(tmp_path):
+    """The native (C++) lint path reports unreadable files the same
+    way."""
+    bad = tmp_path / "broken.cc"
+    bad.write_bytes(b"// mvlint: reactor-context\n\xff\xfe\n")
+    findings = mvlint.lint_file(str(bad))
+    assert [f.rule for f in findings] == ["MV000"]
+    assert "parse-failure" in findings[0].msg
+
+
+# ---------------------------------------------------- --changed mode
+
+def _git(repo, *args):
+    subprocess.run(
+        ["git", "-C", str(repo), "-c", "user.email=t@t", "-c",
+         "user.name=t", *args],
+        check=True, capture_output=True, text=True, timeout=60)
+
+
+def test_changed_mode_lints_only_the_diff(tmp_path, capsys):
+    """--changed=REF lints exactly the files `git diff --name-only REF`
+    reports: a pre-existing (committed) violation stays out of the run;
+    the freshly-touched file is in it."""
+    repo = tmp_path / "r"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    (repo / "touched.py").write_text("x = 1\n")
+    # Committed violation in a file this change does NOT touch.
+    (repo / "untouched.py").write_text("rt.flush_async(q)\n")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-qm", "seed")
+    (repo / "touched.py").write_text("rt.flush_async(q)\n")
+
+    rc = mvlint.main(["--changed=HEAD", str(repo)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "touched.py" in out and "untouched.py" not in out
+
+    # Default behavior unchanged: a full run still sees both.
+    rc = mvlint.main([str(repo)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "touched.py" in out and "untouched.py" in out
+
+
+def test_changed_mode_clean_diff(tmp_path, capsys):
+    repo = tmp_path / "r"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    (repo / "bad.py").write_text("rt.flush_async(q)\n")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-qm", "seed")
+    # Nothing changed since HEAD: --changed lints nothing, exits 0 —
+    # the committed violation is invisible to the pre-commit loop.
+    assert mvlint.main(["--changed", str(repo)]) == 0
+    capsys.readouterr()
+
+
+# ------------------------------------- reasoned exemption (all rules)
+
+def test_exempt_marker_suppresses_python_rules(tmp_path):
+    """The MV018-style reasoned marker works uniformly on Python
+    rules."""
+    assert _lint_src(tmp_path, """\
+        rt.flush_async(q)  # mvlint: MV002-exempt(fire-and-forget flush)
+        """) == []
+    assert _lint_src(tmp_path, """\
+        ptr = _fp(np.zeros(4))  # mvlint: MV001-exempt(scratch freed after sync call)
+        """) == []
+
+
+def test_exempt_marker_requires_nonempty_reason(tmp_path):
+    """An empty reason does not suppress — on any rule."""
+    rules = _lint_src(tmp_path, """\
+        rt.flush_async(q)  # mvlint: MV002-exempt()
+        """)
+    assert [r for r, _ in rules] == ["MV002"], rules
+    rules = _lint_src(tmp_path, """\
+        rt.flush_async(q)  # mvlint: MV002-exempt(   )
+        """)
+    assert [r for r, _ in rules] == ["MV002"], rules
+
+
+def test_exempt_marker_suppresses_native_rules(tmp_path):
+    """The same reasoned marker suppresses on the native (C++) lint
+    path — and the empty-reason rejection holds there too."""
+    src = """\
+        // mvlint: reactor-context
+        void Connect(int fd, const sockaddr* a, socklen_t l) {
+          ::connect(fd, a, l);  // mvlint: MV009-exempt(pre-reactor)
+        }
+        """
+    assert _lint_src(tmp_path, src, name="reactor.cc") == []
+    empty = src.replace("MV009-exempt(pre-reactor)", "MV009-exempt()")
+    rules = _lint_src(tmp_path, empty, name="reactor.cc")
+    assert [r for r, _ in rules] == ["MV009"], rules
+
+
+def test_no_bare_disable_markers_in_tree():
+    """Satellite: every in-tree suppression carries the reasoned
+    -exempt(reason) form.  The bare legacy disable= marker is reserved
+    for tests and the linter's own documentation."""
+    allowed = {os.path.join("tests", "test_static_analysis.py"),
+               os.path.join("tools", "mvlint.py")}
+    offenders = []
+    for path in mvlint.iter_py_files([REPO]):
+        rel = os.path.relpath(path, REPO)
+        if rel in allowed:
+            continue
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            for i, line in enumerate(fh, 1):
+                if "mvlint: disable=" in line:
+                    offenders.append(f"{rel}:{i}")
+    assert offenders == [], offenders
+
+
+# ----------------------------------------------------- rule registry
+
+def test_rules_registry_is_complete():
+    """Every MVxxx a check can emit is registered, and vice versa."""
+    with open(mvlint.__file__, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    import re as _re
+    emitted = set(_re.findall(r'"(MV\d{3})"', src))
+    assert emitted == set(mvlint.RULES), \
+        sorted(emitted ^ set(mvlint.RULES))
+
+
+def test_every_rule_has_a_seeded_violation_test():
+    """Meta test: a new rule cannot land without a test here that
+    names it — each registered rule id must appear inside at least one
+    test function in this file."""
+    import ast as _ast
+    with open(__file__, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    tree = _ast.parse(src)
+    covered = set()
+    for node in tree.body:
+        if isinstance(node, _ast.FunctionDef) \
+                and node.name.startswith("test_"):
+            segment = _ast.get_source_segment(src, node) or ""
+            for rule in mvlint.RULES:
+                if rule in segment:
+                    covered.add(rule)
+    missing = sorted(set(mvlint.RULES) - covered)
+    assert missing == [], \
+        f"rules with no seeded-violation test in this file: {missing}"

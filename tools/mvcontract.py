@@ -1,0 +1,823 @@
+#!/usr/bin/env python
+"""mvcontract — cross-language contract checker (static, docs/static_analysis.md).
+
+The system spans four languages that must agree byte-for-byte: the C++
+wire protocol (``mvtpu/message.h``), the pure-stdlib Python mirror
+(``serve/wire.py``), the ctypes binding (``native/__init__.py``), the
+LuaJIT cdef (``binding/lua/multiverso.lua``), and the native↔Python↔docs
+flag surface (``configure.cc`` / ``config.py`` / the flag tables in
+``docs/*.md``).  Runtime parity tests only catch drift on the paths they
+happen to execute; this tool extracts every surface STATICALLY — no
+process spawned, no native build, no module import of the checked code —
+folds them into one normalized contract model, and diffs them pairwise.
+
+Surfaces and extractors:
+
+- (a) C++ headers: ``MsgType``/``Codec`` enum values and ``msgflag``
+  bits, the stamp struct layouts and sizeofs (``WireHeader``,
+  ``TimingTrail``, ``AuditStamp``, ``QosStamp`` — sizeof computed with
+  the C alignment rules, so a padding hole is drift too), and the C-API
+  prototypes + documented rc codes from ``c_api.h``.
+- (b) ``serve/wire.py``: ``struct.Struct`` format strings (sized via
+  ``struct.calcsize`` semantics), ``FLAG_*`` constants, ``MSG`` numbers.
+- (c) the ctypes binding: bound symbol names, ``argtypes`` arity and
+  ``restype`` kind — statically evaluated from the AST, including the
+  ``for name in (...)`` loops and ``[...] * n`` list forms, plus the
+  rc codes ``_check`` special-cases.
+- (d) the Lua ``ffi.cdef`` block: prototypes parsed like the C header.
+- (e) flags: ``Define*`` calls in ``configure.cc`` vs ``define_*`` calls
+  in ``config.py`` vs every docs table with a ``flag`` column (rows name
+  live flags; a ``plane`` column of Python/native/both is enforced
+  against where the flag is actually defined; defaults shared by both
+  planes must agree).
+
+Pairwise checks (each finding names the file and the surface pair):
+
+- message.h ↔ wire.py: every ``MSG`` name exists in ``MsgType`` with the
+  same value; ``FLAG_*``/``_ACCEPT_RAW`` equal their ``msgflag`` bits;
+  HEADER/TIMING/AUDIT/QOS formats match the struct field layouts and
+  sizeofs primitive-for-primitive.
+- c_api.h ↔ ctypes binding: every bound symbol exists in the header with
+  the same arity and a compatible restype; every header function is
+  bound (the binding is the primary surface — a new C entry point must
+  land with its Python side).
+- c_api.h ↔ Lua cdef: every cdef'd prototype exists in the header with
+  the same arity and return type (the cdef is a deliberate subset).
+- c_api.h ↔ binding rc map: every rc the binding special-cases is a
+  documented code in the header's rc comment.
+- configure.cc ↔ config.py: a flag defined in BOTH planes must carry
+  the same default (dynamic defaults are exempt from the comparison).
+- docs ↔ both flag planes: a flag-table row must name a live flag, and
+  its ``plane`` annotation must hold (``both`` requires definitions in
+  configure.cc AND config.py).
+
+Run ``python tools/mvcontract.py`` (findings printed, exit 0) or with
+``--strict`` (exit 1 on any finding — what ``make contract`` and the
+``make lint`` umbrella use).  ``tests/test_contract.py`` keeps the tree
+clean in tier-1 and seeds drift in every category to prove each check
+still fires.  Per-surface ``--<surface>`` path overrides exist for
+exactly that seeding.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob as _glob
+import os
+import re
+import struct
+import sys
+
+# Default surface locations relative to the repo root.
+DEFAULT_PATHS = {
+    "message_h": "multiverso_tpu/native/include/mvtpu/message.h",
+    "c_api_h": "multiverso_tpu/native/include/mvtpu/c_api.h",
+    "wire_py": "multiverso_tpu/serve/wire.py",
+    "binding_py": "multiverso_tpu/native/__init__.py",
+    "lua": "multiverso_tpu/binding/lua/multiverso.lua",
+    "configure_cc": "multiverso_tpu/native/src/configure.cc",
+    "config_py": "multiverso_tpu/config.py",
+    "docs": "docs",
+}
+
+# Python struct name -> C++ struct it mirrors (serve/wire.py contract).
+WIRE_STRUCTS = {
+    "HEADER": "WireHeader",
+    "TIMING": "TimingTrail",
+    "AUDIT": "AuditStamp",
+    "QOS": "QosStamp",
+}
+
+# Python flag constant -> msgflag bit it mirrors.
+WIRE_FLAGS = {
+    "FLAG_TIMING": "kHasTiming",
+    "FLAG_AUDIT": "kHasAudit",
+    "FLAG_QOS": "kHasQos",
+    "_ACCEPT_RAW": "kAcceptRaw",
+}
+
+# Normalized C return type -> the ctypes restype kind that binds it.
+# char* returns bind as c_void_p on purpose: the binding must take the
+# address (not a copied bytes) so MV_FreeString can free it.
+RET_TO_CTYPES = {"int": "int", "longlong": "longlong",
+                 "charp": "charp", "void": "void"}
+
+
+class Finding:
+    """One contract violation, anchored to a file:line and naming the
+    surface pair that disagrees."""
+
+    def __init__(self, path, line, pair, msg):
+        self.path, self.line, self.pair, self.msg = path, line, pair, msg
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.pair}] {self.msg}"
+
+
+# --------------------------------------------------------------- C parsing
+
+def _strip_c_comments(src: str) -> str:
+    """Blank out // and /* */ comments, preserving line structure."""
+    src = re.sub(r"/\*.*?\*/",
+                 lambda m: re.sub(r"[^\n]", " ", m.group(0)), src,
+                 flags=re.S)
+    return re.sub(r"//[^\n]*", "", src)
+
+
+def _line_of(src: str, offset: int) -> int:
+    return src.count("\n", 0, offset) + 1
+
+
+def _int_const(text: str, consts=None) -> int:
+    """Evaluate an integer constant expression: a literal, `1 << n`, or
+    a named constant from `consts`."""
+    text = text.strip()
+    m = re.fullmatch(r"(\d+)\s*<<\s*(\d+)", text)
+    if m:
+        return int(m.group(1)) << int(m.group(2))
+    if re.fullmatch(r"-?\d+", text):
+        return int(text)
+    if consts and text in consts:
+        return consts[text]
+    raise ValueError(f"unsupported constant expression: {text!r}")
+
+
+def _c_sizeof(prims) -> int:
+    """sizeof() of a struct of int32 ('i') / int64 ('q') members under
+    the standard C layout rules (member alignment + tail padding)."""
+    off, align = 0, 1
+    for p in prims:
+        s = 4 if p == "i" else 8
+        align = max(align, s)
+        off = (off + s - 1) // s * s + s
+    return (off + align - 1) // align * align
+
+
+def _enum_block(src: str, name: str) -> "tuple[str, int] | None":
+    """Body text + start offset of `enum [class] NAME ... { body }`."""
+    m = re.search(rf"enum\s+(?:class\s+)?{name}\b[^{{]*{{", src)
+    if not m:
+        return None
+    depth, i = 1, m.end()
+    while depth and i < len(src):
+        depth += {"{": 1, "}": -1}.get(src[i], 0)
+        i += 1
+    return src[m.end():i - 1], m.end()
+
+
+def _struct_block(src: str, name: str) -> "tuple[str, int] | None":
+    m = re.search(rf"struct\s+{name}\s*{{", src)
+    if not m:
+        return None
+    depth, i = 1, m.end()
+    while depth and i < len(src):
+        depth += {"{": 1, "}": -1}.get(src[i], 0)
+        i += 1
+    return src[m.end():i - 1], m.end()
+
+
+def extract_message_header(path: str) -> dict:
+    """Surface (a1): MsgType/Codec values, msgflag bits, struct layouts
+    from mvtpu/message.h."""
+    with open(path, "r", encoding="utf-8") as fh:
+        raw = fh.read()
+    src = _strip_c_comments(raw)
+
+    out = {"path": path, "msgtypes": {}, "codecs": {}, "msgflags": {},
+           "structs": {}}
+    for field, enum in (("msgtypes", "MsgType"), ("codecs", "Codec")):
+        block = _enum_block(src, enum)
+        if block is None:
+            continue
+        body, base = block
+        for m in re.finditer(r"(\w+)\s*=\s*([^,}]+)", body):
+            out[field][m.group(1)] = (
+                _int_const(m.group(2)), _line_of(src, base + m.start(1)))
+
+    ns = re.search(r"namespace\s+msgflag\s*{", src)
+    if ns:
+        tail = src[ns.end():]
+        end = tail.find("}")
+        for m in re.finditer(
+                r"inline\s+constexpr\s+int32_t\s+(k\w+)\s*=\s*([^;]+);",
+                tail[:end if end >= 0 else len(tail)]):
+            out["msgflags"][m.group(1)] = (
+                _int_const(m.group(2)),
+                _line_of(src, ns.end() + m.start(1)))
+
+    for name in WIRE_STRUCTS.values():
+        block = _struct_block(src, name)
+        if block is None:
+            continue
+        body, base = block
+        line = _line_of(src, base)
+        # Member-local enum constants (TimingTrail::kStamps) size arrays.
+        consts = {}
+        em = re.search(r"enum\s+\w*\s*{", body)
+        if em:
+            depth, i = 1, em.end()
+            while depth and i < len(body):
+                depth += {"{": 1, "}": -1}.get(body[i], 0)
+                i += 1
+            for c in re.finditer(r"(\w+)\s*=\s*(\d+)", body[em.end():i - 1]):
+                consts[c.group(1)] = int(c.group(2))
+            body = body[:em.start()] + body[i:]
+        prims = []
+        for stmt in body.split(";"):
+            m = re.match(r"\s*(int32_t|int64_t)\s+(.*)", stmt, re.S)
+            if not m:
+                continue
+            prim = "i" if m.group(1) == "int32_t" else "q"
+            # Drop brace initializers first: their commas are not
+            # declarator separators (int64_t t[kStamps] = {0, ...}).
+            decls = re.sub(r"\{[^}]*\}", "", m.group(2))
+            for decl in decls.split(","):
+                decl = decl.split("=", 1)[0].strip()
+                if not decl:
+                    continue
+                arr = re.match(r"\w+\s*\[\s*(\w+)\s*\]", decl)
+                count = _int_const(arr.group(1), consts) if arr else 1
+                prims += [prim] * count
+        out["structs"][name] = {"prims": prims,
+                                "sizeof": _c_sizeof(prims),
+                                "line": line}
+    return out
+
+
+# Prototype: normalized return type + name + raw parameter list.
+_PROTO = re.compile(
+    r"(?P<ret>int|void|long\s+long|char\s*\*)\s+(?P<name>MV_\w+)\s*"
+    r"\((?P<params>[^)]*)\)\s*;")
+
+
+def _norm_ret(text: str) -> str:
+    text = re.sub(r"\s+", " ", text.strip())
+    return {"int": "int", "void": "void", "long long": "longlong",
+            "char *": "charp", "char*": "charp"}[text.replace("char *",
+                                                              "char*")]
+
+
+def _proto_arity(params: str) -> int:
+    params = params.strip()
+    if not params or params == "void":
+        return 0
+    return params.count(",") + 1
+
+
+def _extract_prototypes(src: str, line_base: int = 0) -> dict:
+    """name -> (arity, ret, line) for every MV_* prototype in `src`."""
+    funcs = {}
+    for m in _PROTO.finditer(src):
+        funcs[m.group("name")] = (
+            _proto_arity(m.group("params")), _norm_ret(m.group("ret")),
+            line_base + _line_of(src, m.start("name")))
+    return funcs
+
+
+def extract_c_api(path: str) -> dict:
+    """Surface (a2): MV_* prototypes + the documented rc-code map from
+    c_api.h's leading comment block."""
+    with open(path, "r", encoding="utf-8") as fh:
+        raw = fh.read()
+    # rc codes live in the header's TOP comment (before #pragma once) —
+    # "-1 bad args ... -7 borrowed buffer not in a live HostArena".
+    top = raw.split("#pragma", 1)[0]
+    rc_codes = {-int(m.group(1))
+                for m in re.finditer(r"(?<![\w.])-(\d+)\b", top)}
+    src = _strip_c_comments(raw)
+    return {"path": path, "functions": _extract_prototypes(src),
+            "rc_codes": rc_codes}
+
+
+# ------------------------------------------------------------ wire.py (b)
+
+def _py_int(node) -> int:
+    """Statically evaluate a small int expression (literal, <<, |)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        lhs, rhs = _py_int(node.left), _py_int(node.right)
+        if isinstance(node.op, ast.LShift):
+            return lhs << rhs
+        if isinstance(node.op, ast.BitOr):
+            return lhs | rhs
+    raise ValueError("unsupported int expression")
+
+
+def _fmt_prims(fmt: str) -> list:
+    """Expand a little-endian struct format into per-field primitives."""
+    if not re.fullmatch(r"<(?:\d*[iq])+", fmt):
+        raise ValueError(f"unsupported struct format {fmt!r} "
+                         f"(expected little-endian i/q fields)")
+    prims = []
+    for m in re.finditer(r"(\d*)([iq])", fmt[1:]):
+        prims += [m.group(2)] * int(m.group(1) or "1")
+    return prims
+
+
+def extract_wire(path: str) -> dict:
+    """Surface (b): struct formats, FLAG_* constants, and the MSG map
+    from serve/wire.py — pure AST, the module is never imported."""
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    out = {"path": path, "structs": {}, "flags": {}, "msg": {}}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        v = node.value
+        if name in WIRE_STRUCTS and isinstance(v, ast.Call) \
+                and v.args and isinstance(v.args[0], ast.Constant):
+            fmt = v.args[0].value
+            out["structs"][name] = {"fmt": fmt,
+                                    "prims": _fmt_prims(fmt),
+                                    "size": struct.calcsize(fmt),
+                                    "line": node.lineno}
+        elif name in WIRE_FLAGS:
+            out["flags"][name] = (_py_int(v), node.lineno)
+        elif name == "MSG" and isinstance(v, ast.Dict):
+            for k, val in zip(v.keys, v.values):
+                if isinstance(k, ast.Constant) \
+                        and isinstance(val, ast.Constant):
+                    out["msg"][k.value] = (val.value, k.lineno)
+    return out
+
+
+# ----------------------------------------------------- ctypes binding (c)
+
+def _ctypes_list_len(node) -> int:
+    """Length of a statically-built argtypes list: list literals,
+    `[...] * n` repetition, and `+` concatenation."""
+    if isinstance(node, ast.List):
+        return len(node.elts)
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Mult):
+            lhs, rhs = node.left, node.right
+            if isinstance(rhs, ast.Constant):
+                return _ctypes_list_len(lhs) * rhs.value
+            if isinstance(lhs, ast.Constant):
+                return _ctypes_list_len(rhs) * lhs.value
+        if isinstance(node.op, ast.Add):
+            return _ctypes_list_len(node.left) + \
+                _ctypes_list_len(node.right)
+    raise ValueError("argtypes list is not statically evaluable")
+
+
+def _ctypes_restype(node) -> str:
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "void"
+    tail = node.attr if isinstance(node, ast.Attribute) else (
+        node.id if isinstance(node, ast.Name) else "")
+    if tail in ("c_int", "c_int32", "c_int64"):
+        return "int"
+    if tail in ("c_longlong",):
+        return "longlong"
+    if tail in ("c_void_p", "c_char_p"):
+        return "charp"
+    return f"?{tail}"
+
+
+def _binding_targets(target, loop_names) -> list:
+    """MV_* symbol name(s) + attr ('argtypes'/'restype') a target sets:
+    `lib.MV_X.argtypes` or `getattr(lib, name).argtypes` in a loop."""
+    if not (isinstance(target, ast.Attribute)
+            and target.attr in ("argtypes", "restype")):
+        return []
+    base = target.value
+    if isinstance(base, ast.Attribute) and isinstance(base.value, ast.Name) \
+            and base.value.id == "lib":
+        return [(base.attr, target.attr)]
+    if isinstance(base, ast.Call) and isinstance(base.func, ast.Name) \
+            and base.func.id == "getattr" and len(base.args) == 2 \
+            and isinstance(base.args[1], ast.Name) \
+            and base.args[1].id in loop_names:
+        return [(n, target.attr) for n in loop_names[base.args[1].id]]
+    return []
+
+
+def extract_ctypes_binding(path: str) -> dict:
+    """Surface (c): bound symbols with argtypes arity + restype kind,
+    and the rc codes `_check` special-cases — all from the AST."""
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    funcs = {}  # name -> {"arity": n, "ret": kind, "line": l}
+
+    def record(stmts, loop_names):
+        for node in stmts:
+            if isinstance(node, ast.For) and isinstance(node.target,
+                                                        ast.Name) \
+                    and isinstance(node.iter, (ast.Tuple, ast.List)) \
+                    and all(isinstance(e, ast.Constant)
+                            for e in node.iter.elts):
+                inner = dict(loop_names)
+                inner[node.target.id] = [e.value for e in node.iter.elts]
+                record(node.body, inner)
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for sym, attr in _binding_targets(t, loop_names):
+                        entry = funcs.setdefault(
+                            sym, {"arity": None, "ret": None,
+                                  "line": node.lineno})
+                        if attr == "argtypes":
+                            entry["arity"] = _ctypes_list_len(node.value)
+                        else:
+                            entry["ret"] = _ctypes_restype(node.value)
+            elif isinstance(node, (ast.If, ast.With, ast.Try)):
+                record(getattr(node, "body", []), loop_names)
+
+    for fn in ast.walk(tree):
+        if isinstance(fn, ast.FunctionDef) and fn.name == "load":
+            record(fn.body, {})
+
+    rc_handled = {}
+    for fn in ast.walk(tree):
+        if not (isinstance(fn, ast.FunctionDef) and fn.name == "_check"):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+                for side in (node.left, node.comparators[0]):
+                    if isinstance(side, ast.UnaryOp) \
+                            and isinstance(side.op, ast.USub) \
+                            and isinstance(side.operand, ast.Constant):
+                        rc_handled[-side.operand.value] = node.lineno
+    return {"path": path, "functions": funcs, "rc_handled": rc_handled}
+
+
+# ------------------------------------------------------------ Lua cdef (d)
+
+def extract_lua_cdef(path: str) -> dict:
+    """Surface (d): prototypes inside the ffi.cdef[[ ... ]] block."""
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    m = re.search(r"ffi\.cdef\s*\[\[", src)
+    if not m:
+        return {"path": path, "functions": {}}
+    end = src.find("]]", m.end())
+    block = src[m.end():end if end >= 0 else len(src)]
+    block = re.sub(r"--[^\n]*", "", block)
+    base = _line_of(src, m.end()) - 1
+    return {"path": path,
+            "functions": _extract_prototypes(block, line_base=base)}
+
+
+# ----------------------------------------------------------- flags (e)
+
+def _norm_default(v):
+    """Normalize a flag default for cross-plane comparison."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    return v
+
+
+_NATIVE_FLAG = re.compile(
+    r"Define(Bool|Int|Double|String)\(\s*\"(\w+)\"\s*,\s*"
+    r"(\"(?:[^\"\\]|\\.)*\"|[^,)]+)", re.S)
+
+
+def extract_native_flags(path: str) -> dict:
+    """Surface (e1): Define*("name", default, ...) registrations in
+    configure.cc.  name -> (kind, normalized default, line)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        src = _strip_c_comments(fh.read())
+    flags = {}
+    for m in _NATIVE_FLAG.finditer(src):
+        kind, name, default = m.group(1).lower(), m.group(2), m.group(3)
+        default = default.strip()
+        if default.startswith('"'):
+            value = default[1:-1]
+        elif default in ("true", "false"):
+            value = default == "true"
+        else:
+            try:
+                value = float(default)
+            except ValueError:
+                value = None  # computed default: exempt from comparison
+        flags[name] = (kind, _norm_default(value),
+                       _line_of(src, m.start(2)))
+    return flags
+
+
+def extract_config_flags(path: str) -> dict:
+    """Surface (e2): define_*("name", default, help) registrations in
+    config.py.  name -> (kind, normalized default or None, line)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    flags = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)):
+            continue
+        m = re.fullmatch(r"define_(bool|int|double|string)", node.func.id)
+        if not m or not node.args \
+                or not isinstance(node.args[0], ast.Constant):
+            continue
+        name = node.args[0].value
+        default = None  # dynamic (os.environ.get(...) etc.): no compare
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+            default = _norm_default(node.args[1].value)
+        flags[name] = (m.group(1), default, node.lineno)
+    return flags
+
+
+def _md_cells(line: str) -> list:
+    return [c.strip() for c in line.strip().strip("|").split("|")]
+
+
+def extract_docs_flags(paths) -> list:
+    """Surface (e3): rows of every markdown table with a `flag` header
+    column.  Returns [(path, line, flag_name, plane-or-None), ...]."""
+    rows = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+        i = 0
+        while i < len(lines):
+            if not lines[i].lstrip().startswith("|"):
+                i += 1
+                continue
+            header = _md_cells(lines[i])
+            cols = [h.strip("`*").lower() for h in header]
+            if "flag" not in cols:
+                while i < len(lines) and lines[i].lstrip().startswith("|"):
+                    i += 1
+                continue
+            flag_idx = cols.index("flag")
+            plane_idx = cols.index("plane") if "plane" in cols else None
+            i += 1
+            while i < len(lines) and lines[i].lstrip().startswith("|"):
+                cells = _md_cells(lines[i])
+                if all(re.fullmatch(r":?-+:?", c) for c in cells if c):
+                    i += 1
+                    continue
+                if flag_idx < len(cells):
+                    m = re.search(r"`-([A-Za-z0-9_]+)", cells[flag_idx])
+                    if m:
+                        plane = None
+                        if plane_idx is not None and plane_idx < len(cells):
+                            p = cells[plane_idx].strip("`").lower()
+                            if p in ("python", "native", "both"):
+                                plane = p
+                        rows.append((path, i + 1, m.group(1), plane))
+                i += 1
+    return rows
+
+
+# --------------------------------------------------------------- assembly
+
+def build_contract(root: str = None, **overrides) -> dict:
+    """Extract every surface into one contract model.  `overrides`
+    replace individual surface paths (how the seeded-drift tests point
+    one extractor at a doctored copy); `docs` may be a directory or an
+    explicit list of markdown files."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = {k: overrides.get(k) or os.path.join(root, v)
+             for k, v in DEFAULT_PATHS.items()}
+    docs = paths["docs"]
+    if isinstance(docs, str) and os.path.isdir(docs):
+        docs = sorted(_glob.glob(os.path.join(docs, "*.md")))
+    elif isinstance(docs, str):
+        docs = [docs]
+    return {
+        "message": extract_message_header(paths["message_h"]),
+        "capi": extract_c_api(paths["c_api_h"]),
+        "wire": extract_wire(paths["wire_py"]),
+        "binding": extract_ctypes_binding(paths["binding_py"]),
+        "lua": extract_lua_cdef(paths["lua"]),
+        "native_flags": extract_native_flags(paths["configure_cc"]),
+        "config_flags": extract_config_flags(paths["config_py"]),
+        "docs_flags": extract_docs_flags(docs),
+        "paths": paths,
+    }
+
+
+# ----------------------------------------------------------------- diffs
+
+def _diff_wire(c) -> list:
+    """message.h ↔ serve/wire.py: MSG numbers, flag bits, struct
+    layouts + sizeofs."""
+    out = []
+    msg, wire = c["message"], c["wire"]
+    pair = "message.h<->serve/wire.py"
+    for name, (value, line) in sorted(wire["msg"].items()):
+        cxx = msg["msgtypes"].get(name)
+        if cxx is None:
+            out.append(Finding(
+                wire["path"], line, pair,
+                f"MSG[{name!r}] names no MsgType in {msg['path']} — "
+                f"renamed or removed on the C++ side"))
+        elif cxx[0] != value:
+            out.append(Finding(
+                wire["path"], line, pair,
+                f"MSG[{name!r}] = {value} but MsgType::{name} = "
+                f"{cxx[0]} ({msg['path']}:{cxx[1]})"))
+    seen = {}
+    for name, (value, line) in msg["msgtypes"].items():
+        if value in seen:
+            out.append(Finding(
+                msg["path"], line, "message.h<->message.h",
+                f"MsgType::{name} reuses wire value {value} already "
+                f"taken by MsgType::{seen[value]}"))
+        seen[value] = name
+    for pyname, cxxname in WIRE_FLAGS.items():
+        got = wire["flags"].get(pyname)
+        want = msg["msgflags"].get(cxxname)
+        if got is None or want is None:
+            missing = (wire["path"] if got is None else msg["path"])
+            out.append(Finding(
+                missing, 1, pair,
+                f"flag constant {pyname} <-> msgflag::{cxxname}: "
+                f"missing on one side"))
+        elif got[0] != want[0]:
+            out.append(Finding(
+                wire["path"], got[1], pair,
+                f"{pyname} = {got[0]} but msgflag::{cxxname} = "
+                f"{want[0]} ({msg['path']}:{want[1]})"))
+    for pyname, cxxname in WIRE_STRUCTS.items():
+        py = wire["structs"].get(pyname)
+        cxx = msg["structs"].get(cxxname)
+        if py is None or cxx is None:
+            missing = (wire["path"] if py is None else msg["path"])
+            out.append(Finding(
+                missing, 1, pair,
+                f"struct {pyname} <-> {cxxname}: missing on one side"))
+            continue
+        if py["prims"] != cxx["prims"]:
+            out.append(Finding(
+                wire["path"], py["line"], pair,
+                f"{pyname} format {py['fmt']!r} fields "
+                f"{''.join(py['prims'])} != {cxxname} layout "
+                f"{''.join(cxx['prims'])} "
+                f"({msg['path']}:{cxx['line']})"))
+        if py["size"] != cxx["sizeof"]:
+            out.append(Finding(
+                wire["path"], py["line"], pair,
+                f"{pyname} packs {py['size']} bytes but "
+                f"sizeof({cxxname}) = {cxx['sizeof']} "
+                f"({msg['path']}:{cxx['line']})"))
+    return out
+
+
+def _diff_binding(c) -> list:
+    """c_api.h ↔ ctypes binding: symbol set, arity, restype, rc map."""
+    out = []
+    capi, binding = c["capi"], c["binding"]
+    pair = "c_api.h<->ctypes-binding"
+    header = capi["functions"]
+    for name, entry in sorted(binding["functions"].items()):
+        proto = header.get(name)
+        if proto is None:
+            out.append(Finding(
+                binding["path"], entry["line"], pair,
+                f"{name} is bound but not declared in {capi['path']}"))
+            continue
+        arity, ret, hline = proto
+        if entry["arity"] is not None and entry["arity"] != arity:
+            out.append(Finding(
+                binding["path"], entry["line"], pair,
+                f"{name} argtypes arity {entry['arity']} != C "
+                f"prototype arity {arity} ({capi['path']}:{hline})"))
+        want = RET_TO_CTYPES[ret]
+        if entry["ret"] is not None and entry["ret"] != want:
+            out.append(Finding(
+                binding["path"], entry["line"], pair,
+                f"{name} restype kind {entry['ret']!r} incompatible "
+                f"with C return {ret!r} ({capi['path']}:{hline})"))
+    for name, (arity, ret, hline) in sorted(header.items()):
+        if name not in binding["functions"]:
+            out.append(Finding(
+                capi["path"], hline, pair,
+                f"{name} is declared but never bound in "
+                f"{binding['path']} — the C API grew without its "
+                f"Python side"))
+    for rc, line in sorted(binding["rc_handled"].items()):
+        if rc not in capi["rc_codes"]:
+            out.append(Finding(
+                binding["path"], line, "c_api.h<->binding-rc-map",
+                f"binding special-cases rc {rc}, which the rc-code "
+                f"map in {capi['path']}'s header comment does not "
+                f"document"))
+    return out
+
+
+def _diff_lua(c) -> list:
+    """c_api.h ↔ Lua cdef: every cdef'd prototype must match the
+    header exactly (the cdef is a deliberate subset)."""
+    out = []
+    capi, lua = c["capi"], c["lua"]
+    pair = "c_api.h<->lua-cdef"
+    for name, (arity, ret, line) in sorted(lua["functions"].items()):
+        proto = capi["functions"].get(name)
+        if proto is None:
+            out.append(Finding(
+                lua["path"], line, pair,
+                f"{name} is cdef'd but not declared in {capi['path']}"))
+            continue
+        harity, hret, hline = proto
+        if arity != harity:
+            out.append(Finding(
+                lua["path"], line, pair,
+                f"{name} cdef arity {arity} != C prototype arity "
+                f"{harity} ({capi['path']}:{hline})"))
+        if ret != hret:
+            out.append(Finding(
+                lua["path"], line, pair,
+                f"{name} cdef return {ret!r} != C return {hret!r} "
+                f"({capi['path']}:{hline})"))
+    return out
+
+
+def _diff_flags(c) -> list:
+    """configure.cc ↔ config.py ↔ docs flag tables."""
+    out = []
+    native, config = c["native_flags"], c["config_flags"]
+    npath = c["paths"]["configure_cc"]
+    cpath = c["paths"]["config_py"]
+    pair = "configure.cc<->config.py"
+    for name in sorted(set(native) & set(config)):
+        nd, cd = native[name][1], config[name][1]
+        if nd is None or cd is None:
+            continue  # dynamic default on one side: nothing to compare
+        if isinstance(nd, bool) != isinstance(cd, bool) or nd != cd:
+            out.append(Finding(
+                cpath, config[name][2], pair,
+                f"flag -{name} defaults disagree: config.py has "
+                f"{cd!r}, configure.cc has {nd!r} "
+                f"({npath}:{native[name][2]})"))
+    for path, line, name, plane in c["docs_flags"]:
+        in_native, in_config = name in native, name in config
+        if not in_native and not in_config:
+            out.append(Finding(
+                path, line, "docs<->flags",
+                f"flag-table row names -{name}, which neither "
+                f"{npath} nor {cpath} defines — a dead flag"))
+            continue
+        if plane == "native" and not in_native:
+            out.append(Finding(
+                path, line, "docs<->configure.cc",
+                f"-{name} is documented plane=native but {npath} "
+                f"does not define it (only config.py does)"))
+        elif plane == "python" and not in_config:
+            out.append(Finding(
+                path, line, "docs<->config.py",
+                f"-{name} is documented plane=Python but {cpath} "
+                f"does not define it (only configure.cc does)"))
+        elif plane == "both" and not (in_native and in_config):
+            missing = cpath if not in_config else npath
+            out.append(Finding(
+                path, line, "docs<->flags",
+                f"-{name} is documented plane=both but {missing} "
+                f"does not define it — the planes drifted apart"))
+    return out
+
+
+def diff_contract(c) -> list:
+    return _diff_wire(c) + _diff_binding(c) + _diff_lua(c) + \
+        _diff_flags(c)
+
+
+# ------------------------------------------------------------------- CLI
+
+def main(argv) -> int:
+    strict = False
+    overrides = {}
+    root = None
+    args = list(argv)
+    while args:
+        a = args.pop(0)
+        if a == "--strict":
+            strict = True
+        elif a == "--root":
+            root = args.pop(0)
+        elif a.startswith("--") and a[2:].replace("-", "_") \
+                in DEFAULT_PATHS:
+            overrides[a[2:].replace("-", "_")] = args.pop(0)
+        else:
+            print(f"mvcontract: unknown argument {a!r}", file=sys.stderr)
+            return 2
+    contract = build_contract(root, **overrides)
+    findings = diff_contract(contract)
+    for f in findings:
+        print(f)
+    surfaces = (len(contract["capi"]["functions"]),
+                len(contract["wire"]["msg"]),
+                len(contract["native_flags"]) +
+                len(contract["config_flags"]))
+    if findings:
+        print(f"mvcontract: {len(findings)} finding(s) across "
+              f"{surfaces[0]} C-API functions, {surfaces[1]} wire "
+              f"MSG types, {surfaces[2]} flags", file=sys.stderr)
+        return 1 if strict else 0
+    print(f"mvcontract: clean ({surfaces[0]} C-API functions, "
+          f"{surfaces[1]} wire MSG types, {surfaces[2]} flags in "
+          f"contract)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

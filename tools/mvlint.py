@@ -182,7 +182,21 @@ Rules (docs/static_analysis.md has the full rationale):
   ``mvlint: MV018-exempt(<why growth is bounded>)`` — the reason is
   mandatory; an empty marker does not suppress.
 
-Suppress a finding with ``# mvlint: disable=MV00N`` on the same line.
+A file that cannot be linted at all (SyntaxError, undecodable bytes)
+is never silently skipped: it gets an explicit **MV000 parse-failure**
+finding, so a botched merge cannot hide a file from every other rule.
+
+Suppress a finding with a reasoned marker on the same line:
+``mvlint: MV00N-exempt(<why this site is legal>)`` — uniform across
+MV001–MV018, Python and native files alike; the reason is mandatory and
+an empty marker does not suppress.  The bare legacy form
+``# mvlint: disable=MV00N`` still works for tests and one-off triage,
+but in-tree code should carry the reasoned form.
+
+``python tools/mvlint.py --changed[=REF]`` lints only the files
+``git diff --name-only REF`` reports (default ``HEAD``) — the fast
+pre-commit loop on a tree this size; default behavior (full walk) is
+unchanged.
 """
 
 from __future__ import annotations
@@ -210,6 +224,46 @@ class Finding:
 
     def __str__(self):
         return f"{self.path}:{self.line}: {self.rule}: {self.msg}"
+
+
+# Registry of every rule id this linter can emit.  tests/
+# test_static_analysis.py's meta test walks this to assert each rule
+# has at least one seeded-violation test — add the rule here AND a
+# test there, or the suite fails.
+RULES = {
+    "MV000": "parse-failure",
+    "MV001": "ctypes-temporary",
+    "MV002": "dangling-async",
+    "MV003": "host-sync-in-jit",
+    "MV004": "unbounded-subprocess",
+    "MV005": "unbounded-retry",
+    "MV006": "print-in-library",
+    "MV007": "unbounded-client-cache",
+    "MV008": "noncontiguous-ctypes",
+    "MV009": "blocking-socket-in-reactor",
+    "MV010": "observability-bypass",
+    "MV011": "per-key-label-cardinality",
+    "MV012": "bridge-copy-churn",
+    "MV013": "row-at-a-time-table-loop",
+    "MV014": "wall-clock-interval",
+    "MV015": "swallowed-native-exception",
+    "MV016": "serve-read-without-deadline",
+    "MV017": "stale-shard-route",
+    "MV018": "untracked-growth",
+}
+
+
+def _suppressed(finding, lines):
+    """True if the finding's source line carries a suppression marker:
+    the reasoned ``mvlint: MVxxx-exempt(<reason>)`` form (uniform across
+    MV001–MV018, Python and native alike; empty reason does NOT
+    suppress) or the bare legacy ``mvlint: disable=MVxxx``."""
+    line = (lines[finding.line - 1]
+            if 0 < finding.line <= len(lines) else "")
+    if f"mvlint: disable={finding.rule}" in line:
+        return True
+    return bool(re.search(rf"mvlint:\s*{finding.rule}-exempt\(\s*[^)\s]",
+                          line))
 
 
 def _call_name(func):
@@ -1261,7 +1315,9 @@ def lint_native_file(path):
             src = fh.read()
     except (OSError, UnicodeDecodeError) as exc:
         return [Finding(path, 0, "MV000",
-                        f"unreadable: {exc.__class__.__name__}")]
+                        f"parse-failure: file could not be read "
+                        f"({exc.__class__.__name__}: {exc}) — no rule "
+                        f"ran over it")]
     findings = []
     if REACTOR_MARKER in src:
         findings += lint_reactor_file(path, src)
@@ -1269,9 +1325,7 @@ def lint_native_file(path):
     # wherever a growth-named member lives.
     findings += check_native_untracked_growth(path, src)
     lines = src.splitlines()
-    return [f for f in findings
-            if f"mvlint: disable={f.rule}" not in
-            (lines[f.line - 1] if 0 < f.line <= len(lines) else "")]
+    return [f for f in findings if not _suppressed(f, lines)]
 
 
 NATIVE_EXTS = (".cc", ".cpp", ".cxx", ".h", ".hpp")
@@ -1286,7 +1340,11 @@ def lint_file(path):
         tree = ast.parse(src, filename=path)
     except (SyntaxError, UnicodeDecodeError) as exc:
         return [Finding(path, getattr(exc, "lineno", 0) or 0, "MV000",
-                        f"unparseable: {exc.__class__.__name__}")]
+                        f"parse-failure: file could not be parsed "
+                        f"({exc.__class__.__name__}: "
+                        f"{getattr(exc, 'msg', None) or exc}) — no "
+                        f"rule ran over it; fix the syntax or drop "
+                        f"the file from the tree")]
     findings = []
     findings += check_ctypes_temporary(tree, path)
     findings += check_dangling_async(tree, path)
@@ -1345,19 +1403,10 @@ def lint_file(path):
         if os.path.basename(path) != "metrics.py":
             findings += check_observability_bypass(tree, path)
             findings += check_label_cardinality(tree, path)
-    # Per-line suppressions: the generic disable marker, or a rule's
-    # reasoned -exempt(...) form (the reason is mandatory — an empty
-    # marker does not suppress).
+    # Per-line suppressions: the reasoned -exempt(...) marker (reason
+    # mandatory) or the bare legacy disable= form — see _suppressed.
     lines = src.splitlines()
-    kept = []
-    for f in findings:
-        line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
-        if f"mvlint: disable={f.rule}" in line:
-            continue
-        if re.search(rf"mvlint:\s*{f.rule}-exempt\(\s*[^)\s]", line):
-            continue
-        kept.append(f)
-    return kept
+    return [f for f in findings if not _suppressed(f, lines)]
 
 
 def iter_py_files(paths):
@@ -1376,8 +1425,36 @@ def iter_py_files(paths):
                     yield os.path.join(root, name)
 
 
+def changed_files(root, ref):
+    """Lintable files named by ``git diff --name-only REF`` under
+    `root` (the --changed pre-commit mode).  Deleted files vanish from
+    the diff listing by the time they matter, so only paths that still
+    exist are returned."""
+    import subprocess
+    out = subprocess.run(
+        ["git", "-C", root, "diff", "--name-only", "--relative", ref],
+        capture_output=True, text=True, timeout=60, check=True)
+    files = []
+    for rel in out.stdout.splitlines():
+        path = os.path.join(root, rel)
+        if rel and os.path.isfile(path):
+            files.append(path)
+    return files
+
+
 def main(argv):
-    paths = argv or [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    args = list(argv)
+    changed_ref = None
+    for a in list(args):
+        if a == "--changed" or a.startswith("--changed="):
+            changed_ref = a.partition("=")[2] or "HEAD"
+            args.remove(a)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args or [repo_root]
+    if changed_ref is not None:
+        # Lint exactly what the diff names (still honoring extension
+        # and SKIP_DIRS filters via iter_py_files on explicit files).
+        paths = changed_files(args[0] if args else repo_root, changed_ref)
     findings = []
     nfiles = 0
     for path in iter_py_files(paths):
