@@ -174,11 +174,22 @@ failover-demo:
 	$(MAKE) -C $(NATIVE) all
 	JAX_PLATFORMS=cpu $(PYTHON) tools/failover_demo.py
 
+# Closed-loop health-plane smoke (docs/observability.md "health
+# plane"): a 2-rank fleet with the stall watchdog + declarative SLO
+# rules armed — a quiet fleet keeps mvdoctor --strict green, a seeded
+# apply-delay fault fires the latency burn-rate alert FLEET-WIDE within
+# two metric flushes, mvdoctor's top finding names the rank AND the
+# `apply` stage (hot keys correlated from the workload plane), and
+# clearing the fault resolves the alert and re-greens the gate.
+doctor-demo:
+	$(MAKE) -C $(NATIVE) all
+	JAX_PLATFORMS=cpu $(PYTHON) tools/doctor_demo.py
+
 # Demo umbrella: every acceptance smoke in sequence (each target builds
 # the native runtime once; later builds are no-ops).
 demos: metrics-demo serve-demo wire-demo fanin-demo ops-demo skew-demo \
        embedding-demo bridge-demo latency-demo audit-demo \
-       capacity-demo failover-demo
+       capacity-demo failover-demo doctor-demo
 
 # Continuous perf gate (docs/PERF.md): diff the newest bench JSON line
 # against the committed BENCH_BASELINE.json with per-key noise bands;
@@ -193,4 +204,4 @@ clean:
 .PHONY: all test tsan asan analyze mvlint contract lint chaos metrics-demo \
         serve-demo wire-demo fanin-demo ops-demo skew-demo \
         embedding-demo bridge-demo latency-demo audit-demo \
-        capacity-demo failover-demo demos bench-gate clean
+        capacity-demo failover-demo doctor-demo demos bench-gate clean

@@ -814,6 +814,36 @@ def bench_capacity(nclients: int = 256, rows: int = 2048,
     return res
 
 
+def bench_health(nclients: int = 256):
+    """Closed-loop health plane (docs/observability.md "health plane";
+    schema 20): the timed serve probe stream re-run with the health
+    plane armed (default SLO rule pack evaluating each metrics flush,
+    the native watchdog bump, the in-band alerts push) vs disarmed,
+    interleaved best-of-3 → ``health_overhead_pct`` (what closed-loop
+    watching costs the serve tier; acceptance: < 1%); then a seeded
+    25 ms apply-delay fault under a demo-tightened burn-rate rule →
+    ``health_alert_detect_ms`` (fault-to-FIRING wall time through the
+    real flush loop; acceptance: < 2 s at the 100 ms flush cadence)
+    and ``health_alert_fired`` (must be 1).  Fleet + prober live in
+    ``apps/fanin_bench_worker.py`` (mode=health)."""
+    import re
+
+    outs = _spawn_native_workers("fanin_bench_worker.py", 2,
+                                 "FANIN_BENCH_OK",
+                                 (nclients, 8, 0, "health"))
+    res = {}
+    for out in outs:
+        for m in re.finditer(r"(\w+)=(-?[0-9.]+)", out):
+            key = m.group(1)
+            if key == "rank":
+                continue
+            name = key if key.startswith("health_") else f"health_{key}"
+            res[name] = float(m.group(2))
+            if key.endswith("_ms") and float(m.group(2)) >= 0:
+                _observe_iter(float(m.group(2)) * 1e-3)
+    return res
+
+
 def bench_embedding(rows: int = 1 << 16, reqs: int = 512):
     """Sparse-embedding serving fast path (docs/embedding.md; schema
     14): a 2-rank epoll fleet holding one row-sharded embedding table
@@ -1670,7 +1700,7 @@ _SECTIONS = [bench_lr, bench_lr_native8, bench_w2v, bench_w2v_native8,
              bench_wire_micro, bench_ssp, bench_serve, bench_serve_fanin,
              bench_tail,
              bench_ops, bench_latency, bench_audit, bench_failover,
-             bench_skew, bench_capacity,
+             bench_skew, bench_capacity, bench_health,
              bench_embedding,
              bench_bridge,
              bench_add_get,
@@ -1699,7 +1729,7 @@ def main() -> None:
     # Schema/partial line FIRST — before any JAX-touching import — so
     # even a backend-init hang killed by `timeout` leaves one parseable
     # line on stdout.
-    results = {"bench_schema": 19}
+    results = {"bench_schema": 20}
     errors = []
     _emit(results, errors)
 
@@ -1783,6 +1813,15 @@ def main() -> None:
     # (audit_add_overhead_pct — the path the seq stamps ride), and
     # times one injected duplicate send until the in-band "audit"
     # scrape names it (audit_detect_ms, audit_dup_named = 1), all
+    # bench-gated
+    # (17 = tail, 18 = replication/failover, 19 = capacity — see those
+    # sections' docstrings);
+    # 20 = closed-loop health plane (docs/observability.md "health
+    # plane"): bench_health A/Bs the timed serve probe stream with the
+    # SLO rule pack + flush-loop evaluation + alerts push armed vs
+    # disarmed (health_overhead_pct < 1%) and times a seeded 25 ms
+    # apply delay until the burn-rate alert FIRES through the real
+    # flush loop (health_alert_detect_ms; health_alert_fired = 1),
     # bench-gated.
 
     # A budget SIGTERM lands mid-section: convert it to an exception so

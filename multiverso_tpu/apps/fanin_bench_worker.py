@@ -34,6 +34,15 @@ over an async add stream, the path the seq stamps actually ride) and
 ``audit_detect_ms``: one injected duplicate send → the wall time until
 rank 0's in-band ``"audit"`` scrape names it.
 
+``mode=health`` (bench.py ``bench_health``, docs/observability.md
+"health plane") A/Bs the timed serve probe stream with the health
+plane armed (default rule pack evaluating each flush + the watchdog
+bump + the alerts push) vs disarmed → ``health_overhead_pct``
+(acceptance: < 1%), then arms the demo-tightened burn-rate rule,
+kv-signals rank 0 to seed a 25 ms apply delay, and reports
+``health_alert_detect_ms``: the fault-to-FIRING wall time through the
+real flush loop (plus ``health_alert_fired``, which must be 1).
+
 ``mode=latency`` (bench.py ``bench_latency``, docs/observability.md
 "latency plane") runs the probe phase THREE times over the same herd —
 untimed baseline, wire-stamped (per-stage p50/p99 breakdown from the
@@ -364,6 +373,79 @@ def _audit_bench(endpoint: str, nclients: int, rt, h) -> dict:
         rt.clear_faults()
     out["audit_detect_ms"] = detect
     out["audit_dup_named"] = 1.0 if detect >= 0 else 0.0
+    return out
+
+
+def _health_bench(endpoint: str, nclients: int, rt, h, hk) -> dict:
+    """mode=health body (docs/observability.md "health plane").
+
+    Phase A re-runs the serve probe stream with the health plane armed
+    (rule pack + flush-loop evaluation + the watchdog bump + the alerts
+    push) vs disarmed, interleaved best-of-3:
+    ``health_overhead_pct`` is what closed-loop watching costs the
+    serve tier.  Phase B arms a demo-tightened latency burn-rate rule,
+    kv-signals rank 0 to seed a 25 ms ``apply_delay`` fault, and drives
+    timed probes until the alert FIRES: ``health_alert_detect_ms`` is
+    the fault-to-firing wall time through the real flush loop."""
+    from multiverso_tpu import config, health, latency, metrics
+
+    out = {}
+    flush_ms = 100
+    config.set_flag("health_latency_slo_ms", 10.0)
+    metrics.reset()
+    metrics.start_flush(flush_ms)
+
+    def probes(n=64):
+        t0 = time.perf_counter()
+        with latency.attach_metrics(
+                AnonServeClient(endpoint, timeout=30,
+                                timing=True)) as client:
+            for _ in range(n):
+                client.get_shard(h)
+        return n / (time.perf_counter() - t0)
+
+    probes()                                  # warm: connect + JIT
+    armed_runs, disarmed_runs = [], []
+    for _ in range(3):
+        health.disarm(rt)
+        disarmed_runs.append(probes())
+        health.arm(rules=health.default_rules(), runtime=rt)
+        armed_runs.append(probes())
+    base = max(disarmed_runs)
+    out["health_overhead_pct"] = (
+        max(0.0, (base - max(armed_runs)) / base * 100.0)
+        if base else 0.0)
+    out["health_probe_qps"] = max(armed_runs)
+
+    # Phase B: demo-scale burn windows (the doctor-demo rule) so the
+    # detection measures the flush loop, not a 300 s production window.
+    health.arm(rules=[health.Rule(
+        name="lat-slo-burn", metric="lat.slo.breach",
+        op="burn_rate_gt", total_metric="lat.slo.total",
+        threshold=2.0, objective=0.99, window_s=8.0,
+        short_window_s=4.0, for_s=0.0, severity="critical")],
+        runtime=rt)
+    rt.kv_add(hk, "arm_delay", 1.0)
+    while rt.kv_get(hk, "delay_armed") < 1.0:
+        time.sleep(0.005)
+    detect = -1.0
+    t0 = time.perf_counter()
+    deadline = time.time() + 30
+    with latency.attach_metrics(
+            AnonServeClient(endpoint, timeout=30,
+                            timing=True)) as client:
+        while time.time() < deadline:
+            for _ in range(4):
+                client.get_shard(h)           # ~25 ms each, all breaches
+            doc = health.alerts_doc()
+            if any(a["state"] == "firing" for a in doc["alerts"]):
+                detect = (time.perf_counter() - t0) * 1e3
+                break
+    rt.kv_add(hk, "disarm_delay", 1.0)
+    out["health_alert_detect_ms"] = detect
+    out["health_alert_fired"] = 1.0 if detect >= 0 else 0.0
+    health.disarm(rt)
+    metrics.stop_flush()
     return out
 
 
@@ -902,10 +984,18 @@ def main() -> int:
         armed = False
         deadline = time.time() + 600
         while rt.kv_get(hk, "herd_done") < 1.0:
-            if mode == "tail":
+            if mode in ("tail", "health"):
                 if not armed and rt.kv_get(hk, "arm_delay") > 0:
                     rt.set_fault_seed(1234)
-                    rt.set_fault("apply_delay", 0.05)
+                    if mode == "health":
+                        # Every apply eats 25 ms: each timed probe is
+                        # an SLO breach, so the burn rate saturates
+                        # within one flush of traffic (doctor-demo's
+                        # fault shape).
+                        rt.set_fault("delay_ms", 25)
+                        rt.set_fault("apply_delay", 1.0)
+                    else:
+                        rt.set_fault("apply_delay", 0.05)
                     armed = True
                     rt.kv_add(hk, "delay_armed", 1.0)
                 elif armed and rt.kv_get(hk, "disarm_delay") > 0:
@@ -924,6 +1014,8 @@ def main() -> int:
             out = _tail_bench(eps[0], nclients, rt, hk, hm)
         elif mode == "audit":
             out = _audit_bench(eps[0], nclients, rt, h)
+        elif mode == "health":
+            out = _health_bench(eps[0], nclients, rt, h, hk)
         elif mode == "ops":
             # A/B the latency phase: plain, then under a live in-band
             # scraper — the delta is what introspection costs serving.

@@ -150,6 +150,11 @@ int MV_ClockOffset(int rank, long long* offset_ns, long long* rtt_ns);
 int MV_SetProfiler(int hz);
 char* MV_ProfilerDump(void);
 int MV_ProfilerClear(void);
+int MV_SetOpsHostAlerts(const char* alerts_json);
+int MV_SetWatchdog(int stall_ms);
+int MV_WatchdogBump(const char* loop);
+int MV_WatchdogBusy(const char* loop, long long queued);
+char* MV_WatchdogStats(void);
 ]]
 
 -- libmvtpu.so sits two directories up from this file (native/build/).
@@ -593,6 +598,40 @@ end
 --- Drop recorded profiler samples (per-phase A/B runs).
 function mv.profiler_clear()
   check(C.MV_ProfilerClear(), "MV_ProfilerClear")
+end
+
+--- Push this host's health-plane alert document (JSON from the rule
+--- evaluator) so the in-band "alerts" ops scrape serves it alongside
+--- the native watchdog stats (empty/nil clears).
+function mv.set_ops_host_alerts(text)
+  check(C.MV_SetOpsHostAlerts(text or ""), "MV_SetOpsHostAlerts")
+end
+
+--- Arm the native stall watchdog (docs/observability.md): a loop that
+--- reports queued work but makes no progress for stall_ms dumps folded
+--- stacks into the blackbox.  0 disarms.
+function mv.set_watchdog(stall_ms)
+  check(C.MV_SetWatchdog(stall_ms or 0), "MV_SetWatchdog")
+end
+
+--- Record forward progress on a named host-side loop.
+function mv.watchdog_bump(loop)
+  check(C.MV_WatchdogBump(loop), "MV_WatchdogBump")
+end
+
+--- Report how much work a named loop currently has queued (0 = idle;
+--- idle loops are never flagged as stalled).
+function mv.watchdog_busy(loop, queued)
+  check(C.MV_WatchdogBusy(loop, queued or 0), "MV_WatchdogBusy")
+end
+
+--- Per-loop watchdog stats as a JSON array (progress, queued, stalls,
+--- stalled flag, seconds since last progress).
+function mv.watchdog_stats()
+  local p = C.MV_WatchdogStats()
+  local text = ffi.string(p)
+  C.MV_FreeString(p)
+  return text
 end
 
 --- Fleet-scope ops report assembled by THIS rank over the rank wire

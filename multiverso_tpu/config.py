@@ -189,6 +189,34 @@ define_int("profile_hz", 0,
            "folded stacks land in trace_rank<r>.json beside spans at "
            "shutdown.  0 (default) disarms; 97 is the house rate")
 
+# --- health plane (docs/observability.md "health plane") -------------------
+define_int("metrics_history", 64,
+           "time-series ring depth: how many flush snapshots each "
+           "series keeps for rate()/delta()/alert-window queries.  The "
+           "ring spans ~metrics_flush_ms x metrics_history of wall "
+           "time; health-rule window_s / for_s beyond that can never "
+           "fire (docs/observability.md)")
+define_bool("health_rules", True,
+            "arm the built-in SLO/alert rule pack (health.py) when the "
+            "metrics flusher runs: rules evaluate each flush, firing "
+            "alerts land in health.alerts.firing{severity=}, emit "
+            "flight-recorder events, and criticals boost the profiler "
+            "+ trigger a blackbox dump; the 'alerts' OpsQuery kind "
+            "serves the state fleet-wide (tools/mvtop.py --alerts)")
+define_double("health_latency_slo_ms", 250.0,
+              "end-to-end latency SLO threshold: serve round-trips "
+              "slower than this count against the lat.slo.breach "
+              "error budget the burn-rate rule watches; <=0 disables "
+              "the breach counters")
+define_int("watchdog_stall_ms", 0,
+           "native stall watchdog: flag a critical loop (epoll "
+           "reactor shards, actors, heartbeat/lease scan, Python "
+           "metrics flusher) that makes zero progress for this long "
+           "while work is queued — dumps profiler folded stacks + a "
+           "'stall:' blackbox and bumps watchdog.stalls.  0 (default) "
+           "disarms; must exceed the slowest legitimate loop period "
+           "(native-flag parity)")
+
 # --- delivery audit (docs/observability.md "audit plane") ------------------
 define_bool("audit", True,
             "delivery-audit plane: stamp every native-plane Add with a "

@@ -276,6 +276,7 @@ def init(args: Optional[List[str]] = None,
 
             _profiler.start(profile_hz)
         flush_ms = int(config.get("metrics_flush_ms"))
+        metrics.set_history_depth(int(config.get("metrics_history")))
         if flush_ms > 0:
             import os
 
@@ -284,6 +285,14 @@ def init(args: Optional[List[str]] = None,
                 path=os.path.join(trace_dir,
                                   f"metrics_rank{node.rank}.prom")
                 if trace_dir else None)
+            # Health plane (docs/observability.md "health plane"):
+            # -health_rules arms the default SLO/alert pack on the
+            # flush cadence — rules can only evaluate when flushes
+            # actually happen, so the gate rides flush_ms.
+            if bool(config.get("health_rules")):
+                from .. import health as _health
+
+                _health.arm()
 
         _CONTEXT = Context(mesh=mesh, node=node,
                            sync=sync_val,
@@ -309,9 +318,14 @@ def shutdown(finalize: bool = True) -> None:
         _recorder.record("lifecycle",
                          f"shutdown rank {_CONTEXT.node.rank}")
         _CONTEXT.barrier("mvtpu_shutdown")
-        # Observability teardown: final metrics flush, then the span
-        # export (-trace_dir), then the classic Dashboard dump — which
-        # now prints percentiles from the same registry.
+        # Observability teardown: health evaluator off BEFORE the final
+        # flush (an alert must not fire against a half-torn-down rank),
+        # then the last flush, then the span export (-trace_dir), then
+        # the classic Dashboard dump — which now prints percentiles
+        # from the same registry.
+        from .. import health as _health
+
+        _health.disarm()
         metrics.stop_flush()
         # Profiler down BEFORE the trace export so its folded stacks
         # ride trace_rank<r>.json (stop() folds them into the buffer).

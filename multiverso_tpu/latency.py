@@ -43,11 +43,34 @@ def record_stages(stages: Dict[str, float],
     """Fold one round trip's stage breakdown (seconds, as produced by
     :func:`stage_durations`) into the metrics registry — the same
     ``lat.stage.<name>`` / ``lat.total`` series the native bridge
-    imports, so one scrape carries both planes."""
+    imports, so one scrape carries both planes.  When
+    ``-health_latency_slo_ms`` > 0 each total also scores the
+    ``lat.slo.total`` / ``lat.slo.breach`` error-budget counters the
+    health plane's burn-rate rule consumes (docs/observability.md
+    "health plane")."""
     for name, seconds in stages.items():
         series = ("lat.total" if name == "total"
                   else f"lat.stage.{name}")
         metrics.histogram(series).observe(seconds, trace_id=trace_id)
+    total = stages.get("total")
+    if total is not None:
+        slo_s = _slo_threshold_s()
+        if slo_s > 0:
+            metrics.counter("lat.slo.total").inc()
+            if total > slo_s:
+                metrics.counter("lat.slo.breach").inc()
+
+
+def _slo_threshold_s() -> float:
+    """The -health_latency_slo_ms flag in seconds (0 when unset or the
+    flag registry is not initialised — serve/wire must stay usable
+    standalone)."""
+    try:
+        from . import config
+
+        return float(config.get("health_latency_slo_ms")) / 1e3
+    except Exception:
+        return 0.0
 
 
 def attach_metrics(client: Any) -> Any:

@@ -37,7 +37,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
     "counter", "gauge", "histogram", "snapshot", "render_prometheus",
     "reset", "bridge_native", "start_flush", "stop_flush", "set_ops_push",
-    "record_history", "rate", "delta", "history",
+    "record_history", "rate", "delta", "history", "set_history_depth",
+    "add_flush_hook", "remove_flush_hook",
     "NATIVE_TIME_BUCKETS", "DEFAULT_TIME_BUCKETS", "HISTORY_SNAPSHOTS",
 ]
 
@@ -62,6 +63,10 @@ _OVERFLOW_LABELS = (("overflow", "true"),)
 # (one per record_history() call — the flush thread takes one each
 # interval), enabling rate()/delta() queries so QPS / shed-rate /
 # bytes-per-second are first-class instead of eyeball-the-counter.
+# Default depth; the -metrics_history flag retargets it via
+# set_history_depth() at init.  The ring spans roughly
+# flush-interval x depth of wall time — an alert rule's window_s (or
+# for_s hysteresis) longer than that can never see enough history.
 HISTORY_SNAPSHOTS = 64
 
 
@@ -309,9 +314,22 @@ class Registry:
         self._series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Any] = {}
         self._per_name: Dict[str, int] = {}
         # Time-series ring: series key -> deque[(ts, value)], capped at
-        # HISTORY_SNAPSHOTS — bounded by construction (one deque per
-        # live series, N points each).
+        # history_depth — bounded by construction (one deque per live
+        # series, N points each).
         self._history: Dict[str, Any] = {}
+        self.history_depth = HISTORY_SNAPSHOTS
+
+    def set_history_depth(self, n: int) -> None:
+        """Re-cap every ring to ``n`` points (the ``-metrics_history``
+        flag; existing rings keep their newest points)."""
+        import collections
+
+        n = max(2, int(n))  # below 2 points rate()/delta() can never answer
+        with self._lock:
+            self.history_depth = n
+            for key, ring in list(self._history.items()):
+                if ring.maxlen != n:
+                    self._history[key] = collections.deque(ring, maxlen=n)
 
     def _get(self, cls, name: str, labels: Optional[Dict[str, str]],
              **kwargs: Any):
@@ -408,7 +426,7 @@ class Registry:
             for key, v in points:
                 ring = self._history.get(key)
                 if ring is None:
-                    ring = collections.deque(maxlen=HISTORY_SNAPSHOTS)
+                    ring = collections.deque(maxlen=self.history_depth)
                     self._history[key] = ring
                 ring.append((ts, v))
         return len(points)
@@ -527,11 +545,19 @@ def _prom_name(name: str) -> str:
     return "".join(out)
 
 
+def _prom_escape(v: str) -> str:
+    """Label-value escaping per the exposition format: backslash, quote
+    and newline are the three characters the format reserves."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_labels(key: Tuple[Tuple[str, str], ...], **extra: str) -> str:
     items = list(key) + sorted(extra.items())
     if not items:
         return ""
-    return "{" + ",".join(f'{_prom_name(k)}="{v}"' for k, v in items) + "}"
+    return ("{" + ",".join(f'{_prom_name(k)}="{_prom_escape(v)}"'
+                           for k, v in items) + "}")
 
 
 def _fmt(v: float) -> str:
@@ -593,10 +619,22 @@ def history(name: str, labels: Optional[Dict[str, str]] = None) -> list:
 
 
 def reset() -> None:
-    """Drop every series AND stop the flush thread (test isolation)."""
+    """Drop every series AND stop the flush thread (test isolation);
+    flush hooks (the health plane's evaluator) are dropped too and the
+    ring depth returns to the default."""
     stop_flush()
     set_ops_push(None)
+    with _HOOK_LOCK:
+        _FLUSH_HOOKS.clear()
     REGISTRY.reset()
+    REGISTRY.history_depth = HISTORY_SNAPSHOTS
+
+
+def set_history_depth(n: int) -> None:
+    """Re-cap the time-series rings to ``n`` points (the
+    ``-metrics_history`` flag).  The ring spans flush-interval x depth
+    of wall time; health-rule windows longer than that never fire."""
+    REGISTRY.set_history_depth(n)
 
 
 # ---------------------------------------------------------------------------
@@ -674,6 +712,43 @@ def set_ops_push(fn) -> None:
     _PUSH_FN = fn
 
 
+# Flush hooks run on the flush thread each interval, AFTER the history
+# point is recorded and BEFORE the render/push — so a hook that derives
+# new series from the rings (the health plane's alert gauges) lands them
+# in the SAME flush the evidence came from.  Hooks are individually
+# fenced: one raising never kills the flusher or the other hooks.
+# Own lock, NOT _FLUSH_LOCK: start_flush() joins the old flusher while
+# holding _FLUSH_LOCK, and that flusher may be mid-hook.
+_HOOK_LOCK = threading.Lock()
+_FLUSH_HOOKS: list = []
+
+
+def add_flush_hook(fn) -> None:
+    """Register ``fn()`` to run on every metrics flush (idempotent)."""
+    with _HOOK_LOCK:
+        if fn not in _FLUSH_HOOKS:
+            _FLUSH_HOOKS.append(fn)
+
+
+def remove_flush_hook(fn) -> None:
+    """Unregister a flush hook (missing is a no-op)."""
+    with _HOOK_LOCK:
+        try:
+            _FLUSH_HOOKS.remove(fn)
+        except ValueError:
+            pass
+
+
+def _run_flush_hooks() -> None:
+    with _HOOK_LOCK:
+        hooks = list(_FLUSH_HOOKS)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception as exc:
+            Log.error("metrics flush hook %r failed: %s", fn, exc)
+
+
 class _Flusher(threading.Thread):
     def __init__(self, interval_s: float, path: Optional[str]):
         super().__init__(name="mvtpu-metrics-flush", daemon=True)
@@ -696,9 +771,13 @@ class _Flusher(threading.Thread):
 
             _capacity.export_gauges()
             # One time-series point per flush: the ring holds the last
-            # HISTORY_SNAPSHOTS flush snapshots, so rate()/delta() span
-            # roughly interval_s * HISTORY_SNAPSHOTS of history.
+            # history_depth flush snapshots, so rate()/delta() span
+            # roughly interval_s * depth of history.
             record_history()
+            # Hooks (the health plane's rule evaluation) run between
+            # the history point and the render, so derived series are
+            # current in the same exposition they were computed from.
+            _run_flush_hooks()
             if self.path:
                 from .io.stream import LocalStream
 

@@ -197,6 +197,16 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_OpsReport.restype = ctypes.c_void_p
     lib.MV_SetOpsHostMetrics.argtypes = [ctypes.c_char_p]
     lib.MV_SetOpsHostMetrics.restype = ctypes.c_int
+    lib.MV_SetOpsHostAlerts.argtypes = [ctypes.c_char_p]
+    lib.MV_SetOpsHostAlerts.restype = ctypes.c_int
+    lib.MV_SetWatchdog.argtypes = [ctypes.c_int]
+    lib.MV_SetWatchdog.restype = ctypes.c_int
+    lib.MV_WatchdogBump.argtypes = [ctypes.c_char_p]
+    lib.MV_WatchdogBump.restype = ctypes.c_int
+    lib.MV_WatchdogBusy.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.MV_WatchdogBusy.restype = ctypes.c_int
+    lib.MV_WatchdogStats.argtypes = []
+    lib.MV_WatchdogStats.restype = ctypes.c_void_p
     lib.MV_BlackboxEvent.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     lib.MV_BlackboxEvent.restype = ctypes.c_int
     lib.MV_BlackboxTrigger.argtypes = [ctypes.c_char_p]
@@ -790,6 +800,45 @@ class NativeRuntime:
         this each interval via ``metrics.set_ops_push``)."""
         self._check(self.lib.MV_SetOpsHostMetrics(prom_text.encode()),
                     "MV_SetOpsHostMetrics")
+
+    def set_ops_host_alerts(self, alerts_json: str) -> None:
+        """Push the Python health evaluator's alert state (JSON object
+        text) so the in-band ``"alerts"`` OpsQuery kind serves it under
+        its ``"host"`` key beside the native watchdog table (the health
+        flush hook calls this each metrics flush).  Empty clears."""
+        self._check(self.lib.MV_SetOpsHostAlerts(alerts_json.encode()),
+                    "MV_SetOpsHostAlerts")
+
+    def set_watchdog(self, stall_ms: int) -> None:
+        """Arm the native stall watchdog at ``stall_ms`` (<= 0 disarms;
+        boot value: the ``-watchdog_stall_ms`` flag).  A watched loop
+        with queued work and zero progress past the deadline dumps a
+        'stall:' blackbox + profiler folded stacks and bumps
+        ``watchdog.stalls`` (docs/observability.md "health plane")."""
+        self._check(self.lib.MV_SetWatchdog(int(stall_ms)),
+                    "MV_SetWatchdog")
+
+    def watchdog_bump(self, loop: str) -> None:
+        """One unit of progress on a host-side watched loop (e.g.
+        ``py.flush``); registers the loop on first use, no-op when the
+        watchdog is disarmed."""
+        self._check(self.lib.MV_WatchdogBump(loop.encode()),
+                    "MV_WatchdogBump")
+
+    def watchdog_busy(self, loop: str, queued: int) -> None:
+        """Declare a host loop's queued work (0 = idle; an idle loop
+        cannot stall)."""
+        self._check(self.lib.MV_WatchdogBusy(loop.encode(), int(queued)),
+                    "MV_WatchdogBusy")
+
+    def watchdog_stats(self) -> list:
+        """The per-loop watchdog table (loop, progress, queued, stalls,
+        stalled, age_s) — the ``"watchdog"`` section of the ``alerts``
+        ops report."""
+        import json
+
+        return json.loads(self._dump_string(self.lib.MV_WatchdogStats,
+                                            "MV_WatchdogStats"))
 
     def blackbox_event(self, kind: str, detail: str = "") -> None:
         """Record one lifecycle event into the native flight-recorder

@@ -212,6 +212,12 @@ char* MV_OpsReport(const char* kind);
 // bridges every native monitor).  The metrics flush thread calls this
 // each interval.  NULL or empty clears the push (native fallback).
 int MV_SetOpsHostMetrics(const char* prom_text);
+// Push the host (Python) health evaluator's alert state (JSON object
+// text) so the in-band `"alerts"` OpsQuery kind serves it under its
+// "host" key beside the native watchdog table.  The health flush hook
+// calls this each metrics flush.  NULL or empty clears the push
+// (served as null).
+int MV_SetOpsHostAlerts(const char* alerts_json);
 // Flight recorder ("black box"): record one lifecycle event into the
 // bounded in-memory ring (-blackbox_events), and/or trigger a dump of
 // ring + recent spans + monitor totals to
@@ -246,8 +252,10 @@ int MV_SetHotKeyTracking(int on);
 // Fleet-scope ops report assembled BY THIS RANK over the rank wire
 // (the same bounded fan-out + merge an inbound fleet OpsQuery runs) —
 // works on every engine, including the blocking tcp engine that
-// refuses anonymous scraper connections.  kind: "metrics" | "health" |
-// "tables" | "hotkeys".  malloc'd; caller frees with MV_FreeString.
+// refuses anonymous scraper connections.  Any ops kind ("metrics" |
+// "health" | "tables" | "hotkeys" | "latency" | "audit" |
+// "replication" | "capacity" | "alerts").  malloc'd; caller frees
+// with MV_FreeString.
 char* MV_OpsFleetReport(const char* kind);
 
 // ---- capacity plane (docs/observability.md "capacity plane") ---------
@@ -304,6 +312,28 @@ int MV_SetProfiler(int hz);
 char* MV_ProfilerDump(void);
 // Drop recorded samples (per-phase A/B runs, test isolation).
 int MV_ProfilerClear(void);
+
+// ---- health plane: stall watchdog (docs/observability.md) ------------
+// Arm the native stall watchdog at `stall_ms` (<= 0 disarms; boot
+// value: the `-watchdog_stall_ms` flag).  Armed, every critical loop
+// (epoll reactor shards, actors, heartbeat scan, plus host loops via
+// MV_WatchdogBump/Busy) that makes zero progress for stall_ms while
+// work is queued gets flagged: `watchdog.stalls` bumps, a
+// "stall: <loop> no progress for Nms, queue=D" blackbox event lands
+// beside the profiler's folded stacks, and a blackbox dump triggers.
+// stall_ms must exceed the slowest legitimate loop period.  rc 0.
+int MV_SetWatchdog(int stall_ms);
+// One unit of progress on a HOST loop (e.g. "py.flush", the Python
+// metrics flusher) — registers the loop on first use; no-op disarmed.
+int MV_WatchdogBump(const char* loop);
+// Declare a host loop's queued work; 0 = idle (an idle loop cannot
+// stall).  no-op disarmed.
+int MV_WatchdogBusy(const char* loop, long long queued);
+// Per-loop watchdog table as a JSON array — the same payload the
+// `"alerts"` OpsQuery kind serves under "watchdog": loop name,
+// progress, queued, stalls, stalled flag, seconds since progress.
+// malloc'd; caller frees with MV_FreeString.
+char* MV_WatchdogStats(void);
 
 // ---- hot-key read replica (docs/embedding.md) ------------------------
 // Toggle replica-served matrix row reads live (the `-hotkey_replica`

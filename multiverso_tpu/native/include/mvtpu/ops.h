@@ -39,9 +39,15 @@ namespace ops {
 // Python metrics flusher pushes via MV_SetOpsHostMetrics.
 void SetHostMetrics(const std::string& prom_text);
 
+// Host-pushed alert state (JSON object text from the Python health
+// evaluator, via MV_SetOpsHostAlerts each metrics flush).  Served
+// verbatim under the "alerts" report's "host" key — the native side
+// never parses it.  Empty = served as null.
+void SetHostAlerts(const std::string& alerts_json);
+
 // This rank's report for `kind` ("metrics" | "health" | "tables" |
-// "hotkeys" | "latency" — the latency-attribution plane's per-stage
-// histograms + clock offsets + profiler status).
+// "hotkeys" | "latency" | "audit" | "replication" | "capacity" |
+// "alerts" — the health plane's watchdog table + host alert state).
 // Unknown kinds return a one-line JSON error instead of failing — a
 // scraper probing a newer protocol must not kill the connection.
 std::string LocalReport(const std::string& kind);

@@ -1,6 +1,7 @@
 #include "mvtpu/actor.h"
 
 #include "mvtpu/log.h"
+#include "mvtpu/watchdog.h"
 
 namespace mvtpu {
 
@@ -20,6 +21,12 @@ void Actor::Stop() {
 }
 
 void Actor::Main() {
+  // Watchdog (docs/observability.md "health plane"): each dispatched
+  // message is one unit of progress; queued = this message plus
+  // whatever is still in the mailbox.  A handler that never returns —
+  // the wedged-server-actor class of bug — shows as "actor.<name>
+  // no progress" with a nonzero queue.
+  const std::string wd_name = "actor." + name_;
   MessagePtr msg;
   while (mailbox_.Pop(&msg)) {
     if (!msg) continue;
@@ -30,8 +37,12 @@ void Actor::Main() {
                  static_cast<int>(msg->type));
       continue;
     }
+    watchdog::Busy(wd_name, static_cast<long long>(mailbox_.Size()) + 1);
     it->second(msg);
+    watchdog::Bump(wd_name);
+    watchdog::Busy(wd_name, 0);
   }
+  watchdog::Busy(wd_name, 0);
 }
 
 }  // namespace mvtpu

@@ -18,6 +18,7 @@
 #include "mvtpu/ops.h"
 #include "mvtpu/sketch.h"
 #include "mvtpu/stream.h"
+#include "mvtpu/watchdog.h"
 #include "mvtpu/zoo.h"
 
 using mvtpu::AddOption;
@@ -729,6 +730,34 @@ int MV_ProfilerClear(void) {
 int MV_SetOpsHostMetrics(const char* prom_text) {
   mvtpu::ops::SetHostMetrics(prom_text ? prom_text : "");
   return 0;
+}
+
+int MV_SetOpsHostAlerts(const char* alerts_json) {
+  mvtpu::ops::SetHostAlerts(alerts_json ? alerts_json : "");
+  return 0;
+}
+
+// ---- health plane: stall watchdog (docs/observability.md) ------------
+
+int MV_SetWatchdog(int stall_ms) {
+  mvtpu::watchdog::Arm(stall_ms);
+  return 0;
+}
+
+int MV_WatchdogBump(const char* loop) {
+  if (!loop) return -1;
+  mvtpu::watchdog::Bump(loop);
+  return 0;
+}
+
+int MV_WatchdogBusy(const char* loop, long long queued) {
+  if (!loop) return -1;
+  mvtpu::watchdog::Busy(loop, queued);
+  return 0;
+}
+
+char* MV_WatchdogStats(void) {
+  return MallocString(mvtpu::watchdog::StatsJson());
 }
 
 int MV_BlackboxEvent(const char* kind, const char* detail) {

@@ -340,6 +340,16 @@ void RegisterDefaults() {
               "boots disarmed; MV_SetProfiler toggles live.  97 Hz is "
               "the house rate — prime, so it cannot phase-lock with "
               "millisecond-periodic work");
+    DefineInt("watchdog_stall_ms", 0,
+              "stall watchdog (docs/observability.md \"health "
+              "plane\"): flag any critical loop (epoll reactor "
+              "shards, actors, heartbeat scan, host metrics flusher) "
+              "that makes zero progress for this long while work is "
+              "queued — dumps profiler folded stacks + a 'stall:' "
+              "blackbox and bumps watchdog.stalls.  0 (default) "
+              "disarms (every Bump is one relaxed load); must exceed "
+              "the slowest legitimate loop period.  MV_SetWatchdog "
+              "toggles live");
     DefineBool("audit", true,
                "delivery-audit plane (docs/observability.md \"audit "
                "plane\"): stamp every Add with a per-(worker, table, "

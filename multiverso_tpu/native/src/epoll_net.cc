@@ -28,6 +28,7 @@
 #include "mvtpu/net.h"
 #include "mvtpu/ops.h"
 #include "mvtpu/qos.h"
+#include "mvtpu/watchdog.h"
 
 namespace mvtpu {
 
@@ -200,6 +201,7 @@ struct EpollNet::Conn {
 struct EpollNet::Shard {
   int epfd = -1;
   int wake_fd = -1;
+  int idx = 0;  // position in shards_ — names the watchdog loop
   std::thread thread;
   // Hand-off queues: Send/accept threads push, the reactor pops.
   Mutex mu;
@@ -255,6 +257,7 @@ bool EpollNet::Init(const std::vector<std::string>& endpoints, int rank,
   // full, immutable shard vector, never a vector mid-growth.
   for (int i = 0; i < nshards; ++i) {
     auto s = std::make_unique<Shard>();
+    s->idx = i;
     s->epfd = ::epoll_create1(0);
     s->wake_fd = ::eventfd(0, EFD_NONBLOCK);
     if (s->epfd < 0 || s->wake_fd < 0) {
@@ -327,6 +330,11 @@ void EpollNet::AdoptHandoffs(Shard* s) {
 void EpollNet::ReactorLoop(Shard* s) {
   constexpr int kMaxEvents = 128;
   epoll_event events[kMaxEvents];
+  // Watchdog (docs/observability.md "health plane"): one Bump per
+  // drained event batch; "busy" while a batch is in hand.  A reactor
+  // that stops draining with events pending — the lost-wakeup class of
+  // bug — shows as "reactor.<shard> no progress" with a nonzero queue.
+  const std::string wd_name = "reactor." + std::to_string(s->idx);
   while (running_) {
     int n = ::epoll_wait(s->epfd, events, kMaxEvents, 200);
     if (!running_) break;
@@ -334,6 +342,7 @@ void EpollNet::ReactorLoop(Shard* s) {
       if (errno == EINTR) continue;
       break;
     }
+    watchdog::Busy(wd_name, n);
     // Adopt hand-offs first so a just-connected peer's events register
     // before we sleep again.
     AdoptHandoffs(s);
@@ -389,6 +398,8 @@ void EpollNet::ReactorLoop(Shard* s) {
       }
       if (what & EPOLLIN) HandleReadable(s, c);
     }
+    watchdog::Bump(wd_name);
+    watchdog::Busy(wd_name, 0);
   }
 }
 

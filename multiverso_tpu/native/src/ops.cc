@@ -14,6 +14,7 @@
 #include "mvtpu/mutex.h"
 #include "mvtpu/profiler.h"
 #include "mvtpu/qos.h"
+#include "mvtpu/watchdog.h"
 #include "mvtpu/zoo.h"
 
 namespace mvtpu {
@@ -23,6 +24,10 @@ namespace {
 
 Mutex g_mu;
 std::string g_host_metrics GUARDED_BY(g_mu);
+// Host-pushed alert state (JSON object text from the Python health
+// evaluator, spliced verbatim into the "alerts" report — the native
+// side never parses it).  Empty = no host push yet.
+std::string g_host_alerts GUARDED_BY(g_mu);
 
 struct Event {
   int64_t ts_us;
@@ -268,6 +273,11 @@ void SetHostMetrics(const std::string& prom_text) {
   g_host_metrics = prom_text;
 }
 
+void SetHostAlerts(const std::string& alerts_json) {
+  MutexLock lk(g_mu);
+  g_host_alerts = alerts_json;
+}
+
 std::string LocalReport(const std::string& kind) {
   if (kind == "metrics") {
     {
@@ -298,6 +308,23 @@ std::string LocalReport(const std::string& kind) {
   // resident bytes per bucket + the load-history ring.  Fleet scope
   // rides the generic JSON merge; tools/mvplan.py plans over it.
   if (kind == "capacity") return Zoo::Get()->OpsCapacityJson();
+  // Health plane (docs/observability.md "health plane"): the native
+  // stall watchdog's per-loop progress table plus the host-pushed
+  // alert state (SetHostAlerts, fed by health.py each metrics flush —
+  // spliced verbatim, never parsed here).  Fleet scope rides the
+  // generic JSON merge; mvtop --alerts / mvdoctor render it.
+  if (kind == "alerts") {
+    std::string host;
+    {
+      MutexLock lk(g_mu);
+      host = g_host_alerts;
+    }
+    std::ostringstream os;
+    os << "{\"rank\":" << Zoo::Get()->rank()
+       << ",\"watchdog\":" << watchdog::StatsJson()
+       << ",\"host\":" << (host.empty() ? "null" : host) << "}";
+    return os.str();
+  }
   return "{\"error\":\"unknown ops kind '" + JsonEscape(kind) + "'\"}";
 }
 
@@ -505,6 +532,7 @@ void BlackboxReset() {
   }
   MutexLock lk(g_mu);
   g_host_metrics.clear();
+  g_host_alerts.clear();
 }
 
 }  // namespace ops

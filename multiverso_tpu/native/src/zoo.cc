@@ -26,6 +26,7 @@
 #include "mvtpu/qos.h"
 #include "mvtpu/sketch.h"
 #include "mvtpu/waiter.h"
+#include "mvtpu/watchdog.h"
 
 namespace mvtpu {
 
@@ -604,6 +605,11 @@ bool Zoo::Start(int argc, const char* const* argv) {
   latency::Arm(configure::GetBool("wire_timing"));
   if (configure::GetInt("profile_hz") > 0)
     profiler::Start(static_cast<int>(configure::GetInt("profile_hz")));
+  // Health plane (docs/observability.md "health plane"): the stall
+  // watchdog's checker boots AFTER the loops it watches exist; its
+  // stall dump reuses the profiler's folded stacks when armed.
+  if (configure::GetInt("watchdog_stall_ms") > 0)
+    watchdog::Arm(static_cast<int>(configure::GetInt("watchdog_stall_ms")));
   if (configure::GetBool("trace")) Dashboard::SetTraceEnabled(true);
   started_ = true;
   ops::BlackboxEvent("lifecycle",
@@ -640,6 +646,9 @@ void Zoo::Stop() {
   if (size_ > 1) Barrier();
   else FlushWorkerAdds();
   ops::BlackboxEvent("lifecycle", "stop rank " + std::to_string(rank_));
+  // Watchdog off FIRST: the loops it watches are about to be joined,
+  // and a legitimately-exiting loop must never read as a stall.
+  watchdog::Arm(0);
   if (configure::GetInt("profile_hz") > 0) profiler::Stop();
   // Lease loop dies before the transport it sends through.
   if (hb_running_.exchange(false)) {
@@ -977,12 +986,18 @@ void Zoo::HeartbeatLoop() {
       }
     });
   }
+  // Watchdog (docs/observability.md "health plane"): the lease scan is
+  // permanently "busy" while running — a wedged scan means every peer
+  // death goes undetected.  -watchdog_stall_ms must therefore exceed
+  // -heartbeat_ms (the scan's legitimate period).
+  watchdog::Busy("hb.lease", 1);
   while (hb_running_) {
     // Sleep in small steps so Stop never waits a full interval.
     for (int64_t slept = 0; slept < interval && hb_running_; slept += 20)
       std::this_thread::sleep_for(std::chrono::milliseconds(
           std::min<int64_t>(20, interval - slept)));
     if (!hb_running_) break;
+    watchdog::Bump("hb.lease");
     // Scan the leases (every rank, not just rank 0).  A peer
     // transitions to dead ONCE per outage (hb.missed counts outages,
     // not scans) and recovers when a late heartbeat arrives.  With
@@ -1017,6 +1032,7 @@ void Zoo::HeartbeatLoop() {
     // answered must not wedge the client past its deadline.
     ReleaseParkedAcks(/*all=*/false);
   }
+  watchdog::Busy("hb.lease", 0);  // clean exit is idle, not a stall
   for (auto& t : senders) t.join();
 }
 

@@ -39,8 +39,12 @@ from ..serve.wire import (AnonServeClient, OPS_SCOPE_FLEET,
 __all__ = ["OpsClient", "parse_prometheus"]
 
 # `name{labels} value [# {exemplar-labels} exemplar-value]`
+# The label block is quote-aware (not `[^}]*`): escaped label VALUES may
+# legally contain `}`, `\"` and `\\` per the exposition format.
 _LINE = re.compile(
-    r"^(?P<name>[^\s{#]+)(?P<labels>\{[^}]*\})?\s+(?P<value>[^\s#]+)"
+    r"^(?P<name>[^\s{#]+)"
+    r'(?P<labels>\{(?:[^"}]|"(?:[^"\\]|\\.)*")*\})?\s+'
+    r"(?P<value>[^\s#]+)"
     r"(?:\s+#\s+\{(?P<exemplar>[^}]*)\}\s+(?P<exvalue>\S+))?\s*$")
 
 
@@ -157,6 +161,17 @@ class OpsClient:
         ``tools/mvplan.py`` bin-packs placement proposals over it and
         ``tools/mvtop.py --capacity`` renders it."""
         return json.loads(self.report("capacity", fleet=fleet))
+
+    def alerts(self, fleet: bool = False):
+        """Health-plane report (docs/observability.md "health plane"):
+        per rank, the native stall watchdog's per-loop progress table
+        and the host-pushed alert state (every rule's ok / pending /
+        firing verdict with value, severity and age).  Fleet scope
+        returns the usual ``{"ranks": {...}, "silent": [...]}``
+        wrapper — ``tools/mvtop.py --alerts`` renders it and
+        ``tools/mvdoctor.py`` correlates it across planes.  A silent
+        rank's alerts are UNKNOWN, never resolved."""
+        return json.loads(self.report("alerts", fleet=fleet))
 
     def metrics(self, fleet: bool = False) -> Tuple[
             Dict[str, float], Dict[str, Dict[str, str]]]:

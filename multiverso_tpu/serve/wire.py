@@ -53,7 +53,7 @@ __all__ = ["AnonServeClient", "MSG", "pack_frame", "unpack_frame",
            "QOS", "FLAG_QOS", "QOS_CLASSES", "qos_id",
            "STAGES", "default_timeout_ms",
            "stage_durations", "ntp_sample", "OffsetEstimator",
-           "OPS_SCOPE_LOCAL", "OPS_SCOPE_FLEET"]
+           "OPS_SCOPE_LOCAL", "OPS_SCOPE_FLEET", "OPS_KINDS"]
 
 # WireHeader (mvtpu/message.h): 4 x int32, 3 x int64, 4 x int32.
 HEADER = struct.Struct("<4i3q4i")
@@ -138,6 +138,13 @@ MSG = {
 
 OPS_SCOPE_LOCAL = 0
 OPS_SCOPE_FLEET = 1
+# Every report kind the native ops plane dispatches (ops.cc LocalReport)
+# — the wire-level catalogue.  tools/mvcontract.py diffs this tuple
+# against the C++ dispatch strings, and tests assert every kind has an
+# mvtop view and a docs/observability.md section, so adding a kind in
+# only one place fails fast.
+OPS_KINDS = ("metrics", "health", "tables", "hotkeys", "latency",
+             "audit", "replication", "capacity", "alerts")
 _TYPE_NAME = {v: k for k, v in MSG.items()}
 
 _ACCEPT_RAW = 1  # msgflag::kAcceptRaw

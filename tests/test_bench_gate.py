@@ -336,6 +336,35 @@ def test_gate_passes_in_band_failover_line(tmp_path):
     assert rc == 0, out
 
 
+def test_gate_guards_health_keys(tmp_path):
+    """bench_health acceptance bars (docs/observability.md "health
+    plane", schema 20): the armed health plane costing the serve tier
+    real QPS (the evaluation must stay on the flush thread), a seeded
+    fault taking longer than 2 s to page through the flush loop, or
+    the alert never firing at all must all fail the gate."""
+    line = {"extras": {"health_overhead_pct": 8.0,       # > 1% bar
+                       "health_alert_detect_ms": 9000.0,  # loop not closing
+                       "health_alert_fired": 0.0}}        # never paged
+    p = tmp_path / "health_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "health_overhead_pct" in out and "FAIL" in out, out
+    assert "health_alert_detect_ms" in out, out
+    assert "health_alert_fired" in out, out
+
+
+def test_gate_passes_in_band_health_line(tmp_path):
+    line = {"extras": {"health_overhead_pct": 0.5,
+                       "health_probe_qps": 4000.0,
+                       "health_alert_detect_ms": 700.0,
+                       "health_alert_fired": 1.0}}
+    p = tmp_path / "health_ok.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
 def test_last_parseable_line_wins(tmp_path):
     """Schema-7 cumulative emission: the LAST line is the freshest
     cumulative state and must shadow earlier partials."""

@@ -42,6 +42,7 @@ BINDING_PY = _p("multiverso_tpu/native/__init__.py")
 LUA = _p("multiverso_tpu/binding/lua/multiverso.lua")
 CONFIGURE_CC = _p("multiverso_tpu/native/src/configure.cc")
 CONFIG_PY = _p("multiverso_tpu/config.py")
+OPS_CC = _p("multiverso_tpu/native/src/ops.cc")
 
 
 def _seed(tmp_path, src, name, old, new):
@@ -135,6 +136,17 @@ def test_wire_extractor():
     assert msg["RequestGet"] == 1
     assert msg["OpsReply"] == 24
     assert len(msg) >= 11
+    # The ops report-kind catalogue (health plane rode in last).
+    assert "alerts" in w["ops_kinds"]
+    assert "metrics" in w["ops_kinds"]
+
+
+def test_ops_kinds_cc_extractor():
+    cc = mvcontract.extract_ops_kinds_cc(OPS_CC)
+    # Every catalogued kind has a native dispatch, alerts included.
+    for kind in ("metrics", "health", "tables", "hotkeys", "latency",
+                 "audit", "replication", "capacity", "alerts"):
+        assert kind in cc["kinds"], kind
 
 
 # ------------------------------------------------------- extractor: (c)
@@ -360,6 +372,26 @@ def test_drift_docs_dead_flag(tmp_path):
     assert len(f) == 1
     assert "dead flag" in f[0].msg and f[0].path.endswith("stale.md")
     assert f[0].line == 3
+
+
+def test_drift_ops_kind_missing_native_dispatch(tmp_path):
+    # OPS_KINDS names a kind ops.cc stopped dispatching.
+    p = _seed(tmp_path, OPS_CC, "ops.cc",
+              'kind == "alerts"', 'kind == "alertz"')
+    f = _findings(ops_cc=p)
+    assert any(x.pair == "serve/wire.py<->ops.cc"
+               and "'alerts'" in x.msg
+               and "unknown-kind error" in x.msg for x in f)
+
+
+def test_drift_ops_kind_missing_from_catalogue(tmp_path):
+    # ops.cc dispatches a kind the wire catalogue does not list.
+    p = _seed(tmp_path, WIRE_PY, "w.py", '"audit", "replication"',
+              '"replication"')
+    f = _findings(wire_py=p)
+    assert any(x.pair == "serve/wire.py<->ops.cc"
+               and '"audit"' in x.msg
+               and "OPS_KINDS does not list it" in x.msg for x in f)
 
 
 def test_strict_exit_on_seeded_drift(tmp_path, capsys):

@@ -1,0 +1,514 @@
+"""Tier-1 gate for the closed-loop health plane
+(docs/observability.md "health plane"): the pure error-budget math in
+multiverso_tpu/slo.py against hand-computed values, the alert state
+machine's lifecycle edges (hysteresis, no-data discipline, burn-rate
+multiwindow, critical profiler boost), the fleet merge behind
+``mvtop --alerts`` / ``mvdoctor``, the arm()/disarm() flush wiring, the
+Prometheus label-escaping round trip, the ``-metrics_history`` ring
+cap, the native stall watchdog via the C API, and the meta-contract
+that every OpsQuery kind has an mvtop view and a docs section.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+@pytest.fixture()
+def registry():
+    from multiverso_tpu import health, metrics
+
+    health.disarm()
+    metrics.reset()
+    yield metrics
+    health.disarm()
+    metrics.reset()
+
+
+# ---------------------------------------------------------------- slo math
+
+def test_budget_and_validation():
+    from multiverso_tpu import slo
+
+    assert slo.budget(0.999) == pytest.approx(0.001)
+    assert slo.budget(0.99) == pytest.approx(0.01)
+    for bad in (0.0, 1.0, 1.5, -0.1):
+        with pytest.raises(ValueError):
+            slo.budget(bad)
+
+
+def test_window_delta_hand_computed():
+    from multiverso_tpu import slo
+
+    pts = [(0.0, 5.0), (5.0, 9.0), (20.0, 10.0)]
+    assert slo.window_delta(pts, 60.0) == pytest.approx(5.0)
+    # Window ending at the last point only holds one sample: no delta.
+    assert slo.window_delta(pts, 10.0) is None
+    assert slo.window_delta([], 60.0) is None
+    assert slo.window_delta([(0.0, 1.0)], 60.0) is None
+    # A counter reset reads as zero events, never negative.
+    assert slo.window_delta([(0.0, 10.0), (5.0, 2.0)], 60.0) == 0.0
+
+
+def test_window_rate_hand_computed():
+    from multiverso_tpu import slo
+
+    assert slo.window_rate([(0.0, 0.0), (10.0, 30.0)],
+                           60.0) == pytest.approx(3.0)
+    assert slo.window_rate([(3.0, 1.0)], 60.0) is None
+    # Zero elapsed time cannot produce a rate.
+    assert slo.window_rate([(5.0, 1.0), (5.0, 9.0)], 60.0) is None
+
+
+def test_error_fraction_and_burn_rate_hand_computed():
+    from multiverso_tpu import slo
+
+    bad = [(0.0, 0.0), (10.0, 10.0)]
+    total = [(0.0, 0.0), (10.0, 1000.0)]
+    assert slo.error_fraction(bad, total, 60.0) == pytest.approx(0.01)
+    # 10 bad of 1000 against a 0.999 objective = 10x the error budget.
+    assert slo.burn_rate(bad, total, 0.999, 60.0) == pytest.approx(10.0)
+    # Zero traffic is "no data", not "perfect availability".
+    flat = [(0.0, 7.0), (10.0, 7.0)]
+    assert slo.error_fraction(bad, flat, 60.0) is None
+    assert slo.burn_rate(bad, flat, 0.999, 60.0) is None
+    # More bad than total clamps to fraction 1.0, not beyond.
+    worse = [(0.0, 0.0), (10.0, 5000.0)]
+    assert slo.error_fraction(worse, total, 60.0) == pytest.approx(1.0)
+
+
+def test_multiwindow_burn_requires_both_windows():
+    from multiverso_tpu import slo
+
+    # The long window burned hot historically, but the short window has
+    # no fresh points: significant but not still-happening -> no fire.
+    bad = [(0.0, 0.0), (10.0, 10.0)]
+    total = [(0.0, 0.0), (10.0, 1000.0)]
+    long_b, short_b, firing = slo.multiwindow_burn(
+        bad, total, 0.999, 5.0, long_s=60.0, short_s=5.0)
+    assert long_b == pytest.approx(10.0)
+    assert short_b is None and not firing
+    # A fresh breaching point lights the short window too.
+    bad += [(12.0, 12.0)]
+    total += [(12.0, 1200.0)]
+    long_b, short_b, firing = slo.multiwindow_burn(
+        bad, total, 0.999, 5.0, long_s=60.0, short_s=5.0)
+    assert long_b == pytest.approx(10.0)
+    assert short_b == pytest.approx(10.0)
+    assert firing
+    # short_s = 0 degenerates to single-window.
+    long_b, short_b, firing = slo.multiwindow_burn(
+        bad[:2], total[:2], 0.999, 5.0, long_s=60.0, short_s=0.0)
+    assert firing and short_b == long_b
+
+
+# ---------------------------------------------------------------- rules
+
+def test_rule_validation():
+    from multiverso_tpu import health
+
+    with pytest.raises(ValueError):
+        health.Rule(name="r", metric="m", op="gt")
+    with pytest.raises(ValueError):
+        health.Rule(name="r", metric="m", op="rate_gt", severity="fatal")
+    with pytest.raises(ValueError):
+        health.Rule(name="r", metric="m", op="burn_rate_gt")
+
+
+def test_default_rules_are_valid_and_cover_the_planes():
+    from multiverso_tpu import health
+
+    rules = health.default_rules()
+    names = {r.name for r in rules}
+    assert {"lat-p99", "lat-slo-burn", "audit-gap",
+            "rss-growth", "hb-missed"} <= names
+    for r in rules:
+        assert r.op in health.RULE_OPS
+        assert r.severity in health.SEVERITIES
+
+
+# ------------------------------------------------------- alert lifecycle
+
+def _feed_counter(reg, counter, samples):
+    """Drive a counter through ``[(ts, cumulative_value)]`` history."""
+    prev = counter._value
+    for ts, v in samples:
+        counter.inc(v - prev)
+        prev = v
+        reg.record_history(now=ts)
+
+
+def test_counter_delta_rule_fires_and_resolves(registry):
+    from multiverso_tpu import health, metrics
+
+    reg = metrics.Registry()
+    c = reg.counter("t.err")
+    rule = health.Rule(name="r", metric="t.err", op="counter_delta_gt",
+                       threshold=5.0, window_s=60.0)
+    ev = health.HealthEvaluator([rule], registry=reg)
+    _feed_counter(reg, c, [(0.0, 0.0), (10.0, 20.0)])
+    trans = ev.evaluate(now=10.0)
+    assert trans == [{"rule": "r", "to": "firing",
+                      "severity": "warning", "value": 20.0}]
+    (a,) = ev.snapshot()
+    assert a["state"] == "firing" and a["fired"] == 1
+    # Firing state is scrapeable like any other series.
+    assert metrics.gauge("health.alerts.firing",
+                         {"severity": "warning"}).value == 1.0
+    # The counter goes flat -> the window delta drops to 0 -> resolve.
+    _feed_counter(reg, c, [(70.0, 20.0), (80.0, 20.0)])
+    trans = ev.evaluate(now=80.0)
+    assert trans == [{"rule": "r", "to": "resolved",
+                      "severity": "warning", "value": 0.0}]
+    (a,) = ev.snapshot()
+    assert a["state"] == "ok" and a["resolved"] == 1
+
+
+def test_for_s_hysteresis_flapping_shows_pending_churn_only(registry):
+    from multiverso_tpu import health, metrics
+
+    reg = metrics.Registry()
+    rule = health.Rule(name="up", metric="t.up", op="absent",
+                       for_s=30.0)
+    ev = health.HealthEvaluator([rule], registry=reg)
+    # Flap: missing -> present -> missing, never 30 s sustained.
+    ev.evaluate(now=0.0)
+    assert ev.snapshot()[0]["state"] == "pending"
+    reg.gauge("t.up").set(1.0)
+    ev.evaluate(now=10.0)
+    assert ev.snapshot()[0]["state"] == "ok"
+    reg.remove("t.up")
+    ev.evaluate(now=20.0)
+    ev.evaluate(now=45.0)               # 25 s pending: still < for_s
+    a = ev.snapshot()[0]
+    assert a["state"] == "pending" and a["fired"] == 0
+    ev.evaluate(now=51.0)               # 31 s sustained -> fires
+    a = ev.snapshot()[0]
+    assert a["state"] == "firing" and a["fired"] == 1
+
+
+def test_no_data_keeps_firing_but_resets_pending(registry):
+    from multiverso_tpu import health, metrics
+
+    reg = metrics.Registry()
+    c = reg.counter("t.err")
+    firing = health.Rule(name="f", metric="t.err",
+                         op="counter_delta_gt", threshold=5.0,
+                         window_s=60.0)
+    pending = health.Rule(name="p", metric="t.err",
+                          op="counter_delta_gt", threshold=5.0,
+                          for_s=100.0, window_s=60.0)
+    ev = health.HealthEvaluator([firing, pending], registry=reg)
+    _feed_counter(reg, c, [(0.0, 0.0), (10.0, 20.0)])
+    ev.evaluate(now=10.0)
+    by = {a["rule"]: a for a in ev.snapshot()}
+    assert by["f"]["state"] == "firing"
+    assert by["p"]["state"] == "pending"
+    # The series vanishes (rank restart, ring reset): silence is not
+    # proof of recovery -- firing holds; pending loses its evidence.
+    reg.reset()
+    trans = ev.evaluate(now=20.0)
+    assert trans == []
+    by = {a["rule"]: a for a in ev.snapshot()}
+    assert by["f"]["state"] == "firing" and by["f"]["resolved"] == 0
+    assert by["f"]["value"] is None
+    assert by["p"]["state"] == "ok"
+
+
+def test_burn_rate_rule_matches_hand_computed_math(registry):
+    from multiverso_tpu import health, metrics, slo
+
+    reg = metrics.Registry()
+    bad, total = reg.counter("t.breach"), reg.counter("t.total")
+    for ts, b, t in [(0.0, 0.0, 0.0), (10.0, 10.0, 1000.0),
+                     (12.0, 12.0, 1200.0)]:
+        bad.inc(b - bad._value)
+        total.inc(t - total._value)
+        reg.record_history(now=ts)
+    rule = health.Rule(name="burn", metric="t.breach",
+                       op="burn_rate_gt", total_metric="t.total",
+                       objective=0.999, threshold=5.0,
+                       window_s=60.0, short_window_s=5.0)
+    ev = health.HealthEvaluator([rule], registry=reg)
+    trans = ev.evaluate(now=12.0)
+    assert [t["to"] for t in trans] == ["firing"]
+    a = ev.snapshot()[0]
+    expect = slo.burn_rate(reg.history("t.breach"),
+                           reg.history("t.total"), 0.999, 60.0)
+    assert a["value"] == pytest.approx(expect) == pytest.approx(10.0)
+
+
+def test_critical_alert_boosts_profiler_and_restores(registry):
+    from multiverso_tpu import health, metrics
+    from multiverso_tpu import profiler as pyprof
+
+    reg = metrics.Registry()
+    rule = health.Rule(name="crit", metric="t.up", op="absent",
+                       severity="critical")
+    ev = health.HealthEvaluator([rule], registry=reg)
+    try:
+        ev.evaluate(now=0.0)
+        assert ev.snapshot()[0]["state"] == "firing"
+        prof = pyprof.active()
+        assert prof is not None and prof.hz == health.BOOST_HZ
+        # Resolving the last critical restores the previous rate (none
+        # was armed before, so the sampler stops outright).
+        reg.gauge("t.up").set(1.0)
+        ev.evaluate(now=1.0)
+        assert ev.snapshot()[0]["state"] == "ok"
+        assert pyprof.active() is None
+    finally:
+        pyprof.stop(to_trace=False)
+
+
+# ------------------------------------------------------- fleet merge
+
+def test_fleet_alert_rows_silent_and_watchdog(registry):
+    from multiverso_tpu import health
+
+    doc = {"scope": "fleet", "kind": "alerts", "silent": [2],
+           "ranks": {
+               "0": {"rank": 0,
+                     "host": {"armed": True, "alerts": [
+                         {"rule": "lat-slo-burn", "severity": "critical",
+                          "state": "firing", "value": 12.5,
+                          "age_s": 3.0}]},
+                     "watchdog": [
+                         {"loop": "reactor.0", "stalled": True,
+                          "queued": 7, "stalled_s": 1.5},
+                         {"loop": "hb.scan", "stalled": False}]},
+               "1": {"rank": 1, "host": None, "watchdog": []},
+           }}
+    rows = health.fleet_alert_rows(doc)
+    by = {(r["rank"], r["rule"]): r for r in rows}
+    assert by[("0", "lat-slo-burn")]["state"] == "firing"
+    wd = by[("0", "watchdog:reactor.0")]
+    assert wd["severity"] == "critical" and wd["value"] == 7.0
+    assert ("0", "watchdog:hb.scan") not in by  # healthy loop: no row
+    # A silent rank is UNKNOWN, never resolved.
+    assert by[("2", "-")]["state"] == "unknown"
+    assert by[("2", "-")]["value"] is None
+    # A local (non-fleet) report flattens too.
+    local = {"rank": 3, "host": {"alerts": [
+        {"rule": "r", "severity": "info", "state": "ok"}]}}
+    assert health.fleet_alert_rows(local)[0]["rank"] == "3"
+
+
+# ------------------------------------------------------- arm / disarm
+
+def test_arm_wires_the_flush_loop_and_disarm_unwires(registry):
+    from multiverso_tpu import health, metrics
+
+    assert health.alerts_doc()["armed"] is False
+    ev = health.arm(rules=[health.Rule(name="up", metric="t.up",
+                                       op="absent")])
+    try:
+        assert health.evaluator() is ev
+        # Re-arming replaces, not stacks, the flush hook.
+        ev2 = health.arm(rules=[health.Rule(name="up", metric="t.up",
+                                            op="absent")])
+        assert health.evaluator() is ev2
+        with metrics._HOOK_LOCK:
+            assert len(metrics._FLUSH_HOOKS) == 1
+        metrics.start_flush(20)
+        deadline = time.time() + 5
+        doc = health.alerts_doc()
+        while time.time() < deadline:
+            doc = health.alerts_doc()
+            if doc["firing"]:
+                break
+            time.sleep(0.02)
+        assert doc["armed"] and doc["rules"] == 1
+        assert doc["firing"] == 1, doc
+        assert doc["alerts"][0]["rule"] == "up"
+    finally:
+        health.disarm()
+    assert health.alerts_doc() == {"armed": False, "rules": 0,
+                                   "firing": 0, "alerts": []}
+    with metrics._HOOK_LOCK:
+        assert len(metrics._FLUSH_HOOKS) == 0
+
+
+# ------------------------------------------------- registry satellites
+
+def test_prometheus_label_escaping_round_trip(registry):
+    from multiverso_tpu.ops.introspect import parse_prometheus
+
+    hostile = 'a"b\\c\nd}e'
+    registry.gauge("t.esc", {"path": hostile}).set(7.0)
+    text = registry.render_prometheus()
+    # The reserved characters ship escaped on the wire...
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    values, _ = parse_prometheus(text)
+    keys = [k for k in values if k.startswith("t_esc{")]
+    # ...and the quote-aware parser still keys the series despite the
+    # literal `}` inside the label value.
+    assert len(keys) == 1, values
+    assert 'd}e"' in keys[0]
+    assert values[keys[0]] == 7.0
+
+
+def test_history_ring_capped_and_recapped(registry):
+    c = registry.counter("t.n")
+    registry.set_history_depth(4)
+    for i in range(10):
+        c.inc()
+        registry.record_history(now=float(i))
+    pts = registry.history("t.n")
+    assert pts == [(6.0, 7.0), (7.0, 8.0), (8.0, 9.0), (9.0, 10.0)]
+    # Shrinking re-caps existing rings, keeping the newest points.
+    registry.set_history_depth(2)
+    assert registry.history("t.n") == [(8.0, 9.0), (9.0, 10.0)]
+    # Below 2 points rate()/delta() could never answer: clamped.
+    registry.set_history_depth(1)
+    assert registry.REGISTRY.history_depth == 2
+
+
+# ------------------------------------------------- ops-kind meta-test
+
+def test_every_ops_kind_has_an_mvtop_view_and_a_docs_section():
+    """The wire catalogue, the mvtop view map, and the operator docs
+    must name every kind: a plane you cannot render or read about is
+    not shipped (mvcontract separately diffs the catalogue against the
+    native dispatch strings)."""
+    import mvtop
+
+    from multiverso_tpu.serve import wire
+
+    assert set(mvtop.KIND_VIEWS) == set(wire.OPS_KINDS)
+    with open(os.path.join(REPO, "docs", "observability.md")) as fh:
+        doc = fh.read()
+    for kind in wire.OPS_KINDS:
+        assert f'`"{kind}"`' in doc, f"docs/observability.md: {kind}"
+
+
+def test_mvtop_alert_view_rows_and_firing_counts():
+    import mvtop
+
+    doc = {"silent": [1], "ranks": {"0": {
+        "rank": 0,
+        "host": {"alerts": [
+            {"rule": "b", "severity": "warning", "state": "ok",
+             "value": None, "age_s": 4.0},
+            {"rule": "a", "severity": "critical", "state": "firing",
+             "value": 12.25, "age_s": 2.0}]},
+        "watchdog": []}}}
+    rows = mvtop.alert_view_rows(doc)
+    # Firing sorts above ok, unknown between them.
+    assert [(r["rank"], r["rule"], r["state"]) for r in rows] == [
+        ("0", "a", "firing"), ("1", "-", "unknown"), ("0", "b", "ok")]
+    assert rows[0]["value"] == "12.25" and rows[0]["age_s"] == "2"
+    assert rows[2]["value"] == "-"
+    assert mvtop.firing_counts(doc) == {"0": 1, "1": "?"}
+    stale = mvtop.render_stale("r1\nr2", OSError("down"))
+    assert "showing last good scrape" in stale
+    assert stale.count("stale") == 2
+
+
+def test_mvdoctor_diagnose_correlates_planes():
+    import mvdoctor
+
+    planes = {
+        "alerts": {"ranks": {"1": {"rank": 1, "host": {"alerts": [
+            {"rule": "lat-slo-burn", "severity": "critical",
+             "state": "firing", "value": 40.0, "age_s": 3.0}]},
+            "watchdog": []}}},
+        "latency": {"ranks": {"1": {"rank": 1, "stages": {
+            "apply": {"p99_ms": 25.0}, "net": {"p99_ms": 0.2}},
+            "total": {"p99_ms": 25.4}}}},
+        "hotkeys": {"ranks": {"1": [
+            {"id": 0, "gets": 1000, "skew_ratio": 9.0,
+             "hotkeys": {"topk": [{"key": 3, "count": 100}]}}]}},
+        "audit": {}, "capacity": {},
+    }
+    findings = mvdoctor.diagnose(planes)
+    assert findings, "no findings"
+    top = findings[0]
+    assert top["severity"] == "critical" and top["rank"] == "1"
+    assert "latency SLO burn" in top["title"]
+    assert "'apply'" in top["title"]
+    text = mvdoctor.render(findings)
+    assert "[critical] rank 1" in text
+
+
+# ---------------------------------------------------- native watchdog
+
+@needs_gxx
+def test_native_watchdog_and_alerts_report(tmp_path):
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    rt = nat.NativeRuntime(args=["-log_level=error", "-trace=true",
+                                 f"-trace_dir={tmp_path}"])
+    try:
+        rt.set_watchdog(100)
+        # Queued work, zero progress: the checker must flag a stall.
+        rt.watchdog_busy("t.loop", 5)
+
+        def loop_row():
+            return {d["loop"]: d for d in rt.watchdog_stats()
+                    }.get("t.loop")
+
+        deadline = time.time() + 5
+        row = None
+        while time.time() < deadline:
+            row = loop_row()
+            if row and row["stalled"]:
+                break
+            time.sleep(0.05)
+        assert row and row["stalled"], row
+        assert row["queued"] == 5 and row["stalls"] >= 1
+        # Progress clears the stall without disarming.
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            rt.watchdog_bump("t.loop")
+            row = loop_row()
+            if not row["stalled"]:
+                break
+            time.sleep(0.05)
+        assert not row["stalled"], row
+        # Host alert state round-trips through the in-band report,
+        # beside the watchdog table.
+        doc = {"armed": True, "rules": 1, "firing": 0, "alerts": []}
+        rt.set_ops_host_alerts(json.dumps(doc))
+        rep = json.loads(rt.ops_report("alerts"))
+        assert rep["rank"] == 0
+        assert rep["host"] == doc
+        assert "t.loop" in {d["loop"] for d in rep["watchdog"]}
+        rt.set_ops_host_alerts("")
+        rep = json.loads(rt.ops_report("alerts"))
+        assert rep["host"] is None
+        # An idle loop cannot stall even with the watchdog armed.
+        rt.watchdog_busy("t.loop", 0)
+        time.sleep(0.3)
+        assert not loop_row()["stalled"]
+        rt.set_watchdog(0)
+    finally:
+        rt.shutdown()
+
+
+# ------------------------------------------------- closed-loop chaos
+
+@pytest.mark.slow
+@needs_gxx
+def test_doctor_demo_end_to_end():
+    """The full acceptance smoke (``make doctor-demo``): quiet fleet ->
+    seeded apply-delay fault pages fleet-wide -> mvdoctor names the
+    rank and the stage -> clearing resolves (tier-2; minutes)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "doctor_demo.py")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    assert "DOCTOR_DEMO_OK" in r.stdout

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""doctor-demo — acceptance smoke for the closed-loop health plane
+(docs/observability.md "health plane"; ``make doctor-demo``).
+
+Spawns a TWO-RANK native fleet (epoll engine, heartbeats, the native
+stall watchdog armed, the Python health plane armed with a
+demo-tightened latency burn-rate rule) and proves the loop closes:
+
+(a) **Quiet fleet, quiet doctor** — with healthy traffic the fleet's
+    ``"alerts"`` scrape shows zero firing rules on both ranks and
+    ``tools/mvdoctor.py --fleet --strict`` exits 0.
+(b) **A seeded fault pages fleet-wide within two flushes** — after
+    ``MV_SetFault("apply_delay")`` on rank 0 plus one probe burst from
+    rank 1, rank 1's ``lat-slo-burn`` alert is FIRING in the
+    fleet-scope scrape within two flush intervals of the traffic.
+(c) **mvdoctor names the rank and the stage** — its top finding is
+    critical, blames rank 1's latency SLO burn on the ``apply`` stage,
+    and ``--strict`` exits 1.
+(d) **Clearing the fault resolves the alert** — after ``clear`` +
+    healthy probes the alert leaves the firing state (resolved count
+    increments), and ``--strict`` exits 0 again.
+
+Prints ``DOCTOR_DEMO_OK`` and exits 0 on success.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FLUSH_MS = 250  # keep in sync with doctor_demo_worker.FLUSH_MS
+
+
+def _cmd(proc, cmd, marker, timeout=120):
+    proc.stdin.write(cmd + "\n")
+    proc.stdin.flush()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if marker in line:
+            return line
+    raise AssertionError(f"no {marker} after {cmd!r}")
+
+
+def _alert(doc: dict, rank: str, rule: str):
+    rep = (doc.get("ranks") or {}).get(rank) or {}
+    for a in (rep.get("host") or {}).get("alerts") or []:
+        if a["rule"] == rule:
+            return a
+    return None
+
+
+def _doctor(ep, *extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mvdoctor.py"),
+         ep, "--fleet", *extra],
+        capture_output=True, text=True, timeout=60, env=env)
+
+
+def main() -> int:
+    from multiverso_tpu import native as nat
+    from multiverso_tpu.ops.introspect import OpsClient
+
+    nat.ensure_built()
+    socks = [socket.socket() for _ in range(2)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    for s in socks:
+        s.close()
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="mvtpu_doc_")
+    mf = os.path.join(tmp, "machines")
+    with open(mf, "w") as f:
+        f.write("\n".join(eps) + "\n")
+
+    worker = os.path.join(REPO, "multiverso_tpu", "apps",
+                          "doctor_demo_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, mf, str(r)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
+        for r in range(2)
+    ]
+    try:
+        for p in procs:
+            line = p.stdout.readline()
+            assert "DOC_READY" in line, line
+
+        # ---- (a) healthy fleet: nothing firing, strict doctor green --
+        for p in procs:
+            _cmd(p, "probe", "DOC_PROBE_DONE")
+        time.sleep(2.5 * FLUSH_MS / 1e3)
+        with OpsClient(eps[0], timeout=15) as c:
+            doc = c.alerts(fleet=True)
+        assert set(doc["ranks"]) == {"0", "1"}, doc
+        for r in ("0", "1"):
+            host = (doc["ranks"][r] or {}).get("host") or {}
+            assert host.get("armed"), (r, host)
+            assert host.get("firing", 0) == 0, (r, host)
+        dr = _doctor(eps[0], "--strict")
+        assert dr.returncode == 0, (dr.returncode, dr.stdout, dr.stderr)
+        print("healthy fleet: health plane armed on both ranks, zero "
+              "firing alerts, mvdoctor --strict exits 0")
+
+        # ---- (b) seeded apply delay -> fleet-wide page in 2 flushes --
+        _cmd(procs[0], "fault", "DOC_FAULT_ARMED")
+        _cmd(procs[1], "probe", "DOC_PROBE_DONE", timeout=180)
+        time.sleep(2.0 * FLUSH_MS / 1e3)  # two flush intervals
+        with OpsClient(eps[0], timeout=15) as c:
+            doc = c.alerts(fleet=True)
+        a = _alert(doc, "1", "lat-slo-burn")
+        assert a is not None and a["state"] == "firing", (a, doc)
+        print(f"seeded 25 ms apply delay on rank 0: rank 1's "
+              f"lat-slo-burn alert FIRING fleet-wide within two "
+              f"{FLUSH_MS} ms flushes (burn {a['value']:.1f}x budget)")
+
+        # ---- (c) mvdoctor blames the rank AND the apply stage --------
+        # A probe burst before each doctor run keeps the burn windows
+        # hot — the multiwindow rule deliberately un-fires once recent
+        # traffic stops breaching.
+        dr = _doctor(eps[0])
+        assert dr.returncode == 0, (dr.stdout, dr.stderr)
+        head = dr.stdout.splitlines()[0]
+        assert "[critical] rank 1" in head, dr.stdout
+        assert "latency SLO burn" in head, dr.stdout
+        assert "'apply'" in head, dr.stdout
+        _cmd(procs[1], "probe", "DOC_PROBE_DONE", timeout=180)
+        time.sleep(2.0 * FLUSH_MS / 1e3)
+        strict = _doctor(eps[0], "--strict")
+        assert strict.returncode == 1, (strict.returncode, strict.stdout)
+        print("mvdoctor: top finding = " + head)
+        print("mvdoctor --strict exits 1 while the page is live")
+
+        # ---- (d) clearing the fault resolves the alert ---------------
+        _cmd(procs[0], "clear", "DOC_CLEARED")
+        deadline = time.time() + 30
+        state = None
+        while time.time() < deadline:
+            _cmd(procs[1], "probe", "DOC_PROBE_DONE")
+            line = _cmd(procs[1], "alerts", "DOC_ALERTS")
+            local = json.loads(line.split("DOC_ALERTS ", 1)[1])
+            a = next(x for x in local["alerts"]
+                     if x["rule"] == "lat-slo-burn")
+            state = a["state"]
+            if state == "ok" and a["resolved"] >= 1:
+                break
+            time.sleep(FLUSH_MS / 1e3)
+        assert state == "ok", state
+        strict = _doctor(eps[0], "--strict")
+        assert strict.returncode == 0, (strict.returncode, strict.stdout)
+        print(f"fault cleared: alert resolved (resolved count "
+              f"{a['resolved']}), mvdoctor --strict green again")
+    finally:
+        outs = []
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.stdin.write("quit\n")
+                    p.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    pass
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=180)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or f"DOC_OK {r}" not in out:
+            print(out[-3000:])
+            print(f"DOCTOR_DEMO_FAIL: rank {r} rc={p.returncode}")
+            return 1
+    print("DOCTOR_DEMO_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,8 @@ Surfaces and extractors:
   the C alignment rules, so a padding hole is drift too), and the C-API
   prototypes + documented rc codes from ``c_api.h``.
 - (b) ``serve/wire.py``: ``struct.Struct`` format strings (sized via
-  ``struct.calcsize`` semantics), ``FLAG_*`` constants, ``MSG`` numbers.
+  ``struct.calcsize`` semantics), ``FLAG_*`` constants, ``MSG`` numbers,
+  and the ``OPS_KINDS`` report-kind catalogue.
 - (c) the ctypes binding: bound symbol names, ``argtypes`` arity and
   ``restype`` kind — statically evaluated from the AST, including the
   ``for name in (...)`` loops and ``[...] * n`` list forms, plus the
@@ -45,6 +46,9 @@ Pairwise checks (each finding names the file and the surface pair):
   the same arity and return type (the cdef is a deliberate subset).
 - c_api.h ↔ binding rc map: every rc the binding special-cases is a
   documented code in the header's rc comment.
+- wire.py ↔ ops.cc: ``OPS_KINDS`` and the ``kind == "..."`` dispatch
+  strings in the native ops plane must agree exactly — a report kind
+  cannot exist on only one side of the wire.
 - configure.cc ↔ config.py: a flag defined in BOTH planes must carry
   the same default (dynamic defaults are exempt from the comparison).
 - docs ↔ both flag planes: a flag-table row must name a live flag, and
@@ -77,6 +81,7 @@ DEFAULT_PATHS = {
     "lua": "multiverso_tpu/binding/lua/multiverso.lua",
     "configure_cc": "multiverso_tpu/native/src/configure.cc",
     "config_py": "multiverso_tpu/config.py",
+    "ops_cc": "multiverso_tpu/native/src/ops.cc",
     "docs": "docs",
 }
 
@@ -320,7 +325,8 @@ def extract_wire(path: str) -> dict:
     from serve/wire.py — pure AST, the module is never imported."""
     with open(path, "r", encoding="utf-8") as fh:
         tree = ast.parse(fh.read(), filename=path)
-    out = {"path": path, "structs": {}, "flags": {}, "msg": {}}
+    out = {"path": path, "structs": {}, "flags": {}, "msg": {},
+           "ops_kinds": {}}
     for node in tree.body:
         if not (isinstance(node, ast.Assign) and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)):
@@ -341,6 +347,22 @@ def extract_wire(path: str) -> dict:
                 if isinstance(k, ast.Constant) \
                         and isinstance(val, ast.Constant):
                     out["msg"][k.value] = (val.value, k.lineno)
+        elif name == "OPS_KINDS" and isinstance(v, (ast.Tuple, ast.List)):
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                              str):
+                    out["ops_kinds"][e.value] = e.lineno
+    return out
+
+
+def extract_ops_kinds_cc(path: str) -> dict:
+    """The ``kind == "..."`` dispatch strings in the native ops plane
+    (``ops.cc`` LocalReport) — the C++ half of the OPS_KINDS contract."""
+    with open(path, "r", encoding="utf-8") as fh:
+        src = _strip_c_comments(fh.read())
+    out = {"path": path, "kinds": {}}
+    for m in re.finditer(r'kind\s*==\s*"([a-z_]+)"', src):
+        out["kinds"].setdefault(m.group(1), _line_of(src, m.start()))
     return out
 
 
@@ -591,6 +613,7 @@ def build_contract(root: str = None, **overrides) -> dict:
         "lua": extract_lua_cdef(paths["lua"]),
         "native_flags": extract_native_flags(paths["configure_cc"]),
         "config_flags": extract_config_flags(paths["config_py"]),
+        "ops_kinds_cc": extract_ops_kinds_cc(paths["ops_cc"]),
         "docs_flags": extract_docs_flags(docs),
         "paths": paths,
     }
@@ -776,9 +799,34 @@ def _diff_flags(c) -> list:
     return out
 
 
+def _diff_ops_kinds(c) -> list:
+    """wire.py OPS_KINDS ↔ ops.cc dispatch strings: a report kind must
+    exist on both sides of the wire or scrapes drift silently (a
+    Python-only kind scrapes an unknown-kind error; a C++-only kind is
+    invisible to mvtop/mvdoctor and the meta-tests)."""
+    out = []
+    wire, cc = c["wire"], c["ops_kinds_cc"]
+    pair = "serve/wire.py<->ops.cc"
+    for kind, line in sorted(wire.get("ops_kinds", {}).items()):
+        if kind not in cc["kinds"]:
+            out.append(Finding(
+                wire["path"], line, pair,
+                f"OPS_KINDS names {kind!r} but {cc['path']} has no "
+                f'kind == "{kind}" dispatch — the native ops plane '
+                f"would answer it with an unknown-kind error"))
+    for kind, line in sorted(cc["kinds"].items()):
+        if kind not in wire.get("ops_kinds", {}):
+            out.append(Finding(
+                cc["path"], line, pair,
+                f'ops.cc dispatches kind == "{kind}" but '
+                f"{wire['path']} OPS_KINDS does not list it — "
+                f"invisible to the tooling/meta-test surface"))
+    return out
+
+
 def diff_contract(c) -> list:
     return _diff_wire(c) + _diff_binding(c) + _diff_lua(c) + \
-        _diff_flags(c)
+        _diff_flags(c) + _diff_ops_kinds(c)
 
 
 # ------------------------------------------------------------------- CLI

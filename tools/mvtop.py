@@ -49,6 +49,19 @@ which shard the rank backs, its promoted shards, and the
 forward/ack/catch-up ledger — the epoch flip after a failover reads
 directly off the ``epoch``/``owners``/``promoted`` columns.
 
+``--alerts`` switches to the health-plane view (the ``"alerts"``
+OpsQuery kind, docs/observability.md "health plane"): one row per
+(rank, rule) with the declarative SLO rule's ok / pending / firing
+state, severity, observed value and firing age, plus synthetic
+``watchdog:<loop>`` rows for native loops the stall watchdog has
+flagged.  A SILENT rank renders an explicit ``unknown`` row — never
+``resolved``.  The default view's ``--watch`` refresh also derives a
+per-rank firing-alert count column from the same scrape.
+
+Under ``--watch`` a refresh whose scrape fails does NOT kill the loop:
+the last good table is re-printed dimmed with every row marked
+``stale``, and the next interval retries.
+
 Usage::
 
     python tools/mvtop.py HOST:PORT [HOST:PORT ...]       # one snapshot
@@ -57,6 +70,7 @@ Usage::
     python tools/mvtop.py HOST:PORT --hotkeys [--fleet]   # workload view
     python tools/mvtop.py HOST:PORT --audit [--fleet]     # delivery audit
     python tools/mvtop.py HOST:PORT --replication [--fleet]  # repl view
+    python tools/mvtop.py HOST:PORT --alerts [--fleet]    # health plane
     python tools/mvtop.py HOST:PORT --metrics [--fleet]   # raw Prometheus
 
 ``--fleet`` asks the FIRST endpoint to aggregate the whole fleet
@@ -74,6 +88,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from multiverso_tpu import health  # noqa: E402
 from multiverso_tpu.ops.audit import audit_rows  # noqa: E402
 from multiverso_tpu.ops.introspect import OpsClient  # noqa: E402
 
@@ -102,7 +117,27 @@ _CAP_COLS = ("rank", "table", "res_bytes", "rows", "repl_rows",
              "agg_B", "arena_B", "arena_def", "wq_B", "rss_MB")
 _CAP_RATE_COLS = ("b/s", "rss/s")
 
+_ALERT_COLS = ("rank", "rule", "severity", "state", "value", "age_s")
+
+# Every ops-plane report kind (serve.wire.OPS_KINDS) -> the mvtop view
+# that renders it.  tests assert this map covers OPS_KINDS exactly, so
+# a new kind cannot land without an operator-facing view (and a
+# docs/observability.md section).
+KIND_VIEWS = {
+    "metrics": "--metrics",
+    "health": "(default)",
+    "tables": "(default)",
+    "hotkeys": "--hotkeys",
+    "latency": "--qos",
+    "audit": "--audit",
+    "replication": "--replication",
+    "capacity": "--capacity",
+    "alerts": "--alerts",
+}
+
 _SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+_DIM = "\x1b[2m"
+_RESET = "\x1b[0m"
 
 
 def sparkline(values, width: int = 8) -> str:
@@ -518,6 +553,78 @@ def collect_replication(endpoints: list, fleet: bool,
     return repl_rows(doc)
 
 
+def alert_view_rows(doc: dict) -> list:
+    """Format ``health.fleet_alert_rows`` for the table: firing rows
+    first (criticals first within a state), ``unknown`` rows next —
+    a silent rank reads as "no idea", never "all clear".  Pure, so the
+    canned-scrape tests drive it without a fleet."""
+    sev_rank = {"critical": 0, "warning": 1, "info": 2}
+    state_rank = {"firing": 0, "unknown": 1, "pending": 2, "ok": 3}
+    rows = []
+    for r in health.fleet_alert_rows(doc):
+        rows.append({
+            "rank": r["rank"],
+            "rule": r["rule"],
+            "severity": r["severity"],
+            "state": r["state"],
+            "value": "-" if r["value"] is None else f"{r['value']:.4g}",
+            "age_s": "-" if r["age_s"] is None else f"{r['age_s']:.0f}",
+        })
+    rows.sort(key=lambda r: (state_rank.get(r["state"], 9),
+                             sev_rank.get(r["severity"], 9),
+                             str(r["rank"]), r["rule"]))
+    return rows
+
+
+def firing_counts(doc: dict) -> dict:
+    """``{rank: firing-alert count}`` from an ``"alerts"`` report —
+    the default watch view's ``alerts`` column.  A silent rank counts
+    as ``"?"`` (unknown), never 0."""
+    counts = {}
+    for r in health.fleet_alert_rows(doc):
+        rank = str(r["rank"])
+        if r["state"] == "unknown":
+            counts.setdefault(rank, "?")
+        else:
+            base = counts.get(rank, 0)
+            base = 0 if not isinstance(base, int) else base
+            counts[rank] = base + (1 if r["state"] == "firing" else 0)
+    return counts
+
+
+def fetch_alerts(endpoints: list, fleet: bool, timeout: float) -> dict:
+    """Raw ``"alerts"`` report in the fleet-wrapper shape (per-endpoint
+    polling synthesises the same ``{"ranks":, "silent":}`` envelope)."""
+    if fleet:
+        with OpsClient(endpoints[0], timeout=timeout) as c:
+            return c.alerts(fleet=True)
+    doc = {"ranks": {}, "silent": []}
+    for ep in endpoints:
+        try:
+            with OpsClient(ep, timeout=timeout) as c:
+                local = c.alerts()
+            doc["ranks"][str(local.get("rank", ep))] = local
+        except (ConnectionError, OSError, TimeoutError):
+            doc["silent"].append(ep)
+    return doc
+
+
+def collect_alerts(endpoints: list, fleet: bool, timeout: float) -> list:
+    return alert_view_rows(fetch_alerts(endpoints, fleet, timeout))
+
+
+def render_stale(table: str, err: Exception) -> str:
+    """The watch loop's answer to a mid-refresh scrape failure: the
+    last good table re-printed dimmed, every row marked ``stale`` —
+    the loop survives, and stale data cannot masquerade as fresh."""
+    stamp = time.strftime("%H:%M:%S")
+    lines = [f"mvtop @ {stamp} — scrape failed ({err}); "
+             f"showing last good scrape"]
+    for line in table.splitlines():
+        lines.append(f"{_DIM}{line}  stale{_RESET}")
+    return "\n".join(lines)
+
+
 def render(rows: list, cols=_COLS) -> str:
     rows = [{c: r.get(c, "-") for c in cols} for r in rows]
     widths = {c: max(len(c), *(len(str(r[c])) for r in rows))
@@ -561,6 +668,12 @@ def main(argv=None) -> int:
                          "owner/backup maps, promoted shards, and the "
                          "forward/ack ledger per rank "
                          "(docs/replication.md)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="health-plane view: per-(rank, rule) SLO "
+                         "alert state (ok/pending/firing) with value "
+                         "and age, plus native watchdog stall rows — "
+                         "the \"alerts\" OpsQuery kind "
+                         "(docs/observability.md \"health plane\")")
     ap.add_argument("--watch", type=float, default=0.0, metavar="SEC",
                     help="refresh every SEC seconds until interrupted "
                          "(adds two-scrape rate columns + sparklines)")
@@ -568,63 +681,88 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     tracker = RateTracker()
+    last = None  # last good refresh's output (watch-mode stale fallback)
     while True:
-        if args.metrics:
-            with OpsClient(args.endpoints[0], timeout=args.timeout) as c:
-                print(c.metrics_text(fleet=args.fleet))
-        elif args.audit:
-            t = tracker if args.watch > 0 else None
-            rows = collect_audit(args.endpoints, args.fleet,
-                                 args.timeout, tracker=t)
-            cols = _AUDIT_COLS + (_AUDIT_RATE_COLS if t else ())
-            stamp = time.strftime("%H:%M:%S")
-            print(f"mvtop --audit @ {stamp} — {len(rows)} stream(s)")
-            print(render(rows, cols))
-        elif args.qos:
-            t = tracker if args.watch > 0 else None
-            rows = collect_qos(args.endpoints, args.fleet, args.timeout,
-                               tracker=t)
-            cols = _QOS_COLS + (_QOS_RATE_COLS if t else ())
-            stamp = time.strftime("%H:%M:%S")
-            print(f"mvtop --qos @ {stamp} — {len(rows)} class row(s)")
-            print(render(rows, cols))
-        elif args.capacity:
-            t = tracker if args.watch > 0 else None
-            rows = collect_capacity(args.endpoints, args.fleet,
-                                    args.timeout, tracker=t)
-            cols = _CAP_COLS + (_CAP_RATE_COLS if t else ())
-            stamp = time.strftime("%H:%M:%S")
-            print(f"mvtop --capacity @ {stamp} — {len(rows)} "
-                  f"table row(s)")
-            print(render(rows, cols))
-        elif args.replication:
-            rows = collect_replication(args.endpoints, args.fleet,
-                                       args.timeout)
-            stamp = time.strftime("%H:%M:%S")
-            print(f"mvtop --replication @ {stamp} — {len(rows)} rank(s)")
-            print(render(rows, _REPL_COLS))
-        elif args.hotkeys:
-            rows = hotkey_rows(args.endpoints, args.fleet, args.timeout)
-            stamp = time.strftime("%H:%M:%S")
-            print(f"mvtop --hotkeys @ {stamp} — {len(rows)} table row(s)")
-            print(render(rows, _HOTKEY_COLS))
+        try:
+            out = _refresh(args, tracker)
+        except (ConnectionError, OSError, TimeoutError) as e:
+            # A mid-watch scrape failure must not kill the loop: show
+            # the last good table dimmed + marked stale and retry on
+            # the next interval.  Single-shot mode still fails loudly.
+            if args.watch <= 0 or last is None:
+                raise
+            print(render_stale(last, e))
         else:
-            rows = collect(args.endpoints, args.fleet, args.timeout)
-            cols = _COLS
-            if args.watch > 0:
-                cols = _COLS + _RATE_COLS
-                for row in rows:
-                    row.update(tracker.update(
-                        str(row["rank"]), row.get("_counters", {})))
-            stamp = time.strftime("%H:%M:%S")
-            print(f"mvtop @ {stamp} — {len(rows)} rank(s)")
-            print(render(rows, cols))
+            last = out
+            print(out)
         if args.watch <= 0:
             return 0
         try:
             time.sleep(args.watch)
         except KeyboardInterrupt:
             return 0
+
+
+def _refresh(args, tracker: RateTracker) -> str:
+    """One scrape + render pass — everything main()'s loop prints.
+    Raises the usual socket errors instead of printing so the watch
+    loop can fall back to the stale rendering."""
+    stamp = time.strftime("%H:%M:%S")
+    if args.metrics:
+        with OpsClient(args.endpoints[0], timeout=args.timeout) as c:
+            return c.metrics_text(fleet=args.fleet)
+    if args.audit:
+        t = tracker if args.watch > 0 else None
+        rows = collect_audit(args.endpoints, args.fleet,
+                             args.timeout, tracker=t)
+        cols = _AUDIT_COLS + (_AUDIT_RATE_COLS if t else ())
+        return (f"mvtop --audit @ {stamp} — {len(rows)} stream(s)\n"
+                + render(rows, cols))
+    if args.qos:
+        t = tracker if args.watch > 0 else None
+        rows = collect_qos(args.endpoints, args.fleet, args.timeout,
+                           tracker=t)
+        cols = _QOS_COLS + (_QOS_RATE_COLS if t else ())
+        return (f"mvtop --qos @ {stamp} — {len(rows)} class row(s)\n"
+                + render(rows, cols))
+    if args.capacity:
+        t = tracker if args.watch > 0 else None
+        rows = collect_capacity(args.endpoints, args.fleet,
+                                args.timeout, tracker=t)
+        cols = _CAP_COLS + (_CAP_RATE_COLS if t else ())
+        return (f"mvtop --capacity @ {stamp} — {len(rows)} "
+                f"table row(s)\n" + render(rows, cols))
+    if args.replication:
+        rows = collect_replication(args.endpoints, args.fleet,
+                                   args.timeout)
+        return (f"mvtop --replication @ {stamp} — {len(rows)} rank(s)\n"
+                + render(rows, _REPL_COLS))
+    if args.hotkeys:
+        rows = hotkey_rows(args.endpoints, args.fleet, args.timeout)
+        return (f"mvtop --hotkeys @ {stamp} — {len(rows)} table row(s)\n"
+                + render(rows, _HOTKEY_COLS))
+    if args.alerts:
+        rows = collect_alerts(args.endpoints, args.fleet, args.timeout)
+        firing = sum(1 for r in rows if r["state"] == "firing")
+        return (f"mvtop --alerts @ {stamp} — {len(rows)} alert(s), "
+                f"{firing} firing\n" + render(rows, _ALERT_COLS))
+    rows = collect(args.endpoints, args.fleet, args.timeout)
+    cols = _COLS
+    if args.watch > 0:
+        # Watch mode folds in the health plane: a per-rank firing-alert
+        # count ('?' for silent ranks) + the two-scrape rate columns.
+        cols = _COLS + ("alerts",) + _RATE_COLS
+        try:
+            counts = firing_counts(fetch_alerts(
+                args.endpoints, args.fleet, args.timeout))
+        except (ConnectionError, OSError, TimeoutError):
+            counts = {}
+        for row in rows:
+            row["alerts"] = counts.get(str(row["rank"]), "-")
+            row.update(tracker.update(
+                str(row["rank"]), row.get("_counters", {})))
+    return (f"mvtop @ {stamp} — {len(rows)} rank(s)\n"
+            + render(rows, cols))
 
 
 if __name__ == "__main__":
