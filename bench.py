@@ -288,6 +288,21 @@ def _run_native_workers(script_name: str, procs: int, marker: str,
                for out in outs)
 
 
+def _uring_supported() -> bool:
+    """Capability probe for the io_uring engine (docs/transport.md):
+    MV_UringSupported walks IORING_REGISTER_PROBE for every opcode the
+    reactor needs.  Bench arms gate on it so hosts with old or
+    seccomp-restricted kernels skip the ``*_uring_*`` keys instead of
+    failing the run (the bench gate skips absent keys)."""
+    try:
+        from multiverso_tpu import native as nat
+
+        nat.ensure_built()
+        return bool(nat.load().MV_UringSupported())
+    except Exception:
+        return False
+
+
 def _run_test_ranks(scenario: str, procs: int, extra=()):
     """Spawn ``procs`` ranks of the native test binary on a fresh
     loopback machine file and return their stdouts.  One home for the
@@ -374,6 +389,21 @@ def bench_wire_micro():
         parse(outs[0], "wire_epoll", res)
     except Exception:
         traceback.print_exc()
+
+    # io_uring engine sweep: the registered-buffer zero-copy reactor
+    # next to epoll's numbers — wire_uring_{put,get}_gbps_* +
+    # wire_uring_rtt_ms, plus the headline wire_uring_bytes_per_s at
+    # the 64 KiB frame point (the acceptance bar: >= 1.5x epoll's same
+    # point).  Probe-gated: hosts without uring skip these keys.
+    if _uring_supported():
+        try:
+            outs = _run_test_ranks("wire_bench", 2, ("uring",))
+            parse(outs[0], "wire_uring", res)
+            if "wire_uring_put_gbps_64k" in res:
+                res["wire_uring_bytes_per_s"] = \
+                    res["wire_uring_put_gbps_64k"] * 1e9
+        except Exception:
+            traceback.print_exc()
 
     # --- payload-codec sweep (docs/wire_compression.md) ----------------
     # The same dense-add workload raw vs 1bit through the FULL runtime
@@ -574,6 +604,22 @@ def bench_serve_fanin():
                 res[f"fanin_{m.group(1)}"] = float(m.group(2))
                 if m.group(1).endswith("_ms"):
                     _observe_iter(float(m.group(2)) * 1e-3)
+
+    # io_uring serve tier: the same 1000-socket herd against the uring
+    # reactor's multishot accept + registered-buffer receive path —
+    # ``fanin_uring_p99_ms`` is the gate key (probe-gated like the wire
+    # sweep; absent on hosts without uring support).
+    if _uring_supported():
+        try:
+            uouts = _spawn_native_workers(
+                "fanin_bench_worker.py", 2, "FANIN_BENCH_OK",
+                (1000, 8, 0, "", "uring"))
+            for out in uouts:
+                for m in re.finditer(r"(\w+)=([0-9.]+)", out):
+                    if m.group(1) != "rank":
+                        res[f"fanin_uring_{m.group(1)}"] = float(m.group(2))
+        except Exception:
+            traceback.print_exc()
     return res
 
 

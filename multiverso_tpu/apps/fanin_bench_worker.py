@@ -2,9 +2,11 @@
 ``make fanin-demo`` drives it too).
 
 Run as ``python fanin_bench_worker.py <machine_file> <rank> [nclients]
-[inflight_max] [chaos] [mode]``: two of these form a native epoll-engine
-fleet; rank 1 then drives ``nclients`` ANONYMOUS raw sockets (the serve
-wire protocol, ``serve/wire.py``) against rank 0's reactor:
+[inflight_max] [chaos] [mode] [engine]``: two of these form a native
+reactor fleet (``engine`` defaults to epoll; ``uring`` runs the same
+protocol through the io_uring engine); rank 1 then drives ``nclients``
+ANONYMOUS raw sockets (the serve wire protocol, ``serve/wire.py``)
+against rank 0's reactor:
 
 - **latency phase** — every client sends one header-only version probe,
   paced 8-outstanding so the p50/p99 measure the service path, not the
@@ -945,8 +947,10 @@ def main() -> int:
     inflight_max = int(sys.argv[4]) if len(sys.argv) > 4 else 8
     chaos = int(sys.argv[5]) if len(sys.argv) > 5 else 0
     mode = sys.argv[6] if len(sys.argv) > 6 else ""
+    engine = sys.argv[7] if len(sys.argv) > 7 else "epoll"
     args = [
         f"-machine_file={mf}", f"-rank={rank}", "-log_level=error",
+        f"-net_engine={engine}",
         "-rpc_timeout_ms=60000", "-barrier_timeout_ms=120000",
         f"-server_inflight_max={inflight_max}",
         "-net_arena_bytes=8192", "-send_retries=3", "-send_backoff_ms=20"]
@@ -956,7 +960,7 @@ def main() -> int:
         # rest, spare capacity borrowed in weight proportion.
         args += ["-qos_classes=bulk:1,gold:8", "-qos_inflight_max=32"]
     rt = nat.NativeRuntime(args=args)
-    assert rt.net_engine() == "epoll", rt.net_engine()
+    assert rt.net_engine() == engine, rt.net_engine()
     h = rt.new_array_table(SIZE)
     hk = rt.new_kv_table()
     hm = rt.new_matrix_table(MROWS, MCOLS)

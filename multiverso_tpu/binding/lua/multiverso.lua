@@ -123,6 +123,7 @@ int MV_FlushAdds(int32_t handle);
 int MV_WireStats(long long* sent_bytes, long long* recv_bytes,
                  long long* sent_msgs, long long* recv_msgs);
 char* MV_NetEngine(void);
+int MV_UringSupported(void);
 void MV_FreeString(char* s);
 int MV_FanInStats(long long* accepted_total, long long* active_clients,
                   long long* client_shed);
@@ -429,13 +430,21 @@ function mv.wire_stats()
   return tonumber(sb[0]), tonumber(rb[0]), tonumber(sm[0]), tonumber(rm[0])
 end
 
---- Active wire engine (docs/transport.md): "tcp" | "epoll" | "mpi",
---- or "local" for a single process with no transport.
+--- Active (effective) wire engine (docs/transport.md): "tcp" |
+--- "epoll" | "mpi" | "uring", or "local" for a single process with no
+--- transport.  A -net_engine=uring request on a kernel without
+--- io_uring degrades to epoll and reports "epoll" here.
 function mv.net_engine()
   local p = C.MV_NetEngine()
   local name = ffi.string(p)
   C.MV_FreeString(p)
   return name
+end
+
+--- True when this kernel can run the io_uring engine.  Probes the
+--- kernel, not the session — callable before mv.init.
+function mv.uring_supported()
+  return C.MV_UringSupported() ~= 0
 end
 
 --- Anonymous serve-tier fan-in counters (epoll engine only): returns

@@ -279,6 +279,8 @@ def load(build: bool = True) -> ctypes.CDLL:
     lib.MV_ReplicationStats.restype = ctypes.c_int
     lib.MV_NetEngine.argtypes = []
     lib.MV_NetEngine.restype = ctypes.c_void_p
+    lib.MV_UringSupported.argtypes = []
+    lib.MV_UringSupported.restype = ctypes.c_int
     lib.MV_FanInStats.argtypes = [ctypes.POINTER(ctypes.c_longlong)] * 3
     lib.MV_FanInStats.restype = ctypes.c_int
     lib.MV_SetTableCodec.argtypes = [ctypes.c_int32, ctypes.c_char_p]
@@ -1096,9 +1098,18 @@ class NativeRuntime:
 
     # ------------------------------------------------- transport
     def net_engine(self) -> str:
-        """Active wire engine (docs/transport.md): ``tcp`` | ``epoll``
-        | ``mpi``, or ``local`` for a single process with no wire."""
+        """Active (effective) wire engine (docs/transport.md): ``tcp``
+        | ``epoll`` | ``mpi`` | ``uring``, or ``local`` for a single
+        process with no wire.  A ``-net_engine=uring`` request on a
+        kernel without io_uring degrades to epoll and reports
+        ``epoll`` here (the health report records the downgrade)."""
         return self._dump_string(self.lib.MV_NetEngine, "MV_NetEngine")
+
+    def uring_supported(self) -> bool:
+        """True when this kernel can run the io_uring engine.  Probes
+        the kernel, not the session — callable before ``init`` (the
+        uring test suites gate on it)."""
+        return bool(self.lib.MV_UringSupported())
 
     def fanin_stats(self) -> dict:
         """Anonymous serve-tier fan-in counters (epoll engine only):

@@ -425,10 +425,17 @@ int MV_ReplicationStats(long long* forwards, long long* acks,
                         long long* dup_skips, long long* catchups);
 
 // ---- transport (docs/transport.md) -----------------------------------
-// Active wire engine name: "tcp" | "epoll" | "mpi", or "local" for a
-// single process with no transport.  malloc'd; caller frees with
-// MV_FreeString.
+// Active (EFFECTIVE) wire engine name: "tcp" | "epoll" | "mpi" |
+// "uring", or "local" for a single process with no transport.  When
+// `-net_engine=uring` was requested on a kernel that cannot run it,
+// Start degrades to epoll and this reports "epoll".  malloc'd; caller
+// frees with MV_FreeString.
 char* MV_NetEngine(void);
+// 1 when THIS kernel can run the io_uring engine (io_uring_setup plus
+// every opcode the data plane needs), 0 otherwise.  Callable before
+// MV_Init — it probes the kernel, not the session (the uring test
+// suites gate on it).
+int MV_UringSupported(void);
 // Anonymous serve-tier fan-in counters: connections accepted without a
 // rank identity (external serve clients), how many are currently
 // connected, and how many of their requests the per-client admission

@@ -69,6 +69,12 @@ class EpollNet : public RankTransport {
   long long QueuedBytes() const override {
     return wq_bytes_total_.load(std::memory_order_relaxed);
   }
+  // Receive-arena footprint: sum of every connection's live slab —
+  // the `net.rx_arena_bytes` gauge (transport memory that was invisible
+  // to mvtop --capacity / mvplan before it).
+  long long RxArenaBytes() const override {
+    return rx_arena_total_.load(std::memory_order_relaxed);
+  }
 
  private:
   struct PendingFrame;
@@ -123,6 +129,9 @@ class EpollNet : public RankTransport {
   // Engine-wide write-queue depth in bytes (sum of per-conn wq_bytes,
   // maintained beside every wq mutation — QueuedBytes()).
   std::atomic<long long> wq_bytes_total_{0};
+  // Engine-wide receive-arena bytes (sum of per-conn slab sizes,
+  // maintained beside every slab allocation/close — RxArenaBytes()).
+  std::atomic<long long> rx_arena_total_{0};
 
   std::vector<std::unique_ptr<Shard>> shards_;
 

@@ -327,6 +327,19 @@ struct Message {
   // lengths overrunning `len`); the caller drops the connection.
   static bool DeserializeView(std::shared_ptr<std::vector<char>> slab,
                               size_t off, size_t len, Message* out);
+  // Zero-copy deserialize over BORROWED memory (the io_uring registered-
+  // buffer receive path, docs/transport.md): same parse and same
+  // malformed-frame contract as DeserializeView, but the frame lives in
+  // raw caller-owned bytes (a HostArena slab registered with the
+  // kernel), so aligned blobs become Blob::Borrow windows sharing
+  // `keepalive` — the slab recycles only once every borrow (and the
+  // caller's own hold) is gone, the PR 9 two-hold discipline.  `align`
+  // is the frame's byte offset inside its slab, used only for the
+  // 8-alignment view-vs-copy split (the slab base itself must be
+  // 8-aligned, as HostArena buffers are).
+  static bool DeserializeBorrow(const char* frame, size_t align, size_t len,
+                                const std::shared_ptr<void>& keepalive,
+                                Message* out);
 };
 
 using MessagePtr = std::unique_ptr<Message>;

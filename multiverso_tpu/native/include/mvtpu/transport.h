@@ -16,6 +16,13 @@
 //            clients.  The default for TCP fleets.
 //   mpi    — MpiNet (mpi_net.h): the literal MPI wire; rank/size come
 //            from MPI itself, so it keeps its own Init shape.
+//   uring  — UringNet (uring_net.h): the io_uring proactor — completion-
+//            driven I/O, receive buffers registered with the kernel over
+//            HostArena slabs, multishot accept for the anonymous tier,
+//            zero-copy send completions.  Same message semantics as
+//            epoll; zoo.cc degrades to epoll (with a logged reason and
+//            an `effective_engine` health field) when the kernel lacks
+//            io_uring.
 #pragma once
 
 #include <cstdint>
@@ -64,6 +71,13 @@ class Net {
   // engine queues frames (blocking engines hold none); the capacity
   // report's `net.writeq_bytes` gauge reads this.
   virtual long long QueuedBytes() const { return 0; }
+
+  // Capacity plane (docs/observability.md): bytes currently held in
+  // receive-side arenas — per-connection reassembly slabs on the epoll
+  // engine, the registered buffer pool + heap fallback slabs on the
+  // uring engine.  The `net.rx_arena_bytes` gauge reads this; blocking
+  // engines buffer on the stack and report zero.
+  virtual long long RxArenaBytes() const { return 0; }
 };
 
 namespace transport {
@@ -89,7 +103,9 @@ class RankTransport : public Net {
                     InboundFn fn, int64_t connect_retry_ms = 15000) = 0;
 };
 
-// `-net_engine` factory ("tcp" | "epoll"); nullptr on an unknown name.
+// `-net_engine` factory ("tcp" | "epoll" | "uring"); nullptr on an
+// unknown name.  "uring" requires uring::Probe() (uring_net.h) — the
+// zoo checks it first and degrades to epoll with a logged reason.
 std::unique_ptr<RankTransport> MakeRankTransport(const std::string& engine);
 
 }  // namespace mvtpu

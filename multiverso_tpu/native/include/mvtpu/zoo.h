@@ -46,8 +46,13 @@ class Zoo {
 
   int rank() const { return rank_; }
   int size() const { return size_; }
-  // Active wire engine name ("tcp" | "epoll" | "mpi"), or "local" when
-  // this is a single process with no transport (docs/transport.md).
+  // Active wire engine name ("tcp" | "epoll" | "mpi" | "uring"), or
+  // "local" when this is a single process with no transport
+  // (docs/transport.md).  This is the EFFECTIVE engine: when
+  // `-net_engine=uring` was requested but the kernel cannot run it,
+  // Start degrades to epoll and this reports "epoll" (the health
+  // report's `engine_requested`/`engine_fallback` fields record the
+  // downgrade).
   const char* net_engine() const;
   // Anonymous serve-tier fan-in counters — nonzero only on the epoll
   // engine, the one that accepts non-rank client connections.
@@ -300,6 +305,11 @@ class Zoo {
   std::vector<int> worker_ranks_{0};   // ranks holding the worker role
   std::vector<int> server_ranks_{0};   // ranks holding the server role
   std::unique_ptr<Net> net_;  // TcpNet or MpiNet, per -net_type
+  // Engine-degradation record (health plane): what `-net_engine` asked
+  // for and whether Start had to fall back (uring probe failure →
+  // epoll).  Set once in Start, read by OpsHealthJson.
+  std::string engine_requested_;
+  bool engine_fallback_ = false;
 
   std::unique_ptr<Actor> worker_actor_ GUARDED_BY(mu_);
   std::unique_ptr<Actor> server_actor_ GUARDED_BY(mu_);

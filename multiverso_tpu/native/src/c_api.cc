@@ -18,6 +18,7 @@
 #include "mvtpu/ops.h"
 #include "mvtpu/sketch.h"
 #include "mvtpu/stream.h"
+#include "mvtpu/uring_net.h"
 #include "mvtpu/watchdog.h"
 #include "mvtpu/zoo.h"
 
@@ -636,6 +637,10 @@ int MV_ReplicationStats(long long* forwards, long long* acks,
 
 char* MV_NetEngine(void) {
   return MallocString(Zoo::Get()->net_engine());
+}
+
+int MV_UringSupported(void) {
+  return mvtpu::uring::Probe(nullptr) ? 1 : 0;
 }
 
 int MV_FanInStats(long long* accepted_total, long long* active_clients,

@@ -153,12 +153,17 @@ void RegisterDefaults() {
                  ">1 node; isolated singleton otherwise), no machine "
                  "file needed");
     DefineString("net_engine", "epoll",
-                 "tcp|epoll|mpi — readiness model of the wire transport "
-                 "(docs/transport.md).  epoll (default): one event-loop "
-                 "reactor (plus -net_threads shards) drives nonblocking "
-                 "sockets and accepts ANONYMOUS serve clients; tcp: the "
-                 "blocking thread-per-connection engine; mpi: the "
-                 "literal MPI wire (same as -net_type=mpi)");
+                 "tcp|epoll|mpi|uring — readiness model of the wire "
+                 "transport (docs/transport.md).  epoll (default): one "
+                 "event-loop reactor (plus -net_threads shards) drives "
+                 "nonblocking sockets and accepts ANONYMOUS serve "
+                 "clients; tcp: the blocking thread-per-connection "
+                 "engine; mpi: the literal MPI wire (same as "
+                 "-net_type=mpi); uring: the io_uring completion "
+                 "engine — registered-buffer zero-copy receive, "
+                 "zero-copy sends, multishot accept; degrades to epoll "
+                 "(logged, health `effective_engine`) when the kernel "
+                 "lacks io_uring");
     DefineInt("net_threads", 1,
               "epoll engine: number of reactor shards (event-loop "
               "threads); connections round-robin across them.  1 "
@@ -173,6 +178,28 @@ void RegisterDefaults() {
               "reader fills it; senders then wait for drain up to "
               "-io_timeout_ms (the readiness-model twin of SO_SNDTIMEO) "
               "instead of ballooning memory.  <=0 unbounded");
+    DefineInt("uring_depth", 256,
+              "uring engine: submission-queue entries per reactor shard "
+              "(clamped 8..4096; CQ sized 4x).  The depth bounds "
+              "in-flight SQEs, not connections — a full SQ flushes and "
+              "retries");
+    DefineBool("uring_sqpoll", false,
+               "uring engine: IORING_SETUP_SQPOLL — a kernel thread "
+               "polls the submission queue, removing the submit syscall "
+               "from the send path at the cost of a busy kernel thread "
+               "per shard (needs CAP_SYS_NICE on older kernels; setup "
+               "failure falls back to plain submission)");
+    DefineInt("uring_reg_bufs", 16,
+              "uring engine: fixed receive buffers registered with the "
+              "kernel per shard (each -net_arena_bytes big, carved from "
+              "the host arena).  Frames landing in one decode zero-copy "
+              "end to end; 0 disables registration (heap fallback "
+              "only).  Clamped 0..1024");
+    DefineInt("uring_zc_bytes", 65536,
+              "uring engine: frames with at least this many bytes left "
+              "to send go out IORING_OP_SENDMSG_ZC (pages pinned until "
+              "the kernel's notif completion) instead of a copying "
+              "send.  <0 disables zero-copy sends");
     DefineInt("client_inflight_max", 64,
               "epoll engine: per-anonymous-client admission on top of "
               "-server_inflight_max — a client with this many "

@@ -28,6 +28,7 @@
 #include "mvtpu/net.h"
 #include "mvtpu/ops.h"
 #include "mvtpu/qos.h"
+#include "mvtpu/uring_net.h"
 #include "mvtpu/watchdog.h"
 
 namespace mvtpu {
@@ -178,6 +179,9 @@ struct EpollNet::Conn {
   std::shared_ptr<std::vector<char>> slab;
   size_t slab_off = 0;
   size_t slab_used = 0;
+  // Bytes of `slab` currently counted in rx_arena_total_ (reactor-thread
+  // only, like the slab itself) — the net.rx_arena_bytes gauge.
+  size_t slab_tracked = 0;
 
   // Per-client admission (reactor increments on forwarded requests;
   // Send decrements when the reply goes out).
@@ -484,6 +488,18 @@ void EpollNet::HandleReadable(Shard* s, const std::shared_ptr<Conn>& c) {
       c->body_len = len;
       c->body_got = 0;
       c->len_got = 0;
+      // Capacity plane: keep the rx-arena gauge in step with whatever
+      // the placement above allocated/resized (a replaced slab's old
+      // bytes leave the gauge with its last view, not here — the gauge
+      // tracks what the ENGINE holds).
+      size_t sz = c->slab->size();
+      if (sz != c->slab_tracked) {
+        rx_arena_total_.fetch_add(
+            static_cast<long long>(sz) -
+                static_cast<long long>(c->slab_tracked),
+            std::memory_order_relaxed);
+        c->slab_tracked = sz;
+      }
     }
     // Frame body straight into the arena slab.
     size_t want = static_cast<size_t>(c->body_len) - c->body_got;
@@ -706,6 +722,9 @@ void EpollNet::CloseConn(Shard* s, const std::shared_ptr<Conn>& c,
   Log::Debug("EpollNet: closing connection (peer %d): %s", peer, why);
   ::epoll_ctl(s->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
   s->conns.erase(c->fd);
+  rx_arena_total_.fetch_add(-static_cast<long long>(c->slab_tracked),
+                            std::memory_order_relaxed);
+  c->slab_tracked = 0;
   {
     MutexLock lk(c->mu);
     c->closed = true;
@@ -1056,6 +1075,7 @@ void EpollNet::Stop() {
     rank_conns_.clear();
   }
   wq_bytes_total_.store(0, std::memory_order_relaxed);
+  rx_arena_total_.store(0, std::memory_order_relaxed);
   for (auto& s : shards_) {
     ::close(s->epfd);
     ::close(s->wake_fd);
@@ -1067,6 +1087,7 @@ void EpollNet::Stop() {
 std::unique_ptr<RankTransport> MakeRankTransport(const std::string& engine) {
   if (engine == "epoll") return std::make_unique<EpollNet>();
   if (engine == "tcp") return std::make_unique<TcpNet>();
+  if (engine == "uring") return MakeUringTransport();
   return nullptr;
 }
 

@@ -70,10 +70,19 @@ Blob Message::Serialize() const {
   return out;
 }
 
-bool Message::DeserializeView(std::shared_ptr<std::vector<char>> slab,
-                              size_t off, size_t len, Message* out) {
-  if (len < sizeof(WireHeader) || off + len > slab->size()) return false;
-  const char* base = slab->data() + off;
+namespace {
+
+// Shared frame parser behind DeserializeView / DeserializeBorrow: the
+// two receive paths differ ONLY in how an aligned payload blob is
+// minted (a Blob::View sharing a vector slab vs a Blob::Borrow over
+// registered arena bytes), so the bounds discipline — the hostile
+// num_blobs cap, per-blob length validation, the 8-aligned view-vs-copy
+// split, and the exact-consumption check — is written once and cannot
+// drift between engines.  `align` is the frame's offset inside its
+// 8-aligned slab (alignment is a slab property, not a frame property).
+template <typename MakeBlob>
+bool ParseWireFrame(const char* base, size_t align, size_t len,
+                    Message* out, MakeBlob&& make_blob) {
   WireHeader h;
   std::memcpy(&h, base, sizeof(h));
   out->AdoptWireHeader(h);
@@ -129,15 +138,36 @@ bool Message::DeserializeView(std::shared_ptr<std::vector<char>> slab,
     // hot path — one large payload right after the 8-aligned header —
     // always qualifies; small trailing blobs behind odd-length keys
     // pay a copy instead.
-    if ((off + pos) % 8 == 0) {
-      out->data.push_back(
-          Blob::View(slab, off + pos, static_cast<size_t>(blen)));
+    if ((align + pos) % 8 == 0) {
+      out->data.push_back(make_blob(pos, static_cast<size_t>(blen)));
     } else {
       out->data.emplace_back(base + pos, static_cast<size_t>(blen));
     }
     pos += static_cast<size_t>(blen);
   }
   return pos == len;
+}
+
+}  // namespace
+
+bool Message::DeserializeView(std::shared_ptr<std::vector<char>> slab,
+                              size_t off, size_t len, Message* out) {
+  if (len < sizeof(WireHeader) || off + len > slab->size()) return false;
+  const char* base = slab->data() + off;
+  return ParseWireFrame(base, off, len, out,
+                        [&](size_t pos, size_t blen) {
+                          return Blob::View(slab, off + pos, blen);
+                        });
+}
+
+bool Message::DeserializeBorrow(const char* frame, size_t align, size_t len,
+                                const std::shared_ptr<void>& keepalive,
+                                Message* out) {
+  if (frame == nullptr || len < sizeof(WireHeader)) return false;
+  return ParseWireFrame(frame, align, len, out,
+                        [&](size_t pos, size_t blen) {
+                          return Blob::Borrow(frame + pos, blen, keepalive);
+                        });
 }
 
 Message Message::Deserialize(const Blob& buf) {

@@ -25,6 +25,7 @@
 #include "mvtpu/repl.h"
 #include "mvtpu/qos.h"
 #include "mvtpu/sketch.h"
+#include "mvtpu/uring_net.h"
 #include "mvtpu/waiter.h"
 #include "mvtpu/watchdog.h"
 
@@ -446,10 +447,29 @@ bool Zoo::Start(int argc, const char* const* argv) {
   // engines behind MakeRankTransport; `mpi` forces the MPI wire (the
   // legacy -net_type=mpi spelling still works and wins).
   std::string engine = configure::GetString("net_engine");
-  if (engine != "tcp" && engine != "epoll" && engine != "mpi") {
-    Log::Error("unknown -net_engine '%s' (expected tcp|epoll|mpi)",
+  if (engine != "tcp" && engine != "epoll" && engine != "mpi" &&
+      engine != "uring") {
+    Log::Error("unknown -net_engine '%s' (expected tcp|epoll|mpi|uring)",
                engine.c_str());
     return false;
+  }
+  engine_requested_ = engine;
+  engine_fallback_ = false;
+  if (engine == "uring") {
+    // Capability probe (docs/transport.md "io_uring data plane"): the
+    // uring engine needs io_uring_setup plus a handful of opcodes.  A
+    // kernel that can't run it degrades to epoll — same message
+    // semantics, just the readiness model — with the reason logged and
+    // the downgrade visible in the health report (`effective_engine`).
+    std::string why;
+    if (!uring::Probe(&why)) {
+      Log::Info("-net_engine=uring unavailable (%s): falling back to "
+                "epoll", why.c_str());
+      ops::BlackboxEvent("lifecycle",
+                         "net_engine fallback uring->epoll: " + why);
+      engine = "epoll";
+      engine_fallback_ = true;
+    }
   }
   if (net_type == "mpi" || engine == "mpi") {
     // Literal MPI wire (reference net/mpi_net.h, SURVEY §2.17): rank and
@@ -569,6 +589,12 @@ bool Zoo::Start(int argc, const char* const* argv) {
   capacity::RegisterGauge("net.writeq_bytes", [this]() -> long long {
     return net_ ? net_->QueuedBytes() : 0;
   });
+  // Receive-side mirror of the write-queue gauge: reassembly slabs on
+  // the epoll engine, registered buffer pools + heap fallback slabs on
+  // the uring engine (transport memory mvplan placement math must see).
+  capacity::RegisterGauge("net.rx_arena_bytes", [this]() -> long long {
+    return net_ ? net_->RxArenaBytes() : 0;
+  });
   // Delivery-audit plane (docs/observability.md "audit plane"): -audit
   // latches the seq stamping + server books; MV_SetAudit toggles live.
   audit::Arm(configure::GetBool("audit"));
@@ -683,6 +709,7 @@ void Zoo::Stop() {
   if (net) net->Stop();
   // Capacity gauges die with the runtime they read (a scrape after
   // Stop must not chase a dead transport).
+  capacity::UnregisterGauge("net.rx_arena_bytes");
   capacity::UnregisterGauge("net.writeq_bytes");
   capacity::UnregisterGauge("host_arena.bytes");
   capacity::ResetHistory();
@@ -1944,6 +1971,14 @@ std::string Zoo::OpsHealthJson() {
   auto fanin = FanIn();
   os << ",\"rank\":" << rank_ << ",\"size\":" << size_;
   os << ",\"engine\":\"" << net_engine() << "\"";
+  // Engine-degradation record: `engine` above is the EFFECTIVE engine;
+  // these say what was asked for and whether Start downgraded (uring
+  // probe failure -> epoll).  mvtop/mvdoctor surface the mismatch.
+  os << ",\"engine_requested\":\""
+     << (engine_requested_.empty() ? net_engine()
+                                   : engine_requested_.c_str())
+     << "\"";
+  os << ",\"engine_fallback\":" << (engine_fallback_ ? "true" : "false");
   os << ",\"workers\":" << num_workers() << ",\"servers\":"
      << num_servers();
   os << ",\"is_server\":" << (server_id() >= 0 ? "true" : "false");
@@ -2301,7 +2336,8 @@ std::string Zoo::OpsCapacityJson() {
        << ",\"deferred\":" << a.deferred << "}";
   }
   os << ",\"net\":{\"engine\":\"" << net_engine()
-     << "\",\"writeq_bytes\":" << (net_ ? net_->QueuedBytes() : 0) << "}";
+     << "\",\"writeq_bytes\":" << (net_ ? net_->QueuedBytes() : 0)
+     << ",\"rx_arena_bytes\":" << (net_ ? net_->RxArenaBytes() : 0) << "}";
   os << ",\"gauges\":" << capacity::GaugesJson();
   os << ",\"tables\":[";
   for (size_t i = 0; i < snapshot.size(); ++i) {

@@ -2336,7 +2336,8 @@ static int CodecWireChild(const char* machine_file, const char* rank) {
   return 0;
 }
 
-static int AggChild(const char* machine_file, const char* rank) {
+static int AggChild(const char* machine_file, const char* rank,
+                    const char* engine) {
   // Worker-side add aggregation (docs/wire_compression.md): async dense
   // adds sum into a local buffer and ship as ONE wire message per flush
   // window; Get, Clock, and Barrier all force the flush, so read and
@@ -2344,11 +2345,13 @@ static int AggChild(const char* machine_file, const char* rank) {
   // (absorbed adds), agg.flush (windows shipped).
   std::string mf = std::string("-machine_file=") + machine_file;
   std::string rk = std::string("-rank=") + rank;
-  const char* argv2[] = {mf.c_str(), rk.c_str(), "-updater_type=default",
+  std::string eng = std::string("-net_engine=") + engine;
+  const char* argv2[] = {mf.c_str(), rk.c_str(), eng.c_str(),
+                         "-updater_type=default",
                          "-log_level=error", "-rpc_timeout_ms=60000",
                          "-barrier_timeout_ms=60000",
                          "-add_agg_bytes=16777216"};
-  CHECK(MV_Init(7, argv2) == 0);
+  CHECK(MV_Init(8, argv2) == 0);
   int me = MV_WorkerId();
   int32_t h;
   CHECK(MV_NewArrayTable(16, &h) == 0);
@@ -3342,8 +3345,9 @@ int main(int argc, char** argv) {
   if ((argc == 4 || argc == 5) && std::string(argv[1]) == "bridge_child")
     return ScenarioExit(BridgeChild(argv[2], argv[3],
                                     argc == 5 ? argv[4] : "epoll"));
-  if (argc == 4 && std::string(argv[1]) == "agg_child")
-    return ScenarioExit(AggChild(argv[2], argv[3]));
+  if ((argc == 4 || argc == 5) && std::string(argv[1]) == "agg_child")
+    return ScenarioExit(AggChild(argv[2], argv[3],
+                                 argc == 5 ? argv[4] : "epoll"));
   if (argc == 4 && std::string(argv[1]) == "agg_bench")
     return ScenarioExit(AggBenchChild(argv[2], argv[3]));
   if ((argc == 4 || argc == 5) && std::string(argv[1]) == "chaos_retry")

@@ -32,7 +32,13 @@ def main() -> int:
                                  "-log_level=error",
                                  "-rpc_timeout_ms=30000",
                                  "-barrier_timeout_ms=60000", *extra])
-    assert rt.net_engine() == "epoll", rt.net_engine()
+    # Engine-aware: an explicit -net_engine in the extra flags (the
+    # uring suite passes one) must have taken effect; default is epoll.
+    want = "epoll"
+    for flag in extra:
+        if flag.startswith("-net_engine="):
+            want = flag.split("=", 1)[1]
+    assert rt.net_engine() == want, rt.net_engine()
     h = rt.new_array_table(SIZE)
     assert h == 0, h
     rt.barrier()

@@ -365,6 +365,47 @@ def test_gate_passes_in_band_health_line(tmp_path):
     assert rc == 0, out
 
 
+def test_gate_guards_uring_keys(tmp_path):
+    """io_uring engine bars (docs/transport.md): the uring RTT drifting
+    into the Nagle-pathology regime, the 64 KiB put-burst rate
+    collapsing under the committed floor, or the uring serve tier's
+    probe p99 blowing past the herd band must all fail the gate."""
+    line = {"extras": {"wire_uring_rtt_ms": 40.0,            # Nagle regime
+                       "wire_uring_bytes_per_s": 5.0e7,      # < 0.1 GB/s floor
+                       "fanin_uring_p99_ms": 90.0}}          # herd p99 blown
+    p = tmp_path / "uring_regressed.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 1, out
+    assert "wire_uring_rtt_ms" in out and "FAIL" in out, out
+    assert "wire_uring_bytes_per_s" in out, out
+    assert "fanin_uring_p99_ms" in out, out
+
+
+def test_gate_passes_in_band_uring_line(tmp_path):
+    line = {"extras": {"wire_uring_rtt_ms": 0.2,
+                       "wire_uring_bytes_per_s": 1.1e9,
+                       "fanin_uring_p99_ms": 2.0}}
+    p = tmp_path / "uring_ok.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+
+
+def test_gate_skips_absent_uring_keys(tmp_path):
+    """Hosts whose kernel fails the capability probe emit NO uring keys
+    (bench.py gates the whole arm on MV_UringSupported) — the default
+    gate must SKIP them, not fail, so non-uring CI stays green."""
+    line = {"extras": {"fanin_accepted": 1000.0,
+                       "wire_tcp_rtt_ms": 0.4}}
+    p = tmp_path / "no_uring.json"
+    p.write_text(json.dumps(line) + "\n")
+    rc, out = _gate("--line", str(p))
+    assert rc == 0, out
+    assert "wire_uring" not in [l.split()[1] for l in out.splitlines()
+                                if l.startswith("FAIL")], out
+
+
 def test_last_parseable_line_wins(tmp_path):
     """Schema-7 cumulative emission: the LAST line is the freshest
     cumulative state and must shadow earlier partials."""

@@ -20,6 +20,18 @@ pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
                                 reason="no C++ toolchain")
 
 
+def _uring_ok():
+    """Probe once whether the host kernel supports the io_uring engine
+    (MV_UringSupported walks IORING_REGISTER_PROBE); sweeps append their
+    uring arms only when it does, so old kernels skip — not fail."""
+    try:
+        from multiverso_tpu import native as nat
+        nat.ensure_built()
+        return bool(nat.load().MV_UringSupported())
+    except Exception:
+        return False
+
+
 @pytest.fixture(scope="module")
 def native():
     from multiverso_tpu import native as nat
@@ -305,7 +317,7 @@ def test_native_ssp_bounded_staleness(native, tmp_path, staleness):
         assert f"SSP_OK {r}" in out, out[-2000:]
 
 
-@pytest.mark.parametrize("engine", ["tcp", "epoll"])
+@pytest.mark.parametrize("engine", ["tcp", "epoll", "uring"])
 def test_native_wire_bench_scenario(native, tmp_path, engine):
     """The direct transport microbench (bench.py wire_{tcp,epoll}_*
     keys) must produce a full 4-size sweep of positive rates from a
@@ -316,6 +328,8 @@ def test_native_wire_bench_scenario(native, tmp_path, engine):
     `wire_rtt_ms ≈ 98` pathology), so a silent loss of the socket
     option cannot pass this sweep.  20 ms leaves room for a loaded CI
     host; the pathology is an order of magnitude above it."""
+    if engine == "uring" and not _uring_ok():
+        pytest.skip("kernel lacks io_uring op support")
     mf = _machine_file(tmp_path, 2)
     b = _binary()
     outs, procs = _run_ranks(b, "wire_bench", mf, 2, extra=(engine,))
@@ -366,19 +380,28 @@ def test_native_tsan_scenarios(native, tmp_path):
     assert out.returncode == 0 and "ThreadSanitizer" not in report, \
         report[-4000:]
 
-    for scenario, nprocs, extra in [("net_child", 2, ()),
-                                    ("backup_child", 3, ("0.34",)),
-                                    ("ssp_tput", 2, ("3",)),
-                                    ("async_overlap", 2, ()),
-                                    # Borrowed arena sends under
-                                    # drop/dup/delay (host_bridge.md).
-                                    ("bridge_child", 2, ("epoll",)),
-                                    ("embed_child", 2, ("epoll",)),
-                                    # Replication forward + promotion
-                                    # race (docs/replication.md): the
-                                    # new hot surface — rank 1 dies
-                                    # mid-fleet, rank 2 promotes.
-                                    ("failover_child", 3, ("epoll",))]:
+    scenarios = [("net_child", 2, ()),
+                 ("backup_child", 3, ("0.34",)),
+                 ("ssp_tput", 2, ("3",)),
+                 ("async_overlap", 2, ()),
+                 # Borrowed arena sends under
+                 # drop/dup/delay (host_bridge.md).
+                 ("bridge_child", 2, ("epoll",)),
+                 ("embed_child", 2, ("epoll",)),
+                 # Replication forward + promotion
+                 # race (docs/replication.md): the
+                 # new hot surface — rank 1 dies
+                 # mid-fleet, rank 2 promotes.
+                 ("failover_child", 3, ("epoll",))]
+    if _uring_ok():
+        # The io_uring reactor's hottest races: CQE drain vs writer
+        # threads (net_child), injected-fault retries over zero-copy
+        # sends (chaos_retry), and a SIGKILLed rank's in-flight SQEs
+        # during promotion (failover_child).
+        scenarios += [("net_child", 2, ("uring",)),
+                      ("chaos_retry", 2, ("uring",)),
+                      ("failover_child", 3, ("uring",))]
+    for scenario, nprocs, extra in scenarios:
         mf = _machine_file(tmp_path, nprocs)  # rewritten per scenario
         procs = [subprocess.Popen([tsan_bin, scenario, mf, str(r), *extra],
                                   stdout=subprocess.PIPE,
@@ -421,20 +444,29 @@ def test_native_asan_scenarios(native, tmp_path):
     assert out.returncode == 0 and "AddressSanitizer" not in report \
         and "runtime error" not in report, report[-4000:]
 
-    for scenario, nprocs, extra in [("net_child", 2, ()),
-                                    ("backup_child", 3, ("0.34",)),
-                                    ("ssp_child", 2, ("1",)),
-                                    ("async_overlap", 2, ()),
-                                    # Borrowed arena sends under
-                                    # drop/dup/delay: the use-after-
-                                    # recycle class lives here.
-                                    ("bridge_child", 2, ("epoll",)),
-                                    ("embed_child", 2, ("epoll",)),
-                                    # Replication forward + promotion
-                                    # race: a SIGKILLed rank's frames
-                                    # die mid-wire while its backup
-                                    # installs as serving.
-                                    ("failover_child", 3, ("epoll",))]:
+    scenarios = [("net_child", 2, ()),
+                 ("backup_child", 3, ("0.34",)),
+                 ("ssp_child", 2, ("1",)),
+                 ("async_overlap", 2, ()),
+                 # Borrowed arena sends under
+                 # drop/dup/delay: the use-after-
+                 # recycle class lives here.
+                 ("bridge_child", 2, ("epoll",)),
+                 ("embed_child", 2, ("epoll",)),
+                 # Replication forward + promotion
+                 # race: a SIGKILLed rank's frames
+                 # die mid-wire while its backup
+                 # installs as serving.
+                 ("failover_child", 3, ("epoll",))]
+    if _uring_ok():
+        # The heap-lifetime half for uring: registered-slab borrows
+        # outliving a retiring conn (net_child), zero-copy notif CQEs
+        # landing after retry resubmission (chaos_retry), and mid-wire
+        # frame death on a killed rank's ring (failover_child).
+        scenarios += [("net_child", 2, ("uring",)),
+                      ("chaos_retry", 2, ("uring",)),
+                      ("failover_child", 3, ("uring",))]
+    for scenario, nprocs, extra in scenarios:
         mf = _machine_file(tmp_path, nprocs)  # rewritten per scenario
         procs = [subprocess.Popen([asan_bin, scenario, mf, str(r), *extra],
                                   stdout=subprocess.PIPE,

@@ -313,6 +313,76 @@ def test_mv009_repo_reactor_sources_are_marked():
     assert mvlint.lint_file(p) == []
 
 
+def test_mv019_fires_on_unbounded_cqe_drain(tmp_path):
+    """An unbounded `while (true)` loop that consumes completion-queue
+    entries fires; a batch-capped drain and an unbounded loop that never
+    touches the CQ (an EINTR-retry around a syscall) stay quiet."""
+    rules = _lint_src(tmp_path, """\
+        void Drain(Ring* r) {
+          while (true) {
+            io_uring_cqe* cqe = Peek(r);       // BAD: no batch bound
+            if (!cqe) break;
+            Handle(cqe);
+          }
+        }
+        """, name="drain.cc")
+    assert [r for r, _ in rules] == ["MV019"], rules
+    assert _lint_src(tmp_path, """\
+        void Drain(Ring* r) {
+          constexpr unsigned kCqeBatch = 256;
+          for (unsigned n = 0; n < kCqeBatch; ++n) {
+            io_uring_cqe* cqe = Peek(r);
+            if (!cqe) break;
+            Handle(cqe);
+          }
+        }
+        void Retry(int fd) {
+          while (true) {
+            if (::syscall(fd) >= 0) break;     // no CQE in sight: fine
+            if (errno != EINTR) break;
+          }
+        }
+        """, name="bounded.cc") == []
+
+
+def test_mv019_for_semicolon_loop_and_cq_head_fire(tmp_path):
+    """`for (;;)` counts as unbounded, and head/tail pointer access is
+    CQE consumption even without a variable literally named cqe."""
+    rules = _lint_src(tmp_path, """\
+        void Drain(Ring* r) {
+          for (;;) {
+            unsigned head = *r->cq_head;
+            if (head == *r->cq_tail) break;
+            Handle(r, head);
+          }
+        }
+        """, name="forever.cc")
+    assert [r for r, _ in rules] == ["MV019"], rules
+
+
+def test_mv019_suppression_names_the_reason(tmp_path):
+    rules = _lint_src(tmp_path, """\
+        void Drain(Ring* r) {
+          while (true) {  // mvlint: MV019-exempt(bounded by ring size)
+            io_uring_cqe* cqe = Peek(r);
+            if (!cqe) break;
+            Handle(cqe);
+          }
+        }
+        """, name="exempt.cc")
+    assert rules == [], rules
+
+
+def test_mv019_uring_source_is_marked_and_clean():
+    """The io_uring engine source carries the reactor marker (MV009
+    polices its socket calls) and is MV019-clean — its CQE drain is the
+    batch-bounded shape the rule demands."""
+    p = os.path.join(NATIVE_DIR, "src", "uring_net.cc")
+    with open(p) as fh:
+        assert mvlint.REACTOR_MARKER in fh.read()
+    assert mvlint.lint_file(p) == []
+
+
 def test_mv010_fires_on_registry_bypass(tmp_path):
     """Library code minting metric series outside the unified registry
     (direct Counter/Gauge/Histogram construction) fires; the registry

@@ -1,0 +1,386 @@
+"""io_uring transport tests (docs/transport.md "io_uring data plane").
+
+The completion engine (`-net_engine=uring`) must be a drop-in twin of
+the epoll reactor: same anonymous serve protocol, same frame caps, same
+admission gates — only the readiness model changed (registered-buffer
+zero-copy receive, SQE-submitted sends, multishot accept).  This suite
+re-runs the epoll suite's hostile-wire scenarios against a uring fleet:
+
+- partial-frame reassembly (1-byte dribble across RECV completions);
+- mid-frame peer disconnect (the partial dies, the server stays up);
+- hostile frame lengths (dropped at the prefix, no allocation);
+- write-queue backpressure against a slow reader (completion-driven
+  drain, no deadlock, no lost replies);
+- per-client admission shed (reactor-answered ReplyBusy);
+- a 1k-connection fan-in smoke (`-m slow`) — far above `-uring_depth`,
+  proving the SQ is a submission window, not a connection cap;
+- the capability-probe seam: the whole module skips on kernels that
+  cannot run io_uring, and the forced-probe-failure regression proves
+  the uring->epoll fallback end to end (effective engine, health
+  fields, service still up).
+
+Helpers (fleet holder, machine files, frame codec) are shared with
+tests/test_epoll_net.py — the suites must stay structurally identical
+so an engine-semantics drift shows up as a diff here.
+"""
+
+import os
+import shutil
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from multiverso_tpu.serve.wire import (AnonServeClient, FrameDecoder,  # noqa: E402
+                                       MSG, pack_frame, unpack_frame)
+
+from tests.test_epoll_net import (Fleet, _assert_clean_exit,  # noqa: E402
+                                  _binary, _machine_file)
+
+
+def _uring_supported() -> bool:
+    if shutil.which("g++") is None:
+        return False
+    from multiverso_tpu import native as nat
+
+    return bool(nat.load().MV_UringSupported())
+
+
+pytestmark = pytest.mark.skipif(
+    not _uring_supported(),
+    reason="kernel cannot run the io_uring engine (MV_UringSupported=0)")
+
+URING = ("-net_engine=uring",)
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    f = Fleet(tmp_path, extra=URING)
+    try:
+        yield f
+    finally:
+        f.kill()
+
+
+# ------------------------------------------------------- anonymous protocol
+
+def test_anonymous_client_on_uring(fleet):
+    """A raw-socket client probes the version and pulls a shard over the
+    completion engine; the fan-in stats count it like epoll would."""
+    with AnonServeClient(fleet.endpoints[0]) as c:
+        assert c.table_version(0) == 1
+        shard = c.get_shard(0)
+        assert shard.shape == (32,)
+        np.testing.assert_allclose(shard, 1.0)
+        for _ in range(5):
+            assert c.table_version(0) == 1
+    outs = fleet.release()
+    _assert_clean_exit(outs, fleet.procs)
+    assert "FANIN accepted=1" in outs[0], outs[0]
+
+
+def test_partial_frame_dribble_on_uring(fleet):
+    """One byte per send: the engine reassembles the frame across RECV
+    completions (length prefix and body each arrive in shards)."""
+    host, port = fleet.endpoints[0].rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=30)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    frame = pack_frame(MSG["RequestGet"], 0, 7)
+    for i in range(len(frame)):
+        s.sendall(frame[i:i + 1])
+        if i < 16:
+            time.sleep(0.002)
+    dec = FrameDecoder()
+    reply = None
+    while reply is None:
+        chunk = s.recv(65536)
+        assert chunk, "server closed on a dribbled frame"
+        dec.feed(chunk)
+        body = dec.next_frame()
+        if body is not None:
+            reply = unpack_frame(body)
+    assert reply["type_name"] == "ReplyGet" and reply["msg_id"] == 7
+    np.testing.assert_allclose(
+        np.frombuffer(reply["blobs"][0], np.float32), 1.0)
+    s.close()
+    _assert_clean_exit(fleet.release(), fleet.procs)
+
+
+def test_midframe_disconnect_on_uring(fleet):
+    """A client dying mid-frame discards the partial (the in-flight
+    recv completes with reset/EOF); the NEXT client gets full service."""
+    host, port = fleet.endpoints[0].rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=30)
+    frame = pack_frame(MSG["RequestGet"], 0, 9)
+    s.sendall(frame[:len(frame) // 2])
+    time.sleep(0.05)
+    s.close()
+    with AnonServeClient(fleet.endpoints[0]) as c:
+        np.testing.assert_allclose(c.get_shard(0), 1.0)
+    _assert_clean_exit(fleet.release(), fleet.procs)
+
+
+def test_hostile_frame_length_on_uring(fleet):
+    """An anonymous connection claiming a larger-than-allowed frame is
+    dropped at the length prefix — no slab, no READ_FIXED, no parse."""
+    host, port = fleet.endpoints[0].rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=30)
+    s.sendall(struct.pack("<q", 1 << 40))
+    s.settimeout(10)
+    assert s.recv(16) == b""
+    s.close()
+    with AnonServeClient(fleet.endpoints[0]) as c:
+        assert c.table_version(0) == 1
+    _assert_clean_exit(fleet.release(), fleet.procs)
+
+
+def test_write_backpressure_slow_reader_on_uring(tmp_path):
+    """A slow reader fills the bounded write queue; the engine holds
+    frames (send completions pace resubmission) and every reply arrives
+    once the reader catches up — no deadlock, no loss."""
+    f = Fleet(tmp_path, extra=URING + ("-net_writeq_bytes=4096",))
+    try:
+        host, port = f.endpoints[0].rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=60)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        k = 24
+        for i in range(k):
+            s.sendall(pack_frame(MSG["RequestGet"], 0, 100 + i))
+        time.sleep(1.0)
+        dec = FrameDecoder()
+        got = []
+        s.settimeout(60)
+        while len(got) < k:
+            chunk = s.recv(4096)
+            assert chunk, f"connection died after {len(got)}/{k} replies"
+            dec.feed(chunk)
+            while True:
+                body = dec.next_frame()
+                if body is None:
+                    break
+                got.append(unpack_frame(body))
+            time.sleep(0.01)
+        assert [g["msg_id"] for g in got] == list(range(100, 100 + k))
+        for g in got:
+            assert g["type_name"] == "ReplyGet"
+        s.close()
+        _assert_clean_exit(f.release(), f.procs)
+    finally:
+        f.kill()
+
+
+def test_per_client_admission_sheds_busy_on_uring(tmp_path):
+    """`-client_inflight_max=1`: the uring reactor answers the excess
+    of a back-to-back burst with ReplyBusy, without touching actors."""
+    f = Fleet(tmp_path, extra=URING + ("-client_inflight_max=1",))
+    try:
+        host, port = f.endpoints[0].rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=60)
+        k = 8
+        burst = b"".join(pack_frame(MSG["RequestGet"], 0, 200 + i)
+                         for i in range(k))
+        s.sendall(burst)
+        dec = FrameDecoder()
+        replies = []
+        s.settimeout(60)
+        while len(replies) < k:
+            chunk = s.recv(65536)
+            assert chunk
+            dec.feed(chunk)
+            while True:
+                body = dec.next_frame()
+                if body is None:
+                    break
+                replies.append(unpack_frame(body))
+        kinds = {r["type_name"] for r in replies}
+        assert "ReplyBusy" in kinds, kinds
+        assert "ReplyGet" in kinds, kinds
+        s.close()
+        outs = f.release()
+        _assert_clean_exit(outs, f.procs)
+        assert "shed=0" not in outs[0].split("FANIN", 1)[1].split()[-1], \
+            outs[0]
+    finally:
+        f.kill()
+
+
+# ----------------------------------------------------- probe + fallback seam
+
+def test_forced_probe_failure_falls_back_to_epoll(tmp_path):
+    """MVTPU_URING_FORCE_UNSUPPORTED=1 + `-net_engine=uring`: the fleet
+    comes up ON EPOLL (logged fallback), serves anonymous clients, and
+    the health report records requested vs effective engine."""
+    from multiverso_tpu import native as nat
+
+    nat.ensure_built()
+    mf, eps = _machine_file(tmp_path, 2)
+    code = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from multiverso_tpu import native as nat\n"
+        f"rt = nat.NativeRuntime(args=['-machine_file={mf}', "
+        "'-rank=' + sys.argv[1], '-log_level=error', "
+        "'-net_engine=uring', '-barrier_timeout_ms=60000'])\n"
+        "assert rt.net_engine() == 'epoll', rt.net_engine()\n"
+        "h = json.loads(rt.ops_report('health'))\n"
+        "assert h['engine'] == 'epoll', h\n"
+        "assert h['engine_requested'] == 'uring', h\n"
+        "assert h['engine_fallback'] is True, h\n"
+        "t = rt.new_array_table(64)\n"
+        "rt.barrier()\n"
+        "print('READY', flush=True)\n"
+        "sys.stdin.readline()\n"
+        "rt.barrier(); rt.shutdown(); print('FALLBACK_OK', flush=True)\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["MVTPU_URING_FORCE_UNSUPPORTED"] = "1"
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(r)],
+                              stdin=subprocess.PIPE,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for r in range(2)]
+    try:
+        for p in procs:
+            assert "READY" in p.stdout.readline()
+        # The fallback fleet is a REAL epoll fleet: anonymous service up.
+        with AnonServeClient(eps[0]) as c:
+            assert c.table_version(0) >= 0
+        for p in procs:
+            p.stdin.write("done\n")
+            p.stdin.flush()
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0 and "FALLBACK_OK" in out, out[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_probe_env_override_visible_from_python():
+    """The probe itself honors the forced-unsupported env hook (what
+    this module's skipif and CI gates rely on)."""
+    from multiverso_tpu import native as nat
+
+    lib = nat.load()
+    assert lib.MV_UringSupported() == 1
+    os.environ["MVTPU_URING_FORCE_UNSUPPORTED"] = "1"
+    try:
+        assert lib.MV_UringSupported() == 0
+    finally:
+        del os.environ["MVTPU_URING_FORCE_UNSUPPORTED"]
+    assert lib.MV_UringSupported() == 1
+
+
+# --------------------------------------------------------- native scenarios
+
+def test_net_child_scenario_on_uring(tmp_path):
+    """The full sharded-table scenario (adds, barriers, SSP cache, KV)
+    on the completion engine — `-net_engine` switches the readiness
+    model without changing semantics."""
+    mf, _ = _machine_file(tmp_path, 2)
+    b = _binary()
+    procs = [subprocess.Popen([b, "net_child", mf, str(r), "uring"],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} (uring):\n{out[-3000:]}"
+        assert f"NET_CHILD_OK {r}" in out
+
+
+def test_chaos_retry_on_uring_engine(tmp_path):
+    """The PR 2 fault seam on the completion path: injected send
+    failures consume retry attempts, the payload still lands."""
+    mf, _ = _machine_file(tmp_path, 2)
+    b = _binary()
+    procs = [subprocess.Popen([b, "chaos_retry", mf, str(r), "uring"],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out[-3000:]}"
+        assert f"CHAOS_RETRY_OK {r}" in out
+
+
+# ------------------------------------------------------------- 1k fan-in
+
+@pytest.mark.slow
+def test_1k_connection_smoke_on_uring(tmp_path):
+    """1000 concurrent anonymous sockets against one uring server rank
+    — ~60x the default `-uring_depth`: the SQ is a submission window
+    the engine flushes through, not a cap on concurrent connections.
+    Every probe is answered and the fan-in counter records them all."""
+    import resource
+    import selectors
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if hard < 2200:
+        pytest.skip(f"fd hard limit {hard} too low for 1k sockets")
+    resource.setrlimit(resource.RLIMIT_NOFILE,
+                       (min(hard, 16384), hard))
+
+    f = Fleet(tmp_path, extra=URING + ("-net_arena_bytes=8192",))
+    try:
+        host, port = f.endpoints[0].rsplit(":", 1)
+        n = 1000
+        sel = selectors.DefaultSelector()
+        socks = []
+        for i in range(n):
+            s = socket.socket()
+            s.connect((host, int(port)))
+            s.setblocking(False)
+            sel.register(s, selectors.EVENT_READ,
+                         {"dec": FrameDecoder(), "id": i})
+            socks.append(s)
+            s.send(pack_frame(MSG["RequestVersion"], 0, i))
+        answered = set()
+        deadline = time.time() + 120
+        while len(answered) < n and time.time() < deadline:
+            for key, _ in sel.select(timeout=1.0):
+                data = key.data
+                try:
+                    chunk = key.fileobj.recv(65536)
+                except BlockingIOError:
+                    continue
+                assert chunk, f"conn {data['id']} closed unanswered"
+                data["dec"].feed(chunk)
+                body = data["dec"].next_frame()
+                if body is not None:
+                    reply = unpack_frame(body)
+                    assert reply["type_name"] in ("ReplyVersion",
+                                                  "ReplyBusy")
+                    answered.add(data["id"])
+        assert len(answered) == n, f"only {len(answered)}/{n} answered"
+        for s in socks:
+            sel.unregister(s)
+            s.close()
+        outs = f.release()
+        _assert_clean_exit(outs, f.procs)
+        assert f"FANIN accepted={n}" in outs[0], outs[0][-500:]
+    finally:
+        f.kill()

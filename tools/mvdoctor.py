@@ -49,7 +49,7 @@ from multiverso_tpu.latency import dominant_stage, stage_summary  # noqa: E402
 from multiverso_tpu.ops.audit import audit_rows  # noqa: E402
 from multiverso_tpu.ops.introspect import OpsClient  # noqa: E402
 
-PLANES = ("alerts", "latency", "audit", "capacity", "hotkeys")
+PLANES = ("alerts", "latency", "audit", "capacity", "hotkeys", "health")
 
 _SEV_RANK = {"critical": 0, "warning": 1, "info": 2}
 
@@ -153,6 +153,18 @@ def diagnose(planes: dict) -> list:
             "delivery audit gap — acked adds never applied",
             [f"stream: {s}" for s in streams],
             score=len(streams) + 100.0)
+
+    # -- health plane: an engine downgrade deserves a line even when
+    # nothing is on fire — the rank asked for uring and silently lost
+    # its zero-copy data plane at startup.
+    for rank, h in sorted((_per_rank(planes.get("health") or {})).items()):
+        if isinstance(h, dict) and h.get("engine_fallback"):
+            add("info", rank,
+                "net engine degraded at startup",
+                [f"requested '{h.get('engine_requested', '?')}', running "
+                 f"'{h.get('engine', '?')}' — the probe reason is in the "
+                 "startup log / lifecycle blackbox stream"],
+                score=1.0)
 
     # -- alert plane: every firing rule surfaces; correlations enrich.
     for a in alert_rows:

@@ -250,6 +250,7 @@ RULES = {
     "MV016": "serve-read-without-deadline",
     "MV017": "stale-shard-route",
     "MV018": "untracked-growth",
+    "MV019": "unbounded-cqe-drain",
 }
 
 
@@ -1309,6 +1310,49 @@ def lint_reactor_file(path, src):
     return out
 
 
+# ---------------------------------------------------------------- MV019
+# Bounded completion-queue drains (the io_uring engine's loop
+# discipline, docs/transport.md): a `while (true)` / `for (;;)` loop
+# that consumes CQEs has no iteration bound, so a peer able to keep the
+# completion queue non-empty (multishot ops, a blast of tiny frames)
+# starves everything the loop only checks BETWEEN drains — the running_
+# flag, watchdog bumps, handoff adoption.  Drains must cap the batch
+# (leftover CQEs satisfy the next cycle's wait immediately, so a cap
+# costs nothing).
+_UNBOUNDED_LOOP = re.compile(
+    r"while\s*\(\s*(?:true|1)\s*\)|for\s*\(\s*;\s*;\s*\)")
+_CQE_TOUCH = re.compile(r"\bcqes?\b|\bcq_head\b|\bcq_tail\b")
+# A drain loop's CQE access sits within its first lines; judging only
+# this window keeps an EINTR-retry `while (true)` around a syscall from
+# false-positiving on a drain that merely follows it.
+_CQE_LOOKAHEAD = 12
+
+
+def lint_cqe_drain_file(path, src):
+    """MV019 over a native source: unbounded CQE-consuming loops."""
+    out = []
+    lines = src.splitlines()
+    for i, line in enumerate(lines):
+        code = line.split("//", 1)[0]
+        if not _UNBOUNDED_LOOP.search(code):
+            continue
+        body = "\n".join(
+            l.split("//", 1)[0]
+            for l in lines[i:min(i + _CQE_LOOKAHEAD, len(lines))])
+        if not _CQE_TOUCH.search(body):
+            continue
+        out.append(Finding(
+            path, i + 1, "MV019",
+            "unbounded loop consumes completion-queue entries — a peer "
+            "that keeps the CQ non-empty starves every check the loop "
+            "makes between drains (running_, watchdog, handoffs); cap "
+            "the batch (`n < kCqeBatch`-style bound; leftovers satisfy "
+            "the next wait immediately) or suppress with "
+            "`mvlint: MV019-exempt(reason)` if the bound lives "
+            "elsewhere"))
+    return out
+
+
 def lint_native_file(path):
     try:
         with open(path, "r", encoding="utf-8") as fh:
@@ -1322,8 +1366,10 @@ def lint_native_file(path):
     if REACTOR_MARKER in src:
         findings += lint_reactor_file(path, src)
     # MV018 runs over every native source: server/worker state is
-    # wherever a growth-named member lives.
+    # wherever a growth-named member lives.  MV019 likewise — a CQE
+    # drain is a CQE drain wherever it appears.
     findings += check_native_untracked_growth(path, src)
+    findings += lint_cqe_drain_file(path, src)
     lines = src.splitlines()
     return [f for f in findings if not _suppressed(f, lines)]
 

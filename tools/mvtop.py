@@ -237,7 +237,12 @@ def _row_from_health(rank: str, h: dict, tables: list) -> dict:
         "rank": rank,
         "up": "yes",
         "healthy": "yes" if h.get("healthy") else "NO",
-        "engine": h.get("engine", "?"),
+        # Effective engine; a "uring!epoll"-style cell flags a rank
+        # whose requested engine was degraded at startup (the health
+        # report's engine_fallback field).
+        "engine": ("%s!%s" % (h.get("engine_requested", "?"),
+                              h.get("engine", "?"))
+                   if h.get("engine_fallback") else h.get("engine", "?")),
         "queue": h.get("serve_queue_depth", 0),
         "max": h.get("server_inflight_max", 0),
         "clients": h.get("clients", 0),
